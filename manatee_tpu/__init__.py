@@ -1,0 +1,32 @@
+"""manatee_tpu — a clean-room rebuild of the capabilities of
+TritonDataCenter/manatee: an automated fault-monitoring, leader-election and
+failover control plane for replicated PostgreSQL.
+
+The reference (/root/reference) is Node.js + ZooKeeper + ZFS.  This rebuild is
+Python 3 / asyncio with pluggable backends:
+
+- storage:  zfs(8) in production, a directory/hardlink backend for dev images
+  without ZFS (``manatee_tpu.storage``);
+- coordination: an in-repo coordination service speaking a znode-like data
+  model (sessions, ephemeral-sequential nodes, one-shot watches, versioned
+  CAS writes, transactions), with an in-memory backend for unit tests
+  (``manatee_tpu.coord``);
+- database engine: real ``postgres``/``initdb`` binaries when present, and a
+  faithful simulated postgres child process for single-host integration
+  testing (``manatee_tpu.pg``).
+
+Layer map (mirrors SURVEY.md §1):
+
+    cli / adm            manatee_tpu.cli, manatee_tpu.adm
+    daemons              manatee_tpu.daemons.{sitter,backupserver,snapshotter}
+    shard orchestration  manatee_tpu.shard
+    state machine        manatee_tpu.state.machine   (first-class here; the
+                         reference outsources it to the manatee-state-machine
+                         git dependency, package.json:31)
+    consensus            manatee_tpu.coord.manager   (lib/zookeeperMgr.js)
+    database mgmt        manatee_tpu.pg.manager      (lib/postgresMgr.js)
+    data plane           manatee_tpu.storage, manatee_tpu.backup
+    utilities            manatee_tpu.utils
+"""
+
+__version__ = "0.1.0"

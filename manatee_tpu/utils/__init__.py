@@ -1,0 +1,14 @@
+"""Utility layer (reference: lib/common.js, lib/confParser.js)."""
+
+from manatee_tpu.utils.executil import ExecError, ExecResult, run, run_sync
+from manatee_tpu.utils.pgversion import pg_strip_minor
+from manatee_tpu.utils.confparser import ConfFile
+
+__all__ = [
+    "ExecError",
+    "ExecResult",
+    "run",
+    "run_sync",
+    "pg_strip_minor",
+    "ConfFile",
+]

@@ -1,0 +1,101 @@
+"""postgresql.conf-style ``key = value`` file read/modify/write.
+
+Reference parity: lib/confParser.js:31-57 (read/set/write via iniparser).
+Note the reference's conf generation always starts from the *shipped
+template* and rewrites keys programmatically, so unknown keys in the live
+file are dropped (lib/postgresMgr.js:2277-2286); callers here follow the
+same pattern by loading the template and applying overrides.
+
+Supported syntax: ``key = value``, ``key value`` (postgres accepts both),
+``#`` comments, single-quoted values with '' escaping.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+_LINE_RE = re.compile(r"^\s*([A-Za-z_][A-Za-z0-9_.]*)\s*(?:=\s*|\s+)(.*?)\s*$")
+
+
+def _strip_comment(line: str) -> str:
+    """Remove a trailing # comment, honoring single-quoted strings."""
+    out = []
+    in_quote = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == "'":
+            in_quote = not in_quote
+        elif c == "#" and not in_quote:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def quote_conf_value(value: str) -> str:
+    """Single-quote a value for postgresql.conf, escaping embedded quotes.
+
+    Mirrors the synchronous_standby_names quoting the reference needs for
+    PG >= 9.6 (lib/postgresMgr.js:184-191)."""
+    return "'" + value.replace("'", "''") + "'"
+
+
+class ConfFile:
+    """An ordered key→value view of a postgresql.conf-style file."""
+
+    def __init__(self, entries: dict[str, str] | None = None):
+        self._entries: dict[str, str] = dict(entries or {})
+
+    @classmethod
+    def from_text(cls, text: str) -> "ConfFile":
+        entries: dict[str, str] = {}
+        for raw in text.splitlines():
+            line = _strip_comment(raw).strip()
+            if not line:
+                continue
+            m = _LINE_RE.match(line)
+            if not m:
+                continue
+            key, val = m.group(1), m.group(2).strip()
+            entries[key] = val
+        return cls(entries)
+
+    @classmethod
+    def read(cls, path: str | Path) -> "ConfFile":
+        return cls.from_text(Path(path).read_text())
+
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self._entries.get(key, default)
+
+    def get_unquoted(self, key: str, default: str | None = None) -> str | None:
+        v = self._entries.get(key)
+        if v is None:
+            return default
+        if len(v) >= 2 and v[0] == "'" and v[-1] == "'":
+            return v[1:-1].replace("''", "'")
+        return v
+
+    def set(self, key: str, value: str) -> None:
+        self._entries[key] = value
+
+    def delete(self, key: str) -> None:
+        self._entries.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def items(self):
+        return self._entries.items()
+
+    def to_text(self) -> str:
+        return "".join("%s = %s\n" % (k, v) for k, v in self._entries.items())
+
+    def write(self, path: str | Path) -> None:
+        """Atomic replace (write temp + rename), the safe analogue of
+        lib/common.js:22-60 replacefile semantics."""
+        path = Path(path)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(self.to_text())
+        tmp.replace(path)

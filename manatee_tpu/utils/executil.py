@@ -1,0 +1,195 @@
+"""Child-process exec wrappers with run ids and duration accounting.
+
+Reference parity: lib/common.js:148-172 (zfsExecCommon) runs every zfs
+command with an empty environment, a 2 MB output buffer, a per-invocation
+run id and duration_ms logging; lib/snapShotter.js:569-611 (_execZfs) layers
+the same tracing for snapshot operations.  This module provides the same
+contract for any command, both async (asyncio) and sync.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import shlex
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("manatee.exec")
+
+# lib/common.js:151 uses a 2 MB maxBuffer for zfs output.
+MAX_OUTPUT_BYTES = 2 * 1024 * 1024
+
+_run_ids = itertools.count(1)
+
+
+@dataclass
+class ExecResult:
+    argv: list[str]
+    returncode: int
+    stdout: str
+    stderr: str
+    duration_ms: float
+    run_id: int
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class ExecError(Exception):
+    """Command exited non-zero (or was killed by a signal)."""
+
+    def __init__(self, result: ExecResult):
+        self.result = result
+        super().__init__(
+            "command failed (rc=%d): %s: %s"
+            % (result.returncode, shlex.join(result.argv), result.stderr.strip())
+        )
+
+
+def _log_result(res: ExecResult) -> None:
+    log.debug(
+        "exec done",
+        extra={
+            "run_id": res.run_id,
+            "argv": res.argv,
+            "rc": res.returncode,
+            "duration_ms": round(res.duration_ms, 3),
+        },
+    )
+
+
+class OutputLimitExceeded(Exception):
+    pass
+
+
+async def _read_capped(stream: asyncio.StreamReader, cap: int) -> bytes:
+    """Read a stream to EOF, erroring once more than *cap* bytes arrive —
+    the behavior of the reference's forkexec maxBuffer (lib/common.js:151)."""
+    chunks: list[bytes] = []
+    total = 0
+    while True:
+        chunk = await stream.read(65536)
+        if not chunk:
+            return b"".join(chunks)
+        total += len(chunk)
+        if total > cap:
+            raise OutputLimitExceeded()
+        chunks.append(chunk)
+
+
+async def _pump_stdin(proc: asyncio.subprocess.Process,
+                      data: bytes | None) -> None:
+    if proc.stdin is None:
+        return
+    if data:
+        proc.stdin.write(data)
+        try:
+            await proc.stdin.drain()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+    proc.stdin.close()
+
+
+async def run(
+    argv: list[str],
+    *,
+    empty_env: bool = False,
+    env: dict[str, str] | None = None,
+    timeout: float | None = None,
+    check: bool = True,
+    stdin_data: bytes | None = None,
+    cwd: str | None = None,
+    max_output: int = MAX_OUTPUT_BYTES,
+) -> ExecResult:
+    """Run *argv* asynchronously; returns ExecResult, raises ExecError if
+    ``check`` and the command fails.  ``empty_env`` mirrors the reference's
+    habit of exec'ing zfs with ``env: {}`` (lib/common.js:151); output beyond
+    ``max_output`` bytes per stream kills the child and errors, like
+    forkexec's maxBuffer."""
+    run_id = next(_run_ids)
+    t0 = time.monotonic()
+    proc = await asyncio.create_subprocess_exec(
+        *argv,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+        stdin=asyncio.subprocess.PIPE if stdin_data is not None else None,
+        env={} if empty_env else env,
+        cwd=cwd,
+    )
+    tasks = [
+        asyncio.ensure_future(_read_capped(proc.stdout, max_output)),
+        asyncio.ensure_future(_read_capped(proc.stderr, max_output)),
+        asyncio.ensure_future(_pump_stdin(proc, stdin_data)),
+    ]
+
+    async def _discard(stream: asyncio.StreamReader) -> None:
+        # Process.wait() only resolves once every pipe transport reaches
+        # EOF (asyncio wakes exit waiters from _call_connection_lost, gated
+        # on all pipes being disconnected) — so after killing the child we
+        # must still drain its pipes or wait() deadlocks.
+        try:
+            while await stream.read(65536):
+                pass
+        except Exception:
+            pass
+
+    try:
+        out, err, _ = await asyncio.wait_for(
+            asyncio.gather(*tasks), timeout=timeout
+        )
+        await proc.wait()
+    except (asyncio.TimeoutError, OutputLimitExceeded) as e:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        proc.kill()
+        await asyncio.gather(_discard(proc.stdout), _discard(proc.stderr))
+        await proc.wait()
+        why = ("timeout after %ss" % timeout
+               if isinstance(e, asyncio.TimeoutError)
+               else "output exceeded %d bytes" % max_output)
+        res = ExecResult(argv, -9, "", why,
+                         (time.monotonic() - t0) * 1000.0, run_id)
+        _log_result(res)
+        raise ExecError(res) from None
+    res = ExecResult(
+        argv,
+        proc.returncode if proc.returncode is not None else -1,
+        out.decode("utf-8", "replace"),
+        err.decode("utf-8", "replace"),
+        (time.monotonic() - t0) * 1000.0,
+        run_id,
+    )
+    _log_result(res)
+    if check and res.returncode != 0:
+        raise ExecError(res)
+    return res
+
+
+def run_sync(
+    argv: list[str],
+    *,
+    empty_env: bool = False,
+    env: dict[str, str] | None = None,
+    timeout: float | None = None,
+    check: bool = True,
+    stdin_data: bytes | None = None,
+    cwd: str | None = None,
+    max_output: int = MAX_OUTPUT_BYTES,
+) -> ExecResult:
+    """Synchronous variant of :func:`run` for CLI/tools code paths.
+    Shares the async implementation (and its output cap); must not be
+    called from inside a running event loop."""
+    return asyncio.run(run(
+        argv,
+        empty_env=empty_env,
+        env=env,
+        timeout=timeout,
+        check=check,
+        stdin_data=stdin_data,
+        cwd=cwd,
+        max_output=max_output,
+    ))

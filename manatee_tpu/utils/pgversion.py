@@ -1,0 +1,34 @@
+"""PostgreSQL version-string handling.
+
+Reference parity: lib/common.js:463-484 (pgStripMinor) — reduce a full
+PostgreSQL version to its "major" per the two numbering schemes:
+
+* pre-10 ("9.6.3"): major is the first TWO components → "9.6"
+* 10+    ("12.0"):  major is the first component       → "12"
+
+The reference throws on malformed input (asserted in test/tst.common.js and
+test/tst.postgresMgr.js:29-43); we raise ValueError.
+"""
+
+from __future__ import annotations
+
+import re
+
+_VERSION_RE = re.compile(r"^\d+(\.\d+)*$")
+
+
+def pg_strip_minor(version: str) -> str:
+    if not isinstance(version, str) or not _VERSION_RE.match(version):
+        raise ValueError("invalid postgres version: %r" % (version,))
+    parts = version.split(".")
+    first = int(parts[0])
+    if first >= 10:
+        return parts[0]
+    if len(parts) < 2:
+        raise ValueError("pre-10 version must have at least two components: %r"
+                         % (version,))
+    return ".".join(parts[:2])
+
+
+def pg_same_major(a: str, b: str) -> bool:
+    return pg_strip_minor(a) == pg_strip_minor(b)

@@ -1,0 +1,38 @@
+"""JSON-schema config validation.
+
+Reference parity: lib/postgresMgr.js:60-116 validates the sitter's
+postgresMgr config block with a JSON schema at construction time
+(lib/postgresMgr.js:257).  We use the `jsonschema` package and raise
+ConfigError with a readable message.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jsonschema
+
+
+class ConfigError(Exception):
+    pass
+
+
+def validate_config(cfg: dict, schema: dict, *, name: str = "config") -> dict:
+    try:
+        jsonschema.validate(cfg, schema)
+    except jsonschema.ValidationError as e:
+        path = "/".join(str(p) for p in e.absolute_path)
+        raise ConfigError("%s invalid at %r: %s" % (name, path, e.message)) from None
+    return cfg
+
+
+def load_json_config(path: str | Path, schema: dict | None = None,
+                     *, name: str = "config") -> dict:
+    try:
+        cfg = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ConfigError("cannot load %s from %s: %s" % (name, path, e)) from None
+    if schema is not None:
+        validate_config(cfg, schema, name=name)
+    return cfg

@@ -1,0 +1,153 @@
+"""Unit tests for the utility layer.
+
+Mirrors the reference's test/tst.common.js (pgStripMinor table-driven,
+:15-76) and test/confParser.test.js (read/write/set, :85-125).
+"""
+
+import asyncio
+
+import pytest
+
+from manatee_tpu.utils import ConfFile, ExecError, pg_strip_minor, run, run_sync
+from manatee_tpu.utils.confparser import quote_conf_value
+from manatee_tpu.utils.validation import ConfigError, validate_config
+
+
+# ---- pg_strip_minor (test/tst.common.js:15-76 table) ----
+
+@pytest.mark.parametrize("full,major", [
+    ("9.2.4", "9.2"),
+    ("9.6.3", "9.6"),
+    ("9.6", "9.6"),
+    ("10.1", "10"),
+    ("12.0", "12"),
+    ("12", "12"),
+    ("14.7", "14"),
+])
+def test_pg_strip_minor(full, major):
+    assert pg_strip_minor(full) == major
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "9.x", "9..2", ".9", "9.", None, 9])
+def test_pg_strip_minor_invalid(bad):
+    with pytest.raises((ValueError, TypeError)):
+        pg_strip_minor(bad)
+
+
+def test_pg_strip_minor_pre10_needs_two_components():
+    with pytest.raises(ValueError):
+        pg_strip_minor("9")
+
+
+# ---- ConfFile (test/confParser.test.js:85-125) ----
+
+SAMPLE = """\
+# PostgreSQL sample
+listen_addresses = '*'   # bind all
+port = 5432
+wal_level = hot_standby
+synchronous_commit = remote_write
+hot_standby on
+shared_buffers = '128MB'
+"""
+
+
+def test_conf_read(tmp_path):
+    p = tmp_path / "postgresql.conf"
+    p.write_text(SAMPLE)
+    conf = ConfFile.read(p)
+    assert conf.get("port") == "5432"
+    assert conf.get("wal_level") == "hot_standby"
+    assert conf.get_unquoted("listen_addresses") == "*"
+    # "key value" (no '=') form accepted, like postgres itself
+    assert conf.get("hot_standby") == "on"
+
+
+def test_conf_set_write_roundtrip(tmp_path):
+    p = tmp_path / "postgresql.conf"
+    p.write_text(SAMPLE)
+    conf = ConfFile.read(p)
+    conf.set("synchronous_standby_names", quote_conf_value("1 (\"peer\")"))
+    conf.set("port", "10001")
+    conf.write(p)
+    again = ConfFile.read(p)
+    assert again.get("port") == "10001"
+    assert again.get_unquoted("synchronous_standby_names") == '1 ("peer")'
+
+
+def test_conf_comment_inside_quotes():
+    conf = ConfFile.from_text("primary_conninfo = 'host=x port=5 # not a comment'\n")
+    assert conf.get_unquoted("primary_conninfo") == "host=x port=5 # not a comment"
+
+
+def test_conf_delete_and_contains():
+    conf = ConfFile({"a": "1", "b": "2"})
+    assert "a" in conf
+    conf.delete("a")
+    assert "a" not in conf
+    assert conf.get("a", "dflt") == "dflt"
+
+
+def test_quote_conf_value_escapes():
+    assert quote_conf_value("it's") == "'it''s'"
+
+
+# ---- exec wrappers (lib/common.js:148-172 semantics) ----
+
+def test_run_sync_ok():
+    res = run_sync(["/bin/echo", "hello"])
+    assert res.ok and res.stdout.strip() == "hello"
+    assert res.duration_ms >= 0
+    assert res.run_id > 0
+
+
+def test_run_sync_failure_raises():
+    with pytest.raises(ExecError) as ei:
+        run_sync(["/bin/sh", "-c", "echo oops >&2; exit 3"])
+    assert ei.value.result.returncode == 3
+    assert "oops" in ei.value.result.stderr
+
+
+def test_run_sync_empty_env():
+    res = run_sync(["/bin/sh", "-c", "echo x$HOME"], empty_env=True)
+    assert res.stdout.strip() == "x"
+
+
+def test_run_async_ok_and_timeout():
+    async def go():
+        res = await run(["/bin/echo", "async"])
+        assert res.stdout.strip() == "async"
+        with pytest.raises(ExecError):
+            await run(["/bin/sleep", "5"], timeout=0.2)
+    asyncio.run(go())
+
+
+def test_run_output_cap_kills_runaway_child():
+    # forkexec-maxBuffer parity (lib/common.js:151): a child that floods
+    # stdout must be killed and reported, not buffered without bound —
+    # and wait() must not deadlock on the undrained pipes.
+    with pytest.raises(ExecError) as ei:
+        run_sync(["/bin/sh", "-c", "head -c 10000000 /dev/zero"],
+                 max_output=1024 * 1024)
+    assert "output exceeded" in ei.value.result.stderr
+
+
+def test_run_async_stdin():
+    async def go():
+        res = await run(["/bin/cat"], stdin_data=b"piped")
+        assert res.stdout == "piped"
+    asyncio.run(go())
+
+
+# ---- config validation ----
+
+def test_validate_config():
+    schema = {
+        "type": "object",
+        "required": ["ip"],
+        "properties": {"ip": {"type": "string"}},
+    }
+    validate_config({"ip": "127.0.0.1"}, schema)
+    with pytest.raises(ConfigError) as ei:
+        validate_config({"ip": 5}, schema, name="sitter")
+    assert "sitter" in str(ei.value)
