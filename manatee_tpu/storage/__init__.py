@@ -1,0 +1,31 @@
+"""Storage/data-plane layer (reference: lib/zfsClient.js, lib/common.js zfs
+wrappers, lib/snapShotter.js snapshot naming/GC semantics).
+
+Pluggable backends behind :class:`manatee_tpu.storage.base.StorageBackend`:
+
+- :class:`manatee_tpu.storage.zfsbackend.ZfsBackend` — production; shells
+  out to zfs(8) exactly as the reference does.
+- :class:`manatee_tpu.storage.dirstore.DirBackend` — development/testing;
+  plain directories, full-copy snapshots, tar send streams.  Lets the
+  entire control plane (including restores) run on machines without ZFS.
+"""
+
+from manatee_tpu.storage.base import (
+    Snapshot,
+    StorageBackend,
+    StorageError,
+    snapshot_name_now,
+    is_epoch_ms_snapshot,
+)
+from manatee_tpu.storage.dirstore import DirBackend
+from manatee_tpu.storage.zfsbackend import ZfsBackend
+
+__all__ = [
+    "Snapshot",
+    "StorageBackend",
+    "StorageError",
+    "snapshot_name_now",
+    "is_epoch_ms_snapshot",
+    "DirBackend",
+    "ZfsBackend",
+]

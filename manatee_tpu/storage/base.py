@@ -1,0 +1,157 @@
+"""Storage backend interface.
+
+The reference's storage contract is spread across lib/common.js:177-451
+(zfs exec wrappers: set/inherit/get/snapshot/create/rename/mount/unmount/
+destroy/exists) and lib/zfsClient.js (restore/isolate/mount-with-verify).
+This module captures that contract as an abstract interface so the control
+plane is identical over zfs(8) and over a plain-directory dev backend.
+
+Snapshot naming follows the reference exactly: snapshots are named with a
+13-digit epoch-milliseconds timestamp (lib/zfsClient.js:209-221); GC and
+backup-sender selection only ever consider names matching ^\\d{13}$
+(lib/snapShotter.js:251, lib/backupSender.js:268).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import re
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+
+class StorageError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    dataset: str
+    name: str
+    creation: float  # unix seconds
+
+    @property
+    def full(self) -> str:
+        return "%s@%s" % (self.dataset, self.name)
+
+
+_EPOCH_MS_RE = re.compile(r"^\d{13}$")
+
+
+def snapshot_name_now() -> str:
+    """Epoch-ms snapshot name, e.g. '1753731200123' (lib/zfsClient.js:216)."""
+    return str(int(time.time() * 1000))
+
+
+def is_epoch_ms_snapshot(name: str) -> bool:
+    return bool(_EPOCH_MS_RE.match(name))
+
+
+# Progress callback: (bytes_done, bytes_total_estimate_or_None)
+ProgressCb = Callable[[int, int | None], None]
+
+
+class StorageBackend(abc.ABC):
+    """Dataset lifecycle + snapshot + bulk-stream operations.
+
+    Dataset names are hierarchical, '/'-separated, zfs-style.  A dataset
+    has a *mountpoint* (where consumers like PostgreSQL see its data) and
+    may be mounted or not; unmounted data is not visible at the
+    mountpoint.
+    """
+
+    # -- dataset lifecycle --
+
+    @abc.abstractmethod
+    async def exists(self, dataset: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def create(self, dataset: str, *, mountpoint: str | None = None) -> None: ...
+
+    @abc.abstractmethod
+    async def destroy(self, dataset: str, *, recursive: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    async def rename(self, old: str, new: str) -> None:
+        """zfs rename semantics: children and snapshots move with the
+        dataset (used by isolateDataset, lib/zfsClient.js:514-624)."""
+
+    # -- properties / mounting --
+
+    @abc.abstractmethod
+    async def get_prop(self, dataset: str, prop: str) -> str | None: ...
+
+    @abc.abstractmethod
+    async def set_prop(self, dataset: str, prop: str, value: str) -> None: ...
+
+    @abc.abstractmethod
+    async def inherit_prop(self, dataset: str, prop: str) -> None: ...
+
+    @abc.abstractmethod
+    async def set_mountpoint(self, dataset: str, mountpoint: str) -> None: ...
+
+    @abc.abstractmethod
+    async def get_mountpoint(self, dataset: str) -> str | None: ...
+
+    @abc.abstractmethod
+    async def mount(self, dataset: str) -> None: ...
+
+    @abc.abstractmethod
+    async def unmount(self, dataset: str) -> None: ...
+
+    @abc.abstractmethod
+    async def is_mounted(self, dataset: str) -> bool:
+        """Must verify against ground truth (the reference re-checks
+        /etc/mnttab rather than trusting its own bookkeeping,
+        lib/zfsClient.js:251-437)."""
+
+    # -- snapshots --
+
+    @abc.abstractmethod
+    async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot: ...
+
+    @abc.abstractmethod
+    async def list_snapshots(self, dataset: str) -> list[Snapshot]:
+        """Sorted by creation time ascending (zfs list -s creation,
+        lib/snapShotter.js:241-248)."""
+
+    @abc.abstractmethod
+    async def destroy_snapshot(self, dataset: str, name: str) -> None: ...
+
+    # -- bulk streams (the zfs send/recv data path, §3.3 of SURVEY.md) --
+
+    @abc.abstractmethod
+    async def estimate_send_size(self, dataset: str, name: str) -> int | None: ...
+
+    @abc.abstractmethod
+    async def send(
+        self,
+        dataset: str,
+        name: str,
+        writer: asyncio.StreamWriter,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        """Stream snapshot *name* of *dataset* into *writer* (the
+        sender side of lib/backupSender.js:154-242)."""
+
+    @abc.abstractmethod
+    async def recv(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        """Receive a stream produced by :meth:`send` into *dataset*,
+        unmounted (zfs recv -u, lib/zfsClient.js:793).  The received
+        snapshot is preserved on the receiver."""
+
+    # -- convenience shared across backends --
+
+    async def latest_backup_snapshot(self, dataset: str) -> Snapshot | None:
+        """Newest snapshot eligible for backup/GC: 13-digit epoch-ms names
+        only (lib/backupSender.js:244-288)."""
+        snaps = [s for s in await self.list_snapshots(dataset)
+                 if is_epoch_ms_snapshot(s.name)]
+        return snaps[-1] if snaps else None

@@ -1,0 +1,375 @@
+"""Directory-based storage backend for machines without ZFS.
+
+Functional parity with the zfs backend at the interface level:
+hierarchical datasets, mount/unmount visibility at a mountpoint,
+point-in-time snapshots, rename-with-children (isolation), and tar-framed
+send/recv bulk streams.  Snapshots are full copies — correct (unlike
+hardlink farms) even when the consumer (PostgreSQL) rewrites files in
+place; this backend optimizes for fidelity in tests, not disk usage.
+
+On-disk layout under the backend root:
+
+    datasets/<a>/<b>/...        nested dirs, one per dataset path component
+        @data/                  the dataset's live content
+        @snapshots/<name>/      snapshot content
+        @meta.json              {mountpoint, mounted, props, snaps:{name:ctime}}
+
+Mounting is emulated with a symlink: <mountpoint> -> .../@data, so
+unmounted data really is invisible at the mountpoint, as with zfs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+
+from manatee_tpu.storage.base import (
+    ProgressCb,
+    Snapshot,
+    StorageBackend,
+    StorageError,
+    snapshot_name_now,
+)
+
+_RESERVED = {"@data", "@snapshots", "@meta.json"}
+
+
+class DirBackend(StorageBackend):
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        (self.root / "datasets").mkdir(parents=True, exist_ok=True)
+
+    # ---- internals ----
+
+    def _dspath(self, dataset: str) -> Path:
+        if not dataset or dataset.startswith("/") or ".." in dataset.split("/"):
+            raise StorageError("bad dataset name: %r" % dataset)
+        for comp in dataset.split("/"):
+            if comp in _RESERVED or not comp:
+                raise StorageError("bad dataset name: %r" % dataset)
+        return self.root / "datasets" / dataset
+
+    def _meta_path(self, dataset: str) -> Path:
+        return self._dspath(dataset) / "@meta.json"
+
+    def _load_meta(self, dataset: str) -> dict:
+        try:
+            return json.loads(self._meta_path(dataset).read_text())
+        except FileNotFoundError:
+            raise StorageError("dataset does not exist: %s" % dataset) from None
+
+    def _save_meta(self, dataset: str, meta: dict) -> None:
+        p = self._meta_path(dataset)
+        tmp = p.with_name(p.name + ".tmp")
+        tmp.write_text(json.dumps(meta, indent=2))
+        tmp.replace(p)
+
+    def _exists_sync(self, dataset: str) -> bool:
+        return self._meta_path(dataset).exists()
+
+    def _mountlink(self, dataset: str) -> Path | None:
+        meta = self._load_meta(dataset)
+        return Path(meta["mountpoint"]) if meta.get("mountpoint") else None
+
+    # ---- dataset lifecycle ----
+
+    async def exists(self, dataset: str) -> bool:
+        return self._exists_sync(dataset)
+
+    async def create(self, dataset: str, *, mountpoint: str | None = None) -> None:
+        if self._exists_sync(dataset):
+            raise StorageError("dataset exists: %s" % dataset)
+        p = self._dspath(dataset)
+        (p / "@data").mkdir(parents=True)
+        (p / "@snapshots").mkdir()
+        self._save_meta(dataset, {
+            "mountpoint": mountpoint,
+            "mounted": False,
+            "props": {"canmount": "on"},
+            "snaps": {},
+        })
+
+    async def destroy(self, dataset: str, *, recursive: bool = False) -> None:
+        p = self._dspath(dataset)
+        if not self._exists_sync(dataset):
+            raise StorageError("dataset does not exist: %s" % dataset)
+        children = [c.name for c in p.iterdir()
+                    if c.is_dir() and c.name not in _RESERVED]
+        if children and not recursive:
+            raise StorageError("dataset %s has children %s (need recursive)"
+                               % (dataset, children))
+        for child in children:
+            await self.destroy("%s/%s" % (dataset, child), recursive=True)
+        if await self.is_mounted(dataset):
+            await self.unmount(dataset)
+        await asyncio.to_thread(shutil.rmtree, p)
+        # prune now-empty parent plain dirs up to datasets/
+        parent = p.parent
+        base = self.root / "datasets"
+        while parent != base and not any(parent.iterdir()) \
+                and not (parent / "@meta.json").exists():
+            parent.rmdir()
+            parent = parent.parent
+
+    async def rename(self, old: str, new: str) -> None:
+        po, pn = self._dspath(old), self._dspath(new)
+        if not self._exists_sync(old):
+            raise StorageError("dataset does not exist: %s" % old)
+        if pn.exists():
+            raise StorageError("rename target exists: %s" % new)
+        was_mounted = await self.is_mounted(old)
+        pn.parent.mkdir(parents=True, exist_ok=True)
+        await asyncio.to_thread(os.rename, po, pn)
+        if was_mounted:
+            # zfs keeps a renamed dataset mounted; re-point the symlink at
+            # the moved @data so the mountpoint stays live
+            mp = Path(self._load_meta(new)["mountpoint"])
+            if mp.is_symlink():
+                os.unlink(mp)
+            os.symlink((pn / "@data").resolve(), mp)
+
+    # ---- properties / mounting ----
+
+    async def get_prop(self, dataset: str, prop: str) -> str | None:
+        meta = self._load_meta(dataset)
+        if prop == "mountpoint":
+            return meta.get("mountpoint")
+        if prop == "mounted":
+            return "yes" if meta.get("mounted") else "no"
+        return meta.get("props", {}).get(prop)
+
+    async def set_prop(self, dataset: str, prop: str, value: str) -> None:
+        meta = self._load_meta(dataset)
+        if prop == "mountpoint":
+            meta["mountpoint"] = value
+        else:
+            meta.setdefault("props", {})[prop] = value
+        self._save_meta(dataset, meta)
+
+    async def inherit_prop(self, dataset: str, prop: str) -> None:
+        meta = self._load_meta(dataset)
+        meta.get("props", {}).pop(prop, None)
+        self._save_meta(dataset, meta)
+
+    async def set_mountpoint(self, dataset: str, mountpoint: str) -> None:
+        was_mounted = await self.is_mounted(dataset)
+        if was_mounted:
+            await self.unmount(dataset)
+        await self.set_prop(dataset, "mountpoint", mountpoint)
+        if was_mounted:
+            await self.mount(dataset)
+
+    async def get_mountpoint(self, dataset: str) -> str | None:
+        return (await self.get_prop(dataset, "mountpoint"))
+
+    async def mount(self, dataset: str) -> None:
+        meta = self._load_meta(dataset)
+        mp = meta.get("mountpoint")
+        if not mp:
+            raise StorageError("dataset %s has no mountpoint" % dataset)
+        link = Path(mp)
+        target = self._dspath(dataset) / "@data"
+        if link.is_symlink():
+            if os.path.realpath(link) == str(target.resolve()):
+                meta["mounted"] = True
+                self._save_meta(dataset, meta)
+                return
+            raise StorageError("mountpoint %s busy (-> %s)"
+                               % (mp, os.path.realpath(link)))
+        if link.exists():
+            raise StorageError("mountpoint %s exists and is not a mount" % mp)
+        link.parent.mkdir(parents=True, exist_ok=True)
+        os.symlink(target.resolve(), link)
+        meta["mounted"] = True
+        self._save_meta(dataset, meta)
+
+    async def unmount(self, dataset: str) -> None:
+        meta = self._load_meta(dataset)
+        mp = meta.get("mountpoint")
+        if mp and Path(mp).is_symlink():
+            os.unlink(mp)
+        meta["mounted"] = False
+        self._save_meta(dataset, meta)
+
+    async def is_mounted(self, dataset: str) -> bool:
+        # ground truth = the symlink, not the meta flag (mnttab-verify
+        # parity, lib/zfsClient.js:251-437)
+        meta = self._load_meta(dataset)
+        mp = meta.get("mountpoint")
+        if not mp or not Path(mp).is_symlink():
+            return False
+        return os.path.realpath(mp) == str((self._dspath(dataset) / "@data").resolve())
+
+    # ---- snapshots ----
+
+    async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot:
+        name = name or snapshot_name_now()
+        meta = self._load_meta(dataset)
+        if name in meta["snaps"]:
+            raise StorageError("snapshot exists: %s@%s" % (dataset, name))
+        src = self._dspath(dataset) / "@data"
+        dst = self._dspath(dataset) / "@snapshots" / name
+        await asyncio.to_thread(shutil.copytree, src, dst, symlinks=True)
+        now = time.time()
+        meta["snaps"][name] = now
+        self._save_meta(dataset, meta)
+        return Snapshot(dataset, name, now)
+
+    async def list_snapshots(self, dataset: str) -> list[Snapshot]:
+        meta = self._load_meta(dataset)
+        snaps = [Snapshot(dataset, n, t) for n, t in meta["snaps"].items()]
+        snaps.sort(key=lambda s: (s.creation, s.name))
+        return snaps
+
+    async def destroy_snapshot(self, dataset: str, name: str) -> None:
+        meta = self._load_meta(dataset)
+        if name not in meta["snaps"]:
+            raise StorageError("no such snapshot: %s@%s" % (dataset, name))
+        await asyncio.to_thread(
+            shutil.rmtree, self._dspath(dataset) / "@snapshots" / name)
+        del meta["snaps"][name]
+        self._save_meta(dataset, meta)
+
+    # ---- bulk streams ----
+    #
+    # Frame: one JSON header line {"snapshot": ..., "size": ...}\n followed
+    # by a tar stream of the snapshot content (role of `zfs send`,
+    # lib/backupSender.js:172-180).
+
+    async def estimate_send_size(self, dataset: str, name: str) -> int | None:
+        src = self._dspath(dataset) / "@snapshots" / name
+        if not src.exists():
+            raise StorageError("no such snapshot: %s@%s" % (dataset, name))
+
+        def du(p: Path) -> int:
+            total = 0
+            for f in p.rglob("*"):
+                if f.is_file() and not f.is_symlink():
+                    total += f.stat().st_size
+            return total
+
+        return await asyncio.to_thread(du, src)
+
+    async def send(
+        self,
+        dataset: str,
+        name: str,
+        writer: asyncio.StreamWriter,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        src = self._dspath(dataset) / "@snapshots" / name
+        if not src.exists():
+            raise StorageError("no such snapshot: %s@%s" % (dataset, name))
+        size = await self.estimate_send_size(dataset, name)
+        header = json.dumps({"snapshot": name, "size": size}) + "\n"
+        writer.write(header.encode())
+        await writer.drain()
+        proc = await asyncio.create_subprocess_exec(
+            "tar", "-C", str(src), "-cf", "-", ".",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        done = 0
+        try:
+            while True:
+                chunk = await proc.stdout.read(1 << 16)
+                if not chunk:
+                    break
+                done += len(chunk)
+                writer.write(chunk)
+                await writer.drain()
+                if progress_cb:
+                    progress_cb(done, size)
+        except Exception as e:
+            # receiver went away mid-stream: kill tar first, or reading its
+            # stderr to EOF below would block on the full stdout pipe
+            proc.kill()
+            await proc.wait()
+            raise StorageError("send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+        err = await proc.stderr.read()
+        rc = await proc.wait()
+        if rc != 0:
+            raise StorageError("tar send failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))
+
+    async def recv(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        hdr_line = await reader.readline()
+        if not hdr_line:
+            raise StorageError("empty recv stream")
+        try:
+            hdr = json.loads(hdr_line)
+            snapname = hdr["snapshot"]
+            size = hdr.get("size")
+        except (json.JSONDecodeError, KeyError, TypeError):
+            raise StorageError("bad recv stream header: %r" % hdr_line) from None
+        # the snapshot name came off the wire: refuse anything that is not
+        # a single safe path component
+        if (not isinstance(snapname, str) or not snapname
+                or "/" in snapname or "\\" in snapname
+                or snapname in (".", "..") or snapname in _RESERVED):
+            raise StorageError("bad snapshot name in stream: %r" % (snapname,))
+
+        if self._exists_sync(dataset):
+            raise StorageError(
+                "recv target exists: %s (isolate or destroy it first)" % dataset)
+        await self.create(dataset)
+        data = self._dspath(dataset) / "@data"
+
+        proc = await asyncio.create_subprocess_exec(
+            "tar", "-C", str(data), "-xf", "-",
+            stdin=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        done = 0
+        stream_error: Exception | None = None
+        while True:
+            try:
+                chunk = await reader.read(1 << 16)
+            except Exception as e:
+                # the network stream died — a clean tar exit would be
+                # meaningless (truncated-but-aligned archives extract "ok")
+                stream_error = e
+                break
+            if not chunk:
+                break
+            done += len(chunk)
+            try:
+                proc.stdin.write(chunk)
+                await proc.stdin.drain()
+            except (BrokenPipeError, ConnectionResetError):
+                break  # tar died early; its rc/stderr tell the story below
+            if progress_cb:
+                progress_cb(done, size)
+        if stream_error is not None:
+            proc.kill()
+            await proc.wait()
+            await self.destroy(dataset, recursive=True)
+            raise StorageError("recv into %s aborted: %s"
+                               % (dataset, stream_error)) from stream_error
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        err = await proc.stderr.read()
+        rc = await proc.wait()
+        if rc != 0:
+            await self.destroy(dataset, recursive=True)
+            raise StorageError("tar recv failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))
+        # preserve the received snapshot on the receiver, like zfs recv
+        snapdir = self._dspath(dataset) / "@snapshots" / snapname
+        await asyncio.to_thread(shutil.copytree, data, snapdir, symlinks=True)
+        meta = self._load_meta(dataset)
+        meta["snaps"][snapname] = time.time()
+        meta["mounted"] = False  # zfs recv -u: received unmounted
+        self._save_meta(dataset, meta)

@@ -1,0 +1,239 @@
+"""zfs(8) storage backend — the production data plane.
+
+Command mapping follows the reference's wrappers (lib/common.js:177-451)
+and restore/mount flows (lib/zfsClient.js).  All zfs invocations run with
+an empty environment and the traced exec wrapper, as the reference does
+(lib/common.js:148-172).
+
+send/recv parity (lib/backupSender.js:154-242, lib/zfsClient.js:765-886):
+``zfs send -v -P`` writes machine-parsable progress to stderr — total size
+from the "size" line, periodic per-second byte counts — which we surface
+through the progress callback, and ``zfs recv -v -u`` receives unmounted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+
+from manatee_tpu.storage.base import (
+    ProgressCb,
+    Snapshot,
+    StorageBackend,
+    StorageError,
+)
+from manatee_tpu.utils import ExecError, run
+
+# zfs send -P stderr: "size   123456" then lines "HH:MM:SS   123456   ds@snap"
+_SIZE_RE = re.compile(r"^size\s+(\d+)", re.M)
+_TICK_RE = re.compile(r"^\d\d:\d\d:\d\d\s+(\d+)\s+", re.M)
+
+
+class ZfsBackend(StorageBackend):
+    def __init__(self, zfs_cmd: str = "zfs"):
+        self.zfs = zfs_cmd
+
+    async def _zfs(self, *args: str, check: bool = True):
+        try:
+            return await run([self.zfs, *args], empty_env=True, check=check)
+        except ExecError as e:
+            raise StorageError(str(e)) from None
+
+    # ---- dataset lifecycle ----
+
+    async def exists(self, dataset: str) -> bool:
+        res = await self._zfs("list", dataset, check=False)
+        return res.returncode == 0
+
+    async def create(self, dataset: str, *, mountpoint: str | None = None) -> None:
+        args = ["create"]
+        if mountpoint:
+            args += ["-o", "mountpoint=%s" % mountpoint]
+        await self._zfs(*args, dataset)
+
+    async def destroy(self, dataset: str, *, recursive: bool = False) -> None:
+        args = ["destroy"]
+        if recursive:
+            args.append("-r")
+        await self._zfs(*args, dataset)
+
+    async def rename(self, old: str, new: str) -> None:
+        await self._zfs("rename", old, new)
+
+    # ---- properties / mounting ----
+
+    async def get_prop(self, dataset: str, prop: str) -> str | None:
+        res = await self._zfs("get", "-H", "-o", "value", prop, dataset)
+        val = res.stdout.strip()
+        return None if val in ("-", "") else val
+
+    async def set_prop(self, dataset: str, prop: str, value: str) -> None:
+        await self._zfs("set", "%s=%s" % (prop, value), dataset)
+
+    async def inherit_prop(self, dataset: str, prop: str) -> None:
+        await self._zfs("inherit", prop, dataset)
+
+    async def set_mountpoint(self, dataset: str, mountpoint: str) -> None:
+        await self.set_prop(dataset, "mountpoint", mountpoint)
+
+    async def get_mountpoint(self, dataset: str) -> str | None:
+        return await self.get_prop(dataset, "mountpoint")
+
+    async def mount(self, dataset: str) -> None:
+        res = await self._zfs("mount", dataset, check=False)
+        if res.returncode != 0 and "already mounted" not in res.stderr:
+            raise StorageError("zfs mount %s failed: %s"
+                               % (dataset, res.stderr.strip()))
+
+    async def unmount(self, dataset: str) -> None:
+        res = await self._zfs("unmount", dataset, check=False)
+        if res.returncode != 0 and "not currently mounted" not in res.stderr:
+            raise StorageError("zfs unmount %s failed: %s"
+                               % (dataset, res.stderr.strip()))
+
+    async def is_mounted(self, dataset: str) -> bool:
+        # kernel-reported state, the moral equivalent of the reference's
+        # /etc/mnttab verification (lib/zfsClient.js:393-427)
+        return (await self.get_prop(dataset, "mounted")) == "yes"
+
+    # ---- snapshots ----
+
+    async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot:
+        from manatee_tpu.storage.base import snapshot_name_now
+        name = name or snapshot_name_now()
+        await self._zfs("snapshot", "%s@%s" % (dataset, name))
+        snaps = await self.list_snapshots(dataset)
+        for s in snaps:
+            if s.name == name:
+                return s
+        raise StorageError("snapshot %s@%s vanished" % (dataset, name))
+
+    async def list_snapshots(self, dataset: str) -> list[Snapshot]:
+        res = await self._zfs(
+            "list", "-H", "-p", "-t", "snapshot",
+            "-o", "name,creation", "-s", "creation", "-d", "1", dataset)
+        out: list[Snapshot] = []
+        for line in res.stdout.splitlines():
+            if not line.strip():
+                continue
+            full, creation = line.split("\t")
+            ds, snapname = full.split("@", 1)
+            out.append(Snapshot(ds, snapname, float(creation)))
+        return out
+
+    async def destroy_snapshot(self, dataset: str, name: str) -> None:
+        await self._zfs("destroy", "%s@%s" % (dataset, name))
+
+    # ---- bulk streams ----
+
+    async def estimate_send_size(self, dataset: str, name: str) -> int | None:
+        res = await self._zfs("send", "-n", "-v", "-P",
+                              "%s@%s" % (dataset, name), check=False)
+        m = _SIZE_RE.search(res.stderr) or _SIZE_RE.search(res.stdout)
+        return int(m.group(1)) if m else None
+
+    async def send(
+        self,
+        dataset: str,
+        name: str,
+        writer: asyncio.StreamWriter,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env={},
+        )
+        size: int | None = None
+        err_chunks: list[bytes] = []
+
+        async def watch_stderr():
+            nonlocal size
+            while True:
+                line = await proc.stderr.readline()
+                if not line:
+                    return
+                err_chunks.append(line)
+                text = line.decode("utf-8", "replace")
+                m = _SIZE_RE.match(text)
+                if m:
+                    size = int(m.group(1))
+                    continue
+                m = _TICK_RE.match(text)
+                if m and progress_cb:
+                    progress_cb(int(m.group(1)), size)
+
+        async def pump_stdout():
+            done = 0
+            while True:
+                chunk = await proc.stdout.read(1 << 16)
+                if not chunk:
+                    return
+                done += len(chunk)
+                writer.write(chunk)
+                await writer.drain()
+                if progress_cb:
+                    progress_cb(done, size)
+
+        t_err = asyncio.ensure_future(watch_stderr())
+        t_out = asyncio.ensure_future(pump_stdout())
+        try:
+            await asyncio.gather(t_err, t_out)
+        except Exception as e:
+            for t in (t_err, t_out):
+                t.cancel()
+            await asyncio.gather(t_err, t_out, return_exceptions=True)
+            proc.kill()
+            await proc.wait()
+            raise StorageError("zfs send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+        rc = await proc.wait()
+        if rc != 0:
+            raise StorageError("zfs send failed (rc=%d): %s"
+                               % (rc, b"".join(err_chunks).decode("utf-8", "replace")))
+
+    async def recv(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        progress_cb: ProgressCb | None = None,
+    ) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            self.zfs, "recv", "-v", "-u", dataset,
+            stdin=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env={},
+        )
+        done = 0
+        stream_error: Exception | None = None
+        while True:
+            try:
+                chunk = await reader.read(1 << 16)
+            except Exception as e:
+                stream_error = e
+                break
+            if not chunk:
+                break
+            done += len(chunk)
+            try:
+                proc.stdin.write(chunk)
+                await proc.stdin.drain()
+            except (BrokenPipeError, ConnectionResetError):
+                break  # zfs recv died early; rc/stderr below explain
+            if progress_cb:
+                progress_cb(done, None)
+        if stream_error is not None:
+            proc.kill()
+            await proc.wait()
+            raise StorageError("zfs recv into %s aborted: %s"
+                               % (dataset, stream_error)) from stream_error
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        err = await proc.stderr.read()
+        rc = await proc.wait()
+        if rc != 0:
+            raise StorageError("zfs recv failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))

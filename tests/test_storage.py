@@ -1,0 +1,251 @@
+"""DirBackend storage tests: dataset lifecycle, mounting visibility,
+snapshots (epoch-ms naming + GC filter), rename/isolation, and a real
+send/recv roundtrip over a localhost TCP socket — the same data path the
+restore flow uses (SURVEY.md §3.3)."""
+
+import asyncio
+
+import pytest
+
+from manatee_tpu.storage import (
+    DirBackend,
+    StorageError,
+    is_epoch_ms_snapshot,
+    snapshot_name_now,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def be(tmp_path):
+    return DirBackend(tmp_path / "store")
+
+
+def test_create_exists_destroy(be, tmp_path):
+    async def go():
+        assert not await be.exists("manatee/pg")
+        await be.create("manatee/pg", mountpoint=str(tmp_path / "mnt" / "pg"))
+        assert await be.exists("manatee/pg")
+        with pytest.raises(StorageError):
+            await be.create("manatee/pg")
+        await be.destroy("manatee/pg")
+        assert not await be.exists("manatee/pg")
+    run(go())
+
+
+def test_destroy_requires_recursive_for_children(be):
+    async def go():
+        await be.create("a")
+        await be.create("a/b")
+        with pytest.raises(StorageError):
+            await be.destroy("a")
+        await be.destroy("a", recursive=True)
+        assert not await be.exists("a/b")
+    run(go())
+
+
+def test_mount_visibility(be, tmp_path):
+    mnt = tmp_path / "mnt" / "data"
+
+    async def go():
+        await be.create("pg", mountpoint=str(mnt))
+        assert not await be.is_mounted("pg")
+        await be.mount("pg")
+        assert await be.is_mounted("pg")
+        (mnt / "hello.txt").write_text("hi")
+        await be.unmount("pg")
+        assert not mnt.exists()          # unmounted data is invisible
+        await be.mount("pg")
+        assert (mnt / "hello.txt").read_text() == "hi"
+    run(go())
+
+
+def test_mount_idempotent_and_busy(be, tmp_path):
+    mnt = tmp_path / "m"
+
+    async def go():
+        await be.create("x", mountpoint=str(mnt))
+        await be.mount("x")
+        await be.mount("x")  # idempotent
+        await be.create("y", mountpoint=str(mnt))
+        with pytest.raises(StorageError):
+            await be.mount("y")  # busy
+    run(go())
+
+
+def test_snapshot_and_rollback_content(be, tmp_path):
+    mnt = tmp_path / "d"
+
+    async def go():
+        await be.create("pg", mountpoint=str(mnt))
+        await be.mount("pg")
+        (mnt / "f").write_text("v1")
+        snap = await be.snapshot("pg")
+        assert is_epoch_ms_snapshot(snap.name)
+        (mnt / "f").write_text("v2")  # in-place rewrite must not corrupt snap
+        snaps = await be.list_snapshots("pg")
+        assert [s.name for s in snaps] == [snap.name]
+        snapdir = be._dspath("pg") / "@snapshots" / snap.name
+        assert (snapdir / "f").read_text() == "v1"
+    run(go())
+
+
+def test_latest_backup_snapshot_filters_names(be):
+    async def go():
+        await be.create("pg")
+        await be.snapshot("pg", "manual-snap")   # non-epoch: ignored
+        s1 = await be.snapshot("pg", "1700000000001")
+        s2 = await be.snapshot("pg", "1700000000002")
+        latest = await be.latest_backup_snapshot("pg")
+        assert latest.name == s2.name
+        await be.destroy_snapshot("pg", s2.name)
+        latest = await be.latest_backup_snapshot("pg")
+        assert latest.name == s1.name
+    run(go())
+
+
+def test_rename_moves_snapshots_and_children(be, tmp_path):
+    async def go():
+        await be.create("parent")
+        await be.create("parent/pg", mountpoint=str(tmp_path / "mp"))
+        await be.snapshot("parent/pg", "1700000000001")
+        # isolateDataset semantics (lib/zfsClient.js:514-624)
+        await be.create("parent/isolated")
+        await be.rename("parent/pg", "parent/isolated/autorebuild-x")
+        assert not await be.exists("parent/pg")
+        assert await be.exists("parent/isolated/autorebuild-x")
+        snaps = await be.list_snapshots("parent/isolated/autorebuild-x")
+        assert [s.name for s in snaps] == ["1700000000001"]
+    run(go())
+
+
+def test_send_recv_roundtrip_over_tcp(be, tmp_path):
+    """Sender peer streams its latest snapshot over a socket; receiver peer
+    (a second backend rooted elsewhere) receives, then mounts — the §3.3
+    bootstrap path minus the HTTP control plane."""
+    be2 = DirBackend(tmp_path / "store2")
+    src_mnt = tmp_path / "srcmnt"
+    dst_mnt = tmp_path / "dstmnt"
+    progress: list[tuple[int, int | None]] = []
+
+    async def go():
+        await be.create("pg", mountpoint=str(src_mnt))
+        await be.mount("pg")
+        (src_mnt / "base.dat").write_bytes(b"x" * 300_000)
+        (src_mnt / "sub").mkdir()
+        (src_mnt / "sub" / "wal.log").write_text("wal-contents")
+        snap = await be.snapshot("pg", snapshot_name_now())
+
+        recv_done = asyncio.Event()
+
+        async def handle(reader, writer):
+            await be2.recv("pg", reader, progress_cb=lambda d, t: progress.append((d, t)))
+            writer.close()
+            recv_done.set()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await be.send("pg", snap.name, writer)
+        writer.close()
+        await writer.wait_closed()
+        await asyncio.wait_for(recv_done.wait(), 10)
+        server.close()
+        await server.wait_closed()
+
+        # received unmounted (zfs recv -u), then mount and verify content
+        assert not await be2.is_mounted("pg")
+        await be2.set_mountpoint("pg", str(dst_mnt))
+        await be2.mount("pg")
+        assert (dst_mnt / "base.dat").read_bytes() == b"x" * 300_000
+        assert (dst_mnt / "sub" / "wal.log").read_text() == "wal-contents"
+        # the snapshot itself was preserved on the receiver
+        snaps = await be2.list_snapshots("pg")
+        assert [s.name for s in snaps] == [snap.name]
+        assert progress and progress[-1][0] > 0
+    run(go())
+
+
+def test_recv_into_existing_dataset_refused(be, tmp_path):
+    async def go():
+        await be.create("pg")
+        reader = asyncio.StreamReader()
+        reader.feed_data(b'{"snapshot": "170", "size": 1}\n')
+        reader.feed_eof()
+        with pytest.raises(StorageError):
+            await be.recv("pg", reader)
+    run(go())
+
+
+def test_recv_rejects_traversal_snapshot_name(be):
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(b'{"snapshot": "../@data/../../evil", "size": 1}\n')
+        reader.feed_eof()
+        with pytest.raises(StorageError) as ei:
+            await be.recv("pg", reader)
+        assert "snapshot name" in str(ei.value)
+        assert not await be.exists("pg")
+        # non-dict header must also be a clean StorageError
+        r2 = asyncio.StreamReader()
+        r2.feed_data(b'[1]\n')
+        r2.feed_eof()
+        with pytest.raises(StorageError):
+            await be.recv("pg", r2)
+    run(go())
+
+
+def test_rename_mounted_dataset_keeps_mountpoint_live(be, tmp_path):
+    mnt = tmp_path / "live"
+
+    async def go():
+        await be.create("parent")
+        await be.create("parent/isolated")
+        await be.create("parent/pg", mountpoint=str(mnt))
+        await be.mount("parent/pg")
+        (mnt / "f").write_text("x")
+        await be.rename("parent/pg", "parent/isolated/pg")
+        # zfs keeps a renamed dataset mounted; data stays visible
+        assert await be.is_mounted("parent/isolated/pg")
+        assert (mnt / "f").read_text() == "x"
+        await be.unmount("parent/isolated/pg")
+        # mountpoint is free for a replacement dataset now
+        await be.create("parent/pg", mountpoint=str(mnt))
+        await be.mount("parent/pg")
+        assert await be.is_mounted("parent/pg")
+    run(go())
+
+
+def test_send_receiver_disconnect_raises_storage_error(be, tmp_path):
+    mnt = tmp_path / "big"
+
+    async def go():
+        await be.create("pg", mountpoint=str(mnt))
+        await be.mount("pg")
+        (mnt / "big.bin").write_bytes(b"z" * 5_000_000)
+        snap = await be.snapshot("pg", "1700000000009")
+
+        async def handler(reader, writer):
+            await reader.read(1024)  # read a little, then slam the door
+            writer.transport.abort()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        with pytest.raises(StorageError):
+            await asyncio.wait_for(be.send("pg", snap.name, writer), 10)
+        server.close()
+        await server.wait_closed()
+    run(go())
+
+
+def test_bad_dataset_names(be):
+    async def go():
+        for bad in ("", "/abs", "a/../b", "a/@data", "a//b"):
+            with pytest.raises(StorageError):
+                await be.create(bad)
+    run(go())
