@@ -1,0 +1,51 @@
+"""Coordination layer (reference: lib/zookeeperMgr.js + ZooKeeper).
+
+The reference delegates consensus/membership to a ZooKeeper ensemble.  This
+rebuild keeps the same data model — a tree of versioned znodes with
+ephemeral-sequential nodes, one-shot watches, and transactions — behind a
+narrow client API (:mod:`manatee_tpu.coord.api`) with three backends:
+
+- :class:`manatee_tpu.coord.memory.MemoryCoord` — in-process, for unit
+  tests and simulation (sessions expired programmatically);
+- ``coordd`` (:mod:`manatee_tpu.coord.server`) + the TCP client
+  (:mod:`manatee_tpu.coord.client`) — a real service with real session
+  timeouts, so multi-process clusters get ZooKeeper-like liveness
+  detection on machines without ZooKeeper;
+- a ZooKeeper backend can be slotted in later (kazoo/aiozk) without
+  touching anything above the API.
+
+:class:`manatee_tpu.coord.manager.ConsensusMgr` reimplements the
+zookeeperMgr contract on top: election join, active-list dedup/debounce,
+cluster-state watch, and transactional putClusterState with CAS.
+"""
+
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    ConnectionLossError,
+    CoordClient,
+    CoordError,
+    NodeExistsError,
+    NoNodeError,
+    NotEmptyError,
+    Op,
+    SessionExpiredError,
+    WatchEvent,
+)
+from manatee_tpu.coord.memory import CoordSpace, MemoryCoord
+from manatee_tpu.coord.manager import ConsensusMgr
+
+__all__ = [
+    "BadVersionError",
+    "ConnectionLossError",
+    "CoordClient",
+    "CoordError",
+    "NodeExistsError",
+    "NoNodeError",
+    "NotEmptyError",
+    "Op",
+    "SessionExpiredError",
+    "WatchEvent",
+    "CoordSpace",
+    "MemoryCoord",
+    "ConsensusMgr",
+]

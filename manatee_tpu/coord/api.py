@@ -1,0 +1,178 @@
+"""Client API for the coordination service — the znode data model.
+
+Semantics follow ZooKeeper (what lib/zookeeperMgr.js programs against):
+
+- versioned nodes with compare-and-set writes;
+- ephemeral nodes tied to a session, deleted when the session expires;
+- sequential nodes with a parent-scoped monotonic 10-digit suffix;
+- ONE-SHOT watches on data, existence, and children;
+- atomic multi-op transactions;
+- sessions that survive TCP disconnects and expire only after the
+  session timeout without contact.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable
+
+
+class CoordError(Exception):
+    pass
+
+
+class NoNodeError(CoordError):
+    pass
+
+
+class NodeExistsError(CoordError):
+    pass
+
+
+class BadVersionError(CoordError):
+    pass
+
+
+class NotEmptyError(CoordError):
+    pass
+
+
+class ConnectionLossError(CoordError):
+    pass
+
+
+class SessionExpiredError(CoordError):
+    pass
+
+
+class EventType(str, Enum):
+    CREATED = "created"
+    DELETED = "deleted"
+    DATA_CHANGED = "data_changed"
+    CHILDREN_CHANGED = "children_changed"
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: EventType
+    path: str
+
+
+WatchCb = Callable[[WatchEvent], None]
+
+
+@dataclass
+class Op:
+    """One operation in a multi() transaction."""
+    kind: str  # 'create' | 'set' | 'delete' | 'check'
+    path: str
+    data: bytes | None = None
+    version: int = -1
+    ephemeral: bool = False
+    sequential: bool = False
+
+    @classmethod
+    def create(cls, path: str, data: bytes, *, ephemeral: bool = False,
+               sequential: bool = False) -> "Op":
+        return cls("create", path, data, ephemeral=ephemeral,
+                   sequential=sequential)
+
+    @classmethod
+    def set(cls, path: str, data: bytes, version: int = -1) -> "Op":
+        return cls("set", path, data, version)
+
+    @classmethod
+    def delete(cls, path: str, version: int = -1) -> "Op":
+        return cls("delete", path, None, version)
+
+    @classmethod
+    def check(cls, path: str, version: int = -1) -> "Op":
+        return cls("check", path, None, version)
+
+
+@dataclass
+class Stat:
+    version: int
+    ephemeral_owner: str | None = None
+    num_children: int = 0
+
+
+class CoordClient(abc.ABC):
+    """The narrow interface everything above the coordination layer uses."""
+
+    # -- lifecycle --
+
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    @property
+    @abc.abstractmethod
+    def session_id(self) -> str | None: ...
+
+    @abc.abstractmethod
+    def on_session_event(self, cb: Callable[[str], None]) -> None:
+        """cb receives 'connected' | 'disconnected' | 'expired'."""
+
+    # -- znode ops --
+
+    @abc.abstractmethod
+    async def create(self, path: str, data: bytes = b"", *,
+                     ephemeral: bool = False,
+                     sequential: bool = False) -> str:
+        """Returns the actual path (with sequence suffix if sequential)."""
+
+    @abc.abstractmethod
+    async def get(self, path: str, watch: WatchCb | None = None
+                  ) -> tuple[bytes, int]: ...
+
+    @abc.abstractmethod
+    async def set(self, path: str, data: bytes, version: int = -1) -> int: ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str, version: int = -1) -> None: ...
+
+    @abc.abstractmethod
+    async def exists(self, path: str, watch: WatchCb | None = None
+                     ) -> Stat | None: ...
+
+    @abc.abstractmethod
+    async def get_children(self, path: str, watch: WatchCb | None = None
+                           ) -> list[str]: ...
+
+    @abc.abstractmethod
+    async def multi(self, ops: list[Op]) -> list: ...
+
+    # -- conveniences --
+
+    async def mkdirp(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            try:
+                await self.create(cur)
+            except NodeExistsError:
+                pass
+
+    async def delete_recursive(self, path: str) -> None:
+        try:
+            for child in await self.get_children(path):
+                await self.delete_recursive(path + "/" + child)
+            await self.delete(path)
+        except NoNodeError:
+            pass
+
+
+def validate_path(path: str) -> None:
+    if not path.startswith("/") or (len(path) > 1 and path.endswith("/")):
+        raise CoordError("invalid path: %r" % path)
+    if "//" in path:
+        raise CoordError("invalid path: %r" % path)
+    for comp in path.split("/")[1:]:
+        if comp in (".", ".."):
+            raise CoordError("invalid path: %r" % path)

@@ -1,0 +1,324 @@
+"""NetCoord — TCP client for coordd.
+
+Session semantics mirror what the reference's ZK client gives
+lib/zookeeperMgr.js: the session survives TCP disconnects; the client
+auto-reconnects and resumes it.  If the session cannot be resumed before
+it times out, a single 'expired' event fires and the client is dead —
+the layer above builds a fresh client (ConsensusMgr._setup_client, after
+lib/zookeeperMgr.js:560-570).
+
+Watch delivery across reconnects: armed one-shot watches are refired
+synthetically after a resume (the handler re-reads and re-arms, so a
+spurious event is harmless while a missed one would wedge the cluster).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import itertools
+import json
+import logging
+import time
+from typing import Callable
+
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    ConnectionLossError,
+    CoordClient,
+    CoordError,
+    EventType,
+    NodeExistsError,
+    NoNodeError,
+    NotEmptyError,
+    Op,
+    SessionExpiredError,
+    Stat,
+    WatchCb,
+    WatchEvent,
+)
+
+log = logging.getLogger("manatee.coord.client")
+
+_ERRS = {
+    "NoNodeError": NoNodeError,
+    "NodeExistsError": NodeExistsError,
+    "BadVersionError": BadVersionError,
+    "NotEmptyError": NotEmptyError,
+    "CoordError": CoordError,
+}
+
+RECONNECT_DELAY = 0.2
+MAX_LINE = 8 * 1024 * 1024  # must match coordd's stream limit
+
+
+class NetCoord(CoordClient):
+    def __init__(self, host: str, port: int, *,
+                 session_timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self._timeout = session_timeout
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._session_id: str | None = None
+        self._xids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[tuple[str, str], list[WatchCb]] = {}
+        self._session_cbs: list[Callable[[str], None]] = []
+        self._read_task: asyncio.Task | None = None
+        self._ping_task: asyncio.Task | None = None
+        self._reconnect_task: asyncio.Task | None = None
+        self._closed = False
+        self._expired = False
+        self._connected = asyncio.Event()
+
+    # ---- lifecycle ----
+
+    async def connect(self) -> None:
+        await self._open_conn(resume=False)
+
+    async def _open_conn(self, resume: bool) -> None:
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, limit=MAX_LINE)
+        self._reader, self._writer = reader, writer
+        self._read_task = asyncio.ensure_future(self._read_loop(reader))
+        hello: dict = {"op": "hello"}
+        if resume and self._session_id:
+            hello["session_id"] = self._session_id
+        else:
+            hello["session_timeout"] = self._timeout
+        res = await self._request(hello)
+        self._session_id = res["session_id"]
+        self._connected.set()
+        if self._ping_task is None or self._ping_task.done():
+            self._ping_task = asyncio.ensure_future(self._ping_loop())
+        self._notify("connected")
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in (self._read_task, self._ping_task, self._reconnect_task):
+            if t:
+                t.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, RuntimeError):
+                pass
+        self._fail_pending(ConnectionLossError("closed"))
+
+    @property
+    def session_id(self) -> str | None:
+        return None if self._expired else self._session_id
+
+    def on_session_event(self, cb: Callable[[str], None]) -> None:
+        self._session_cbs.append(cb)
+
+    def _notify(self, event: str) -> None:
+        for cb in list(self._session_cbs):
+            try:
+                cb(event)
+            except Exception:
+                log.exception("session callback failed")
+
+    # ---- wire ----
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                try:
+                    line = await reader.readline()
+                except ValueError:
+                    break  # response over the stream limit
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "watch" in msg:
+                    self._deliver_watch(msg["watch"])
+                    continue
+                fut = self._pending.pop(msg.get("xid"), None)
+                if fut and not fut.done():
+                    fut.set_result(msg)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            if not self._closed:
+                self._on_disconnect()
+
+    def _on_disconnect(self) -> None:
+        self._connected.clear()
+        self._fail_pending(ConnectionLossError("connection lost"))
+        if self._expired or self._closed:
+            return
+        self._notify("disconnected")
+        if self._reconnect_task is None or self._reconnect_task.done():
+            self._reconnect_task = asyncio.ensure_future(self._reconnect())
+
+    async def _reconnect(self) -> None:
+        deadline = time.monotonic() + self._timeout
+        while not self._closed and time.monotonic() < deadline:
+            await asyncio.sleep(RECONNECT_DELAY)
+            try:
+                await self._open_conn(resume=True)
+            except (ConnectionLossError, OSError):
+                continue         # transient: retry until deadline
+            except CoordError:
+                break            # server refused the session: expired
+            self._refire_watches()
+            return
+        if not self._closed:
+            self._expire()
+
+    def _expire(self) -> None:
+        if self._expired:
+            return
+        self._expired = True
+        self._watches.clear()
+        self._fail_pending(SessionExpiredError(self._session_id or "?"))
+        self._notify("expired")
+
+    def _fail_pending(self, err: Exception) -> None:
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    def _deliver_watch(self, w: dict) -> None:
+        key = (w.get("kind"), w.get("path"))
+        cbs = self._watches.pop(key, [])
+        try:
+            event = WatchEvent(EventType(w.get("type")), w.get("path"))
+        except ValueError:
+            return
+        for cb in cbs:
+            try:
+                cb(event)
+            except Exception:
+                log.exception("watch callback failed")
+
+    def _refire_watches(self) -> None:
+        """After a session resume the server-side watches are gone; fire
+        every armed watch so handlers re-read and re-arm."""
+        armed = self._watches
+        self._watches = {}
+        for (kind, path), cbs in armed.items():
+            ev = WatchEvent(EventType.DATA_CHANGED
+                            if kind == "data" else EventType.CHILDREN_CHANGED,
+                            path)
+            for cb in cbs:
+                try:
+                    cb(ev)
+                except Exception:
+                    log.exception("watch refire failed")
+
+    async def _ping_loop(self) -> None:
+        interval = max(self._timeout / 3.0, 0.05)
+        try:
+            while not self._closed and not self._expired:
+                await asyncio.sleep(interval)
+                if not self._connected.is_set():
+                    continue
+                try:
+                    await self._request({"op": "ping"})
+                except CoordError:
+                    pass
+        except asyncio.CancelledError:
+            pass
+
+    async def _request(self, req: dict) -> dict | list | str | int | None:
+        if self._expired:
+            raise SessionExpiredError(self._session_id or "?")
+        if self._writer is None or self._writer.is_closing():
+            raise ConnectionLossError("not connected")
+        xid = next(self._xids)
+        req["xid"] = xid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[xid] = fut
+        try:
+            self._writer.write((json.dumps(req) + "\n").encode())
+            await self._writer.drain()
+        except (ConnectionError, RuntimeError) as e:
+            self._pending.pop(xid, None)
+            raise ConnectionLossError(str(e)) from None
+        msg = await fut
+        if msg.get("ok"):
+            return msg.get("result")
+        raise _ERRS.get(msg.get("error"), CoordError)(msg.get("msg", ""))
+
+    # ---- ops ----
+
+    def _arm(self, kind: str, path: str, watch: WatchCb | None) -> bool:
+        if watch is None:
+            return False
+        self._watches.setdefault((kind, path), []).append(watch)
+        return True
+
+    async def create(self, path: str, data: bytes = b"", *,
+                     ephemeral: bool = False,
+                     sequential: bool = False) -> str:
+        return await self._request({
+            "op": "create", "path": path,
+            "data": base64.b64encode(data).decode(),
+            "ephemeral": ephemeral, "sequential": sequential})
+
+    async def get(self, path: str, watch: WatchCb | None = None
+                  ) -> tuple[bytes, int]:
+        armed = self._arm("data", path, watch)
+        try:
+            res = await self._request({"op": "get", "path": path,
+                                       "watch": armed})
+        except CoordError:
+            if armed:
+                self._watches[("data", path)].remove(watch)
+            raise
+        return base64.b64decode(res["data"]), res["version"]
+
+    async def set(self, path: str, data: bytes, version: int = -1) -> int:
+        return await self._request({
+            "op": "set", "path": path,
+            "data": base64.b64encode(data).decode(), "version": version})
+
+    async def delete(self, path: str, version: int = -1) -> None:
+        await self._request({"op": "delete", "path": path,
+                             "version": version})
+
+    async def exists(self, path: str, watch: WatchCb | None = None
+                     ) -> Stat | None:
+        armed = self._arm("data", path, watch)
+        try:
+            res = await self._request({"op": "exists", "path": path,
+                                       "watch": armed})
+        except CoordError:
+            if armed:
+                self._watches[("data", path)].remove(watch)
+            raise
+        if res is None:
+            return None
+        return Stat(version=res["version"],
+                    ephemeral_owner=res.get("ephemeral_owner"),
+                    num_children=res.get("num_children", 0))
+
+    async def get_children(self, path: str, watch: WatchCb | None = None
+                           ) -> list[str]:
+        armed = self._arm("children", path, watch)
+        try:
+            return await self._request({"op": "children", "path": path,
+                                        "watch": armed})
+        except CoordError:
+            if armed:
+                self._watches[("children", path)].remove(watch)
+            raise
+    async def multi(self, ops: list[Op]) -> list:
+        wire_ops = []
+        for op in ops:
+            wire_ops.append({
+                "kind": op.kind, "path": op.path,
+                "data": base64.b64encode(op.data or b"").decode(),
+                "version": op.version,
+                "ephemeral": op.ephemeral,
+                "sequential": op.sequential,
+            })
+        return await self._request({"op": "multi", "ops": wire_ops})

@@ -1,0 +1,316 @@
+"""ConsensusMgr — the rebuild of lib/zookeeperMgr.js.
+
+Owns all coordination-service interaction for one peer:
+
+- paths under the shard root (lib/zookeeperMgr.js:82-85):
+    <root>/election/<id>-NNNNNNNNNN   ephemeral-sequential membership
+    <root>/state                      versioned cluster-state node
+    <root>/history/<gen>-NNNNNNNNNN   persistent-sequential audit records
+- one-shot watches with automatic re-registration (:204-264);
+- stale-session dedup: a restarting peer leaves an older ephemeral
+  behind, so actives keep only the HIGHEST sequence per peer id,
+  sorted by id (parseAndUniqueActives, :168-200);
+- activeChange debounced when the id set is unchanged (idListsEqual,
+  :267-300);
+- putClusterState writes state + history node in one transaction with
+  an optimistic version check (:605-630);
+- full client teardown/rebuild on session expiry (:488-586).
+
+Events (emitted via registered callbacks, delivered on the event loop):
+    'init'               {'active': [...], 'clusterState': {...}|None}
+    'activeChange'       [ {id, ...data}, ... ]
+    'clusterStateChange' {...}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Awaitable, Callable
+
+from manatee_tpu.coord.api import (
+    ConnectionLossError,
+    CoordClient,
+    CoordError,
+    NoNodeError,
+    Op,
+    SessionExpiredError,
+)
+
+log = logging.getLogger("manatee.coord")
+
+RETRY_DELAY = 5.0  # re-register backoff on watch errors (zookeeperMgr.js:253)
+
+
+def parse_and_unique_actives(names: list[str]) -> list[dict]:
+    """['a-10','b-25','a-5'] -> [{'id':'a','seq':10,'name':'a-10'}, ...]
+    keeping only the newest (highest-seq) entry per id, sorted by id."""
+    best: dict[str, dict] = {}
+    for n in names:
+        idx = n.rfind("-")
+        if idx <= 0:
+            continue
+        try:
+            seq = int(n[idx + 1:], 10)
+        except ValueError:
+            continue
+        ent = {"id": n[:idx], "seq": seq, "name": n}
+        if ent["id"] not in best or seq > best[ent["id"]]["seq"]:
+            best[ent["id"]] = ent
+    return [best[k] for k in sorted(best)]
+
+
+def _id_lists_equal(a: list[dict] | None, b: list[dict] | None) -> bool:
+    if a is None or b is None:
+        return False
+    return [x["id"] for x in a] == [x["id"] for x in b]
+
+
+class ConsensusMgr:
+    def __init__(
+        self,
+        *,
+        client_factory: Callable[[], Awaitable[CoordClient]],
+        path: str,
+        ident: str,
+        data: dict,
+    ):
+        """*ident* is the peer id (ip:pgPort:backupPort in the reference,
+        lib/shard.js:39-54); *data* is the member payload (zoneId, ip,
+        pgUrl, backupUrl)."""
+        self._factory = client_factory
+        root = path.rstrip("/")
+        self._election_path = root + "/election"
+        self._history_path = root + "/history"
+        self._state_path = root + "/state"
+        self._ident = ident
+        self._data = data
+
+        self._client: CoordClient | None = None
+        self._inited = False
+        self._closed = False
+        self._active: list[dict] = []
+        self._cluster_state: dict | None = None
+        self._cluster_state_version: int | None = None
+        self._listeners: dict[str, list[Callable]] = {}
+        self._lock = asyncio.Lock()   # serializes watch handlers
+        self._setup_task: asyncio.Task | None = None
+        self._generation_of_setup = 0
+
+    # ---- events ----
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def _emit(self, event: str, payload) -> None:
+        for cb in self._listeners.get(event, []):
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                cb(payload)
+                continue
+            loop.call_soon(cb, payload)
+
+    # ---- public accessors (zookeeperMgr getters) ----
+
+    @property
+    def active(self) -> list[dict]:
+        out = []
+        for a in self._active:
+            c = {"id": a["id"]}
+            c.update(a.get("data") or {})
+            out.append(c)
+        return out
+
+    @property
+    def cluster_state(self) -> dict | None:
+        return self._cluster_state
+
+    @property
+    def status(self) -> str:
+        if self._client is None:
+            return "UNINIT"
+        if self._closed:
+            return "CLOSED"
+        return "CONNECTED" if self._client.session_id else "DISCONNECTED"
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        await self._setup_client()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._client:
+            try:
+                await self._client.close()
+            except CoordError:
+                pass
+
+    async def _setup_client(self) -> None:
+        """(Re)build the client and all coordination state — the analogue of
+        setupZkClient + setupData (lib/zookeeperMgr.js:488-586)."""
+        self._generation_of_setup += 1
+        gen = self._generation_of_setup
+        while not self._closed:
+            try:
+                client = await self._factory()
+                self._client = client
+
+                def on_session(ev: str, _gen=gen):
+                    if ev == "expired" and not self._closed \
+                            and _gen == self._generation_of_setup:
+                        log.info("coord session expired; rebuilding client")
+                        self._schedule_resetup()
+
+                client.on_session_event(on_session)
+                await self._setup_data(client)
+                return
+            except CoordError as e:
+                log.warning("coord setup failed (%s); retrying in %.1fs",
+                            e, RETRY_DELAY)
+                await asyncio.sleep(RETRY_DELAY)
+
+    def _schedule_resetup(self) -> None:
+        if self._setup_task and not self._setup_task.done():
+            return
+        self._setup_task = asyncio.ensure_future(self._setup_client())
+
+    async def _setup_data(self, client: CoordClient) -> None:
+        """mkdirp directories, watch state, join election, watch election
+        (setupData, lib/zookeeperMgr.js:419-471)."""
+        await client.mkdirp(self._election_path)
+        await client.mkdirp(self._history_path)
+        await self._read_state_and_watch(client)
+        await client.create(
+            self._election_path + "/" + self._ident + "-",
+            json.dumps(self._data).encode(),
+            ephemeral=True, sequential=True)
+        await self._read_active_and_watch(client)
+        if not self._inited:
+            self._inited = True
+            self._emit("init", {
+                "active": self.active,
+                "clusterState": self._cluster_state,
+            })
+
+    # ---- state watch ----
+
+    def _make_watch(self, handler: Callable[[CoordClient], Awaitable[None]],
+                    client: CoordClient):
+        """One-shot watch callback that re-reads and re-registers, retrying
+        on errors (watch(), lib/zookeeperMgr.js:204-264)."""
+
+        def fired(_event):
+            if self._closed or client is not self._client:
+                return
+
+            async def rearm():
+                async with self._lock:
+                    if self._closed or client is not self._client:
+                        return
+                    try:
+                        await handler(client)
+                    except (ConnectionLossError, SessionExpiredError):
+                        pass  # session path handles teardown/rebuild
+                    except CoordError as e:
+                        log.warning("watch handler error on %s: %s; retrying",
+                                    handler.__name__, e)
+                        await asyncio.sleep(RETRY_DELAY)
+                        fired(None)
+
+            asyncio.ensure_future(rearm())
+
+        return fired
+
+    async def _read_state_and_watch(self, client: CoordClient) -> None:
+        handler = self._read_state_and_watch_inner
+        watch_cb = self._make_watch(handler, client)
+        try:
+            data, version = await client.get(self._state_path, watch=watch_cb)
+        except NoNodeError:
+            # not created yet: watch for its creation via exists; if it was
+            # created while we looked away, plain-read it (the watch is
+            # already armed — zookeeperMgr.js:227-236)
+            stat = await client.exists(self._state_path, watch=watch_cb)
+            if stat is not None:
+                data, version = await client.get(self._state_path)
+            else:
+                return
+        self._handle_cluster_state(data, version)
+
+    async def _read_state_and_watch_inner(self, client: CoordClient) -> None:
+        await self._read_state_and_watch(client)
+
+    def _handle_cluster_state(self, data: bytes, version: int) -> None:
+        try:
+            state = json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError):
+            log.error("unparseable cluster state at v%s", version)
+            return
+        changed = version != self._cluster_state_version
+        self._cluster_state = state
+        self._cluster_state_version = version
+        if self._inited and changed:
+            self._emit("clusterStateChange", state)
+
+    # ---- active watch ----
+
+    async def _read_active_and_watch(self, client: CoordClient) -> None:
+        handler = self._read_active_and_watch_inner
+        watch_cb = self._make_watch(handler, client)
+        names = await client.get_children(self._election_path, watch=watch_cb)
+        await self._handle_active(client, names)
+
+    async def _read_active_and_watch_inner(self, client: CoordClient) -> None:
+        await self._read_active_and_watch(client)
+
+    async def _handle_active(self, client: CoordClient,
+                             names: list[str]) -> None:
+        """Dedup, fetch member data (with id+seq cache), debounce, emit
+        (handleActive, lib/zookeeperMgr.js:307-386)."""
+        active = parse_and_unique_actives(names)
+        cache = {a["id"]: a for a in self._active}
+        for ent in active:
+            cached = cache.get(ent["id"])
+            if cached and cached["seq"] == ent["seq"]:
+                ent["data"] = cached.get("data")
+                continue
+            try:
+                data, _v = await client.get(
+                    self._election_path + "/" + ent["name"])
+                ent["data"] = json.loads(data.decode())
+            except NoNodeError:
+                ent["data"] = {}
+            except (ValueError, UnicodeDecodeError):
+                ent["data"] = {}
+        should_debounce = _id_lists_equal(self._active, active)
+        self._active = active
+        if self._inited and not should_debounce:
+            self._emit("activeChange", self.active)
+
+    # ---- putClusterState ----
+
+    async def put_cluster_state(self, state: dict) -> None:
+        """Write state + history atomically with optimistic versioning
+        (putClusterState, lib/zookeeperMgr.js:605-630).  Raises
+        BadVersionError on CAS conflict."""
+        if self._client is None:
+            raise ConnectionLossError("not connected")
+        if "generation" not in state:
+            raise CoordError("cluster state requires a generation")
+        data = json.dumps(state).encode()
+        ops = [Op.create(
+            "%s/%d-" % (self._history_path, int(state["generation"])),
+            data, sequential=True)]
+        if self._cluster_state is not None \
+                and self._cluster_state_version is not None:
+            ops.append(Op.set(self._state_path, data,
+                              self._cluster_state_version))
+        else:
+            ops.append(Op.create(self._state_path, data))
+        res = await self._client.multi(ops)
+        self._cluster_state = state
+        # the set op reports the new version; a fresh create starts at 0
+        self._cluster_state_version = res[1] if isinstance(res[1], int) else 0

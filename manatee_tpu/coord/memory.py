@@ -1,0 +1,149 @@
+"""In-process coordination backend for unit tests and simulation.
+
+A :class:`CoordSpace` is the moral equivalent of a ZooKeeper ensemble:
+it owns one znode tree.  Each :class:`MemoryCoord` client gets its own
+session; tests drive failure scenarios by expiring sessions
+(``space.expire(client)``) exactly where the real system would see a
+dead peer's ephemeral nodes vanish.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from manatee_tpu.coord import model
+from manatee_tpu.coord.api import (
+    CoordClient,
+    Op,
+    SessionExpiredError,
+    Stat,
+    WatchCb,
+)
+
+
+class CoordSpace:
+    def __init__(self):
+        self.tree = model.ZNodeTree()
+
+    def client(self, session_timeout: float = 60.0) -> "MemoryCoord":
+        return MemoryCoord(self, session_timeout)
+
+    def expire(self, client: "MemoryCoord") -> None:
+        """Simulate session expiry for *client* (peer death as seen by the
+        ensemble)."""
+        client._expire()
+
+
+class MemoryCoord(CoordClient):
+    def __init__(self, space: CoordSpace, session_timeout: float):
+        self._space = space
+        self._timeout = session_timeout
+        self._session: model.Session | None = None
+        self._session_cbs: list[Callable[[str], None]] = []
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # ---- lifecycle ----
+
+    async def connect(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._session = self._space.tree.create_session(self._timeout)
+        self._notify("connected")
+
+    async def close(self) -> None:
+        # closing a ZK session removes its ephemerals immediately
+        if self._session and not self._session.expired:
+            self._space.tree.remove_watches_for(
+                lambda w: getattr(w, "__owner__", None) is self)
+            self._space.tree.expire_session(self._session.id)
+
+    @property
+    def session_id(self) -> str | None:
+        return self._session.id if self._session else None
+
+    def on_session_event(self, cb: Callable[[str], None]) -> None:
+        self._session_cbs.append(cb)
+
+    def _notify(self, event: str) -> None:
+        for cb in list(self._session_cbs):
+            self._call_soon(cb, event)
+
+    def _call_soon(self, cb, *args) -> None:
+        loop = self._loop or asyncio.get_event_loop()
+        loop.call_soon(cb, *args)
+
+    def _expire(self) -> None:
+        if self._session and not self._session.expired:
+            # drop this client's own watches first: a session does not
+            # observe its own ephemerals vanishing (it is dead)
+            self._space.tree.remove_watches_for(
+                lambda w: getattr(w, "__owner__", None) is self)
+            self._space.tree.expire_session(self._session.id)
+            self._notify("expired")
+
+    def _check(self) -> None:
+        if not self._session:
+            raise SessionExpiredError("not connected")
+        if self._session.expired:
+            raise SessionExpiredError(self._session.id)
+        self._space.tree.touch_session(self._session.id)
+
+    def _wrap_watch(self, watch: WatchCb | None):
+        if watch is None:
+            return None
+
+        def sink(event):
+            # deliver asynchronously, and only while our session lives
+            if self._session and not self._session.expired:
+                self._call_soon(watch, event)
+
+        sink.__owner__ = self
+        return sink
+
+    # ---- ops ----
+
+    async def create(self, path: str, data: bytes = b"", *,
+                     ephemeral: bool = False,
+                     sequential: bool = False) -> str:
+        self._check()
+        return self._space.tree.create(
+            path, data,
+            ephemeral_owner=self._session.id if ephemeral else None,
+            sequential=sequential)
+
+    async def get(self, path: str, watch: WatchCb | None = None
+                  ) -> tuple[bytes, int]:
+        self._check()
+        data, version = self._space.tree.get(path)
+        if watch:
+            self._space.tree.add_watch(model.DATA, path, self._wrap_watch(watch))
+        return data, version
+
+    async def set(self, path: str, data: bytes, version: int = -1) -> int:
+        self._check()
+        return self._space.tree.set(path, data, version)
+
+    async def delete(self, path: str, version: int = -1) -> None:
+        self._check()
+        self._space.tree.delete(path, version)
+
+    async def exists(self, path: str, watch: WatchCb | None = None
+                     ) -> Stat | None:
+        self._check()
+        stat = self._space.tree.exists(path)
+        if watch:
+            self._space.tree.add_watch(model.DATA, path, self._wrap_watch(watch))
+        return stat
+
+    async def get_children(self, path: str, watch: WatchCb | None = None
+                           ) -> list[str]:
+        self._check()
+        children = self._space.tree.get_children(path)
+        if watch:
+            self._space.tree.add_watch(
+                model.CHILDREN, path, self._wrap_watch(watch))
+        return children
+
+    async def multi(self, ops: list[Op]) -> list:
+        self._check()
+        return self._space.tree.multi(ops, session_id=self._session.id)

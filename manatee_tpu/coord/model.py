@@ -1,0 +1,301 @@
+"""The znode tree: single-threaded core shared by the in-memory backend
+and the coordd server.
+
+All mutation goes through this class; watch callbacks are invoked
+synchronously after a successful mutation (callers deliver them to the
+right place — the memory backend schedules them on the event loop, coordd
+pushes them down client connections).  Watches are ONE-SHOT, like
+ZooKeeper's: triggering removes them.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    CoordError,
+    EventType,
+    NodeExistsError,
+    NoNodeError,
+    NotEmptyError,
+    Op,
+    Stat,
+    WatchEvent,
+    validate_path,
+)
+
+
+@dataclass
+class _Node:
+    data: bytes = b""
+    version: int = 0
+    ephemeral_owner: str | None = None
+    seq_counter: int = 0
+    children: dict[str, "_Node"] = field(default_factory=dict)
+
+
+# watch kinds
+DATA = "data"      # fires on data change / delete / create (set via get/exists)
+CHILDREN = "children"
+
+# (kind, path) -> list of callbacks
+WatchSink = Callable[[WatchEvent], None]
+
+
+@dataclass
+class Session:
+    id: str
+    timeout: float                 # seconds
+    last_seen: float = field(default_factory=time.monotonic)
+    connected: bool = True
+    expired: bool = False
+
+    def deadline(self) -> float:
+        return self.last_seen + self.timeout
+
+
+class ZNodeTree:
+    def __init__(self):
+        self._root = _Node()
+        self._watches: dict[tuple[str, str], list[WatchSink]] = {}
+        self.sessions: dict[str, Session] = {}
+        self._session_counter = 0
+
+    # ---- sessions ----
+
+    def create_session(self, timeout: float) -> Session:
+        self._session_counter += 1
+        sid = "s%08x-%04d" % (int(time.time()) & 0xFFFFFFFF, self._session_counter)
+        s = Session(id=sid, timeout=timeout)
+        self.sessions[sid] = s
+        return s
+
+    def touch_session(self, sid: str) -> None:
+        s = self.sessions.get(sid)
+        if s and not s.expired:
+            s.last_seen = time.monotonic()
+
+    def expire_session(self, sid: str) -> None:
+        """Remove the session and all its ephemeral nodes (firing watches)."""
+        s = self.sessions.get(sid)
+        if not s or s.expired:
+            return
+        s.expired = True
+        s.connected = False
+        for path in self._ephemerals_of(sid):
+            try:
+                self.delete(path, -1, force_ephemeral=True)
+            except CoordError:
+                pass
+
+    def expired_sessions(self, now: float | None = None) -> list[str]:
+        now = time.monotonic() if now is None else now
+        return [sid for sid, s in self.sessions.items()
+                if not s.expired and not s.connected and s.deadline() <= now]
+
+    def _ephemerals_of(self, sid: str) -> list[str]:
+        out: list[str] = []
+
+        def walk(node: _Node, path: str):
+            for name, child in node.children.items():
+                cpath = (path if path != "/" else "") + "/" + name
+                if child.ephemeral_owner == sid:
+                    out.append(cpath)
+                walk(child, cpath)
+
+        walk(self._root, "/")
+        return out
+
+    # ---- watches ----
+
+    def add_watch(self, kind: str, path: str, sink: WatchSink) -> None:
+        self._watches.setdefault((kind, path), []).append(sink)
+
+    def remove_watches_for(self, predicate: Callable[[WatchSink], bool]) -> None:
+        for key in list(self._watches):
+            self._watches[key] = [w for w in self._watches[key]
+                                  if not predicate(w)]
+            if not self._watches[key]:
+                del self._watches[key]
+
+    def _fire(self, kind: str, path: str, event: WatchEvent) -> None:
+        sinks = self._watches.pop((kind, path), [])
+        for sink in sinks:
+            try:
+                sink(event)
+            except Exception:
+                pass
+
+    # ---- tree navigation ----
+
+    def _resolve(self, path: str) -> _Node:
+        node = self._root
+        for comp in [c for c in path.split("/") if c]:
+            if comp not in node.children:
+                raise NoNodeError(path)
+            node = node.children[comp]
+        return node
+
+    def _parent_of(self, path: str) -> tuple[_Node, str]:
+        validate_path(path)
+        if path == "/":
+            raise CoordError("cannot operate on /")
+        parent_path, _, name = path.rpartition("/")
+        parent = self._resolve(parent_path or "/")
+        return parent, name
+
+    # ---- ops ----
+
+    def create(self, path: str, data: bytes = b"", *,
+               ephemeral_owner: str | None = None,
+               sequential: bool = False) -> str:
+        parent, name = self._parent_of(path)
+        if parent.ephemeral_owner is not None:
+            # ZK forbids children under ephemeral nodes; allowing them
+            # would let an ephemeral dodge deletion at session expiry
+            raise CoordError("ephemeral nodes cannot have children: %s"
+                             % path)
+        parent_path = path.rpartition("/")[0] or "/"
+        if sequential:
+            name = "%s%010d" % (name, parent.seq_counter)
+            parent.seq_counter += 1
+            path = (parent_path if parent_path != "/" else "") + "/" + name
+        if name in parent.children:
+            raise NodeExistsError(path)
+        parent.children[name] = _Node(
+            data=bytes(data), ephemeral_owner=ephemeral_owner)
+        self._fire(DATA, path, WatchEvent(EventType.CREATED, path))
+        self._fire(CHILDREN, parent_path,
+                   WatchEvent(EventType.CHILDREN_CHANGED, parent_path))
+        return path
+
+    def get(self, path: str) -> tuple[bytes, int]:
+        validate_path(path)
+        node = self._resolve(path)
+        return node.data, node.version
+
+    def set(self, path: str, data: bytes, version: int = -1) -> int:
+        validate_path(path)
+        node = self._resolve(path)
+        if version != -1 and node.version != version:
+            raise BadVersionError("%s: expected v%d, have v%d"
+                                  % (path, version, node.version))
+        node.data = bytes(data)
+        node.version += 1
+        self._fire(DATA, path, WatchEvent(EventType.DATA_CHANGED, path))
+        return node.version
+
+    def delete(self, path: str, version: int = -1, *,
+               force_ephemeral: bool = False) -> None:
+        parent, name = self._parent_of(path)
+        if name not in parent.children:
+            raise NoNodeError(path)
+        node = parent.children[name]
+        if version != -1 and node.version != version:
+            raise BadVersionError(path)
+        if node.children:
+            if not force_ephemeral:
+                raise NotEmptyError(path)
+            # ephemeral nodes cannot have children in ZK; defensive only
+            raise NotEmptyError(path)
+        del parent.children[name]
+        parent_path = path.rpartition("/")[0] or "/"
+        self._fire(DATA, path, WatchEvent(EventType.DELETED, path))
+        self._fire(CHILDREN, parent_path,
+                   WatchEvent(EventType.CHILDREN_CHANGED, parent_path))
+
+    def exists(self, path: str) -> Stat | None:
+        validate_path(path)
+        try:
+            node = self._resolve(path)
+        except NoNodeError:
+            return None
+        return Stat(version=node.version,
+                    ephemeral_owner=node.ephemeral_owner,
+                    num_children=len(node.children))
+
+    def get_children(self, path: str) -> list[str]:
+        validate_path(path)
+        node = self._resolve(path)
+        return sorted(node.children.keys())
+
+    # ---- transactions ----
+
+    def multi(self, ops: list[Op], *, session_id: str | None = None) -> list:
+        """Atomic: validate everything would succeed, then apply.  Mirrors
+        the ZK transaction used by putClusterState
+        (lib/zookeeperMgr.js:605-630)."""
+        # Validate against a virtual view: track created/deleted paths and
+        # version bumps without mutating the tree.
+        virtual_exists: dict[str, bool] = {}
+        virtual_version: dict[str, int] = {}
+
+        def v_exists(path: str) -> bool:
+            if path in virtual_exists:
+                return virtual_exists[path]
+            return self.exists(path) is not None
+
+        def v_version(path: str) -> int:
+            if path in virtual_version:
+                return virtual_version[path]
+            node = self._resolve(path)
+            return node.version
+
+        for op in ops:
+            validate_path(op.path)
+            if op.kind == "create":
+                parent = op.path.rpartition("/")[0] or "/"
+                if not v_exists(parent):
+                    raise NoNodeError(parent)
+                if not op.sequential and v_exists(op.path):
+                    raise NodeExistsError(op.path)
+                if not op.sequential:
+                    virtual_exists[op.path] = True
+                    virtual_version[op.path] = 0
+            elif op.kind in ("set", "check"):
+                if not v_exists(op.path):
+                    raise NoNodeError(op.path)
+                if op.version != -1 and v_version(op.path) != op.version:
+                    raise BadVersionError(op.path)
+                if op.kind == "set":
+                    virtual_version[op.path] = v_version(op.path) + 1
+            elif op.kind == "delete":
+                if not v_exists(op.path):
+                    raise NoNodeError(op.path)
+                if op.version != -1 and v_version(op.path) != op.version:
+                    raise BadVersionError(op.path)
+                stat = self.exists(op.path)
+                real_children = (set(self.get_children(op.path))
+                                 if stat is not None else set())
+                prefix = op.path + "/"
+                for vpath, vexists in virtual_exists.items():
+                    if vpath.startswith(prefix) \
+                            and "/" not in vpath[len(prefix):]:
+                        name = vpath[len(prefix):]
+                        (real_children.add if vexists
+                         else real_children.discard)(name)
+                if real_children:
+                    raise NotEmptyError(op.path)
+                virtual_exists[op.path] = False
+            else:
+                raise CoordError("bad op kind: %r" % op.kind)
+
+        # Apply for real.
+        results: list = []
+        for op in ops:
+            if op.kind == "create":
+                results.append(self.create(
+                    op.path, op.data or b"",
+                    ephemeral_owner=session_id if op.ephemeral else None,
+                    sequential=op.sequential))
+            elif op.kind == "set":
+                results.append(self.set(op.path, op.data or b"", op.version))
+            elif op.kind == "delete":
+                self.delete(op.path, op.version)
+                results.append(None)
+            elif op.kind == "check":
+                results.append(None)
+        return results

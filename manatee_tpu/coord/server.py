@@ -1,0 +1,289 @@
+"""coordd — the coordination service daemon.
+
+Plays the role ZooKeeper plays for the reference: znode tree with
+versioned CAS writes, ephemeral-sequential nodes, one-shot watches,
+transactions, and session-timeout liveness (a SIGKILLed peer's ephemeral
+nodes vanish only after its session times out, which is exactly the
+failure-detection path of SURVEY.md §5.3).
+
+Wire protocol: newline-delimited JSON over TCP.
+
+  client -> server   {"xid": 1, "op": "create", "path": "/a", "data": "<b64>",
+                      "ephemeral": true, "sequential": true}
+  server -> client   {"xid": 1, "ok": true, "result": "/a0000000001"}
+                     {"xid": 1, "ok": false, "error": "NoNodeError", "msg": "..."}
+  watch push         {"watch": {"kind": "data", "type": "deleted", "path": "/a"}}
+
+Sessions: ``hello`` creates (or resumes) a session; a dropped TCP
+connection leaves the session alive until ``session_timeout`` elapses.
+In production this daemon would run as an ensemble; for the single-host
+deployments this rebuild targets it runs as one process (the reference
+likewise tolerates a single-node ZK in dev, docs/working-on-manatee.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import logging
+import signal
+import time
+
+from manatee_tpu.coord import model
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    CoordError,
+    NodeExistsError,
+    NoNodeError,
+    NotEmptyError,
+    Op,
+)
+
+log = logging.getLogger("manatee.coordd")
+
+_ERR_NAMES = {
+    NoNodeError: "NoNodeError",
+    NodeExistsError: "NodeExistsError",
+    BadVersionError: "BadVersionError",
+    NotEmptyError: "NotEmptyError",
+}
+
+MAX_LINE = 8 * 1024 * 1024
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _unb64(s: str | None) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+class _Conn:
+    def __init__(self, server: "CoordServer", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.session: model.Session | None = None
+        self.alive = True
+
+    def push(self, msg: dict) -> None:
+        if not self.alive:
+            return
+        try:
+            self.writer.write((json.dumps(msg) + "\n").encode())
+        except (ConnectionError, RuntimeError):
+            self.alive = False
+
+    def watch_sink(self, kind: str):
+        def sink(event):
+            self.push({"watch": {"kind": kind, "type": event.type.value,
+                                 "path": event.path}})
+        sink.__owner__ = self
+        return sink
+
+
+class CoordServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 tick: float = 0.25):
+        self.host = host
+        self.port = port
+        self.tick = tick
+        self.tree = model.ZNodeTree()
+        self._server: asyncio.AbstractServer | None = None
+        self._expiry_task: asyncio.Task | None = None
+        self._conns: set[_Conn] = set()
+        # session id -> live conn (one at a time)
+        self._session_conns: dict[str, _Conn] = {}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port, limit=MAX_LINE)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.ensure_future(self._expiry_loop())
+        log.info("coordd listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._expiry_task:
+            self._expiry_task.cancel()
+        # close live connections BEFORE wait_closed(): since 3.12 it waits
+        # for every connection handler to finish
+        for conn in list(self._conns):
+            conn.alive = False
+            try:
+                conn.writer.transport.abort()
+            except (AttributeError, RuntimeError):
+                conn.writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _expiry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick)
+            for sid in self.tree.expired_sessions():
+                log.info("session %s expired", sid)
+                self.tree.expire_session(sid)
+                self.tree.sessions.pop(sid, None)
+                self._session_conns.pop(sid, None)
+
+    # ---- per-connection ----
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                try:
+                    line = await reader.readline()
+                except (ConnectionError, ValueError):
+                    # ValueError = line over the stream limit
+                    break
+                if not line:
+                    break
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    conn.push({"ok": False, "error": "CoordError",
+                               "msg": "bad json"})
+                    continue
+                await self._dispatch(conn, req)
+                try:
+                    await writer.drain()
+                except (ConnectionError, RuntimeError):
+                    break
+        finally:
+            conn.alive = False
+            self._conns.discard(conn)
+            # the session survives the connection; watches don't
+            self.tree.remove_watches_for(
+                lambda w: getattr(w, "__owner__", None) is conn)
+            if conn.session and not conn.session.expired \
+                    and self._session_conns.get(conn.session.id) is conn:
+                # only if the session wasn't already resumed elsewhere
+                del self._session_conns[conn.session.id]
+                conn.session.connected = False
+                conn.session.last_seen = time.monotonic()
+            writer.close()
+
+    async def _dispatch(self, conn: _Conn, req: dict) -> None:
+        xid = req.get("xid")
+        op = req.get("op")
+        try:
+            if op == "hello":
+                result = self._op_hello(conn, req)
+            elif conn.session is None or conn.session.expired:
+                raise CoordError("no session (hello first)")
+            else:
+                self.tree.touch_session(conn.session.id)
+                result = self._op(conn, op, req)
+            conn.push({"xid": xid, "ok": True, "result": result})
+        except CoordError as e:
+            conn.push({"xid": xid, "ok": False,
+                       "error": _ERR_NAMES.get(type(e), "CoordError"),
+                       "msg": str(e)})
+        except Exception as e:
+            # malformed-but-valid-JSON requests must get an error reply,
+            # not kill the connection
+            log.warning("bad request %r: %s", op, e)
+            conn.push({"xid": xid, "ok": False, "error": "CoordError",
+                       "msg": "bad request: %s" % e})
+
+    def _op_hello(self, conn: _Conn, req: dict):
+        sid = req.get("session_id")
+        if sid:
+            sess = self.tree.sessions.get(sid)
+            if not sess or sess.expired:
+                raise CoordError("session expired: %s" % sid)
+            old = self._session_conns.get(sid)
+            if old and old is not conn:
+                old.alive = False
+                old.writer.close()
+        else:
+            timeout = float(req.get("session_timeout", 60.0))
+            sess = self.tree.create_session(timeout)
+        sess.connected = True
+        sess.last_seen = time.monotonic()
+        conn.session = sess
+        self._session_conns[sess.id] = conn
+        return {"session_id": sess.id, "session_timeout": sess.timeout}
+
+    def _op(self, conn: _Conn, op: str, req: dict):
+        tree = self.tree
+        path = req.get("path", "")
+        if op == "ping":
+            return "pong"
+        if op == "create":
+            return tree.create(
+                path, _unb64(req.get("data")),
+                ephemeral_owner=(conn.session.id if req.get("ephemeral")
+                                 else None),
+                sequential=bool(req.get("sequential")))
+        if op == "get":
+            data, version = tree.get(path)
+            if req.get("watch"):
+                tree.add_watch(model.DATA, path, conn.watch_sink(model.DATA))
+            return {"data": _b64(data), "version": version}
+        if op == "set":
+            return tree.set(path, _unb64(req.get("data")),
+                            int(req.get("version", -1)))
+        if op == "delete":
+            tree.delete(path, int(req.get("version", -1)))
+            return None
+        if op == "exists":
+            if req.get("watch"):
+                tree.add_watch(model.DATA, path, conn.watch_sink(model.DATA))
+            stat = tree.exists(path)
+            if stat is None:
+                return None
+            return {"version": stat.version,
+                    "ephemeral_owner": stat.ephemeral_owner,
+                    "num_children": stat.num_children}
+        if op == "children":
+            names = tree.get_children(path)
+            if req.get("watch"):
+                tree.add_watch(model.CHILDREN, path,
+                               conn.watch_sink(model.CHILDREN))
+            return names
+        if op == "multi":
+            ops = []
+            for o in req.get("ops", []):
+                ops.append(Op(
+                    kind=o["kind"], path=o["path"],
+                    data=_unb64(o.get("data")),
+                    version=int(o.get("version", -1)),
+                    ephemeral=bool(o.get("ephemeral")),
+                    sequential=bool(o.get("sequential"))))
+            return tree.multi(ops, session_id=conn.session.id)
+        raise CoordError("unknown op: %r" % op)
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="manatee coordination daemon")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2281)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    async def run():
+        server = CoordServer(args.host, args.port)
+        await server.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ import asyncio
 import re
 import time
 from dataclasses import dataclass
-from typing import Awaitable, Callable
+from typing import Callable
 
 
 class StorageError(Exception):

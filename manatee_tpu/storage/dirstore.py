@@ -83,6 +83,11 @@ class DirBackend(StorageBackend):
     async def create(self, dataset: str, *, mountpoint: str | None = None) -> None:
         if self._exists_sync(dataset):
             raise StorageError("dataset exists: %s" % dataset)
+        if "/" in dataset and not self._exists_sync(dataset.rpartition("/")[0]):
+            # zfs parity: the parent dataset must exist (a bare top-level
+            # name plays the role of a pool root)
+            raise StorageError("parent dataset does not exist: %s"
+                               % dataset.rpartition("/")[0])
         p = self._dspath(dataset)
         (p / "@data").mkdir(parents=True)
         (p / "@snapshots").mkdir()
@@ -191,7 +196,11 @@ class DirBackend(StorageBackend):
         meta = self._load_meta(dataset)
         mp = meta.get("mountpoint")
         if mp and Path(mp).is_symlink():
-            os.unlink(mp)
+            # only unlink if the mountpoint is OUR mount — another dataset
+            # may own that path now
+            ours = str((self._dspath(dataset) / "@data").resolve())
+            if os.path.realpath(mp) == ours:
+                os.unlink(mp)
         meta["mounted"] = False
         self._save_meta(dataset, meta)
 

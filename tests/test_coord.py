@@ -1,0 +1,430 @@
+"""Coordination-layer tests.
+
+Mirrors the reference's test/zookeeperMgr.test.js suite (join/active
+dedup, state create/update, membership add/remove, debounce, history-node
+writes, CAS failure of putClusterState — exports at :186-691) but runs
+against both the in-memory backend and a real coordd server over TCP,
+including session-expiry liveness that the reference can only get from a
+live ZooKeeper.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from manatee_tpu.coord import (
+    BadVersionError,
+    ConsensusMgr,
+    CoordSpace,
+    MemoryCoord,
+    NodeExistsError,
+    NoNodeError,
+    NotEmptyError,
+    Op,
+)
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.manager import parse_and_unique_actives
+from manatee_tpu.coord.server import CoordServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------- znode model via MemoryCoord ----------
+
+def test_basic_node_ops():
+    async def go():
+        space = CoordSpace()
+        c = space.client()
+        await c.connect()
+        await c.create("/a", b"one")
+        data, v = await c.get("/a")
+        assert (data, v) == (b"one", 0)
+        v = await c.set("/a", b"two", 0)
+        assert v == 1
+        with pytest.raises(BadVersionError):
+            await c.set("/a", b"three", 0)
+        with pytest.raises(NoNodeError):
+            await c.get("/nope")
+        with pytest.raises(NodeExistsError):
+            await c.create("/a")
+        await c.create("/a/b")
+        with pytest.raises(NotEmptyError):
+            await c.delete("/a")
+        await c.delete("/a/b")
+        await c.delete("/a")
+        assert await c.exists("/a") is None
+    run(go())
+
+
+def test_sequential_and_ephemeral():
+    async def go():
+        space = CoordSpace()
+        c1 = space.client()
+        c2 = space.client()
+        await c1.connect()
+        await c2.connect()
+        await c1.mkdirp("/el")
+        p1 = await c1.create("/el/peer1-", b"d1", ephemeral=True,
+                             sequential=True)
+        p2 = await c2.create("/el/peer2-", b"d2", ephemeral=True,
+                             sequential=True)
+        assert p1 == "/el/peer1-0000000000"
+        assert p2 == "/el/peer2-0000000001"
+        assert await c1.get_children("/el") == sorted(
+            ["peer1-0000000000", "peer2-0000000001"])
+        # expiring c1's session removes only its ephemerals
+        space.expire(c1)
+        assert await c2.get_children("/el") == ["peer2-0000000001"]
+    run(go())
+
+
+def test_one_shot_watches():
+    async def go():
+        space = CoordSpace()
+        c1, c2 = space.client(), space.client()
+        await c1.connect()
+        await c2.connect()
+        await c1.create("/n", b"v0")
+        events = []
+        await c2.get("/n", watch=events.append)
+        await c1.set("/n", b"v1")
+        await c1.set("/n", b"v2")   # second change: watch already fired
+        await asyncio.sleep(0.01)
+        assert len(events) == 1
+        assert events[0].type.value == "data_changed"
+    run(go())
+
+
+def test_multi_transaction_atomicity():
+    async def go():
+        space = CoordSpace()
+        c = space.client()
+        await c.connect()
+        await c.create("/state", b"s0")
+        await c.mkdirp("/history")
+        # good transaction: history create + CAS set
+        res = await c.multi([
+            Op.create("/history/1-", b"x", sequential=True),
+            Op.set("/state", b"s1", 0),
+        ])
+        assert res[0] == "/history/1-0000000000"
+        assert res[1] == 1
+        # bad version: nothing applied
+        with pytest.raises(BadVersionError):
+            await c.multi([
+                Op.create("/history/2-", b"y", sequential=True),
+                Op.set("/state", b"s2", 0),
+            ])
+        assert await c.get_children("/history") == ["1-0000000000"]
+        data, v = await c.get("/state")
+        assert (data, v) == (b"s1", 1)
+        # delete of a non-empty node must fail in VALIDATION, applying
+        # nothing (atomicity)
+        await c.create("/parent")
+        await c.create("/parent/kid")
+        with pytest.raises(NotEmptyError):
+            await c.multi([
+                Op.set("/state", b"s2", 1),
+                Op.delete("/parent"),
+            ])
+        data, v = await c.get("/state")
+        assert (data, v) == (b"s1", 1)
+    run(go())
+
+
+def test_ephemeral_nodes_cannot_have_children():
+    async def go():
+        space = CoordSpace()
+        c = space.client()
+        await c.connect()
+        await c.create("/e", b"", ephemeral=True)
+        with pytest.raises(Exception):
+            await c.create("/e/child")
+        # expiry still removes the ephemeral
+        space.expire(c)
+        checker = space.client()
+        await checker.connect()
+        assert await checker.exists("/e") is None
+    run(go())
+
+
+# ---------- parse_and_unique_actives (zookeeperMgr.js:168-200) ----------
+
+def test_parse_and_unique_actives():
+    got = parse_and_unique_actives(["a-10", "b-25", "a-5", "c-10", "c-5"])
+    assert got == [
+        {"id": "a", "seq": 10, "name": "a-10"},
+        {"id": "b", "seq": 25, "name": "b-25"},
+        {"id": "c", "seq": 10, "name": "c-10"},
+    ]
+    # ids contain dashes/colons; seq is after the LAST dash
+    got = parse_and_unique_actives(["10.0.0.1:5432:1234-0000000003"])
+    assert got[0]["id"] == "10.0.0.1:5432:1234"
+    assert got[0]["seq"] == 3
+
+
+# ---------- ConsensusMgr over memory backend ----------
+
+def make_mgr(space, ident, *, timeout=60.0, path="/shard"):
+    async def factory():
+        c = space.client(timeout)
+        await c.connect()
+        return c
+
+    return ConsensusMgr(
+        client_factory=factory, path=path, ident=ident,
+        data={"zoneId": ident, "ip": ident.split(":")[0],
+              "pgUrl": "tcp://postgres@%s/postgres" % ident,
+              "backupUrl": "http://%s:12345" % ident})
+
+
+def test_mgr_init_join_and_state(caplog):
+    async def go():
+        space = CoordSpace()
+        mgr = make_mgr(space, "10.0.0.1:5432:12345")
+        inits = []
+        mgr.on("init", inits.append)
+        await mgr.start()
+        await asyncio.sleep(0.02)
+        assert len(inits) == 1
+        assert inits[0]["clusterState"] is None
+        assert [a["id"] for a in inits[0]["active"]] == ["10.0.0.1:5432:12345"]
+        assert inits[0]["active"][0]["pgUrl"].startswith("tcp://")
+
+        # first putClusterState creates the state node + history entry
+        state = {"generation": 0, "primary": "A", "sync": None,
+                 "async": [], "deposed": [], "initWal": "0/0"}
+        await mgr.put_cluster_state(state)
+        assert mgr.cluster_state == state
+
+        checker = space.client()
+        await checker.connect()
+        hist = await checker.get_children("/shard/history")
+        assert len(hist) == 1 and hist[0].startswith("0-")
+        data, _ = await checker.get("/shard/state")
+        assert json.loads(data.decode())["generation"] == 0
+        await mgr.close()
+    run(go())
+
+
+def test_mgr_active_change_and_debounce():
+    async def go():
+        space = CoordSpace()
+        mgr = make_mgr(space, "peerA:5432:1")
+        changes = []
+        mgr.on("activeChange", changes.append)
+        await mgr.start()
+        await asyncio.sleep(0.02)
+
+        # second peer joins
+        mgr2 = make_mgr(space, "peerB:5432:1")
+        await mgr2.start()
+        await asyncio.sleep(0.05)
+        assert len(changes) == 1
+        assert [a["id"] for a in changes[-1]] == ["peerA:5432:1",
+                                                  "peerB:5432:1"]
+        # a stale duplicate for peerB joins (restart before old session
+        # expired): id list unchanged -> debounced, no event
+        c = space.client()
+        await c.connect()
+        await c.create("/shard/election/peerB:5432:1-", b'{"ip":"peerB"}',
+                       ephemeral=True, sequential=True)
+        await asyncio.sleep(0.05)
+        assert len(changes) == 1
+        await mgr.close()
+        await mgr2.close()
+    run(go())
+
+
+def test_mgr_peer_death_emits_active_change():
+    async def go():
+        space = CoordSpace()
+        mgr = make_mgr(space, "peerA:5432:1")
+        await mgr.start()
+        mgr2 = make_mgr(space, "peerB:5432:1")
+        await mgr2.start()
+        await asyncio.sleep(0.05)
+        changes = []
+        mgr.on("activeChange", changes.append)
+        # peer B dies: no rebuild on its side, just session expiry
+        mgr2._closed = True
+        space.expire(mgr2._client)
+        await asyncio.sleep(0.05)
+        assert len(changes) == 1
+        assert [a["id"] for a in changes[0]] == ["peerA:5432:1"]
+        await mgr.close()
+    run(go())
+
+
+def test_mgr_cluster_state_change_and_cas():
+    async def go():
+        space = CoordSpace()
+        mgr1 = make_mgr(space, "A:1:1")
+        mgr2 = make_mgr(space, "B:1:1")
+        await mgr1.start()
+        await mgr2.start()
+        await asyncio.sleep(0.02)
+        seen = []
+        mgr2.on("clusterStateChange", seen.append)
+        await mgr1.put_cluster_state({"generation": 1, "primary": "A:1:1"})
+        await asyncio.sleep(0.05)
+        assert seen and seen[-1]["generation"] == 1
+        # mgr2's cached version is now current; concurrent write race:
+        await mgr2.put_cluster_state({"generation": 2, "primary": "B:1:1"})
+        await asyncio.sleep(0.05)
+        # mgr1 lost the race with a stale version -> CAS failure
+        mgr1._cluster_state_version = 0
+        mgr1._cluster_state = {"generation": 1}
+        with pytest.raises(BadVersionError):
+            await mgr1.put_cluster_state({"generation": 3})
+        await mgr1.close()
+        await mgr2.close()
+    run(go())
+
+
+def test_mgr_session_expiry_rejoins_election():
+    async def go():
+        space = CoordSpace()
+        mgr = make_mgr(space, "A:1:1")
+        await mgr.start()
+        await asyncio.sleep(0.02)
+        first = await _election_names(space)
+        space.expire(mgr._client)
+        await asyncio.sleep(0.1)
+        second = await _election_names(space)
+        assert first != second
+        assert len(second) == 1
+        assert second[0].startswith("A:1:1-")
+        await mgr.close()
+    run(go())
+
+
+async def _election_names(space):
+    c = space.client()
+    await c.connect()
+    names = await c.get_children("/shard/election")
+    await c.close()
+    return names
+
+
+# ---------- coordd server + NetCoord over real TCP ----------
+
+def test_netcoord_basic_and_watch():
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            c1 = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            c2 = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await c1.connect()
+            await c2.connect()
+            await c1.mkdirp("/shard/election")
+            p = await c1.create("/shard/election/a-", b"data",
+                                ephemeral=True, sequential=True)
+            assert p.endswith("-0000000000")
+            events = []
+            await c2.get_children("/shard/election", watch=events.append)
+            await c1.create("/shard/election/b-", b"x", ephemeral=True,
+                            sequential=True)
+            await asyncio.sleep(0.1)
+            assert events and events[0].type.value == "children_changed"
+            # versioned ops over the wire
+            await c1.create("/shard/state", b"s0")
+            with pytest.raises(BadVersionError):
+                await c2.set("/shard/state", b"oops", 5)
+            res = await c2.multi([
+                Op.create("/shard/history", b""),
+                Op.create("/shard/history/0-", b"s", sequential=True),
+                Op.set("/shard/state", b"s1", 0),
+            ])
+            assert res[2] == 1
+            await c1.close()
+            await c2.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_netcoord_session_expiry_on_kill():
+    """SIGKILL-analog: abort the TCP connection without closing the
+    session; ephemerals must survive for session_timeout, then vanish and
+    fire the survivor's watch — ZK liveness semantics (SURVEY §5.3)."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            victim = NetCoord("127.0.0.1", server.port, session_timeout=0.4)
+            survivor = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await victim.connect()
+            await survivor.connect()
+            await victim.mkdirp("/el")
+            await victim.create("/el/v-", b"d", ephemeral=True,
+                                sequential=True)
+            events = []
+            assert await survivor.get_children("/el",
+                                               watch=events.append) != []
+            # kill: abort transport, no goodbye; stop its tasks entirely
+            victim._closed = True
+            for t in (victim._read_task, victim._ping_task):
+                if t:
+                    t.cancel()
+            victim._writer.transport.abort()
+
+            await asyncio.sleep(0.15)
+            # before expiry: node still there
+            assert await survivor.get_children("/el") != []
+            await asyncio.sleep(0.6)
+            assert await survivor.get_children("/el") == []
+            assert events and events[0].type.value == "children_changed"
+            await survivor.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_consensus_mgr_over_netcoord_failover_detection():
+    """Full ConsensusMgr stack over real TCP: two peers join, one dies
+    (socket abort), the other sees activeChange after session timeout."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            def factory_for(timeout):
+                async def factory():
+                    c = NetCoord("127.0.0.1", server.port,
+                                 session_timeout=timeout)
+                    await c.connect()
+                    return c
+                return factory
+
+            mgrA = ConsensusMgr(client_factory=factory_for(5),
+                                path="/shard", ident="A:1:1",
+                                data={"ip": "A"})
+            mgrB = ConsensusMgr(client_factory=factory_for(0.4),
+                                path="/shard", ident="B:1:1",
+                                data={"ip": "B"})
+            await mgrA.start()
+            await mgrB.start()
+            await asyncio.sleep(0.1)
+            assert [a["id"] for a in mgrA.active] == ["A:1:1", "B:1:1"]
+
+            changes = []
+            mgrA.on("activeChange", changes.append)
+            # B dies hard: stop both the manager's rebuild machinery and
+            # the client's reconnect machinery, then abort the socket
+            mgrB._closed = True
+            mgrB._client._closed = True
+            for t in (mgrB._client._read_task, mgrB._client._ping_task):
+                if t:
+                    t.cancel()
+            mgrB._client._writer.transport.abort()
+
+            await asyncio.sleep(1.0)
+            assert changes and [a["id"] for a in changes[-1]] == ["A:1:1"]
+            await mgrA.close()
+        finally:
+            await server.stop()
+    run(go())

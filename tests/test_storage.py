@@ -27,6 +27,10 @@ def be(tmp_path):
 def test_create_exists_destroy(be, tmp_path):
     async def go():
         assert not await be.exists("manatee/pg")
+        # zfs parity: parent dataset must exist first
+        with pytest.raises(StorageError):
+            await be.create("manatee/pg")
+        await be.create("manatee")
         await be.create("manatee/pg", mountpoint=str(tmp_path / "mnt" / "pg"))
         assert await be.exists("manatee/pg")
         with pytest.raises(StorageError):
