@@ -118,7 +118,9 @@ class ConsensusMgr:
     def active(self) -> list[dict]:
         out = []
         for a in self._active:
-            c = {"id": a["id"]}
+            # id + member data (zookeeperMgr active getter, :97-110), plus
+            # the election sequence so the state machine can see join order
+            c = {"id": a["id"], "seq": a["seq"]}
             c.update(a.get("data") or {})
             out.append(c)
         return out
@@ -126,6 +128,12 @@ class ConsensusMgr:
     @property
     def cluster_state(self) -> dict | None:
         return self._cluster_state
+
+    @property
+    def cluster_state_version(self) -> int | None:
+        """Version paired with :attr:`cluster_state`; read both in the
+        same event-loop step for a consistent snapshot."""
+        return self._cluster_state_version
 
     @property
     def status(self) -> str:
@@ -292,22 +300,27 @@ class ConsensusMgr:
 
     # ---- putClusterState ----
 
-    async def put_cluster_state(self, state: dict) -> None:
+    async def put_cluster_state(self, state: dict, *,
+                                expected_version: int | None = None
+                                ) -> None:
         """Write state + history atomically with optimistic versioning
         (putClusterState, lib/zookeeperMgr.js:605-630).  Raises
-        BadVersionError on CAS conflict."""
+        BadVersionError on CAS conflict.  Pass *expected_version* (from
+        :attr:`cluster_state_version` at snapshot time) so a decision
+        computed from an older state cannot silently overwrite writes
+        that landed mid-decision."""
         if self._client is None:
             raise ConnectionLossError("not connected")
         if "generation" not in state:
             raise CoordError("cluster state requires a generation")
+        version = (expected_version if expected_version is not None
+                   else self._cluster_state_version)
         data = json.dumps(state).encode()
         ops = [Op.create(
             "%s/%d-" % (self._history_path, int(state["generation"])),
             data, sequential=True)]
-        if self._cluster_state is not None \
-                and self._cluster_state_version is not None:
-            ops.append(Op.set(self._state_path, data,
-                              self._cluster_state_version))
+        if version is not None:
+            ops.append(Op.set(self._state_path, data, version))
         else:
             ops.append(Op.create(self._state_path, data))
         res = await self._client.multi(ops)

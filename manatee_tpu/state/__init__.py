@@ -1,0 +1,21 @@
+"""Cluster state machine (reference: the external `manatee-state-machine`
+git dependency, package.json:31 — rebuilt here as a first-class component).
+"""
+
+from manatee_tpu.state.types import (
+    ClusterState,
+    PeerInfo,
+    compare_lsn,
+    peer_info_from_active,
+    role_of,
+)
+from manatee_tpu.state.machine import PeerStateMachine
+
+__all__ = [
+    "ClusterState",
+    "PeerInfo",
+    "compare_lsn",
+    "peer_info_from_active",
+    "role_of",
+    "PeerStateMachine",
+]

@@ -1,0 +1,506 @@
+"""PeerStateMachine — the topology decision engine.
+
+The reference outsources this to the `manatee-state-machine` dependency
+(consumed at lib/shard.js:59-71); its behavior is re-derived here from the
+observable schema, the history annotations (lib/adm.js:2296-2416), the
+man-page promote semantics (docs/man/manatee-adm.md:346-419), the user
+guide (docs/user-guide.md:69-90, 330-400), and the integration scenarios
+(test/integ.test.js).
+
+Inputs: the consensus manager's events ('init', 'activeChange',
+'clusterStateChange' — lib/zookeeperMgr.js:44-52) and the PG manager's
+'init' event (lib/postgresMgr.js:401-421).  Outputs:
+``zk.put_cluster_state()`` and ``pg.reconfigure()/stop()``.
+
+Decision rules:
+
+* BOOTSTRAP — no cluster state yet:
+  - singleton (ONWM): the configured peer writes gen-0 state with itself
+    as primary, no sync, and an auto-freeze (moving ONWM->HA requires an
+    explicit unfreeze, docs/user-guide.md:367-387);
+  - normal: the peer with the LOWEST election sequence declares the
+    cluster once >= 2 peers are present: primary = itself, sync = next
+    in election order, rest = asyncs; generation 0, initWal '0/0000000'
+    (the same initial shape state-backfill writes, lib/adm.js:1266-1276).
+
+* PRIMARY duties (docs/user-guide.md:86-90 "the primary manages
+  topology"): appoint a replacement sync from the asyncs when the sync
+  dies (generation bump, initWal = its current xlog); add newly-joined
+  peers as asyncs and remove dead asyncs (no bump); act on promote
+  requests for asyncs.
+
+* SYNC duties: take over when the primary dies (generation bump, old
+  primary -> deposed, first async -> new sync), but ONLY if its own xlog
+  has reached state.initWal (it actually replicated from this
+  generation); act on a promote request naming itself (deposes a live
+  primary).
+
+* FROZEN clusters make no automatic transitions (docs/user-guide.md
+  freeze section).
+
+* A peer that finds itself deposed stops PostgreSQL and waits for the
+  operator (docs/user-guide.md:337-365).  In ONWM, a peer that is not
+  the primary shuts down (docs/user-guide.md:369-372).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+from typing import Any, Callable
+
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    CoordError,
+    NodeExistsError,
+)
+from manatee_tpu.state.types import (
+    INITIAL_WAL,
+    ClusterState,
+    compare_lsn,
+    frozen,
+    peer_info_from_active,
+    role_of,
+)
+
+log = logging.getLogger("manatee.state")
+
+RETRY_DELAY = 1.0
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def _iso_to_ts(s: str) -> float:
+    try:
+        return datetime.datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class PeerStateMachine:
+    def __init__(self, *, zk, pg, self_info: dict,
+                 singleton: bool = False):
+        """*zk* is a ConsensusMgr-shaped object (on/active/cluster_state/
+        put_cluster_state); *pg* provides async reconfigure(cfg), stop(),
+        get_xlog_location() (the pginterface of lib/shard.js:59-71);
+        *self_info* is this peer's PeerInfo dict."""
+        self.zk = zk
+        self.pg = pg
+        self.self_info = self_info
+        self.self_id = self_info["id"]
+        self.singleton = singleton
+
+        self._zk_ready = False
+        self._pg_ready = False
+        self._closed = False
+        self._notified_role: str | None = None
+        self._kick = asyncio.Event()
+        self._worker_task: asyncio.Task | None = None
+        self._pg_task: asyncio.Task | None = None
+        self._pg_target: dict | None = None
+        self._pg_applied: dict | None = None
+        self._listeners: dict[str, list[Callable]] = {}
+
+        zk.on("init", self._on_zk_init)
+        zk.on("activeChange", self._on_active_change)
+        zk.on("clusterStateChange", self._on_cluster_state)
+
+    # ---- events out (role changes, shutdown requests) ----
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def _emit(self, event: str, payload=None) -> None:
+        for cb in self._listeners.get(event, []):
+            try:
+                cb(payload)
+            except Exception:
+                log.exception("listener for %s failed", event)
+
+    # ---- events in ----
+
+    # Events only kick the worker; the evaluation reads state+version+
+    # actives from the consensus manager in one event-loop step so the
+    # CAS version always matches the snapshot the decision was computed
+    # from.
+
+    def _on_zk_init(self, _payload: dict) -> None:
+        self._zk_ready = True
+        self.kick()
+
+    def _on_active_change(self, _actives: list[dict]) -> None:
+        self.kick()
+
+    def _on_cluster_state(self, _state: ClusterState) -> None:
+        self.kick()
+
+    @property
+    def _state(self) -> ClusterState | None:
+        return self.zk.cluster_state
+
+    @property
+    def _actives(self) -> list[dict]:
+        return self.zk.active
+
+    def pg_init(self) -> None:
+        """Called once the PG manager is constructed and has reported its
+        initial status (the 'init' event, lib/postgresMgr.js:401-421)."""
+        self._pg_ready = True
+        self.kick()
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        if self._worker_task is None:
+            self._worker_task = asyncio.ensure_future(self._worker())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._kick.set()
+        for t in (self._worker_task, self._pg_task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+    def kick(self) -> None:
+        self._kick.set()
+
+    def debug_state(self) -> dict:
+        """Introspection for the status server (lib/shard.js:74-76)."""
+        return {
+            "id": self.self_id,
+            "singleton": self.singleton,
+            "role": role_of(self._state, self.self_id),
+            "zkReady": self._zk_ready,
+            "pgReady": self._pg_ready,
+            "active": self._actives,
+            "clusterState": self._state,
+            "pgTarget": self._pg_target,
+            "pgApplied": self._pg_applied,
+        }
+
+    async def _worker(self) -> None:
+        while not self._closed:
+            await self._kick.wait()
+            self._kick.clear()
+            try:
+                await self._evaluate()
+            except asyncio.CancelledError:
+                return
+            except BadVersionError:
+                # lost a CAS race; the watch will deliver the winning
+                # state and re-kick us
+                log.info("cluster-state CAS conflict; deferring")
+            except Exception:
+                log.exception("state machine evaluation failed")
+                await asyncio.sleep(RETRY_DELAY)
+                self._kick.set()
+
+    # ---- the decision procedure ----
+
+    async def _evaluate(self) -> None:
+        if not (self._zk_ready and self._pg_ready):
+            return
+        # consistent snapshot: state, its CAS version, and membership read
+        # in the same event-loop step
+        st = self.zk.cluster_state
+        ver = self.zk.cluster_state_version
+        actives = self.zk.active
+
+        if st is None:
+            await self._bootstrap(actives)
+            return
+
+        my_role = role_of(st, self.self_id)
+        self._notify_role(my_role, st)
+
+        if st.get("oneNodeWriteMode") and my_role != "primary":
+            # ONWM: foreign peers shut down (docs/user-guide.md:369-372)
+            log.warning("cluster is in one-node-write mode and we are not "
+                        "the primary; shutting down")
+            await self._apply_pg({"role": "none"})
+            return
+
+        if my_role == "primary":
+            await self._apply_pg(self._pg_config_for(st, "primary"))
+            await self._primary_duties(st, ver, actives)
+        elif my_role == "sync":
+            acted = await self._sync_duties(st, ver, actives)
+            if not acted:
+                await self._apply_pg(self._pg_config_for(st, "sync"))
+        elif my_role == "async":
+            await self._apply_pg(self._pg_config_for(st, "async"))
+        elif my_role == "deposed":
+            await self._apply_pg({"role": "none", "deposed": True})
+        else:
+            # unassigned: wait for the primary to adopt us
+            await self._apply_pg({"role": "none"})
+
+    def _notify_role(self, my_role: str | None, st: ClusterState) -> None:
+        """Emit role-transition events ONCE per transition."""
+        key = my_role
+        if st.get("oneNodeWriteMode") and my_role != "primary":
+            key = "onwm-foreign"
+        if key == self._notified_role:
+            return
+        self._notified_role = key
+        if key == "deposed":
+            log.warning("we are deposed; stopping postgres and waiting "
+                        "for operator rebuild")
+            self._emit("deposed", None)
+        elif key == "onwm-foreign":
+            self._emit("shutdown", "onwm-foreign-peer")
+        self._emit("roleChange", key)
+
+    # -- bootstrap --
+
+    async def _bootstrap(self, actives: list[dict]) -> None:
+        ids = [a["id"] for a in actives]
+        if self.self_id not in ids:
+            return
+        if self.singleton:
+            state = {
+                "generation": 0,
+                "initWal": INITIAL_WAL,
+                "primary": self.self_info,
+                "sync": None,
+                "async": [],
+                "deposed": [],
+                "oneNodeWriteMode": True,
+                "freeze": {"date": _now_iso(),
+                           "reason": "one-node-write mode setup"},
+            }
+            await self._write_state(state, "singleton setup", None)
+            return
+        # normal mode: lowest election sequence declares, needs a sync
+        by_seq = sorted(actives, key=lambda a: a.get("seq", 1 << 30))
+        if len(by_seq) < 2 or by_seq[0]["id"] != self.self_id:
+            return
+        state = {
+            "generation": 0,
+            "initWal": INITIAL_WAL,
+            "primary": peer_info_from_active(by_seq[0]),
+            "sync": peer_info_from_active(by_seq[1]),
+            "async": [peer_info_from_active(a) for a in by_seq[2:]],
+            "deposed": [],
+        }
+        await self._write_state(state, "cluster setup", None)
+
+    # -- primary --
+
+    async def _primary_duties(self, st: ClusterState, ver: int | None,
+                              actives: list[dict]) -> None:
+        if frozen(st):
+            return
+        alive = {a["id"] for a in actives}
+
+        if await self._handle_promote_as_primary(st, ver, alive):
+            return
+
+        if st.get("oneNodeWriteMode"):
+            return
+
+        asyncs = list(st.get("async") or [])
+        alive_asyncs = [a for a in asyncs if a["id"] in alive]
+        unassigned = [a for a in actives
+                      if role_of(st, a["id"]) is None]
+
+        sync = st.get("sync")
+        if sync is None or sync["id"] not in alive:
+            # need a replacement sync: prefer an alive async, else an
+            # unassigned joiner ("sync added", lib/adm.js:2349-2358)
+            if alive_asyncs:
+                cand = alive_asyncs[0]
+                rest = [a for a in asyncs if a["id"] != cand["id"]]
+            elif unassigned:
+                cand = peer_info_from_active(unassigned[0])
+                rest = asyncs
+            else:
+                return  # nothing to appoint; wait for a joiner
+            new = dict(st)
+            new["generation"] = st["generation"] + 1
+            new["initWal"] = await self.pg.get_xlog_location()
+            new["sync"] = cand
+            new["async"] = [a for a in rest if a["id"] in alive]
+            await self._write_state(
+                new, "appointed new sync %s" % cand["id"], ver)
+            return
+
+        # prune dead asyncs (no generation bump)
+        if len(alive_asyncs) != len(asyncs):
+            new = dict(st)
+            new["async"] = alive_asyncs
+            await self._write_state(new, "removed dead asyncs", ver)
+            return
+
+        # adopt unassigned joiners as asyncs (no generation bump)
+        if unassigned:
+            new = dict(st)
+            new["async"] = asyncs + [peer_info_from_active(a)
+                                     for a in unassigned]
+            await self._write_state(
+                new, "adopted asyncs %s"
+                % [a["id"] for a in unassigned], ver)
+            return
+
+    async def _handle_promote_as_primary(self, st: ClusterState,
+                                         ver: int | None,
+                                         alive: set) -> bool:
+        pr = st.get("promote")
+        if not pr or pr.get("role") != "async":
+            return False
+        if pr.get("generation") != st.get("generation"):
+            return False
+        if _iso_to_ts(pr.get("expireTime", "")) < \
+                datetime.datetime.now(datetime.timezone.utc).timestamp():
+            return False
+        asyncs = list(st.get("async") or [])
+        idx = pr.get("asyncIndex", 0)
+        if idx >= len(asyncs) or asyncs[idx]["id"] != pr.get("id"):
+            return False  # topology moved; ignore the request
+        if asyncs[idx]["id"] not in alive:
+            return False
+        new = dict(st)
+        new.pop("promote", None)
+        if idx == 0:
+            # first async -> sync; old sync -> first async (gen bump:
+            # sync changed, docs/man/manatee-adm.md:363-365)
+            old_sync = st.get("sync")
+            if old_sync is None:
+                return False
+            new["generation"] = st["generation"] + 1
+            new["initWal"] = await self.pg.get_xlog_location()
+            new["sync"] = asyncs[0]
+            new["async"] = [old_sync] + asyncs[1:]
+        else:
+            # move up one position in the async chain (no data-path
+            # impact, docs/man/manatee-adm.md:366)
+            asyncs[idx - 1], asyncs[idx] = asyncs[idx], asyncs[idx - 1]
+            new["async"] = asyncs
+        await self._write_state(new, "acted on promote request", ver)
+        return True
+
+    # -- sync --
+
+    async def _sync_duties(self, st: ClusterState, ver: int | None,
+                           actives: list[dict]) -> bool:
+        """Returns True if a takeover happened (state write succeeded)."""
+        if frozen(st):
+            return False
+        alive = {a["id"] for a in actives}
+        primary_alive = st["primary"]["id"] in alive
+
+        pr = st.get("promote")
+        promote_me = (
+            pr is not None
+            and pr.get("role") == "sync"
+            and pr.get("id") == self.self_id
+            and pr.get("generation") == st.get("generation")
+            and _iso_to_ts(pr.get("expireTime", "")) >
+            datetime.datetime.now(datetime.timezone.utc).timestamp())
+
+        if primary_alive and not promote_me:
+            return False
+
+        # safety: never take over unless our xlog reached this
+        # generation's initWal — otherwise we never replicated from this
+        # primary and our database may predate it (docs/xlog-diverge.md)
+        my_xlog = await self.pg.get_xlog_location()
+        try:
+            if compare_lsn(my_xlog, st.get("initWal", INITIAL_WAL)) < 0:
+                log.warning(
+                    "declining takeover: xlog %s behind initWal %s",
+                    my_xlog, st.get("initWal"))
+                return False
+        except ValueError:
+            log.warning("declining takeover: bad xlog %r", my_xlog)
+            return False
+
+        asyncs = list(st.get("async") or [])
+        alive_asyncs = [a for a in asyncs if a["id"] in alive]
+        new_sync = alive_asyncs[0] if alive_asyncs else None
+        new = {
+            "generation": st["generation"] + 1,
+            "initWal": my_xlog,
+            "primary": st["sync"],
+            "sync": new_sync,
+            "async": [a for a in asyncs
+                      if new_sync is None or a["id"] != new_sync["id"]],
+            "deposed": (st.get("deposed") or []) + [st["primary"]],
+        }
+        why = ("promote request" if promote_me else "primary death")
+        if not await self._write_state(new, "takeover (%s)" % why, ver):
+            # lost the race (e.g. an operator freeze landed first): do NOT
+            # promote local postgres; re-evaluate against the winner
+            return False
+        # the takeover is durable; we are the primary now
+        await self._apply_pg(self._pg_config_for(new, "primary"))
+        return True
+
+    # -- shared helpers --
+
+    async def _write_state(self, state: ClusterState, why: str,
+                           expected_version: int | None) -> bool:
+        """CAS-write; returns False when the write lost a race."""
+        log.info("writing cluster state gen=%s (%s)",
+                 state.get("generation"), why)
+        try:
+            await self.zk.put_cluster_state(
+                state, expected_version=expected_version)
+        except (BadVersionError, NodeExistsError):
+            log.info("state write lost a race (%s); deferring", why)
+            self.kick()
+            return False
+        self._emit("stateWritten", state)
+        self.kick()
+        return True
+
+    def _pg_config_for(self, st: ClusterState, role: str) -> dict:
+        """The reconfigure contract {role, upstream, downstream}
+        (lib/postgresMgr.js:758-816)."""
+        asyncs = st.get("async") or []
+        if role == "primary":
+            return {"role": "primary", "upstream": None,
+                    "downstream": st.get("sync")}
+        if role == "sync":
+            return {"role": "sync", "upstream": st.get("primary"),
+                    "downstream": asyncs[0] if asyncs else None}
+        idx = next(i for i, a in enumerate(asyncs)
+                   if a["id"] == self.self_id)
+        upstream = st.get("sync") if idx == 0 else asyncs[idx - 1]
+        downstream = asyncs[idx + 1] if idx + 1 < len(asyncs) else None
+        return {"role": "async", "upstream": upstream,
+                "downstream": downstream}
+
+    async def _apply_pg(self, cfg: dict) -> None:
+        if cfg == self._pg_target:
+            return
+        self._pg_target = cfg
+        if self._pg_task and not self._pg_task.done():
+            # cancel the in-flight transition (a restore can take hours
+            # and must not wedge the next topology change,
+            # lib/postgresMgr.js:1263-1275)
+            self._pg_task.cancel()
+        self._pg_task = asyncio.ensure_future(self._run_pg(cfg))
+
+    async def _run_pg(self, cfg: dict) -> None:
+        try:
+            await self.pg.reconfigure(cfg)
+            self._pg_applied = cfg
+            self._emit("pgApplied", cfg)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("pg reconfigure to %s failed; will retry",
+                          cfg.get("role"))
+            self._pg_target = None
+            await asyncio.sleep(RETRY_DELAY)
+            self.kick()

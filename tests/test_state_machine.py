@@ -1,0 +1,530 @@
+"""Cluster state-machine scenario tests.
+
+Each simulated peer = real ConsensusMgr (in-memory coordination backend) +
+PeerStateMachine + a simulated PG manager.  Scenarios mirror the
+reference's integration suite (test/integ.test.js: primaryDeath :449,
+syncDeath :640, asyncDeath :853, add4thManatee :3848) plus the promote /
+freeze / ONWM / deposed semantics from docs/man/manatee-adm.md and
+docs/user-guide.md.  Every state write is checked against the transition
+invariants encoded by the reference's history annotator
+(lib/adm.js:2296-2416) via validate_transition().
+"""
+
+import asyncio
+import datetime
+
+import pytest
+
+from manatee_tpu.coord import ConsensusMgr, CoordSpace
+from manatee_tpu.state.machine import PeerStateMachine
+from manatee_tpu.state.types import role_of, validate_transition
+
+
+class SimPg:
+    """Stand-in for the PG manager: records reconfigure calls, reports a
+    settable xlog position."""
+
+    def __init__(self):
+        self.cfg = None
+        self.calls = []
+        self.xlog = "0/0000000"
+        self.stopped = False
+
+    async def reconfigure(self, cfg):
+        self.calls.append(cfg)
+        self.cfg = cfg
+        self.stopped = cfg.get("role") == "none"
+
+    async def stop(self):
+        self.stopped = True
+
+    async def get_xlog_location(self):
+        return self.xlog
+
+
+class SimPeer:
+    def __init__(self, space, name, *, singleton=False, timeout=60.0):
+        self.space = space
+        self.name = name
+        self.ident = "%s:5432:12345" % name
+        self.info = {
+            "id": self.ident, "zoneId": name, "ip": name,
+            "pgUrl": "tcp://postgres@%s:5432/postgres" % name,
+            "backupUrl": "http://%s:12345" % name,
+        }
+        self.pg = SimPg()
+        self.violations = []
+
+        async def factory():
+            c = space.client(timeout)
+            await c.connect()
+            self._client = c
+            return c
+
+        data = {k: v for k, v in self.info.items() if k != "id"}
+        self.zk = ConsensusMgr(client_factory=factory, path="/shard",
+                               ident=self.ident, data=data)
+        self.sm = PeerStateMachine(zk=self.zk, pg=self.pg,
+                                   self_info=self.info,
+                                   singleton=singleton)
+        self._last_state = None
+
+        def check(state):
+            prev, self._last_state = self._last_state, state
+            self.violations.extend(
+                "%s: %s" % (self.ident, v)
+                for v in validate_transition(prev, state))
+
+        self.sm.on("stateWritten", check)
+
+    async def start(self):
+        self.sm.start()
+        await self.zk.start()
+        self.sm.pg_init()
+
+    async def kill(self):
+        """Peer death: no clean close; session expiry only."""
+        self.sm._closed = True
+        self.zk._closed = True
+        self.space.expire(self._client)
+        await self.sm.close()
+
+    async def close(self):
+        await self.sm.close()
+        await self.zk.close()
+
+
+async def wait_for(pred, timeout=5.0, what="condition"):
+    t0 = asyncio.get_event_loop().time()
+    while asyncio.get_event_loop().time() - t0 < timeout:
+        if pred():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+async def get_state(space):
+    c = space.client()
+    await c.connect()
+    import json
+    data, _ = await c.get("/shard/state")
+    await c.close()
+    return json.loads(data.decode())
+
+
+def no_violations(*peers):
+    for p in peers:
+        assert p.violations == [], p.violations
+
+
+# ---------- scenarios ----------
+
+def test_two_peer_bootstrap_then_third_joins():
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A")
+        await a.start()
+        await asyncio.sleep(0.1)
+        # a single normal-mode peer must NOT declare a cluster
+        assert a.sm._state is None
+
+        b = SimPeer(space, "B")
+        await b.start()
+        await wait_for(lambda: role_of(a.sm._state, b.ident) == "sync",
+                       what="bootstrap")
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert st["initWal"] == "0/0000000"
+        assert st["primary"]["id"] == a.ident  # first joiner is primary
+        assert st["sync"]["id"] == b.ident
+        assert st["async"] == [] and st["deposed"] == []
+
+        # pg configured: A primary with downstream B; B sync upstream A
+        await wait_for(lambda: a.pg.cfg and a.pg.cfg["role"] == "primary")
+        assert a.pg.cfg["downstream"]["id"] == b.ident
+        await wait_for(lambda: b.pg.cfg and b.pg.cfg["role"] == "sync")
+        assert b.pg.cfg["upstream"]["id"] == a.ident
+
+        # third peer joins -> adopted as async, same generation
+        c = SimPeer(space, "C")
+        await c.start()
+        await wait_for(lambda: role_of(a.sm._state, c.ident) == "async",
+                       what="async adoption")
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert [x["id"] for x in st["async"]] == [c.ident]
+        await wait_for(lambda: c.pg.cfg and c.pg.cfg["role"] == "async")
+        assert c.pg.cfg["upstream"]["id"] == b.ident  # chains off the sync
+        no_violations(a, b, c)
+        for p in (a, b, c):
+            await p.close()
+    asyncio.run(go())
+
+
+def make_three(space):
+    return SimPeer(space, "A"), SimPeer(space, "B"), SimPeer(space, "C")
+
+
+async def start_three(a, b, c):
+    await a.start()
+    await b.start()
+    await wait_for(lambda: a.sm._state is not None, what="bootstrap")
+    await c.start()
+    await wait_for(lambda: role_of(a.sm._state, c.ident) == "async",
+                   what="async adoption")
+    # replication established: standbys reach initWal
+    for p in (a, b, c):
+        p.pg.xlog = "0/0001000"
+
+
+def test_primary_death_sync_takeover():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+
+        await a.kill()
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 1,
+                       what="takeover")
+        st = await get_state(space)
+        assert st["primary"]["id"] == b.ident       # sync took over
+        assert st["sync"]["id"] == c.ident          # async promoted
+        assert st["async"] == []
+        assert [d["id"] for d in st["deposed"]] == [a.ident]
+        assert st["initWal"] == "0/0001000"         # new primary's xlog
+        await wait_for(lambda: b.pg.cfg["role"] == "primary")
+        await wait_for(lambda: c.pg.cfg["role"] == "sync")
+        no_violations(b, c)
+        await b.close()
+        await c.close()
+    asyncio.run(go())
+
+
+def test_sync_death_primary_appoints_async():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+
+        await b.kill()
+        await wait_for(lambda: (a.sm._state or {}).get("generation") == 1,
+                       what="sync replacement")
+        st = await get_state(space)
+        assert st["primary"]["id"] == a.ident
+        assert st["sync"]["id"] == c.ident
+        assert st["async"] == [] and st["deposed"] == []
+        await wait_for(lambda: a.pg.cfg["downstream"]["id"] == c.ident)
+        no_violations(a, c)
+        await a.close()
+        await c.close()
+    asyncio.run(go())
+
+
+def test_async_death_no_generation_bump():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+
+        await c.kill()
+        await wait_for(lambda: (a.sm._state or {}).get("async") == [],
+                       what="async removal")
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert st["sync"]["id"] == b.ident
+        no_violations(a, b)
+        await a.close()
+        await b.close()
+    asyncio.run(go())
+
+
+def test_takeover_declined_when_behind_initwal():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        # force a generation with nonzero initWal: kill C, then the
+        # primary appoints... simpler: kill sync B; A appoints C with
+        # initWal 0/0002000
+        a.pg.xlog = "0/0002000"
+        await b.kill()
+        await wait_for(lambda: (a.sm._state or {}).get("generation") == 1)
+        # C never replicated anything of gen 1: its xlog stays 0/0001000
+        c.pg.xlog = "0/0001000"
+        await a.kill()
+        await asyncio.sleep(0.3)
+        st = await get_state(space)
+        assert st["generation"] == 1            # NO takeover happened
+        assert st["primary"]["id"] == a.ident   # dead but not replaced
+        # now C catches up and retries
+        c.pg.xlog = "0/0002000"
+        c.sm.kick()
+        await wait_for(lambda: (c.sm._state or {}).get("generation") == 2,
+                       what="takeover after catch-up")
+        st = await get_state(space)
+        assert st["primary"]["id"] == c.ident
+        no_violations(c)
+        await c.close()
+    asyncio.run(go())
+
+
+def test_freeze_blocks_takeover():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        # operator freezes the cluster
+        st = await get_state(space)
+        st["freeze"] = {"date": "2026-01-01T00:00:00Z", "reason": "test"}
+        writer = space.client()
+        await writer.connect()
+        import json
+        await writer.set("/shard/state", json.dumps(st).encode())
+        await asyncio.sleep(0.1)
+
+        await a.kill()
+        await asyncio.sleep(0.3)
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert st["primary"]["id"] == a.ident   # frozen: no takeover
+        await b.close()
+        await c.close()
+    asyncio.run(go())
+
+
+def test_onwm_bootstrap_and_foreign_shutdown():
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A", singleton=True)
+        await a.start()
+        await wait_for(lambda: a.sm._state is not None, what="onwm setup")
+        st = await get_state(space)
+        assert st["oneNodeWriteMode"] is True
+        assert st["primary"]["id"] == a.ident
+        assert st["sync"] is None
+        assert st.get("freeze")                 # auto-frozen
+        await wait_for(lambda: a.pg.cfg and a.pg.cfg["role"] == "primary")
+        assert a.pg.cfg["downstream"] is None
+
+        # a foreign peer joining an ONWM cluster shuts down
+        b = SimPeer(space, "B")
+        shutdowns = []
+        b.sm.on("shutdown", shutdowns.append)
+        await b.start()
+        await wait_for(lambda: shutdowns, what="onwm foreign shutdown")
+        assert b.pg.stopped
+        await a.close()
+        await b.close()
+    asyncio.run(go())
+
+
+def _expire_iso(seconds_from_now):
+    t = datetime.datetime.now(datetime.timezone.utc) + \
+        datetime.timedelta(seconds=seconds_from_now)
+    return t.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+async def _write_promote(space, promote):
+    import json
+    c = space.client()
+    await c.connect()
+    data, v = await c.get("/shard/state")
+    st = json.loads(data.decode())
+    st["promote"] = promote
+    await c.set("/shard/state", json.dumps(st).encode(), v)
+    await c.close()
+    return st
+
+
+def test_promote_sync_deposes_live_primary():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        st = await get_state(space)
+        await _write_promote(space, {
+            "id": b.ident, "role": "sync",
+            "generation": st["generation"],
+            "expireTime": _expire_iso(30),
+        })
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 1,
+                       what="promote takeover")
+        st = await get_state(space)
+        assert st["primary"]["id"] == b.ident
+        assert [d["id"] for d in st["deposed"]] == [a.ident]
+        assert "promote" not in st
+        # old primary sees itself deposed and goes passive
+        await wait_for(lambda: a.pg.cfg and a.pg.cfg["role"] == "none",
+                       what="deposed passivation")
+        no_violations(a, b, c)
+        for p in (a, b, c):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_promote_first_async_swaps_with_sync():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        st = await get_state(space)
+        await _write_promote(space, {
+            "id": c.ident, "role": "async", "asyncIndex": 0,
+            "generation": st["generation"],
+            "expireTime": _expire_iso(30),
+        })
+        await wait_for(
+            lambda: (a.sm._state or {}).get("generation") == 1,
+            what="async promote")
+        st = await get_state(space)
+        assert st["sync"]["id"] == c.ident
+        assert [x["id"] for x in st["async"]] == [b.ident]
+        assert "promote" not in st
+        no_violations(a, b, c)
+        for p in (a, b, c):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_expired_promote_ignored():
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        st = await get_state(space)
+        await _write_promote(space, {
+            "id": c.ident, "role": "async", "asyncIndex": 0,
+            "generation": st["generation"],
+            "expireTime": _expire_iso(-5),   # already expired
+        })
+        await asyncio.sleep(0.3)
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert st["sync"]["id"] == b.ident
+        assert "promote" in st   # ignored requests stay (man page)
+        for p in (a, b, c):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_rebuilt_deposed_peer_rejoins_after_reap():
+    """After takeover, the operator removes the deposed entry (what
+    manatee-adm rebuild does, lib/adm.js:1533-1539); the rebuilt peer is
+    then adopted as an async by the new primary."""
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        await a.kill()
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 1)
+
+        # operator: remove A from deposed
+        import json
+        w = space.client()
+        await w.connect()
+        data, v = await w.get("/shard/state")
+        st = json.loads(data.decode())
+        st["deposed"] = []
+        await w.set("/shard/state", json.dumps(st).encode(), v)
+
+        # A comes back (rebuilt)
+        a2 = SimPeer(space, "A")
+        a2.pg.xlog = "0/0001000"
+        await a2.start()
+        await wait_for(
+            lambda: role_of(b.sm._state, a2.ident) == "async",
+            what="rebuilt peer adoption")
+        st = await get_state(space)
+        assert st["generation"] == 1
+        assert [x["id"] for x in st["async"]] == [a2.ident]
+        no_violations(b, c)
+        for p in (a2, b, c):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_dead_sync_replaced_by_new_joiner():
+    """Two-peer cluster: sync dies, then a fresh peer joins — the primary
+    must appoint the joiner as the new sync (gen bump), not strand the
+    cluster without synchronous replication."""
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A")
+        b = SimPeer(space, "B")
+        await a.start()
+        await b.start()
+        await wait_for(lambda: a.sm._state is not None)
+        a.pg.xlog = "0/0001000"
+        await b.kill()
+        await asyncio.sleep(0.1)
+
+        c = SimPeer(space, "C")
+        await c.start()
+        await wait_for(
+            lambda: role_of(a.sm._state, c.ident) == "sync",
+            what="joiner appointed sync")
+        st = await get_state(space)
+        assert st["generation"] == 1
+        assert st["sync"]["id"] == c.ident
+        no_violations(a, c)
+        await a.close()
+        await c.close()
+    asyncio.run(go())
+
+
+def test_everyone_dies_and_returns():
+    """everyoneDies (test/integ.test.js:1068): kill all peers, restart
+    them; the cluster must come back with the same topology decisions
+    (state persists in the coordination service)."""
+    async def go():
+        space = CoordSpace()
+        a, b, c = make_three(space)
+        await start_three(a, b, c)
+        for p in (a, b, c):
+            await p.kill()
+        await asyncio.sleep(0.1)
+
+        a2, b2, c2 = make_three(space)
+        for p in (a2, b2, c2):
+            p.pg.xlog = "0/0001000"
+            await p.start()
+        await wait_for(lambda: a2.pg.cfg and a2.pg.cfg["role"] == "primary",
+                       what="primary resumes")
+        st = await get_state(space)
+        assert st["generation"] == 0
+        assert st["primary"]["id"] == a2.ident
+        no_violations(a2, b2, c2)
+        for p in (a2, b2, c2):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_degenerate_takeover_then_sync_added():
+    """Two-peer cluster, primary dies: sync takes over with sync=None
+    (read-only); a new joiner is appointed sync with a generation bump
+    ('sync added', lib/adm.js:2349-2358)."""
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A")
+        b = SimPeer(space, "B")
+        await a.start()
+        await b.start()
+        await wait_for(lambda: b.sm._state is not None)
+        b.pg.xlog = "0/0001000"
+        await a.kill()
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 1,
+                       what="degenerate takeover")
+        st = await get_state(space)
+        assert st["primary"]["id"] == b.ident
+        assert st["sync"] is None
+
+        c = SimPeer(space, "C")
+        await c.start()
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 2,
+                       what="sync appointment")
+        st = await get_state(space)
+        assert st["sync"]["id"] == c.ident
+        no_violations(b, c)
+        await b.close()
+        await c.close()
+    asyncio.run(go())
