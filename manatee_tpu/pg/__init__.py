@@ -1,0 +1,18 @@
+"""PostgreSQL management layer (reference: lib/postgresMgr.js, 2556 lines).
+
+:class:`manatee_tpu.pg.manager.PostgresMgr` owns the database child
+process and all of its configuration, behind a pluggable *engine*:
+
+- :class:`manatee_tpu.pg.postgres.PostgresEngine` drives real
+  ``postgres``/``initdb`` binaries (production);
+- :class:`manatee_tpu.pg.simpg.SimPgEngine` drives
+  ``manatee_tpu.pg.simpg`` — an in-repo simulated postgres child process
+  with real TCP queries, real WAL streaming replication (synchronous
+  acks, cascading), standby recovery config, and postgres signal
+  semantics — so the full manager and the fault-injection suite run on
+  machines without PostgreSQL installed.
+"""
+
+from manatee_tpu.pg.manager import PostgresMgr
+
+__all__ = ["PostgresMgr"]

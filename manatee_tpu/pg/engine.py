@@ -1,0 +1,187 @@
+"""Database engine abstraction for PostgresMgr.
+
+Separates WHAT the manager does (lifecycle, transitions, health,
+replication checks — lib/postgresMgr.js) from HOW a concrete database is
+driven.  Two engines:
+
+- SimPgEngine → manatee_tpu.pg.simpg child processes (dev/test images);
+- PostgresEngine → real postgres/initdb (manatee_tpu.pg.postgres).
+
+The engine query surface is structured (dicts), modeled on the exact
+queries the reference issues: ``select current_time`` health probes
+(lib/postgresMgr.js:1550-1646), ``pg_stat_replication`` rows with
+sent/write/flush/replay LSNs and sync_state (:2390-2555),
+``pg_current_wal_lsn``/``pg_last_wal_receive_lsn`` (:868-899), and
+``pg_is_in_recovery``.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import sys
+from pathlib import Path
+from urllib.parse import urlparse
+
+
+class PgError(Exception):
+    pass
+
+
+class PgQueryTimeout(PgError):
+    pass
+
+
+def parse_pg_url(url: str) -> tuple[str, str, int]:
+    """Returns (scheme, host, port).  'tcp://postgres@10.0.0.1:5432/postgres'
+    (the reference's pgUrl shape, lib/shard.js:39-54) or 'sim://host:port'."""
+    u = urlparse(url)
+    if not u.hostname or not u.port:
+        raise PgError("bad pg url: %r" % url)
+    return u.scheme, u.hostname, int(u.port)
+
+
+class Engine(abc.ABC):
+    """Driver for one local database instance plus remote status queries."""
+
+    scheme = "?"
+
+    # -- local cluster management --
+
+    @abc.abstractmethod
+    def is_initialized(self, datadir: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def initdb(self, datadir: str) -> None: ...
+
+    @abc.abstractmethod
+    def start_argv(self, datadir: str) -> list[str]: ...
+
+    def child_env(self) -> dict | None:
+        """Extra environment for the spawned database process (None =
+        inherit unchanged)."""
+        return None
+
+    @abc.abstractmethod
+    def write_config(self, datadir: str, *, host: str, port: int,
+                    peer_id: str,
+                    read_only: bool,
+                    sync_standby_ids: list[str],
+                    upstream: dict | None) -> None:
+        """Write the full server config for a role.  *upstream* is a
+        PeerInfo dict (standby mode: primary_conninfo) or None (primary).
+        The reference's analogue regenerates postgresql.conf from the
+        template plus recovery.conf / standby.signal for PG>=12
+        (lib/postgresMgr.js:2200-2336)."""
+
+    # -- queries (local or remote) --
+
+    @abc.abstractmethod
+    async def query(self, host: str, port: int, op: dict,
+                    timeout: float = 5.0) -> dict:
+        """Issue one structured query; raises PgError/PgQueryTimeout."""
+
+    async def query_url(self, url: str, op: dict,
+                        timeout: float = 5.0) -> dict:
+        _, host, port = parse_pg_url(url)
+        return await self.query(host, port, op, timeout)
+
+    async def health(self, host: str, port: int,
+                     timeout: float = 5.0) -> bool:
+        try:
+            res = await self.query(host, port, {"op": "health"}, timeout)
+            return bool(res.get("ok"))
+        except PgError:
+            return False
+
+    async def status(self, host: str, port: int,
+                     timeout: float = 5.0) -> dict:
+        return await self.query(host, port, {"op": "status"}, timeout)
+
+
+class SimPgEngine(Engine):
+    """Engine for the simulated postgres (manatee_tpu.pg.simpg)."""
+
+    scheme = "sim"
+
+    def is_initialized(self, datadir: str) -> bool:
+        from manatee_tpu.pg.simpg import VERSION_FILE
+        return (Path(datadir) / VERSION_FILE).exists()
+
+    async def initdb(self, datadir: str) -> None:
+        from manatee_tpu.pg.simpg import CONF_NAME, VERSION, VERSION_FILE
+        d = Path(datadir)
+        d.mkdir(parents=True, exist_ok=True)
+        if self.is_initialized(datadir):
+            raise PgError("already initialized: %s" % datadir)
+        (d / VERSION_FILE).write_text(VERSION + "\n")
+        (d / CONF_NAME).write_text(json.dumps({
+            "port": 0, "read_only": True,
+            "synchronous_standby_names": [],
+            "primary_conninfo": None,
+        }))
+
+    def start_argv(self, datadir: str) -> list[str]:
+        return [sys.executable, "-m", "manatee_tpu.pg.simpg",
+                "-D", str(datadir)]
+
+    def child_env(self) -> dict | None:
+        # the child must be able to import this package regardless of the
+        # parent's cwd
+        import os
+        import manatee_tpu
+        pkg_root = str(Path(manatee_tpu.__file__).parent.parent)
+        env = dict(os.environ)
+        parts = [pkg_root] + ([env["PYTHONPATH"]]
+                              if env.get("PYTHONPATH") else [])
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        return env
+
+    def write_config(self, datadir: str, *, host: str, port: int,
+                     peer_id: str, read_only: bool,
+                     sync_standby_ids: list[str],
+                     upstream: dict | None) -> None:
+        from manatee_tpu.pg.simpg import CONF_NAME
+        conninfo = None
+        if upstream is not None:
+            _s, uhost, uport = parse_pg_url(upstream["pgUrl"])
+            conninfo = {"host": uhost, "port": uport}
+        conf = {
+            "host": host,
+            "port": port,
+            "peer_id": peer_id,
+            "read_only": read_only,
+            "synchronous_standby_names": sync_standby_ids,
+            "primary_conninfo": conninfo,
+        }
+        p = Path(datadir) / CONF_NAME
+        tmp = p.with_name(p.name + ".tmp")
+        tmp.write_text(json.dumps(conf, indent=2))
+        tmp.replace(p)
+
+    async def query(self, host: str, port: int, op: dict,
+                    timeout: float = 5.0) -> dict:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise PgError("cannot connect to %s:%d: %s"
+                          % (host, port, e)) from None
+        try:
+            writer.write((json.dumps(op) + "\n").encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), timeout)
+            if not line:
+                raise PgError("connection closed by %s:%d" % (host, port))
+            res = json.loads(line)
+        except asyncio.TimeoutError:
+            raise PgQueryTimeout("query timed out after %ss" % timeout) \
+                from None
+        except (ConnectionError, json.JSONDecodeError) as e:
+            raise PgError(str(e)) from None
+        finally:
+            writer.close()
+        if not res.get("ok") and "error" in res:
+            raise PgError(res["error"])
+        return res

@@ -1,0 +1,427 @@
+"""PostgresMgr — owns the database child process and its configuration.
+
+Reference parity map (lib/postgresMgr.js):
+
+- role reconfiguration contract {role: primary|sync|async|none, upstream,
+  downstream} (:758-845);
+- primary transition: prepare database (mount/create dataset, initdb if
+  empty) → drop recovery config → force read-only → restart → storage
+  snapshot → background wait-for-standby-catchup → enable writes + SIGHUP
+  (_primary :1115-1184, _waitForStandby :1037-1105);
+- standby-only change on a running primary = conf rewrite + SIGHUP
+  (_updateStandby :1195-1260);
+- standby transition: stop → mount dataset → rewrite upstream conf →
+  restart, falling back to a FULL restore from the upstream's backupUrl
+  on any failure (_standby :1282-1460);
+- stop = SIGINT → SIGQUIT → SIGKILL escalation, never a clean shutdown
+  (_stop :1484-1541; docs/xlog-diverge.md:12-15 explains why);
+- health check every healthChkInterval with timeout → unhealthy
+  (:1550-1646);
+- serialized queries to our own database (:1989-2172);
+- replication catch-up: downstream's flush must reach sent, with
+  replicationTimeout bounding NO-PROGRESS intervals (_checkRepl
+  :2390-2555);
+- cancelable in-flight transitions (:379-385, 1123-1131) — a restore can
+  take hours and must be interruptible by the next topology change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import signal
+import time
+from pathlib import Path
+from typing import Awaitable, Callable
+
+from manatee_tpu.pg.engine import Engine, PgError, PgQueryTimeout
+from manatee_tpu.state.types import INITIAL_WAL
+from manatee_tpu.storage.base import StorageBackend, StorageError
+
+log = logging.getLogger("manatee.pg")
+
+
+class NeedsRestoreError(PgError):
+    """The local database cannot serve this role; a restore from the
+    upstream's backup server is required."""
+
+
+DEFAULTS = {
+    "opsTimeout": 60.0,
+    "healthChkInterval": 1.0,
+    "healthChkTimeout": 5.0,
+    "replicationTimeout": 60.0,
+    "singleton": False,
+}
+
+
+class PostgresMgr:
+    def __init__(self, *, engine: Engine, storage: StorageBackend,
+                 config: dict,
+                 restore_fn: Callable[[dict], Awaitable[None]] | None = None):
+        """*config*: peer_id, host, port, datadir, dataset, plus the
+        DEFAULTS knobs (etc/sitter.json parity).  *restore_fn(upstream)*
+        performs the bulk restore (wired to the backup client)."""
+        self.engine = engine
+        self.storage = storage
+        self.cfg = dict(DEFAULTS)
+        self.cfg.update(config)
+        self.restore_fn = restore_fn
+
+        self.peer_id = self.cfg["peer_id"]
+        self.host = self.cfg.get("host", "127.0.0.1")
+        self.port = int(self.cfg["port"])
+        self.datadir = str(self.cfg["datadir"])
+        self.dataset = self.cfg.get("dataset")
+
+        self._proc: asyncio.subprocess.Process | None = None
+        self._applied: dict | None = None   # last successful role config
+        self._online = False
+        self._health_task: asyncio.Task | None = None
+        self._catchup_task: asyncio.Task | None = None
+        self._reconf_lock = asyncio.Lock()
+        self._query_lock = asyncio.Lock()   # serialized local queries
+        self._last_xlog = INITIAL_WAL
+        self._listeners: dict[str, list[Callable]] = {}
+        self._closed = False
+        self._log_fh = None
+
+    # ---- events ----
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def _emit(self, event: str, payload=None) -> None:
+        for cb in self._listeners.get(event, []):
+            try:
+                cb(payload)
+            except Exception:
+                log.exception("pg listener for %s failed", event)
+
+    # ---- lifecycle ----
+
+    async def start_manager(self) -> None:
+        """Initial probe + health loop; emits 'init' {setup, online}
+        (lib/postgresMgr.js:401-421)."""
+        setup = self.engine.is_initialized(self.datadir)
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        self._emit("init", {"setup": setup, "online": False})
+
+    async def close(self) -> None:
+        """Crash-only shutdown: the child is shot in the head, never a
+        clean postgres shutdown (lib/shard.js:78-93)."""
+        self._closed = True
+        for t in (self._health_task, self._catchup_task):
+            if t:
+                t.cancel()
+        await self._kill_proc()
+        if self._log_fh:
+            self._log_fh.close()
+
+    @property
+    def online(self) -> bool:
+        return self._online
+
+    @property
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.returncode is None
+
+    def status(self) -> dict:
+        return {
+            "peer_id": self.peer_id,
+            "online": self._online,
+            "running": self.running,
+            "pid": self._proc.pid if self.running else None,
+            "setup": self.engine.is_initialized(self.datadir),
+            "role": (self._applied or {}).get("role"),
+            "lastXlog": self._last_xlog,
+        }
+
+    # ---- queries ----
+
+    async def _local_query(self, op: dict, timeout: float = 5.0) -> dict:
+        async with self._query_lock:
+            return await self.engine.query(self.host, self.port, op,
+                                           timeout)
+
+    async def get_xlog_location(self) -> str:
+        """Current WAL position; falls back to the last observed position
+        when the database is down (lib/postgresMgr.js:868-899)."""
+        try:
+            res = await self._local_query({"op": "status"}, 5.0)
+            self._last_xlog = res["xlog_location"]
+        except PgError:
+            pass
+        return self._last_xlog
+
+    # ---- reconfiguration ----
+
+    async def reconfigure(self, pgcfg: dict) -> None:
+        """{role, upstream, downstream} — the contract of
+        lib/postgresMgr.js:758-845.  Cancelable; serialized."""
+        async with self._reconf_lock:
+            role = pgcfg.get("role")
+            log.info("%s: reconfigure -> %s", self.peer_id, role)
+            await self._cancel_catchup()
+            if role == "primary":
+                if self._applied and self._applied.get("role") == \
+                        "primary" and self.running:
+                    await self._update_standby(pgcfg)
+                else:
+                    await self._primary(pgcfg)
+            elif role in ("sync", "async"):
+                await self._standby(pgcfg)
+            elif role == "none":
+                await self._stop()
+            else:
+                raise PgError("bad role: %r" % role)
+            self._applied = pgcfg
+
+    async def _cancel_catchup(self) -> None:
+        if self._catchup_task and not self._catchup_task.done():
+            self._catchup_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._catchup_task
+        self._catchup_task = None
+
+    # -- primary --
+
+    async def _primary(self, pgcfg: dict) -> None:
+        """(lib/postgresMgr.js:1115-1184)"""
+        await self._stop()
+        await self._prepare_database()
+        downstream = pgcfg.get("downstream")
+        singleton = bool(self.cfg.get("singleton"))
+        sync_ids = [downstream["id"]] if downstream else []
+        # read-only until the sync catches up — taking writes before
+        # the sync is established risks data loss on the next failover
+        self.engine.write_config(
+            self.datadir, host=self.host, port=self.port,
+            peer_id=self.peer_id,
+            read_only=not singleton,
+            sync_standby_ids=sync_ids, upstream=None)
+        await self._start()
+        await self._snapshot_safe()
+        if downstream:
+            self._catchup_task = asyncio.ensure_future(
+                self._wait_for_standby(downstream["id"], sync_ids))
+
+    async def _update_standby(self, pgcfg: dict) -> None:
+        """Already primary; only the downstream changed: conf rewrite +
+        SIGHUP (lib/postgresMgr.js:1195-1260)."""
+        downstream = pgcfg.get("downstream")
+        singleton = bool(self.cfg.get("singleton"))
+        sync_ids = [downstream["id"]] if downstream else []
+        self.engine.write_config(
+            self.datadir, host=self.host, port=self.port,
+            peer_id=self.peer_id,
+            read_only=not singleton,
+            sync_standby_ids=sync_ids, upstream=None)
+        self._reload()
+        if downstream:
+            self._catchup_task = asyncio.ensure_future(
+                self._wait_for_standby(downstream["id"], sync_ids))
+
+    async def _wait_for_standby(self, standby_id: str,
+                                sync_ids: list[str]) -> None:
+        """Poll replication status until the downstream catches up
+        (sent == flush), bounded by replicationTimeout of NO progress,
+        then enable writes (lib/postgresMgr.js:1037-1105, 2390-2555)."""
+        last_flush: str | None = None
+        deadline = time.monotonic() + float(self.cfg["replicationTimeout"])
+        while not self._closed:
+            try:
+                res = await self._local_query({"op": "status"}, 5.0)
+                row = next((r for r in res.get("replication", [])
+                            if r["application_name"] == standby_id), None)
+                if row and row.get("state") == "streaming":
+                    if row["flush_lsn"] != last_flush:
+                        last_flush = row["flush_lsn"]
+                        deadline = time.monotonic() + \
+                            float(self.cfg["replicationTimeout"])
+                    if row["sent_lsn"] == row["flush_lsn"]:
+                        log.info("%s: standby %s caught up at %s; "
+                                 "enabling writes", self.peer_id,
+                                 standby_id, row["flush_lsn"])
+                        self.engine.write_config(
+                            self.datadir, host=self.host, port=self.port,
+                            peer_id=self.peer_id, read_only=False,
+                            sync_standby_ids=sync_ids, upstream=None)
+                        self._reload()
+                        self._emit("writable", standby_id)
+                        return
+                if time.monotonic() > deadline:
+                    log.error("%s: standby %s made no replication "
+                              "progress in %ss; still waiting",
+                              self.peer_id, standby_id,
+                              self.cfg["replicationTimeout"])
+                    self._emit("replicationTimeout", standby_id)
+                    deadline = time.monotonic() + \
+                        float(self.cfg["replicationTimeout"])
+            except PgError as e:
+                log.debug("catchup poll error: %s", e)
+            await asyncio.sleep(1.0)
+
+    # -- standby --
+
+    async def _standby(self, pgcfg: dict) -> None:
+        """(lib/postgresMgr.js:1282-1460)"""
+        upstream = pgcfg["upstream"]
+        try:
+            await self._stop()
+            await self._ensure_dataset_mounted(create=False)
+            if not self.engine.is_initialized(self.datadir):
+                raise NeedsRestoreError("no local database")
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id, read_only=True,
+                sync_standby_ids=[], upstream=upstream)
+            await self._start(allow_restore_exit=True)
+        except asyncio.CancelledError:
+            raise
+        except (PgError, StorageError) as e:
+            # ANY failure becoming a standby ⇒ full restore from the
+            # upstream's backup server (lib/postgresMgr.js:1363-1374)
+            if self.restore_fn is None:
+                raise
+            log.warning("%s: standby setup failed (%s); restoring from "
+                        "%s", self.peer_id, e, upstream.get("backupUrl"))
+            await self._stop()
+            self._emit("restoreStart", upstream)
+            await self.restore_fn(upstream)
+            self._emit("restoreDone", upstream)
+            await self._ensure_dataset_mounted(create=False)
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id, read_only=True,
+                sync_standby_ids=[], upstream=upstream)
+            await self._start()
+
+    # -- database preparation --
+
+    async def _prepare_database(self) -> None:
+        """Mount or create the dataset; initdb if empty
+        (lib/postgresMgr.js:1806-1987)."""
+        await self._ensure_dataset_mounted(create=True)
+        if not self.engine.is_initialized(self.datadir):
+            log.info("%s: initializing fresh database", self.peer_id)
+            await self.engine.initdb(self.datadir)
+
+    async def _ensure_dataset_mounted(self, *, create: bool) -> None:
+        if not self.dataset:
+            Path(self.datadir).mkdir(parents=True, exist_ok=True)
+            return
+        if not await self.storage.exists(self.dataset):
+            if not create:
+                raise NeedsRestoreError("dataset %s missing" % self.dataset)
+            await self.storage.create(self.dataset,
+                                      mountpoint=self.datadir)
+        if not await self.storage.is_mounted(self.dataset):
+            await self.storage.set_mountpoint(self.dataset, self.datadir)
+            await self.storage.mount(self.dataset)
+
+    async def _snapshot_safe(self) -> None:
+        """Snapshot at primary-transition time
+        (lib/postgresMgr.js:1158-1160); failures are non-fatal."""
+        if not self.dataset:
+            return
+        try:
+            await self.storage.snapshot(self.dataset)
+        except StorageError as e:
+            log.warning("%s: transition snapshot failed: %s",
+                        self.peer_id, e)
+
+    # -- process control --
+
+    async def _start(self, allow_restore_exit: bool = False) -> None:
+        """Spawn and poll health until up, bounded by opsTimeout
+        (lib/postgresMgr.js:1695-1794)."""
+        if self.running:
+            return
+        argv = self.engine.start_argv(self.datadir)
+        if self._log_fh is None:
+            logpath = self.cfg.get(
+                "pgLogFile", str(Path(self.datadir).parent
+                                 / ("pg-%d.log" % self.port)))
+            self._log_fh = open(logpath, "ab")
+        self._proc = await asyncio.create_subprocess_exec(
+            *argv, stdout=self._log_fh, stderr=self._log_fh,
+            env=self.engine.child_env())
+        log.info("%s: started db pid=%d", self.peer_id, self._proc.pid)
+        deadline = time.monotonic() + float(self.cfg["opsTimeout"])
+        while time.monotonic() < deadline:
+            if self._proc.returncode is not None:
+                rc = self._proc.returncode
+                self._proc = None
+                if allow_restore_exit:
+                    raise NeedsRestoreError(
+                        "database exited rc=%d during standby boot" % rc)
+                raise PgError("database exited rc=%d during boot" % rc)
+            if await self.engine.health(self.host, self.port, 1.0):
+                self._online = True
+                return
+            await asyncio.sleep(0.2)
+        raise PgError("database did not come up within opsTimeout")
+
+    async def _stop(self) -> None:
+        """SIGINT → SIGQUIT → SIGKILL escalation
+        (lib/postgresMgr.js:1484-1541)."""
+        proc = self._proc
+        self._proc = None
+        self._online = False
+        if proc is None or proc.returncode is not None:
+            return
+        step = max(0.5, float(self.cfg["opsTimeout"]) / 6.0)
+        for sig in (signal.SIGINT, signal.SIGQUIT, signal.SIGKILL):
+            try:
+                proc.send_signal(sig)
+            except ProcessLookupError:
+                return
+            try:
+                await asyncio.wait_for(proc.wait(), step)
+                return
+            except asyncio.TimeoutError:
+                continue
+        await proc.wait()
+
+    async def _kill_proc(self) -> None:
+        proc = self._proc
+        self._proc = None
+        if proc and proc.returncode is None:
+            with contextlib.suppress(ProcessLookupError):
+                proc.kill()
+            with contextlib.suppress(Exception):
+                await proc.wait()
+
+    def _reload(self) -> None:
+        """SIGHUP (conf reload) — lib/postgresMgr.js:1003, 2338-2345."""
+        if self.running:
+            with contextlib.suppress(ProcessLookupError):
+                self._proc.send_signal(signal.SIGHUP)
+
+    async def _restart(self) -> None:
+        await self._stop()
+        await self._start()
+
+    # -- health --
+
+    async def _health_loop(self) -> None:
+        """(lib/postgresMgr.js:1550-1646)"""
+        interval = float(self.cfg["healthChkInterval"])
+        timeout = float(self.cfg["healthChkTimeout"])
+        while not self._closed:
+            await asyncio.sleep(interval)
+            if not self.running:
+                if self._online:
+                    self._online = False
+                    self._emit("unhealthy", "not running")
+                continue
+            ok = await self.engine.health(self.host, self.port, timeout)
+            if ok and not self._online:
+                self._online = True
+                self._emit("healthy", None)
+            elif not ok and self._online:
+                self._online = False
+                self._emit("unhealthy", "health check failed")

@@ -1,0 +1,240 @@
+"""PostgresEngine — drives real postgres/initdb binaries.
+
+Config-generation parity with the reference:
+
+- postgresql.conf regenerated from a shipped template plus programmatic
+  key rewrites (lib/postgresMgr.js:2282-2336, etc/postgresql.conf):
+  wal_level=hot_standby, synchronous_commit=remote_write, fsync=on,
+  full_page_writes=off, hot_standby=on;
+- synchronous_standby_names quoted for >=9.6 (lib/postgresMgr.js:184-191);
+- standby recovery config: recovery.conf with standby_mode=on +
+  primary_conninfo for PG<12; standby.signal + primary_conninfo in
+  postgresql.conf for PG>=12 (lib/postgresMgr.js:601-607, 2200-2260);
+- WAL naming translations xlog/location vs wal/lsn by major version
+  (lib/postgresMgr.js:139-161, 649-677);
+- initdb run as the postgres OS user (lib/postgresMgr.js:1806-1987).
+
+Queries go through psql(1) so no driver dependency is needed; the result
+is normalized to the same structured dicts SimPgEngine returns.  This
+engine requires real binaries and is exercised only on hosts that have
+them (the dev image does not).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+from manatee_tpu.pg.engine import Engine, PgError, PgQueryTimeout, parse_pg_url
+from manatee_tpu.utils import ConfFile, ExecError, run
+from manatee_tpu.utils.confparser import quote_conf_value
+from manatee_tpu.utils.pgversion import pg_strip_minor
+
+DEFAULT_TEMPLATE = {
+    "listen_addresses": "'0.0.0.0'",
+    "wal_level": "hot_standby",
+    "synchronous_commit": "remote_write",
+    "fsync": "on",
+    "full_page_writes": "off",
+    "hot_standby": "on",
+    "max_wal_senders": "10",
+    "wal_keep_segments": "100",
+}
+
+
+def wal_function_names(major: str) -> dict:
+    """xlog/location (<10) vs wal/lsn (>=10) naming
+    (lib/postgresMgr.js:139-161)."""
+    if float(major.split(".")[0]) >= 10:
+        return {
+            "current": "pg_current_wal_lsn()",
+            "receive": "pg_last_wal_receive_lsn()",
+            "replay_ts": "pg_last_xact_replay_timestamp()",
+            "stat_sent": "sent_lsn",
+            "stat_flush": "flush_lsn",
+            "stat_write": "write_lsn",
+            "stat_replay": "replay_lsn",
+        }
+    return {
+        "current": "pg_current_xlog_location()",
+        "receive": "pg_last_xlog_receive_location()",
+        "replay_ts": "pg_last_xact_replay_timestamp()",
+        "stat_sent": "sent_location",
+        "stat_flush": "flush_location",
+        "stat_write": "write_location",
+        "stat_replay": "replay_location",
+    }
+
+
+class PostgresEngine(Engine):
+    scheme = "tcp"
+
+    def __init__(self, *, pg_bin_dir: str = "", version: str = "12.0",
+                 pg_user: str = "postgres", use_sudo: bool = True,
+                 template: dict | None = None,
+                 overrides: dict | None = None):
+        self.bin = Path(pg_bin_dir) if pg_bin_dir else None
+        self.version = version
+        self.major = pg_strip_minor(version)
+        self.pg_user = pg_user
+        self.use_sudo = use_sudo
+        self.template = dict(template or DEFAULT_TEMPLATE)
+        # pg_overrides.json-style tunables merged over the template
+        # (lib/postgresMgr.js:118-137, 527-560)
+        self.template.update(overrides or {})
+
+    def _cmd(self, name: str) -> str:
+        return str(self.bin / name) if self.bin else name
+
+    # -- local cluster management --
+
+    def is_initialized(self, datadir: str) -> bool:
+        return (Path(datadir) / "PG_VERSION").exists()
+
+    async def initdb(self, datadir: str) -> None:
+        argv = [self._cmd("initdb"), "-D", str(datadir), "-E", "UTF8"]
+        if self.use_sudo:
+            argv = ["sudo", "-u", self.pg_user] + argv
+        try:
+            await run(argv, timeout=300)
+        except ExecError as e:
+            raise PgError("initdb failed: %s" % e) from None
+
+    def start_argv(self, datadir: str) -> list[str]:
+        return [self._cmd("postgres"), "-D", str(datadir)]
+
+    def write_config(self, datadir: str, *, host: str, port: int,
+                     peer_id: str, read_only: bool,
+                     sync_standby_ids: list[str],
+                     upstream: dict | None) -> None:
+        d = Path(datadir)
+        conf = ConfFile(dict(self.template))
+        conf.set("port", str(port))
+        conf.set("default_transaction_read_only",
+                 "on" if read_only else "off")
+        if sync_standby_ids:
+            names = ",".join('"%s"' % s for s in sync_standby_ids)
+            # >= 9.6 takes the num-sync form (lib/postgresMgr.js:184-191)
+            if float(self.major) >= 9.6:
+                conf.set("synchronous_standby_names",
+                         quote_conf_value("1 (%s)" % names))
+            else:
+                conf.set("synchronous_standby_names",
+                         quote_conf_value(names))
+        else:
+            conf.delete("synchronous_standby_names")
+        # wal_keep_segments was removed in PG 13 (wal_keep_size replaces it)
+        if int(self.major.split(".")[0]) >= 13:
+            if "wal_keep_segments" in conf:
+                conf.delete("wal_keep_segments")
+                conf.set("wal_keep_size", "'1600MB'")
+
+        is_modern = int(self.major.split(".")[0]) >= 12
+        recovery = d / "recovery.conf"
+        signal = d / "standby.signal"
+        if upstream is None:
+            # primary: drop all recovery configuration
+            # (lib/postgresMgr.js:1145-1152)
+            for f in (recovery, signal):
+                if f.exists():
+                    f.unlink()
+        else:
+            _s, uhost, uport = parse_pg_url(upstream["pgUrl"])
+            conninfo = ("host=%s port=%d user=%s application_name=%s"
+                        % (uhost, uport, self.pg_user, peer_id))
+            if is_modern:
+                conf.set("primary_conninfo", quote_conf_value(conninfo))
+                signal.touch()
+                if recovery.exists():
+                    recovery.unlink()
+            else:
+                rc = ConfFile({
+                    "standby_mode": "'on'",
+                    "primary_conninfo": quote_conf_value(conninfo),
+                })
+                rc.write(recovery)
+        conf.write(d / "postgresql.conf")
+
+    # -- queries via psql --
+
+    async def _psql(self, host: str, port: int, sql: str,
+                    timeout: float) -> str:
+        argv = [self._cmd("psql"), "-h", host, "-p", str(port),
+                "-U", self.pg_user, "-d", "postgres",
+                "-At", "-F", "\x1f", "-c", sql]
+        import os
+        env = dict(os.environ)
+        env["PGCONNECT_TIMEOUT"] = str(int(timeout))
+        try:
+            res = await run(argv, timeout=timeout, env=env)
+        except ExecError as e:
+            if "timeout" in e.result.stderr:
+                raise PgQueryTimeout(str(e)) from None
+            raise PgError(e.result.stderr.strip() or str(e)) from None
+        return res.stdout
+
+    async def query(self, host: str, port: int, op: dict,
+                    timeout: float = 5.0) -> dict:
+        kind = op.get("op")
+        w = wal_function_names(self.major)
+        if kind == "health":
+            await self._psql(host, port, "SELECT current_time;", timeout)
+            return {"ok": True}
+        if kind == "status":
+            in_rec = (await self._psql(
+                host, port, "SELECT pg_is_in_recovery();",
+                timeout)).strip() == "t"
+            if in_rec:
+                xlog = (await self._psql(
+                    host, port, "SELECT %s;" % w["receive"],
+                    timeout)).strip()
+                lag = (await self._psql(
+                    host, port,
+                    "SELECT EXTRACT(EPOCH FROM (now() - %s));"
+                    % w["replay_ts"], timeout)).strip()
+                lag_s = float(lag) if lag else None
+            else:
+                xlog = (await self._psql(
+                    host, port, "SELECT %s;" % w["current"],
+                    timeout)).strip()
+                lag_s = None
+            rows = await self._psql(
+                host, port,
+                "SELECT application_name, state, %s, %s, %s, %s, "
+                "sync_state FROM pg_stat_replication;"
+                % (w["stat_sent"], w["stat_write"], w["stat_flush"],
+                   w["stat_replay"]), timeout)
+            repl = []
+            for line in rows.splitlines():
+                if not line.strip():
+                    continue
+                f = line.split("\x1f")
+                repl.append({
+                    "application_name": f[0], "state": f[1],
+                    "sent_lsn": f[2], "write_lsn": f[3],
+                    "flush_lsn": f[4], "replay_lsn": f[5],
+                    "sync_state": f[6],
+                })
+            ro = (await self._psql(
+                host, port, "SHOW default_transaction_read_only;",
+                timeout)).strip() == "on"
+            return {"ok": True, "in_recovery": in_rec,
+                    "read_only": in_rec or ro,
+                    "xlog_location": xlog or "0/0000000",
+                    "replication": repl, "replay_lag_seconds": lag_s,
+                    "version": self.version}
+        if kind == "insert":
+            val = json.dumps(op.get("value"))
+            await self._psql(
+                host, port,
+                "CREATE TABLE IF NOT EXISTS manatee_probe (v text); "
+                "INSERT INTO manatee_probe VALUES (%s);"
+                % quote_conf_value(val), timeout)
+            return {"ok": True}
+        if kind == "select":
+            out = await self._psql(
+                host, port, "SELECT v FROM manatee_probe;", timeout)
+            return {"ok": True,
+                    "rows": [json.loads(x) for x in out.splitlines() if x]}
+        raise PgError("unknown op %r" % kind)

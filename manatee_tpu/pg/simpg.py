@@ -1,0 +1,417 @@
+"""simpg — a simulated PostgreSQL server process.
+
+Runnable as ``python -m manatee_tpu.pg.simpg -D <datadir>``.  It models
+exactly the PostgreSQL surface the control plane depends on
+(lib/postgresMgr.js), with real processes, sockets, and files:
+
+- a data directory created by "initdb" (``SimPgEngine.initdb``) holding
+  a WAL file (JSON-lines of ``{lsn, value}`` records) and config;
+- a TCP server speaking newline-JSON for the queries the manager issues:
+  health ("select current_time", :1550-1646), replication status
+  (pg_stat_replication, :2390-2555), xlog position (:868-899),
+  pg_is_in_recovery, plus INSERT/SELECT for availability tests;
+- **synchronous replication**: with ``synchronous_standby_names`` set,
+  an insert does not ack until the named standby has flushed that
+  record (the guarantee docs/user-guide.md:79-84 relies on);
+- **streaming + cascading replication**: a standby connects to its
+  upstream (``primary_conninfo`` in the conf), pulls records from its
+  flush point, acks flush positions, and serves replication to its own
+  downstream in turn;
+- **recovery config**: with primary_conninfo set the server is a
+  standby (in_recovery=True, read-only); without it, a primary;
+- **divergence detection**: a standby whose WAL is ahead of (or
+  inconsistent with) its upstream refuses to stream and exits, forcing
+  the manager down its restore path (docs/xlog-diverge.md analogue);
+- postgres signal semantics: SIGINT = fast shutdown, SIGQUIT =
+  immediate, SIGHUP = reload (read_only + synchronous_standby_names
+  only, like pg's reloadable GUCs).
+
+LSNs are rendered "0/XXXXXXX" like postgres so the control plane's LSN
+arithmetic (pg-lsn parity) is exercised for real.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+CONF_NAME = "simpg.conf"
+WAL_NAME = "wal.jsonl"
+VERSION_FILE = "SIMPG_VERSION"
+VERSION = "12.0"
+
+
+def lsn_str(n: int) -> str:
+    return "%X/%08X" % (n >> 32, n & 0xFFFFFFFF)
+
+
+def read_conf(datadir: Path) -> dict:
+    return json.loads((datadir / CONF_NAME).read_text())
+
+
+class Wal:
+    """Append-only record log; lsn = 1 + index (0 reserved for 'nothing')."""
+
+    def __init__(self, datadir: Path):
+        self.path = datadir / WAL_NAME
+        self.records: list[dict] = []
+        if self.path.exists():
+            for line in self.path.read_text().splitlines():
+                if line.strip():
+                    self.records.append(json.loads(line))
+        self._fh = open(self.path, "a")
+
+    @property
+    def last_lsn(self) -> int:
+        return len(self.records)
+
+    def append(self, value, ts: float | None = None) -> int:
+        rec = {"lsn": self.last_lsn + 1, "value": value,
+               "ts": ts if ts is not None else time.time()}
+        self.records.append(rec)
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        return rec["lsn"]
+
+    def get_from(self, lsn: int) -> list[dict]:
+        return self.records[lsn:]
+
+
+class SimPgServer:
+    def __init__(self, datadir: Path):
+        self.datadir = datadir
+        self.conf = read_conf(datadir)
+        self.wal = Wal(datadir)
+        self.port = int(self.conf["port"])
+        self.peer_id = self.conf.get("peer_id", "?")
+        # replication bookkeeping: standby_id -> {sent, flush, replay}
+        self.downstreams: dict[str, dict] = {}
+        self._repl_waiters: list[asyncio.Event] = []
+        self._upstream_task: asyncio.Task | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._stopping = False
+        self.last_replay_ts: float | None = None
+
+    # ---- role helpers ----
+
+    @property
+    def in_recovery(self) -> bool:
+        return bool(self.conf.get("primary_conninfo"))
+
+    @property
+    def read_only(self) -> bool:
+        return self.in_recovery or bool(self.conf.get("read_only"))
+
+    def sync_names(self) -> list[str]:
+        return self.conf.get("synchronous_standby_names") or []
+
+    # ---- lifecycle ----
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+
+        def fast_shutdown():
+            # SIGINT: abort connections, flush, exit 0
+            self._stopping = True
+            stop.set()
+
+        def immediate_shutdown():
+            # SIGQUIT: die NOW, no checkpoint (crash-consistent state)
+            os._exit(2)
+
+        def reload_conf():
+            try:
+                newconf = read_conf(self.datadir)
+            except (OSError, json.JSONDecodeError):
+                return
+            # reloadable GUCs only (postgres parity): read_only,
+            # synchronous_standby_names
+            self.conf["read_only"] = newconf.get("read_only")
+            self.conf["synchronous_standby_names"] = \
+                newconf.get("synchronous_standby_names")
+            self._wake_repl_waiters()
+
+        loop.add_signal_handler(signal.SIGINT, fast_shutdown)
+        loop.add_signal_handler(signal.SIGTERM, fast_shutdown)
+        loop.add_signal_handler(signal.SIGQUIT, immediate_shutdown)
+        loop.add_signal_handler(signal.SIGHUP, reload_conf)
+
+        if self.in_recovery:
+            # probe the upstream for divergence BEFORE opening our
+            # listener: a diverged standby must fail its boot (so the
+            # manager takes the restore path) rather than answer health
+            # checks and die moments later.  An unreachable upstream is
+            # fine — the background streamer keeps retrying.
+            await self._probe_upstream_divergence()
+
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.conf.get("host", "127.0.0.1"),
+            self.port)
+        sys.stderr.write("simpg %s listening on %d (recovery=%s)\n"
+                         % (self.peer_id, self.port, self.in_recovery))
+        sys.stderr.flush()
+
+        if self.in_recovery:
+            self._upstream_task = asyncio.ensure_future(
+                self._stream_from_upstream())
+
+        await stop.wait()
+        if self._upstream_task:
+            self._upstream_task.cancel()
+        self._server.close()
+
+    # ---- upstream replication (we are a standby) ----
+
+    async def _probe_upstream_divergence(self) -> None:
+        conninfo = self.conf["primary_conninfo"]
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(conninfo["host"],
+                                        int(conninfo["port"])), 2.0)
+        except (OSError, asyncio.TimeoutError):
+            return  # upstream down; not a divergence verdict
+        try:
+            req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
+                   "standby_id": self.peer_id}
+            writer.write((json.dumps(req) + "\n").encode())
+            await writer.drain()
+            hello = json.loads(await asyncio.wait_for(
+                reader.readline(), 2.0))
+            if not hello.get("ok"):
+                sys.stderr.write("simpg: boot replication probe refused: "
+                                 "%s\n" % hello.get("error"))
+                sys.stderr.flush()
+                os._exit(3)
+        except (OSError, ValueError, json.JSONDecodeError,
+                asyncio.TimeoutError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _stream_from_upstream(self) -> None:
+        conninfo = self.conf["primary_conninfo"]
+        while not self._stopping:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    conninfo["host"], int(conninfo["port"]))
+                req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
+                       "standby_id": self.peer_id}
+                writer.write((json.dumps(req) + "\n").encode())
+                await writer.drain()
+                hello = json.loads(await reader.readline())
+                if not hello.get("ok"):
+                    # divergence: our WAL is ahead of/inconsistent with
+                    # upstream — a real standby would fail to stream;
+                    # exit non-zero so the manager goes down its restore
+                    # path (lib/postgresMgr.js:1363-1374)
+                    sys.stderr.write("simpg: replication refused: %s\n"
+                                     % hello.get("error"))
+                    sys.stderr.flush()
+                    os._exit(3)
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    rec = json.loads(line)
+                    self.wal.append(rec["value"], rec.get("ts"))
+                    self.last_replay_ts = time.time()
+                    self._wake_repl_waiters()
+                    ack = {"flush": self.wal.last_lsn}
+                    writer.write((json.dumps(ack) + "\n").encode())
+                    await writer.drain()
+            except (OSError, ValueError, json.JSONDecodeError):
+                pass
+            await asyncio.sleep(0.2)
+
+    # ---- serving connections ----
+
+    def _wake_repl_waiters(self) -> None:
+        for ev in self._repl_waiters:
+            ev.set()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await reader.readline()
+            if not line:
+                return
+            req = json.loads(line)
+            if req.get("op") == "replicate":
+                await self._serve_replication(req, reader, writer)
+                return
+            # simple request/response session: first request already read
+            while True:
+                resp = await self._dispatch(req)
+                writer.write((json.dumps(resp) + "\n").encode())
+                await writer.drain()
+                line = await reader.readline()
+                if not line:
+                    break
+                req = json.loads(line)
+        except (ConnectionError, json.JSONDecodeError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _serve_replication(self, req: dict,
+                                 reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        standby_id = req.get("standby_id", "?")
+        from_lsn = int(req.get("from_lsn", 0))
+        if from_lsn > self.wal.last_lsn:
+            writer.write((json.dumps(
+                {"ok": False,
+                 "error": "requested start %s beyond local wal %s "
+                          "(diverged)" % (lsn_str(from_lsn),
+                                          lsn_str(self.wal.last_lsn))}
+            ) + "\n").encode())
+            await writer.drain()
+            return
+        writer.write((json.dumps({"ok": True}) + "\n").encode())
+        await writer.drain()
+        st = {"sent": from_lsn, "flush": from_lsn, "replay": from_lsn,
+              "sync_state": "async"}
+        self.downstreams[standby_id] = st
+
+        async def read_acks():
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    ack = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                st["flush"] = max(st["flush"], int(ack.get("flush", 0)))
+                st["replay"] = st["flush"]
+                self._wake_repl_waiters()
+
+        ack_task = asyncio.ensure_future(read_acks())
+        try:
+            cursor = from_lsn
+            while True:
+                recs = self.wal.get_from(cursor)
+                for rec in recs:
+                    writer.write((json.dumps(rec) + "\n").encode())
+                    cursor = rec["lsn"]
+                    st["sent"] = cursor
+                await writer.drain()
+                # wait for new records
+                ev = asyncio.Event()
+                self._repl_waiters.append(ev)
+                try:
+                    if self.wal.last_lsn == cursor:
+                        await asyncio.wait_for(ev.wait(), 0.5)
+                finally:
+                    self._repl_waiters.remove(ev)
+        except (ConnectionError, asyncio.TimeoutError, OSError):
+            pass
+        finally:
+            ack_task.cancel()
+            self.downstreams.pop(standby_id, None)
+
+    async def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "health":
+            # "select current_time" analogue
+            return {"ok": True, "now": time.time()}
+        if op == "status":
+            repl = []
+            syncs = self.sync_names()
+            for sid, st in self.downstreams.items():
+                repl.append({
+                    "application_name": sid,
+                    "state": "streaming",
+                    "sent_lsn": lsn_str(st["sent"]),
+                    "write_lsn": lsn_str(st["flush"]),
+                    "flush_lsn": lsn_str(st["flush"]),
+                    "replay_lsn": lsn_str(st["replay"]),
+                    "sync_state": "sync" if sid in syncs else "async",
+                })
+            return {
+                "ok": True,
+                "in_recovery": self.in_recovery,
+                "read_only": self.read_only,
+                "xlog_location": lsn_str(self.wal.last_lsn),
+                "replication": repl,
+                "replay_lag_seconds": (
+                    None if not self.in_recovery or
+                    self.last_replay_ts is None
+                    else max(0.0, time.time() - self.last_replay_ts)),
+                "version": VERSION,
+            }
+        if op == "insert":
+            if self.read_only:
+                return {"ok": False,
+                        "error": "cannot execute INSERT in a read-only "
+                                 "transaction"}
+            lsn = self.wal.append(req.get("value"))
+            syncs = self.sync_names()
+            if syncs:
+                # synchronous_commit: wait for the sync standby to flush
+                ok = await self._wait_sync_flush(syncs, lsn,
+                                                 float(req.get(
+                                                     "timeout", 10.0)))
+                if not ok:
+                    return {"ok": False,
+                            "error": "canceling wait for synchronous "
+                                     "replication (timeout)"}
+            return {"ok": True, "lsn": lsn_str(lsn)}
+        if op == "select":
+            return {"ok": True,
+                    "rows": [r["value"] for r in self.wal.records]}
+        return {"ok": False, "error": "unknown op %r" % op}
+
+    async def _wait_sync_flush(self, syncs: list[str], lsn: int,
+                               timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for sid in syncs:
+                st = self.downstreams.get(sid)
+                if st and st["flush"] >= lsn:
+                    return True
+            ev = asyncio.Event()
+            self._repl_waiters.append(ev)
+            try:
+                await asyncio.wait_for(
+                    ev.wait(), max(0.01, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self._repl_waiters.remove(ev)
+        return False
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="simulated postgres")
+    p.add_argument("-D", "--datadir", required=True)
+    args = p.parse_args(argv)
+    datadir = Path(args.datadir)
+    if not (datadir / VERSION_FILE).exists():
+        sys.stderr.write(
+            'simpg: directory "%s" is not a database cluster directory\n'
+            % datadir)
+        sys.exit(1)
+    server = SimPgServer(datadir)
+    try:
+        asyncio.run(server.run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

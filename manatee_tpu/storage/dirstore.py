@@ -275,8 +275,12 @@ class DirBackend(StorageBackend):
             raise StorageError("no such snapshot: %s@%s" % (dataset, name))
         size = await self.estimate_send_size(dataset, name)
         header = json.dumps({"snapshot": name, "size": size}) + "\n"
-        writer.write(header.encode())
-        await writer.drain()
+        try:
+            writer.write(header.encode())
+            await writer.drain()
+        except Exception as e:
+            raise StorageError("send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
         proc = await asyncio.create_subprocess_exec(
             "tar", "-C", str(src), "-cf", "-", ".",
             stdout=asyncio.subprocess.PIPE,

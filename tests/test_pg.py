@@ -1,0 +1,346 @@
+"""PostgresMgr tests against real simulated-postgres child processes.
+
+These exercise the actual manager code paths the reference tests via its
+REPL and integration suite: primary bring-up with read-only-until-caught-
+up semantics, synchronous replication acks, cascading standbys, crash-only
+stop, divergence-triggered restore, and reconfigure cancelation.
+"""
+
+import asyncio
+import shutil
+import socket
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.pg.engine import PgError, SimPgEngine
+from manatee_tpu.pg.manager import NeedsRestoreError, PostgresMgr
+from manatee_tpu.storage import DirBackend
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_mgr(tmp_path, name, *, singleton=False, dataset=None,
+             storage=None, restore_fn=None, **over):
+    port = free_port()
+    cfg = {
+        "peer_id": name,
+        "host": "127.0.0.1",
+        "port": port,
+        "datadir": str(tmp_path / name / "data"),
+        "dataset": dataset,
+        "opsTimeout": 10.0,
+        "healthChkInterval": 0.2,
+        "healthChkTimeout": 2.0,
+        "replicationTimeout": 10.0,
+        "singleton": singleton,
+    }
+    cfg.update(over)
+    (tmp_path / name).mkdir(parents=True, exist_ok=True)
+    eng = SimPgEngine()
+    mgr = PostgresMgr(engine=eng, storage=storage or DirBackend(tmp_path / (name + "-store")),
+                      config=cfg, restore_fn=restore_fn)
+    return mgr
+
+
+def copying_restore(dst_box):
+    """Restore-fn stub playing the backup plane's role: bulk-copy the
+    upstream's datadir into our own.  dst_box is a dict set later to the
+    destination manager (managers reference each other)."""
+    import shutil as _sh
+
+    async def restore_fn(upstream):
+        src = Path(dst_box["peers"][upstream["id"]].datadir)
+        dst = Path(dst_box["self"].datadir)
+        if dst.exists():
+            _sh.rmtree(dst)
+        _sh.copytree(src, dst)
+    return restore_fn
+
+
+def wire_restores(*mgrs):
+    """Give every manager a restore_fn that copies from any peer."""
+    peers = {m.peer_id: m for m in mgrs}
+    for m in mgrs:
+        box = {"peers": peers, "self": m}
+        m.restore_fn = copying_restore(box)
+
+
+def info_for(mgr):
+    return {"id": mgr.peer_id, "zoneId": mgr.peer_id, "ip": mgr.host,
+            "pgUrl": "sim://%s:%d" % (mgr.host, mgr.port),
+            "backupUrl": "http://%s:1" % mgr.host}
+
+
+async def wait_until(pred, timeout=10.0, what="condition"):
+    t0 = asyncio.get_event_loop().time()
+    while asyncio.get_event_loop().time() - t0 < timeout:
+        r = pred()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("timed out waiting for " + what)
+
+
+def test_singleton_primary_insert_select(tmp_path):
+    async def go():
+        m = make_mgr(tmp_path, "solo", singleton=True)
+        await m.start_manager()
+        try:
+            await m.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            assert m.running
+            res = await m._local_query({"op": "insert", "value": "hello"})
+            assert res["ok"]
+            res = await m._local_query({"op": "select"})
+            assert res["rows"] == ["hello"]
+            assert (await m.get_xlog_location()) != "0/0000000"
+        finally:
+            await m.close()
+    run(go())
+
+
+def test_primary_sync_catchup_then_writable(tmp_path):
+    async def go():
+        p = make_mgr(tmp_path, "prim")
+        s = make_mgr(tmp_path, "sync")
+        wire_restores(p, s)
+        await p.start_manager()
+        await s.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": info_for(s)})
+            # read-only until the sync catches up
+            with pytest.raises(PgError, match="read-only"):
+                await p._local_query({"op": "insert", "value": "early"})
+
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": None})
+            # catch-up task flips the primary writable
+            async def writable():
+                try:
+                    await p._local_query({"op": "insert", "value": "w"},
+                                         5.0)
+                    return True
+                except PgError:
+                    return False
+            await wait_until(writable, what="primary writable")
+
+            # synchronous replication: the record must be on the sync
+            res = await s._local_query({"op": "select"})
+            assert "w" in res["rows"]
+
+            # sync status visible in pg_stat_replication with sync_state
+            st = await p._local_query({"op": "status"})
+            row = next(r for r in st["replication"]
+                       if r["application_name"] == s.peer_id)
+            assert row["sync_state"] == "sync"
+            assert row["state"] == "streaming"
+        finally:
+            await p.close()
+            await s.close()
+    run(go())
+
+
+def test_cascading_async_and_sync_commit_blocks_on_dead_sync(tmp_path):
+    async def go():
+        p = make_mgr(tmp_path, "prim")
+        s = make_mgr(tmp_path, "sync")
+        a = make_mgr(tmp_path, "asy")
+        wire_restores(p, s, a)
+        for m in (p, s, a):
+            await m.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": info_for(s)})
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": info_for(a)})
+            await a.reconfigure({"role": "async", "upstream": info_for(s),
+                                 "downstream": None})
+
+            async def writable():
+                try:
+                    await p._local_query({"op": "insert", "value": "x1"},
+                                         5.0)
+                    return True
+                except PgError:
+                    return False
+            await wait_until(writable, what="writable")
+
+            # cascade: record reaches the async THROUGH the sync
+            async def on_async():
+                res = await a._local_query({"op": "select"})
+                return "x1" in res["rows"]
+            await wait_until(on_async, what="cascade to async")
+
+            # kill the sync process hard: synchronous commit now blocks
+            s._proc.kill()
+            await s._proc.wait()
+            with pytest.raises(PgError, match="synchronous"):
+                await p._local_query({"op": "insert", "value": "x2",
+                                      "timeout": 1.0}, 5.0)
+        finally:
+            for m in (p, s, a):
+                await m.close()
+    run(go())
+
+
+def test_crash_only_stop_and_health_events(tmp_path):
+    async def go():
+        m = make_mgr(tmp_path, "solo", singleton=True)
+        await m.start_manager()
+        events = []
+        m.on("unhealthy", lambda p: events.append(("unhealthy", p)))
+        m.on("healthy", lambda p: events.append(("healthy", p)))
+        try:
+            await m.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            await wait_until(lambda: m.online, what="online")
+            # database dies out from under us -> unhealthy event
+            m._proc.kill()
+            await wait_until(lambda: not m.online, what="unhealthy")
+            assert ("unhealthy", "not running") in events or \
+                any(e[0] == "unhealthy" for e in events)
+            # role none: stop is clean even when already dead
+            await m.reconfigure({"role": "none"})
+            assert not m.running
+        finally:
+            await m.close()
+    run(go())
+
+
+def test_divergence_triggers_restore(tmp_path):
+    async def go():
+        p = make_mgr(tmp_path, "prim", singleton=True)
+        await p.start_manager()
+        restores = []
+
+        async def restore_fn(upstream):
+            # bulk-copy the upstream's datadir (the role the backup
+            # plane plays), preserving our own conf-free state
+            restores.append(upstream["id"])
+            src = Path(p.datadir)
+            dst = Path(s.datadir)
+            if dst.exists():
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+
+        s = make_mgr(tmp_path, "stand", singleton=True,
+                     restore_fn=restore_fn)
+        await s.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            for i in range(3):
+                await p._local_query({"op": "insert", "value": "p%d" % i})
+
+            # the standby has its own DIVERGED history: more local WAL
+            # than the upstream
+            await s.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            for i in range(10):
+                await s._local_query({"op": "insert", "value": "s%d" % i})
+            s.cfg["singleton"] = False
+
+            # now demote it to sync of p: replication is refused
+            # (diverged) -> simpg exits rc=3 -> restore path
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": None})
+            assert restores == [p.peer_id]
+            # after restore it streams: new writes arrive
+            async def synced():
+                try:
+                    res = await s._local_query({"op": "select"})
+                    return "pnew" in res["rows"]
+                except PgError:
+                    return False
+            await p._local_query({"op": "insert", "value": "pnew",
+                                  "timeout": 8.0}, 10.0)
+            await wait_until(synced, what="post-restore streaming")
+        finally:
+            await p.close()
+            await s.close()
+    run(go())
+
+
+def test_standby_without_data_and_no_restore_fn_raises(tmp_path):
+    async def go():
+        p = make_mgr(tmp_path, "prim", singleton=True)
+        await p.start_manager()
+        s = make_mgr(tmp_path, "stand")  # no restore_fn
+        await s.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            with pytest.raises(NeedsRestoreError):
+                await s.reconfigure({"role": "sync",
+                                     "upstream": info_for(p),
+                                     "downstream": None})
+        finally:
+            await p.close()
+            await s.close()
+    run(go())
+
+
+def test_reconfigure_cancelable(tmp_path):
+    async def go():
+        hang = asyncio.Event()
+
+        async def hanging_restore(upstream):
+            hang.set()
+            await asyncio.sleep(3600)
+
+        p = make_mgr(tmp_path, "prim", singleton=True)
+        await p.start_manager()
+        s = make_mgr(tmp_path, "stand", restore_fn=hanging_restore)
+        await s.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            t = asyncio.ensure_future(s.reconfigure(
+                {"role": "sync", "upstream": info_for(p),
+                 "downstream": None}))
+            await hang.wait()
+            t.cancel()      # topology changed mid-restore
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            # manager is reusable afterward
+            await s.reconfigure({"role": "none"})
+            assert not s.running
+        finally:
+            await p.close()
+            await s.close()
+    run(go())
+
+
+def test_dataset_mount_prepare_database(tmp_path):
+    """Primary prepare path with a real storage dataset: create dataset,
+    mount at datadir, initdb, snapshot on transition."""
+    async def go():
+        storage = DirBackend(tmp_path / "store")
+        m = make_mgr(tmp_path, "solo", singleton=True,
+                     dataset="shard/pg", storage=storage)
+        await storage.create("shard")
+        await m.start_manager()
+        try:
+            await m.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            assert await storage.is_mounted("shard/pg")
+            snaps = await storage.list_snapshots("shard/pg")
+            assert len(snaps) == 1  # transition snapshot
+            await m._local_query({"op": "insert", "value": "on-dataset"})
+        finally:
+            await m.close()
+    run(go())
