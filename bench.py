@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Benchmark: failover-to-writable time.
+
+The north-star metric defined by BASELINE.md: after SIGKILLing the
+primary of a live 3-peer shard, how long until the cluster accepts
+(synchronously replicated) writes again.  The reference publishes no
+benchmark numbers; its own integration suite's convergence budget is
+30 s on a single host (test/integ.test.js:52), with production failure
+detection bounded by a 60 s coordination-session timeout
+(etc/sitter.json).  This benchmark runs the full stack — coordination
+daemon, three sitters with database children, backup servers — on
+localhost with a 1 s session timeout, kills the primary, and measures
+wall-clock time until a synchronous write commits on the new primary.
+
+Prints ONE JSON line:
+  {"metric": "failover_to_writable", "value": <seconds>, "unit": "s",
+   "vs_baseline": <30.0 / value>}
+"""
+
+import asyncio
+import json
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from tests.harness import ClusterHarness  # noqa: E402
+
+BASELINE_BUDGET_S = 30.0   # test/integ.test.js:52 convergence budget
+RUNS = 3
+SESSION_TIMEOUT = 1.0
+
+
+async def one_run(tmp: Path) -> float:
+    cluster = ClusterHarness(tmp, n_peers=3,
+                             session_timeout=SESSION_TIMEOUT)
+    try:
+        await cluster.start()
+        p1, p2, p3 = cluster.peers
+        await cluster.wait_topology(primary=p1, sync=p2, asyncs=[p3],
+                                    timeout=60)
+        await cluster.wait_writable(p1, "pre-failover", timeout=60)
+
+        t0 = time.monotonic()
+        p1.kill()
+        await cluster.wait_topology(primary=p2, timeout=60)
+        await cluster.wait_writable(p2, "post-failover", timeout=60)
+        return time.monotonic() - t0
+    finally:
+        await cluster.stop()
+
+
+async def main() -> None:
+    times = []
+    for i in range(RUNS):
+        with tempfile.TemporaryDirectory(prefix="manatee-bench-") as d:
+            dt = await one_run(Path(d))
+            print("run %d: %.2fs" % (i + 1, dt), file=sys.stderr)
+            times.append(dt)
+    value = statistics.median(times)
+    print(json.dumps({
+        "metric": "failover_to_writable",
+        "value": round(value, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_BUDGET_S / value, 2),
+    }))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,23 @@
+"""Backup/bootstrap plane (reference: lib/backupServer.js,
+lib/backupQueue.js, lib/backupSender.js, lib/zfsClient.js restore path).
+
+The bulk-data path of SURVEY.md §3.3: a joining/rebuilding peer opens a
+TCP listener, POSTs a backup job to its upstream's backup server, and the
+sender streams the latest storage snapshot into that socket while the
+receiver pipes it into ``storage.recv``; job progress is observable over
+the REST API and consumed by the manatee-adm rebuild progress bar.
+"""
+
+from manatee_tpu.backup.queue import BackupJob, BackupQueue
+from manatee_tpu.backup.server import BackupRestServer
+from manatee_tpu.backup.sender import BackupSender
+from manatee_tpu.backup.client import RestoreClient, RestoreError
+
+__all__ = [
+    "BackupJob",
+    "BackupQueue",
+    "BackupRestServer",
+    "BackupSender",
+    "RestoreClient",
+    "RestoreError",
+]

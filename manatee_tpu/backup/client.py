@@ -1,0 +1,173 @@
+"""RestoreClient — bootstrap/rebuild a peer's dataset from an upstream's
+backup server.
+
+Reference parity: lib/zfsClient.js restore path —
+
+- ``restore()``: isolate the old dataset → receive the stream → set
+  mount properties → mount → take the initial post-restore snapshot
+  (:115-207, :177-183);
+- ``_receive()``: open a TCP listener, POST /backup {host, port,
+  dataset} to the upstream's backup server, pipe the accepted socket
+  into the storage receive, and poll GET <jobPath> until done/'failed'
+  (:638-754, :765-886);
+- ``isolateDataset({prefix})``: rename to
+  ``<parent>/isolated/<prefix>-<ISO time>`` (:514-624).
+
+The current restore job (with byte progress) is exposed for the status
+server's GET /restore (lib/statusServer.js:111-121) and the manatee-adm
+rebuild progress bar (lib/adm.js:1632-1658).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+
+import aiohttp
+
+from manatee_tpu.storage.base import StorageBackend, StorageError
+
+log = logging.getLogger("manatee.backup.client")
+
+
+class RestoreError(Exception):
+    pass
+
+
+def _iso_now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%S.%f")
+
+
+class RestoreClient:
+    def __init__(self, storage: StorageBackend, *, dataset: str,
+                 mountpoint: str, listen_host: str = "127.0.0.1",
+                 listen_port: int = 0, poll_interval: float = 1.0):
+        """*listen_host/port*: where the sender connects back (the
+        zfsHost/zfsPort of etc/sitter.json)."""
+        self.storage = storage
+        self.dataset = dataset
+        self.mountpoint = mountpoint
+        self.listen_host = listen_host
+        self.listen_port = listen_port
+        self.poll_interval = poll_interval
+        self.current_job: dict | None = None   # for GET /restore
+
+    async def isolate(self, prefix: str) -> str | None:
+        """Move the current dataset out of the way; returns the isolated
+        name (or None if the dataset didn't exist)."""
+        if not await self.storage.exists(self.dataset):
+            return None
+        parent, _, _leaf = self.dataset.rpartition("/")
+        iso_parent = (parent + "/isolated") if parent else "isolated"
+        if not await self.storage.exists(iso_parent):
+            await self.storage.create(iso_parent)
+        target = "%s/%s-%s" % (iso_parent, prefix, _iso_now())
+        await self.storage.rename(self.dataset, target)
+        if await self.storage.is_mounted(target):
+            await self.storage.unmount(target)
+        log.info("isolated %s as %s", self.dataset, target)
+        return target
+
+    async def restore(self, backup_url: str, *,
+                      isolate_prefix: str = "autorebuild") -> None:
+        """Full restore from *backup_url* (the upstream PeerInfo's
+        backupUrl)."""
+        isolated = await self.isolate(isolate_prefix)
+        try:
+            await self._receive(backup_url)
+        except Exception:
+            # the failed partial was cleaned by storage.recv; the
+            # isolated dataset is left for operator recovery, as the
+            # reference does
+            raise
+        await self.storage.set_mountpoint(self.dataset, self.mountpoint)
+        await self.storage.mount(self.dataset)
+        await self.storage.snapshot(self.dataset)   # initial snapshot
+        if isolated:
+            log.info("restore complete; previous data preserved at %s",
+                     isolated)
+
+    async def destroy_isolated(self, isolated: str) -> None:
+        await self.storage.destroy(isolated, recursive=True)
+
+    async def _receive(self, backup_url: str) -> None:
+        recv_done: asyncio.Future = asyncio.get_running_loop() \
+            .create_future()
+        job: dict = {"done": False, "size": None, "completed": 0,
+                     "url": backup_url}
+        self.current_job = job
+
+        def progress(done: int, total: int | None) -> None:
+            job["completed"] = done
+            if total is not None:
+                job["size"] = total
+
+        async def handle(reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+            try:
+                await self.storage.recv(self.dataset, reader,
+                                        progress_cb=progress)
+                if not recv_done.done():
+                    recv_done.set_result(None)
+            except Exception as e:
+                if not recv_done.done():
+                    recv_done.set_exception(e)
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(handle, self.listen_host,
+                                            self.listen_port)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                        backup_url.rstrip("/") + "/backup",
+                        json={"host": self.listen_host, "port": port,
+                              "dataset": self.dataset},
+                        timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                    if resp.status != 201:
+                        raise RestoreError(
+                            "backup request refused: %d %s"
+                            % (resp.status, await resp.text()))
+                    body = await resp.json()
+                    job_path = body["jobPath"]
+
+                # poll the job while receiving (zfsClient:685-754)
+                poll_error: str | None = None
+                while not recv_done.done():
+                    await asyncio.wait(
+                        [recv_done], timeout=self.poll_interval)
+                    if recv_done.done():
+                        break
+                    try:
+                        async with http.get(
+                                backup_url.rstrip("/") + job_path,
+                                timeout=aiohttp.ClientTimeout(
+                                    total=10)) as jr:
+                            remote = await jr.json()
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError) as e:
+                        log.warning("restore job poll failed: %s", e)
+                        continue
+                    job["remote"] = remote
+                    if remote.get("size") is not None:
+                        job["size"] = remote["size"]
+                    if remote.get("done") == "failed":
+                        poll_error = remote.get("error") or "sender failed"
+                        break
+                if poll_error:
+                    raise RestoreError("restore failed on the sender: %s"
+                                       % poll_error)
+                await recv_done
+            job["done"] = True
+        except Exception as e:
+            job["done"] = "failed"
+            job["error"] = str(e)
+            if not recv_done.done():
+                recv_done.cancel()
+            raise
+        finally:
+            server.close()
+            await server.wait_closed()

@@ -1,0 +1,60 @@
+"""Backup job queue shared by the REST server and the sender.
+
+Reference parity: lib/backupQueue.js — an EventEmitter FIFO; ``push``
+notifies the sender (:56-67), jobs are looked up by uuid for status polls
+(:96-110).  Job shape matches lib/backupServer.js:140-151: {uuid, host,
+port, dataset, done: False | True | 'failed', size, completed}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class BackupJob:
+    host: str
+    port: int
+    dataset: str
+    uuid: str = field(default_factory=lambda: str(uuidlib.uuid4()))
+    done: bool | str = False          # False | True | 'failed'
+    error: str | None = None
+    size: int | None = None
+    completed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "uuid": self.uuid,
+            "host": self.host,
+            "port": self.port,
+            "dataset": self.dataset,
+            "done": self.done,
+            "error": self.error,
+            "size": self.size,
+            "completed": self.completed,
+        }
+
+
+class BackupQueue:
+    def __init__(self):
+        self._jobs: dict[str, BackupJob] = {}
+        self._fifo: asyncio.Queue[BackupJob] = asyncio.Queue()
+        self._push_cbs: list[Callable[[BackupJob], None]] = []
+
+    def on_push(self, cb: Callable[[BackupJob], None]) -> None:
+        self._push_cbs.append(cb)
+
+    def push(self, job: BackupJob) -> None:
+        self._jobs[job.uuid] = job
+        self._fifo.put_nowait(job)
+        for cb in list(self._push_cbs):
+            cb(job)
+
+    async def take(self) -> BackupJob:
+        return await self._fifo.get()
+
+    def get(self, uuid: str) -> BackupJob | None:
+        return self._jobs.get(uuid)

@@ -1,0 +1,81 @@
+"""Backup sender: streams the latest snapshot to a requesting peer.
+
+Reference parity: lib/backupSender.js — on queue push, find the latest
+13-digit-epoch-named snapshot of OUR dataset (:244-288), connect to the
+requester's receive listener, and stream the snapshot with progress
+published into the job object (:154-242; size/completed parsed from
+``zfs send -v`` there, delivered by the storage backend's progress
+callback here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from manatee_tpu.backup.queue import BackupJob, BackupQueue
+from manatee_tpu.storage.base import StorageBackend, StorageError
+
+log = logging.getLogger("manatee.backup.sender")
+
+
+class BackupSender:
+    def __init__(self, queue: BackupQueue, storage: StorageBackend,
+                 dataset: str):
+        self.queue = queue
+        self.storage = storage
+        self.dataset = dataset
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            job = await self.queue.take()
+            try:
+                await self._send(job)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.error("backup job %s failed: %s", job.uuid, e)
+                job.done = "failed"
+                job.error = str(e)
+
+    async def _send(self, job: BackupJob) -> None:
+        snap = await self.storage.latest_backup_snapshot(self.dataset)
+        if snap is None:
+            raise StorageError("no snapshots of %s eligible for backup"
+                               % self.dataset)
+        log.info("sending %s to %s:%d for job %s", snap.full, job.host,
+                 job.port, job.uuid)
+        reader, writer = await asyncio.open_connection(job.host, job.port)
+
+        def progress(done: int, total: int | None) -> None:
+            job.completed = done
+            if total is not None:
+                job.size = total
+
+        try:
+            await self.storage.send(self.dataset, snap.name, writer,
+                                    progress_cb=progress)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        except StorageError:
+            writer.close()
+            raise
+        job.done = True
+        log.info("completed backup job %s (%d bytes)", job.uuid,
+                 job.completed)

@@ -1,0 +1,68 @@
+"""Backup REST server.
+
+Reference parity: lib/backupServer.js — ``POST /backup`` with
+{host, port, dataset} enqueues a job and returns 201 with the job path
+(:132-155); ``GET /backup/:uuid`` reports status/progress (:108-130).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from manatee_tpu.backup.queue import BackupJob, BackupQueue
+
+log = logging.getLogger("manatee.backup.server")
+
+
+class BackupRestServer:
+    def __init__(self, queue: BackupQueue, *, host: str = "0.0.0.0",
+                 port: int = 12345):
+        self.queue = queue
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        app = web.Application()
+        app.router.add_post("/backup", self._post_backup)
+        app.router.add_get("/backup/{uuid}", self._get_backup)
+        self._app = app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        log.info("backup server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _post_backup(self, req: web.Request) -> web.Response:
+        try:
+            params = await req.json()
+        except Exception:
+            return web.json_response(
+                {"error": "invalid json"}, status=400)
+        if not all(params.get(k) for k in ("host", "port", "dataset")):
+            return web.json_response(
+                {"error": "host, dataset, and port parameters required"},
+                status=409)
+        job = BackupJob(host=str(params["host"]),
+                        port=int(params["port"]),
+                        dataset=str(params["dataset"]))
+        self.queue.push(job)
+        log.info("enqueued backup job %s -> %s:%d", job.uuid, job.host,
+                 job.port)
+        return web.json_response(
+            {"jobid": job.uuid, "jobPath": "/backup/%s" % job.uuid},
+            status=201)
+
+    async def _get_backup(self, req: web.Request) -> web.Response:
+        job = self.queue.get(req.match_info["uuid"])
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(job.to_dict())

@@ -202,6 +202,14 @@ class ConsensusMgr:
                 "active": self.active,
                 "clusterState": self._cluster_state,
             })
+        else:
+            # a post-init rebuild (session expiry): membership knowledge
+            # was reconstructed from scratch — consumers that reason
+            # about "how long has X been absent" must re-arm
+            self._emit("sessionRebuilt", {
+                "active": self.active,
+                "clusterState": self._cluster_state,
+            })
 
     # ---- state watch ----
 

@@ -1,0 +1,2 @@
+"""Daemon entry points (reference: sitter.js, backupserver.js,
+snapshotter.js — one OS process each, supervisor-managed)."""

@@ -1,0 +1,51 @@
+"""manatee-backupserver — hosts the snapshot-send REST service.
+
+Reference parity: backupserver.js — the REST server and the sender share
+one queue (:120-123).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from manatee_tpu.backup import BackupQueue, BackupRestServer, BackupSender
+from manatee_tpu.daemons.common import daemon_main
+from manatee_tpu.shard import build_storage
+
+log = logging.getLogger("manatee.backupserver")
+
+SCHEMA = {
+    "type": "object",
+    "required": ["ip", "backupPort", "dataset"],
+    "properties": {
+        "ip": {"type": "string"},
+        "backupPort": {"type": "integer"},
+        "dataset": {"type": "string"},
+    },
+}
+
+
+async def start_backupserver(cfg: dict):
+    storage = build_storage(cfg)
+    queue = BackupQueue()
+    server = BackupRestServer(queue,
+                              host=cfg.get("listenHost", "0.0.0.0"),
+                              port=int(cfg["backupPort"]))
+    sender = BackupSender(queue, storage, cfg["dataset"])
+    await server.start()
+    sender.start()
+
+    async def stop():
+        await sender.stop()
+        await server.stop()
+
+    return stop
+
+
+def main(argv=None) -> None:
+    daemon_main("manatee-backupserver", "manatee backup server",
+                SCHEMA, start_backupserver, argv)
+
+
+if __name__ == "__main__":
+    main()

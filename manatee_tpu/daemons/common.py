@@ -1,0 +1,39 @@
+"""Shared daemon plumbing: -f/-v option parsing, config loading, signal
+handling (parseOptions/readConfig parity, sitter.js:50-94)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from manatee_tpu.utils.logutil import setup_logging
+from manatee_tpu.utils.validation import load_json_config
+
+
+def parse_daemon_args(description: str, argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--config", required=True,
+                   help="JSON config file path")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def daemon_main(name: str, description: str, schema: dict | None,
+                run_coro_factory, argv=None) -> None:
+    """Parse args, load config, set up logging, run until SIGINT/SIGTERM.
+    *run_coro_factory(cfg)* returns (start_coro, stop_coro_factory)."""
+    args = parse_daemon_args(description, argv)
+    setup_logging(name, args.verbose)
+    cfg = load_json_config(args.config, schema, name=name)
+
+    async def run():
+        stop_evt = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_evt.set)
+        stopper = await run_coro_factory(cfg)
+        await stop_evt.wait()
+        await stopper()
+
+    asyncio.run(run())

@@ -1,0 +1,55 @@
+"""manatee-snapshotter — periodic storage snapshots of the PG dataset.
+
+Reference parity: snapshotter.js (:119-127) + lib/snapShotter.js
+semantics (see manatee_tpu.snapshots).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from manatee_tpu.daemons.common import daemon_main
+from manatee_tpu.shard import build_storage
+from manatee_tpu.snapshots import SnapShotter
+
+log = logging.getLogger("manatee.snapshotter")
+
+SCHEMA = {
+    "type": "object",
+    "required": ["dataset"],
+    "properties": {
+        "dataset": {"type": "string"},
+        "pollInterval": {"type": "number"},
+        "snapshotNumber": {"type": "integer"},
+    },
+}
+
+
+async def start_snapshotter(cfg: dict):
+    storage = build_storage(cfg)
+    ping = cfg.get("sitterPingUrl")
+    if not ping and cfg.get("ip") and cfg.get("postgresPort"):
+        ping = "http://%s:%d/ping" % (cfg["ip"],
+                                      int(cfg["postgresPort"]) + 1)
+    snap = SnapShotter(
+        storage,
+        dataset=cfg["dataset"],
+        poll_interval=float(cfg.get("pollInterval", 3600.0)),
+        snapshot_number=int(cfg.get("snapshotNumber", 50)),
+        sitter_ping_url=ping,
+    )
+    snap.start()
+
+    async def stop():
+        await snap.stop()
+
+    return stop
+
+
+def main(argv=None) -> None:
+    daemon_main("manatee-snapshotter", "manatee snapshotter", SCHEMA,
+                start_snapshotter, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Predictive health scoring (JAX).
+
+The reference's failure detection is purely reactive: a 1 s
+``select current_time`` probe with a 5 s timeout
+(lib/postgresMgr.js:1550-1646) and coordination-session expiry.  This
+optional subsystem adds a learned early-warning score over health-probe
+telemetry windows (latencies, timeout counts, replication lag) so
+operators can be alerted before a peer trips the hard thresholds.  It is
+the only numerical workload in this control plane and the target of the
+driver's accelerator entry points (__graft_entry__.py).
+"""
+
+from manatee_tpu.health.predictor import (
+    HealthModel,
+    init_params,
+    predict,
+    train_step,
+    make_mesh_train_step,
+)
+
+__all__ = [
+    "HealthModel",
+    "init_params",
+    "predict",
+    "train_step",
+    "make_mesh_train_step",
+]

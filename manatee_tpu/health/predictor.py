@@ -1,0 +1,116 @@
+"""Peer-failure early-warning model.
+
+A small MLP scoring a window of health-probe telemetry per peer:
+features per probe tick are [latency_ms, timed_out, replication_lag_s,
+wal_rate, reconnects]; a window of W ticks is scored to a failure
+probability.  Everything is jittable, static-shaped, and batched so it
+maps onto accelerator matrix units; the training step is data-parallel
+over a ``jax.sharding.Mesh`` with replicated parameters and sharded
+batches (gradient psum inserted by the partitioner).
+
+This is deliberately small: the control plane's job is HA PostgreSQL,
+and this model augments (never replaces) the reference's reactive
+detection semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+N_FEATURES = 5     # latency_ms, timed_out, lag_s, wal_rate, reconnects
+WINDOW = 16        # probe ticks per scoring window
+HIDDEN = 32
+
+
+class HealthModel(NamedTuple):
+    w1: jax.Array   # [WINDOW * N_FEATURES, HIDDEN]
+    b1: jax.Array   # [HIDDEN]
+    w2: jax.Array   # [HIDDEN, HIDDEN]
+    b2: jax.Array   # [HIDDEN]
+    w3: jax.Array   # [HIDDEN, 1]
+    b3: jax.Array   # [1]
+
+
+def init_params(key: jax.Array) -> HealthModel:
+    k1, k2, k3 = jax.random.split(key, 3)
+    d_in = WINDOW * N_FEATURES
+    s1 = (2.0 / d_in) ** 0.5
+    s2 = (2.0 / HIDDEN) ** 0.5
+    return HealthModel(
+        w1=jax.random.normal(k1, (d_in, HIDDEN), jnp.float32) * s1,
+        b1=jnp.zeros((HIDDEN,), jnp.float32),
+        w2=jax.random.normal(k2, (HIDDEN, HIDDEN), jnp.float32) * s2,
+        b2=jnp.zeros((HIDDEN,), jnp.float32),
+        w3=jax.random.normal(k3, (HIDDEN, 1), jnp.float32) * s2,
+        b3=jnp.zeros((1,), jnp.float32),
+    )
+
+
+def _logits(params: HealthModel, windows: jax.Array) -> jax.Array:
+    """windows: [batch, WINDOW, N_FEATURES] -> [batch] logits."""
+    x = windows.reshape((windows.shape[0], WINDOW * N_FEATURES))
+    h = jax.nn.relu(x @ params.w1 + params.b1)
+    h = jax.nn.relu(h @ params.w2 + params.b2)
+    return (h @ params.w3 + params.b3)[:, 0]
+
+
+@jax.jit
+def predict(params: HealthModel, windows: jax.Array) -> jax.Array:
+    """Failure probability per window, [batch]."""
+    return jax.nn.sigmoid(_logits(params, windows))
+
+
+def _loss(params: HealthModel, windows: jax.Array,
+          labels: jax.Array) -> jax.Array:
+    logits = _logits(params, windows)
+    # numerically-stable binary cross-entropy
+    return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+@jax.jit
+def train_step(params: HealthModel, windows: jax.Array,
+               labels: jax.Array, lr: float = 1e-2
+               ) -> tuple[HealthModel, jax.Array]:
+    loss, grads = jax.value_and_grad(_loss)(params, windows, labels)
+    new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return new, loss
+
+
+def make_mesh_train_step(mesh: jax.sharding.Mesh):
+    """A jitted training step laid out over *mesh*: batches sharded on
+    the 'data' axis, parameters replicated; the partitioner inserts the
+    gradient all-reduce."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_sharding = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(
+            jax.tree_util.tree_map(lambda _: repl,
+                                   HealthModel(*([None] * 6))),
+            data_sharding, data_sharding),
+        out_shardings=(
+            jax.tree_util.tree_map(lambda _: repl,
+                                   HealthModel(*([None] * 6))),
+            repl),
+        static_argnums=(3,),
+    )
+    return step, data_sharding, repl
+
+
+def synthetic_batch(key: jax.Array, batch: int
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Plausible telemetry: failing peers show rising latency/timeouts."""
+    k1, k2 = jax.random.split(key)
+    base = jax.random.uniform(k1, (batch, WINDOW, N_FEATURES))
+    labels = (jax.random.uniform(k2, (batch,)) > 0.5).astype(jnp.float32)
+    trend = jnp.linspace(0.0, 1.0, WINDOW)[None, :, None]
+    windows = base + labels[:, None, None] * trend * 2.0
+    return windows, labels

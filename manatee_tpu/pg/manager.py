@@ -180,11 +180,20 @@ class PostgresMgr:
             self._applied = pgcfg
 
     async def _cancel_catchup(self) -> None:
-        if self._catchup_task and not self._catchup_task.done():
-            self._catchup_task.cancel()
-            with contextlib.suppress(asyncio.CancelledError, Exception):
-                await self._catchup_task
-        self._catchup_task = None
+        t, self._catchup_task = self._catchup_task, None
+        if t and not t.done():
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                # if WE are being cancelled (topology changed again while
+                # awaiting the child's teardown), propagate — otherwise
+                # the supposedly-cancelled reconfigure would continue
+                cur = asyncio.current_task()
+                if cur is not None and cur.cancelling():
+                    raise
+            except Exception:
+                pass
 
     # -- primary --
 

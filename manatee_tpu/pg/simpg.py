@@ -179,8 +179,10 @@ class SimPgServer:
         except (OSError, asyncio.TimeoutError):
             return  # upstream down; not a divergence verdict
         try:
+            # distinct id: the probe must never collide with the real
+            # stream's registration on the upstream
             req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
-                   "standby_id": self.peer_id}
+                   "standby_id": self.peer_id + ":probe"}
             writer.write((json.dumps(req) + "\n").encode())
             await writer.drain()
             hello = json.loads(await asyncio.wait_for(
@@ -310,19 +312,25 @@ class SimPgServer:
                     cursor = rec["lsn"]
                     st["sent"] = cursor
                 await writer.drain()
-                # wait for new records
+                # wait for new records; idle-poll timeout just loops
                 ev = asyncio.Event()
                 self._repl_waiters.append(ev)
                 try:
                     if self.wal.last_lsn == cursor:
-                        await asyncio.wait_for(ev.wait(), 0.5)
+                        try:
+                            await asyncio.wait_for(ev.wait(), 0.5)
+                        except asyncio.TimeoutError:
+                            pass
                 finally:
                     self._repl_waiters.remove(ev)
         except (ConnectionError, asyncio.TimeoutError, OSError):
             pass
         finally:
             ack_task.cancel()
-            self.downstreams.pop(standby_id, None)
+            # a newer connection for the same standby may have replaced
+            # our entry; never pop someone else's registration
+            if self.downstreams.get(standby_id) is st:
+                del self.downstreams[standby_id]
 
     async def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
@@ -360,6 +368,7 @@ class SimPgServer:
                         "error": "cannot execute INSERT in a read-only "
                                  "transaction"}
             lsn = self.wal.append(req.get("value"))
+            self._wake_repl_waiters()   # push-driven replication
             syncs = self.sync_names()
             if syncs:
                 # synchronous_commit: wait for the sync standby to flush

@@ -1,0 +1,137 @@
+"""Periodic snapshot service.
+
+Reference parity: lib/snapShotter.js — every ``pollInterval`` take a
+storage snapshot named with epoch-ms, but skip if the local sitter's
+``/ping`` reports unhealthy (:122-152, :445-512); an independent,
+self-rescheduling cleanup pass lists snapshots by creation time, only
+ever touches 13-digit-epoch names, keeps the newest ``snapshotNumber``,
+and keeps per-snapshot stuck-destroy accounting with a fatal alarm when
+NO candidate can be deleted (:177-433).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+import aiohttp
+
+from manatee_tpu.storage.base import (
+    StorageBackend,
+    StorageError,
+    is_epoch_ms_snapshot,
+)
+
+log = logging.getLogger("manatee.snapshotter")
+
+
+class SnapShotter:
+    def __init__(self, storage: StorageBackend, *, dataset: str,
+                 poll_interval: float = 3600.0,
+                 snapshot_number: int = 50,
+                 sitter_ping_url: str | None = None):
+        self.storage = storage
+        self.dataset = dataset
+        self.poll_interval = poll_interval
+        self.snapshot_number = snapshot_number
+        self.sitter_ping_url = sitter_ping_url
+        self._tasks: list[asyncio.Task] = []
+        self._stuck: dict[str, int] = {}   # snapshot name -> failed destroys
+        self._listeners: dict[str, list[Callable]] = {}
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def _emit(self, event: str, payload=None) -> None:
+        for cb in self._listeners.get(event, []):
+            try:
+                cb(payload)
+            except Exception:
+                log.exception("snapshotter listener failed")
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._create_loop()),
+            asyncio.ensure_future(self._cleanup_loop()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # -- creation --
+
+    async def _create_loop(self) -> None:
+        while True:
+            await self.create_snapshot()
+            await asyncio.sleep(self.poll_interval)
+
+    async def create_snapshot(self) -> bool:
+        """One snapshot attempt; returns whether one was taken."""
+        if self.sitter_ping_url:
+            if not await self._sitter_healthy():
+                log.info("sitter unhealthy; skipping snapshot "
+                         "(snapShotter.js:122-152)")
+                return False
+        try:
+            snap = await self.storage.snapshot(self.dataset)
+            log.info("took snapshot %s", snap.full)
+            self._emit("snapshot", snap)
+            return True
+        except StorageError as e:
+            log.warning("snapshot of %s failed: %s", self.dataset, e)
+            return False
+
+    async def _sitter_healthy(self) -> bool:
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        self.sitter_ping_url,
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    return r.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    # -- cleanup --
+
+    async def _cleanup_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_interval)
+            await self.cleanup_once()
+
+    async def cleanup_once(self) -> None:
+        try:
+            snaps = await self.storage.list_snapshots(self.dataset)
+        except StorageError as e:
+            log.warning("cannot list snapshots: %s", e)
+            return
+        # only 13-digit epoch names are ours to manage
+        # (snapShotter.js:251)
+        ours = [s for s in snaps if is_epoch_ms_snapshot(s.name)]
+        excess = len(ours) - self.snapshot_number
+        if excess <= 0:
+            return
+        victims = ours[:excess]   # list is creation-ascending
+        any_deleted = False
+        for v in victims:
+            try:
+                await self.storage.destroy_snapshot(self.dataset, v.name)
+                self._stuck.pop(v.name, None)
+                any_deleted = True
+                log.info("deleted old snapshot %s", v.full)
+            except StorageError as e:
+                self._stuck[v.name] = self._stuck.get(v.name, 0) + 1
+                log.warning("cannot delete snapshot %s (attempt %d): %s",
+                            v.full, self._stuck[v.name], e)
+        if not any_deleted and victims:
+            # every deletable candidate is stuck: fatal alarm path
+            # (snapShotter.js:370-404)
+            log.critical("ALL %d excess snapshots are stuck; manual "
+                         "intervention required", len(victims))
+            self._emit("stuck", [v.name for v in victims])

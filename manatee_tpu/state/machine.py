@@ -84,16 +84,25 @@ def _iso_to_ts(s: str) -> float:
 
 class PeerStateMachine:
     def __init__(self, *, zk, pg, self_info: dict,
-                 singleton: bool = False):
+                 singleton: bool = False,
+                 takeover_grace: float = 0.0):
         """*zk* is a ConsensusMgr-shaped object (on/active/cluster_state/
         put_cluster_state); *pg* provides async reconfigure(cfg), stop(),
         get_xlog_location() (the pginterface of lib/shard.js:59-71);
-        *self_info* is this peer's PeerInfo dict."""
+        *self_info* is this peer's PeerInfo dict.
+
+        *takeover_grace*: seconds after our own coordination init during
+        which the sync will NOT treat the primary's absence as death.
+        On a cold start the primary may simply not have joined yet —
+        absence observed for less than a session timeout is not evidence
+        of failure.  Wire it to the session timeout."""
         self.zk = zk
         self.pg = pg
         self.self_info = self_info
         self.self_id = self_info["id"]
         self.singleton = singleton
+        self.takeover_grace = takeover_grace
+        self._boot_time: float | None = None
 
         self._zk_ready = False
         self._pg_ready = False
@@ -109,6 +118,7 @@ class PeerStateMachine:
         zk.on("init", self._on_zk_init)
         zk.on("activeChange", self._on_active_change)
         zk.on("clusterStateChange", self._on_cluster_state)
+        zk.on("sessionRebuilt", self._on_session_rebuilt)
 
     # ---- events out (role changes, shutdown requests) ----
 
@@ -131,6 +141,14 @@ class PeerStateMachine:
 
     def _on_zk_init(self, _payload: dict) -> None:
         self._zk_ready = True
+        if self._boot_time is None:
+            self._boot_time = asyncio.get_event_loop().time()
+        self.kick()
+
+    def _on_session_rebuilt(self, _payload: dict) -> None:
+        # after a session expiry/rebuild the absence-isn't-death grace
+        # must re-arm: everyone just re-registered from scratch
+        self._boot_time = asyncio.get_event_loop().time()
         self.kick()
 
     def _on_active_change(self, _actives: list[dict]) -> None:
@@ -409,6 +427,19 @@ class PeerStateMachine:
 
         if primary_alive and not promote_me:
             return False
+
+        if not primary_alive and not promote_me and self._boot_time:
+            # cold-start grace: shortly after boot, the primary's absence
+            # may mean it has not re-joined yet, not that it died
+            elapsed = asyncio.get_event_loop().time() - self._boot_time
+            if elapsed < self.takeover_grace:
+                delay = self.takeover_grace - elapsed + 0.05
+                log.info("primary absent %0.1fs after boot; deferring "
+                         "takeover %0.1fs (cold-start grace)",
+                         elapsed, delay)
+                loop = asyncio.get_event_loop()
+                loop.call_later(delay, self.kick)
+                return False
 
         # safety: never take over unless our xlog reached this
         # generation's initWal — otherwise we never replicated from this

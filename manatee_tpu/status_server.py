@@ -1,0 +1,70 @@
+"""Status HTTP server — operator/automation introspection per peer.
+
+Reference parity: lib/statusServer.js — restify server on
+``postgresPort + 1`` with:
+
+- ``GET /``        route list (:62-75)
+- ``GET /ping``    200/503 from the PG health state (:78-97)
+- ``GET /state``   the state machine's debugState() (:100-109)
+- ``GET /restore`` the restore client's current job (:111-121)
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+log = logging.getLogger("manatee.status")
+
+
+class StatusServer:
+    def __init__(self, *, host: str = "0.0.0.0", port: int,
+                 pg_mgr=None, state_machine=None, restore_client=None):
+        self.host = host
+        self.port = port
+        self.pg_mgr = pg_mgr
+        self.state_machine = state_machine
+        self.restore_client = restore_client
+        self._runner: web.AppRunner | None = None
+        app = web.Application()
+        app.router.add_get("/", self._routes)
+        app.router.add_get("/ping", self._ping)
+        app.router.add_get("/state", self._state)
+        app.router.add_get("/restore", self._restore)
+        self._app = app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        log.info("status server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _routes(self, _req: web.Request) -> web.Response:
+        return web.json_response(["/ping", "/state", "/restore"])
+
+    async def _ping(self, _req: web.Request) -> web.Response:
+        healthy = bool(self.pg_mgr and self.pg_mgr.online)
+        body = {"healthy": healthy,
+                "pg": self.pg_mgr.status() if self.pg_mgr else None}
+        return web.json_response(body, status=200 if healthy else 503)
+
+    async def _state(self, _req: web.Request) -> web.Response:
+        if self.state_machine is None:
+            return web.json_response({"error": "no state machine"},
+                                     status=503)
+        return web.json_response(self.state_machine.debug_state())
+
+    async def _restore(self, _req: web.Request) -> web.Response:
+        job = (self.restore_client.current_job
+               if self.restore_client else None)
+        if job is None:
+            return web.json_response({"restore": None})
+        return web.json_response({"restore": job})

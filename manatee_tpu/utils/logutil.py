@@ -1,0 +1,62 @@
+"""Structured JSON logging (bunyan-style parity).
+
+The reference logs bunyan JSON records with child loggers per component
+(sitter.js:36-42, lib/zookeeperMgr.js:70) and ``-v`` stacking to TRACE
+(sitter.js:62-66).  This formatter emits compatible-shaped records:
+{"name", "hostname", "pid", "level", "component", "msg", "time"} with
+bunyan numeric levels (trace 10 … fatal 60).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import sys
+import time
+
+_BUNYAN_LEVELS = {
+    logging.DEBUG: 20,
+    logging.INFO: 30,
+    logging.WARNING: 40,
+    logging.ERROR: 50,
+    logging.CRITICAL: 60,
+}
+
+
+class BunyanFormatter(logging.Formatter):
+    def __init__(self, name: str):
+        super().__init__()
+        self.name = name
+        self.hostname = socket.gethostname()
+
+    def format(self, record: logging.LogRecord) -> str:
+        rec = {
+            "v": 0,
+            "name": self.name,
+            "hostname": self.hostname,
+            "pid": os.getpid(),
+            "level": _BUNYAN_LEVELS.get(record.levelno, 30),
+            "component": record.name,
+            "msg": record.getMessage(),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  time.gmtime(record.created))
+                    + ".%03dZ" % (record.msecs,),
+        }
+        for attr in ("run_id", "argv", "rc", "duration_ms"):
+            if hasattr(record, attr):
+                rec[attr] = getattr(record, attr)
+        if record.exc_info:
+            rec["err"] = self.formatException(record.exc_info)
+        return json.dumps(rec)
+
+
+def setup_logging(name: str, verbose: int = 0,
+                  stream=None) -> None:
+    """-v stacking: 0 = INFO, 1 = DEBUG (sitter.js:62-66)."""
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(BunyanFormatter(name))
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(logging.DEBUG if verbose else logging.INFO)

@@ -2,12 +2,18 @@ import os
 import sys
 
 # JAX-using tests (health predictor, graft entry) run on a virtual 8-device
-# CPU mesh, per the driver contract.  Must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CPU mesh, per the driver contract.  The image pins an accelerator plugin
+# that ignores the JAX_PLATFORMS env var, so force cpu via jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

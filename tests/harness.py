@@ -1,0 +1,282 @@
+"""TestCluster — single-host N-peer fixture with port/dataset namespacing.
+
+Reference parity: test/testManatee.js — fabricates complete peers on
+localhost, each with its own storage area, rewritten sitter/backupserver/
+snapshotter configs with unique port blocks, and the real daemons spawned
+as child processes; ``kill()`` SIGKILLs them (:99-398).  Peers are
+spawned in their own process group so a kill takes down the sitter AND
+its database child, like killing a zone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+from manatee_tpu.coord.client import NetCoord           # noqa: E402
+from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
+from manatee_tpu.storage import DirBackend              # noqa: E402
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Peer:
+    def __init__(self, cluster: "ClusterHarness", idx: int):
+        self.cluster = cluster
+        self.idx = idx
+        self.name = "peer%d" % idx
+        self.root = cluster.root / self.name
+        self.pg_port = free_port()
+        self.status_port = self.pg_port + 1
+        self.backup_port = free_port()
+        self.zfs_port = free_port()
+        self.ip = "127.0.0.1"
+        self.ident = "%s:%d:%d" % (self.ip, self.pg_port, self.backup_port)
+        self.sitter_proc: subprocess.Popen | None = None
+        self.backup_proc: subprocess.Popen | None = None
+        self.snap_proc: subprocess.Popen | None = None
+
+    # -- config --
+
+    async def write_configs(self) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        store_root = str(self.root / "store")
+        # pre-create the parent dataset (the operator's delegated
+        # dataset in production)
+        be = DirBackend(store_root)
+        if not await be.exists("manatee"):
+            await be.create("manatee")
+        common = {
+            "name": self.name,
+            "ip": self.ip,
+            "postgresPort": self.pg_port,
+            "backupPort": self.backup_port,
+            "dataset": "manatee/pg",
+            "dataDir": str(self.root / "data"),
+            "storageBackend": "dir",
+            "storageRoot": store_root,
+            "pgEngine": "sim",
+        }
+        sitter = dict(common)
+        sitter.update({
+            "shardPath": self.cluster.shard_path,
+            "zfsHost": self.ip,
+            "zfsPort": self.zfs_port,
+            "coordCfg": {"host": "127.0.0.1",
+                         "port": self.cluster.coord_port,
+                         "sessionTimeout": self.cluster.session_timeout},
+            "opsTimeout": 10,
+            "healthChkInterval": 0.3,
+            "healthChkTimeout": 2,
+            "replicationTimeout": 10,
+            "oneNodeWriteMode": self.cluster.singleton,
+        })
+        (self.root / "sitter.json").write_text(json.dumps(sitter, indent=2))
+        backup = dict(common)
+        (self.root / "backupserver.json").write_text(
+            json.dumps(backup, indent=2))
+        snap = dict(common)
+        snap.update({"pollInterval": 3600, "snapshotNumber": 5})
+        (self.root / "snapshotter.json").write_text(
+            json.dumps(snap, indent=2))
+
+    # -- processes --
+
+    def _spawn(self, module: str, cfg: str, logname: str) -> subprocess.Popen:
+        env = dict(os.environ, PYTHONPATH=str(REPO))
+        logf = open(self.root / logname, "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", module, "-f", cfg],
+            stdout=logf, stderr=logf, env=env,
+            start_new_session=True, cwd=str(self.root))
+
+    def start(self, *, snapshotter: bool = False) -> None:
+        self.sitter_proc = self._spawn(
+            "manatee_tpu.daemons.sitter",
+            str(self.root / "sitter.json"), "sitter.log")
+        self.backup_proc = self._spawn(
+            "manatee_tpu.daemons.backupserver",
+            str(self.root / "backupserver.json"), "backupserver.log")
+        if snapshotter:
+            self.snap_proc = self._spawn(
+                "manatee_tpu.daemons.snapshotter",
+                str(self.root / "snapshotter.json"), "snapshotter.log")
+
+    def kill(self, sig: int = signal.SIGKILL) -> None:
+        """SIGKILL the whole peer (sitter + database child +
+        backupserver), testManatee.js kill() parity."""
+        for proc in (self.sitter_proc, self.backup_proc, self.snap_proc):
+            if proc and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, sig)
+                except ProcessLookupError:
+                    pass
+        for proc in (self.sitter_proc, self.backup_proc, self.snap_proc):
+            if proc:
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.sitter_proc = self.backup_proc = self.snap_proc = None
+
+    def kill_sitter_only(self, sig: int = signal.SIGKILL) -> None:
+        if self.sitter_proc and self.sitter_proc.poll() is None:
+            try:
+                os.killpg(self.sitter_proc.pid, sig)
+            except ProcessLookupError:
+                pass
+            self.sitter_proc.wait(timeout=5)
+        self.sitter_proc = None
+
+    # -- queries --
+
+    async def pg_query(self, op: dict, timeout: float = 5.0) -> dict:
+        return await SimPgEngine().query(self.ip, self.pg_port, op,
+                                         timeout)
+
+
+class ClusterHarness:
+    def __init__(self, root: Path, *, n_peers: int = 3,
+                 session_timeout: float = 2.0, singleton: bool = False,
+                 shard: str = "1"):
+        self.root = Path(root)
+        self.shard_path = "/manatee/%s" % shard
+        self.session_timeout = session_timeout
+        self.singleton = singleton
+        self.coord_port = free_port()
+        self.coord_proc: subprocess.Popen | None = None
+        self.peers = [Peer(self, i + 1) for i in range(n_peers)]
+
+    # -- lifecycle --
+
+    async def start(self, *, peers: list[int] | None = None,
+                    stagger: float = 0.3) -> None:
+        env = dict(os.environ, PYTHONPATH=str(REPO))
+        logf = open(self.root / "coordd.log", "ab")
+        self.coord_proc = subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.coord.server",
+             "--port", str(self.coord_port)],
+            stdout=logf, stderr=logf, env=env, start_new_session=True)
+        await self._wait_port(self.coord_port)
+        which = peers if peers is not None else range(len(self.peers))
+        for i in which:
+            await self.peers[i].write_configs()
+            self.peers[i].start()
+            await asyncio.sleep(stagger)  # join order = peer order
+
+    async def stop(self) -> None:
+        for p in self.peers:
+            p.kill()
+        if self.coord_proc and self.coord_proc.poll() is None:
+            try:
+                os.killpg(self.coord_proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self.coord_proc.wait(timeout=5)
+
+    async def _wait_port(self, port: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                return
+            except OSError:
+                await asyncio.sleep(0.05)
+        raise RuntimeError("port %d never came up" % port)
+
+    # -- cluster state inspection --
+
+    async def coord_client(self) -> NetCoord:
+        c = NetCoord("127.0.0.1", self.coord_port, session_timeout=30)
+        await c.connect()
+        return c
+
+    async def cluster_state(self) -> dict | None:
+        c = await self.coord_client()
+        try:
+            data, _v = await c.get(self.shard_path + "/state")
+            return json.loads(data.decode())
+        except Exception:
+            return None
+        finally:
+            await c.close()
+
+    def peer_by_id(self, peer_id: str) -> Peer:
+        for p in self.peers:
+            if p.ident == peer_id:
+                return p
+        raise KeyError(peer_id)
+
+    async def wait_for(self, pred, timeout: float = 30.0,
+                       what: str = "condition"):
+        """30s default budget — the reference's convergence budget
+        (test/integ.test.js:52)."""
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            st = await self.cluster_state()
+            last = st
+            try:
+                if st is not None and pred(st):
+                    return st
+            except (KeyError, TypeError, IndexError):
+                pass
+            await asyncio.sleep(0.25)
+        raise AssertionError("timed out waiting for %s; last state: %r"
+                             % (what, last))
+
+    async def wait_topology(self, *, primary: Peer | None = None,
+                            sync: Peer | None = None,
+                            asyncs: list[Peer] | None = None,
+                            gen: int | None = None,
+                            timeout: float = 30.0):
+        def pred(st):
+            if primary is not None and \
+                    st["primary"]["id"] != primary.ident:
+                return False
+            if sync is not None:
+                if st.get("sync") is None or \
+                        st["sync"]["id"] != sync.ident:
+                    return False
+            if asyncs is not None:
+                if [a["id"] for a in st.get("async") or []] != \
+                        [p.ident for p in asyncs]:
+                    return False
+            if gen is not None and st.get("generation") != gen:
+                return False
+            return True
+        return await self.wait_for(pred, timeout, "topology")
+
+    async def wait_writable(self, peer: Peer, value: str,
+                            timeout: float = 30.0) -> None:
+        """Write through *peer*'s database until a synchronous commit
+        acks — the 'failover-to-writable' end state."""
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                res = await peer.pg_query({"op": "insert", "value": value,
+                                           "timeout": 3.0}, 5.0)
+                if res.get("ok"):
+                    return
+            except Exception as e:
+                last_err = e
+            await asyncio.sleep(0.25)
+        raise AssertionError("peer %s never writable: %r"
+                             % (peer.name, last_err))

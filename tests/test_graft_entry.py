@@ -1,0 +1,47 @@
+"""Driver-contract checks: entry() compiles and runs; dryrun_multichip
+executes a sharded training step on the virtual 8-device CPU mesh
+(conftest.py sets JAX_PLATFORMS=cpu + host_platform_device_count=8)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_entry_jits():
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (64,)
+    assert ((out >= 0) & (out <= 1)).all()
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_training_learns():
+    import jax
+
+    from manatee_tpu.health.predictor import (
+        init_params,
+        predict,
+        synthetic_batch,
+        train_step,
+    )
+
+    params = init_params(jax.random.PRNGKey(0))
+    windows, labels = synthetic_batch(jax.random.PRNGKey(1), 256)
+    _p, loss0 = train_step(params, windows, labels, 0.05)
+    p = params
+    for _ in range(100):
+        p, loss = train_step(p, windows, labels, 0.05)
+    assert float(loss) < float(loss0) * 0.7
+    acc = (((predict(p, windows) > 0.5).astype("float32") == labels)
+           .mean())
+    assert float(acc) > 0.8

@@ -1,0 +1,172 @@
+"""Full-stack integration: real daemon processes (sitter + backupserver +
+coordd + simulated postgres children) on localhost, fault injection by
+SIGKILL, convergence asserted against live cluster state and database
+writes — mirroring test/integ.test.js (primaryDeath :449, syncDeath
+:640, asyncDeath :853, everyoneDies :1068, add4thManatee :3848) with the
+reference's 30s convergence budget (:52).
+
+Roles are derived from the observed cluster state rather than assumed
+from start order: under load a peer's first session can expire before
+bootstrap, legitimately changing who declares the cluster.
+"""
+
+import asyncio
+
+from tests.harness import ClusterHarness
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def converged(cluster, n=3, timeout=45):
+    """Wait until the cluster has a primary, a sync, and n-2 asyncs, all
+    writable; return (primary, sync, [asyncs]) as Peer objects."""
+    def pred(st):
+        return (st.get("primary") is not None
+                and st.get("sync") is not None
+                and len(st.get("async") or []) == n - 2)
+    st = await cluster.wait_for(pred, timeout, "%d-peer convergence" % n)
+    primary = cluster.peer_by_id(st["primary"]["id"])
+    sync = cluster.peer_by_id(st["sync"]["id"])
+    asyncs = [cluster.peer_by_id(a["id"]) for a in st["async"]]
+    await cluster.wait_writable(primary, "setup-write")
+    return primary, sync, asyncs
+
+
+def test_three_peer_setup_and_write(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            st = await cluster.cluster_state()
+            assert st["generation"] == 0
+            assert st["initWal"] == "0/0000000"
+            # the write really is on the sync (synchronous replication)
+            res = await sync.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+            # status endpoints live
+            import aiohttp
+            async with aiohttp.ClientSession() as http:
+                async with http.get("http://127.0.0.1:%d/ping"
+                                    % primary.status_port) as r:
+                    assert r.status == 200
+                async with http.get("http://127.0.0.1:%d/state"
+                                    % primary.status_port) as r:
+                    body = await r.json()
+                    assert body["role"] == "primary"
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_primary_death(tmp_path):
+    """integ.test.js primaryDeath (:449)."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync,
+                                             sync=asyncs[0])
+            assert st["generation"] == gen0 + 1
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+            await cluster.wait_writable(sync, "post-failover")
+            res = await asyncs[0].pg_query({"op": "select"})
+            assert "post-failover" in res["rows"]
+            assert "setup-write" in res["rows"]   # no data loss
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_sync_death(tmp_path):
+    """integ.test.js syncDeath (:640)."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            sync.kill()
+            st = await cluster.wait_topology(primary=primary,
+                                             sync=asyncs[0], asyncs=[])
+            assert st["generation"] == gen0 + 1
+            assert st["deposed"] == []
+            await cluster.wait_writable(primary, "after-sync-death")
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_async_death(tmp_path):
+    """integ.test.js asyncDeath (:853): async removed, no gen bump,
+    writes unaffected."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            asyncs[0].kill()
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             asyncs=[])
+            assert st["generation"] == gen0
+            await cluster.wait_writable(primary, "after-async-death")
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_add_fourth_peer(tmp_path):
+    """integ.test.js add4thManatee (:3848): chain extension."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=4)
+        try:
+            await cluster.start(peers=[0, 1, 2])
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+            p4 = cluster.peers[3]
+
+            await p4.write_configs()
+            p4.start()
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             asyncs=asyncs + [p4])
+            assert st["generation"] == gen0
+            await cluster.wait_writable(primary, "with-four")
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_everyone_dies(tmp_path):
+    """integ.test.js everyoneDies (:1068): kill all, restart, converge
+    with data intact."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            before = await cluster.cluster_state()
+
+            for p in cluster.peers:
+                p.kill()
+            await asyncio.sleep(cluster.session_timeout + 0.5)
+
+            for p in cluster.peers:
+                p.start()
+            # the durable state resumes: same primary and sync, same gen
+            st = await cluster.wait_topology(primary=primary, sync=sync)
+            assert st["generation"] == before["generation"]
+            await cluster.wait_writable(primary, "after-resurrection")
+            res = await sync.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
