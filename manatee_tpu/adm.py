@@ -1,0 +1,658 @@
+"""Admin library — everything behind the manatee-adm CLI.
+
+Reference parity: lib/adm.js (2541 lines).  Implements:
+
+- cluster-details loading: coordination-state read plus per-peer
+  PostgreSQL status/lag via direct queries with a 1 s timeout
+  (:348-427, :2196-2227), honoring the MANATEE_ADM_TEST_STATE env hook
+  that substitutes a canned cluster-details JSON (:662-745);
+- the ClusterDetails object (pgs_* fields, :577-985) with error/warning
+  derivation including replication-chain verification (loadErrors /
+  loadReplErrors, :860-985);
+- operations: freeze/unfreeze (:1048-1098), reap (:1108-1146),
+  set-onwm (:1148-1209), state-backfill (:1231-1312), promote /
+  clear-promote with the 30 s expiry (:1693-2040), rebuild (:1319-1684),
+  check-lock (:2049-2086), annotated history (:2088-2162, :2296-2416);
+- lag computation helpers (:2504-2541).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import os
+from dataclasses import dataclass
+
+from manatee_tpu.coord.api import BadVersionError, NoNodeError
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.pg.engine import PgError, parse_pg_url
+from manatee_tpu.state.types import role_of
+from manatee_tpu.utils import iso_ms as _now_iso
+
+PG_QUERY_TIMEOUT = 1.0     # lib/adm.js:2203-2205
+PROMOTE_EXPIRY_S = 30.0    # lib/adm.js:1925-1926
+DEFAULT_LAG_TO_IGNORE = 5.0
+
+
+class AdmError(Exception):
+    pass
+
+
+def load_test_state(value: str) -> "ClusterDetails":
+    """MANATEE_ADM_TEST_STATE hook: the env value is either a path to, or
+    the inline text of, a canned cluster-details JSON
+    (lib/adm.js:662-745)."""
+    return ClusterDetails.from_json(
+        open(value).read() if os.path.exists(value) else value)
+
+
+def pg_duration(lag_seconds: float | None) -> str:
+    """Human duration like '87m12s' (pgDuration, bin/manatee-adm)."""
+    if lag_seconds is None:
+        return "-"
+    try:
+        secs = int(lag_seconds)
+    except (TypeError, ValueError):
+        return "?"
+    if secs < 0:
+        return "?"
+    out = ""
+    days, secs = divmod(secs, 86400)
+    hours, secs = divmod(secs, 3600)
+    mins, secs = divmod(secs, 60)
+    if days:
+        out += "%dd" % days
+    if hours or days:
+        out += "%dh" % hours
+    if mins or hours or days:
+        out += "%dm" % mins
+    out += "%ds" % secs
+    return out
+
+
+@dataclass
+class PeerStatus:
+    """pgp_* parity (lib/adm.js loadPeer)."""
+    ident: dict                       # PeerInfo
+    label: str = ""                   # first 8 chars of zoneId
+    pgerr: str | None = None          # error string or None
+    repl: dict | None = None          # downstream pg_stat_replication row
+    lag: float | None = None          # replay lag seconds (standbys)
+    online: bool = False
+
+    def __post_init__(self):
+        if not self.label:
+            self.label = str(self.ident.get("zoneId", "?"))[:8]
+
+    def to_dict(self) -> dict:
+        return {"ident": self.ident, "label": self.label,
+                "pgerr": self.pgerr, "repl": self.repl, "lag": self.lag,
+                "online": self.online}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PeerStatus":
+        return cls(ident=d["ident"], label=d.get("label", ""),
+                   pgerr=d.get("pgerr"), repl=d.get("repl"),
+                   lag=d.get("lag"), online=d.get("online", False))
+
+
+class ClusterDetails:
+    """pgs_* parity (ManateeClusterDetails, lib/adm.js:577-985)."""
+
+    def __init__(self, shard: str, state: dict,
+                 peer_status: dict[str, PeerStatus]):
+        self.shard = shard
+        self.state = state
+        self.peers: dict[str, PeerStatus] = peer_status
+        self.primary = state["primary"]["id"]
+        self.sync = state["sync"]["id"] if state.get("sync") else None
+        self.asyncs = [a["id"] for a in state.get("async") or []]
+        self.deposed = [d["id"] for d in state.get("deposed") or []]
+        self.generation = state.get("generation")
+        self.initwal = state.get("initWal")
+        self.singleton = bool(state.get("oneNodeWriteMode"))
+        fr = state.get("freeze")
+        self.frozen = bool(fr)
+        self.freeze_time = (fr or {}).get("date", "unknown") \
+            if self.frozen else None
+        self.freeze_reason = (fr or {}).get("reason", "unknown") \
+            if self.frozen else None
+        self.errors: list[str] = []
+        self.warnings: list[str] = []
+        self._load_errors()
+
+    # -- serialization (MANATEE_ADM_TEST_STATE hook) --
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "shard": self.shard,
+            "state": self.state,
+            "peers": {k: v.to_dict() for k, v in self.peers.items()},
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ClusterDetails":
+        d = json.loads(text)
+        return cls(d["shard"], d["state"],
+                   {k: PeerStatus.from_dict(v)
+                    for k, v in d["peers"].items()})
+
+    # -- error derivation (loadErrors, lib/adm.js:875-927) --
+
+    def _load_errors(self) -> None:
+        p = self.peers[self.primary]
+        if p.pgerr:
+            self.errors.append(
+                "cannot query postgres on primary: peer \"%s\": %s"
+                % (p.label, p.pgerr))
+
+        if self.singleton:
+            if len(self.peers) > 1:
+                self.warnings.append(
+                    "found %d peers in singleton mode" % len(self.peers))
+            return
+
+        if self.sync is None:
+            self.errors.append("cluster has no sync peer")
+            return
+        s = self.peers[self.sync]
+        if s.pgerr:
+            self.errors.append(
+                "cannot query postgres on sync: peer \"%s\": %s"
+                % (s.label, s.pgerr))
+
+        if self.deposed:
+            self.warnings.append("cluster has a deposed peer")
+        if not self.asyncs:
+            self.warnings.append("cluster has no async peers")
+
+        if s.pgerr:
+            return  # if the sync is down, that's all we can check
+
+        self._repl_errors(p, self.sync, "sync", self.errors)
+        self._repl_errors(s, self.asyncs[0] if self.asyncs else None,
+                          "async", self.warnings)
+        for i, a in enumerate(self.asyncs):
+            nxt = self.asyncs[i + 1] if i + 1 < len(self.asyncs) else None
+            self._repl_errors(self.peers[a], nxt, "async", self.warnings)
+
+    def _repl_errors(self, peer: PeerStatus, ds_id: str | None,
+                     kind: str, errors: list[str]) -> None:
+        """(loadReplErrors, lib/adm.js:930-985)"""
+        if ds_id is None:
+            return
+        before = len(errors)
+        dspeer = self.peers[ds_id]
+        if peer.repl is None:
+            errors.append('peer "%s": downstream replication peer not '
+                          "connected" % peer.label)
+            return
+        expected = dspeer.ident["id"]
+        found = peer.repl.get("application_name") \
+            or peer.repl.get("client_addr")
+        if found != expected and found != dspeer.ident.get("ip"):
+            errors.append('peer "%s": expected downstream peer to be '
+                          '"%s", but found "%s"'
+                          % (peer.label, dspeer.label, found))
+        if peer.repl.get("state") != "streaming":
+            errors.append('peer "%s": downstream replication not yet '
+                          'established (expected state "streaming", '
+                          'found "%s")'
+                          % (peer.label, peer.repl.get("state")))
+        if len(errors) > before:
+            return
+        if peer.repl.get("sync_state") != kind:
+            errors.append('peer "%s": expected downstream replication '
+                          'to be "%s", but found "%s"'
+                          % (peer.label, kind,
+                             peer.repl.get("sync_state")))
+
+    def role_of(self, peer_id: str) -> str | None:
+        return role_of(self.state, peer_id)
+
+
+# ---------------------------------------------------------------------------
+
+
+def history_annotation(state: dict, last: dict | None) -> str:
+    """Semantic annotation for one history transition
+    (annotateHistoryNode, lib/adm.js:2296-2416)."""
+    def zid(p):
+        return str(p.get("zoneId", p.get("id", "?")))[:8]
+
+    if last is None:
+        if state.get("oneNodeWriteMode"):
+            return "cluster setup for singleton (one-node-write) mode"
+        return "cluster setup for normal (multi-peer) mode"
+    nst, lst = state, last
+    if nst.get("generation", 0) < lst.get("generation", 0):
+        return "error: gen number went backwards"
+    if not lst.get("oneNodeWriteMode") and nst.get("oneNodeWriteMode"):
+        return ("error: unsupported transition from multi-peer mode to "
+                "singleton (one-node-write) mode")
+    if lst.get("oneNodeWriteMode") and not nst.get("oneNodeWriteMode"):
+        return ("cluster transitioned from singleton (one-node-write) "
+                "mode to multi-peer mode")
+    if nst["primary"]["id"] != lst["primary"]["id"]:
+        if nst.get("generation") == lst.get("generation"):
+            return "error: new primary, but same gen number"
+        if lst.get("sync") is None or \
+                nst["primary"]["id"] != lst["sync"]["id"]:
+            return "error: new primary was not previous sync"
+        return "sync (%s) took over as primary (from %s)" % (
+            zid(nst["primary"]), zid(lst["primary"]))
+    if nst.get("generation", 0) > lst.get("generation", 0):
+        if lst.get("sync") is None and not lst.get("oneNodeWriteMode"):
+            return 'sync "%s" added' % zid(nst["sync"])
+        if nst.get("sync") and lst.get("sync") and \
+                nst["sync"]["id"] == lst["sync"]["id"]:
+            return ("error: gen number changed, but primary and sync "
+                    "did not")
+        return "primary (%s) selected new sync (was %s, now %s)" % (
+            zid(nst["primary"]), zid(lst["sync"]), zid(nst["sync"]))
+    nsync, lsync = nst.get("sync"), lst.get("sync")
+    if (nsync is None) != (lsync is None) or \
+            (nsync and lsync and nsync["id"] != lsync["id"]):
+        return "error: sync changed, but gen number did not"
+
+    changes = []
+    if nst.get("freeze") and not lst.get("freeze"):
+        changes.append("cluster frozen: %s"
+                       % nst["freeze"].get("reason"))
+    elif not nst.get("freeze") and lst.get("freeze"):
+        changes.append("cluster unfrozen")
+    nas = {a["zoneId"]: 1 for a in nst.get("async") or []}
+    las = {a["zoneId"]: 1 for a in lst.get("async") or []}
+    for z in nas:
+        if z not in las:
+            changes.append('async "%s" added' % z[:8])
+    for z in las:
+        if z not in nas:
+            changes.append('async "%s" removed' % z[:8])
+    nd = {d["zoneId"]: 1 for d in nst.get("deposed") or []}
+    ld = {d["zoneId"]: 1 for d in lst.get("deposed") or []}
+    for z in nd:
+        if z not in ld:
+            changes.append('"%s" deposed' % z[:8])
+    for z in ld:
+        if z not in nd:
+            changes.append('"%s" no longer deposed' % z[:8])
+    return ", ".join(changes)
+
+
+# ---------------------------------------------------------------------------
+
+
+class AdmClient:
+    """Operator-side client: talks to the coordination service and each
+    peer's database directly (lib/adm.js:81-209, 2166-2227)."""
+
+    def __init__(self, coord_addr: str, *, base_path: str = "/manatee"):
+        host, _, port = coord_addr.partition(":")
+        self.host = host
+        self.port = int(port or 2281)
+        self.base_path = base_path
+        self._client: NetCoord | None = None
+
+    async def __aenter__(self):
+        await self.connect()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def connect(self) -> None:
+        self._client = NetCoord(self.host, self.port, session_timeout=30)
+        await asyncio.wait_for(self._client.connect(), 10)
+
+    async def close(self) -> None:
+        if self._client:
+            await self._client.close()
+
+    def _shard_path(self, shard: str) -> str:
+        return "%s/%s" % (self.base_path, shard)
+
+    # -- reads --
+
+    async def list_shards(self) -> list[str]:
+        try:
+            return await self._client.get_children(self.base_path)
+        except NoNodeError:
+            return []
+
+    async def get_state(self, shard: str) -> tuple[dict | None, int]:
+        try:
+            data, ver = await self._client.get(
+                self._shard_path(shard) + "/state")
+            return json.loads(data.decode()), ver
+        except NoNodeError:
+            return None, -1
+
+    async def get_active(self, shard: str) -> list[dict]:
+        from manatee_tpu.coord.manager import parse_and_unique_actives
+        path = self._shard_path(shard) + "/election"
+        try:
+            names = await self._client.get_children(path)
+        except NoNodeError:
+            return []
+        actives = parse_and_unique_actives(names)
+        for ent in actives:
+            try:
+                data, _ = await self._client.get(path + "/" + ent["name"])
+                ent["data"] = json.loads(data.decode())
+            except (NoNodeError, ValueError):
+                ent["data"] = {}
+        return actives
+
+    async def get_history(self, shard: str) -> list[dict]:
+        """[{time, generation, state, annotation}] ordered by sequence
+        (lib/adm.js:2088-2162)."""
+        path = self._shard_path(shard) + "/history"
+        try:
+            names = await self._client.get_children(path)
+        except NoNodeError:
+            return []
+        names.sort(key=lambda n: int(n.rsplit("-", 1)[1]))
+        out = []
+        last_state = None
+        for n in names:
+            try:
+                data, _v, ctime = await self._client.get_full(
+                    path + "/" + n)
+                state = json.loads(data.decode())
+            except (NoNodeError, ValueError):
+                continue
+            out.append({
+                "node": n,
+                "time": _now_iso(ctime) if ctime else "?",
+                "generation": state.get("generation"),
+                "state": state,
+                "annotation": history_annotation(state, last_state),
+            })
+            last_state = state
+        return out
+
+    # -- cluster details --
+
+    async def load_cluster_details(self, shard: str) -> ClusterDetails:
+        canned = os.environ.get("MANATEE_ADM_TEST_STATE")
+        if canned:
+            return load_test_state(canned)
+        state, _v = await self.get_state(shard)
+        if state is None:
+            raise AdmError("no cluster state for shard %r" % shard)
+        peer_status: dict[str, PeerStatus] = {}
+        peers = [state["primary"]]
+        if state.get("sync"):
+            peers.append(state["sync"])
+        peers.extend(state.get("async") or [])
+        peers.extend(state.get("deposed") or [])
+        await asyncio.gather(*[
+            self._add_pg_status(p, peer_status, state) for p in peers])
+        return ClusterDetails(shard, state, peer_status)
+
+    async def _add_pg_status(self, peer: dict,
+                             out: dict[str, PeerStatus],
+                             state: dict) -> None:
+        """(lib/adm.js:348-427: pg_stat_replication + replay lag with a
+        1 s timeout)"""
+        ps = PeerStatus(ident=peer)
+        out[peer["id"]] = ps
+        engine = self._engine_for(peer)
+        if engine is None:
+            ps.pgerr = "unsupported pgUrl %r" % peer.get("pgUrl")
+            return
+        try:
+            st = await engine.query_url(peer["pgUrl"], {"op": "status"},
+                                        PG_QUERY_TIMEOUT)
+        except (PgError, asyncio.TimeoutError, OSError) as e:
+            ps.pgerr = str(e)
+            return
+        ps.online = True
+        ps.lag = st.get("replay_lag_seconds")
+        # the row describing this peer's DOWNSTREAM (first repl row)
+        repl = st.get("replication") or []
+        ps.repl = repl[0] if repl else None
+
+    @staticmethod
+    def _engine_for(peer: dict):
+        try:
+            scheme, _h, _p = parse_pg_url(peer.get("pgUrl") or "")
+        except PgError:
+            return None
+        if scheme == "sim":
+            from manatee_tpu.pg.engine import SimPgEngine
+            return SimPgEngine()
+        if scheme == "tcp":
+            from manatee_tpu.pg.postgres import PostgresEngine
+            return PostgresEngine()
+        return None
+
+    # -- state mutations (operator actions) --
+
+    async def _update_state(self, shard: str, mutate, *,
+                            retries: int = 3) -> dict:
+        """Read-modify-CAS loop for operator writes.  *mutate(state)*
+        returns the new state dict (or raises AdmError)."""
+        for _ in range(retries):
+            state, ver = await self.get_state(shard)
+            if state is None:
+                raise AdmError("no cluster state for shard %r" % shard)
+            new = mutate(json.loads(json.dumps(state)))
+            try:
+                data = json.dumps(new).encode()
+                from manatee_tpu.coord.api import Op
+                await self._client.multi([
+                    Op.create("%s/history/%d-" % (
+                        self._shard_path(shard),
+                        int(new["generation"])), data,
+                        sequential=True),
+                    Op.set(self._shard_path(shard) + "/state", data, ver),
+                ])
+                return new
+            except BadVersionError:
+                continue
+        raise AdmError("lost the update race %d times; try again"
+                       % retries)
+
+    async def freeze(self, shard: str, reason: str) -> dict:
+        """(lib/adm.js:1048-1075)"""
+        def mutate(st):
+            if st.get("freeze"):
+                raise AdmError("cluster is already frozen")
+            st["freeze"] = {"date": _now_iso(), "reason": reason}
+            return st
+        return await self._update_state(shard, mutate)
+
+    async def unfreeze(self, shard: str) -> dict:
+        """(lib/adm.js:1077-1098)"""
+        def mutate(st):
+            if not st.get("freeze"):
+                raise AdmError("cluster is not frozen")
+            st.pop("freeze", None)
+            return st
+        return await self._update_state(shard, mutate)
+
+    async def reap(self, shard: str, zonename: str | None = None) -> dict:
+        """Remove deposed entries that are gone (or the one named).
+        (lib/adm.js:1108-1146; safety per docs/man/manatee-adm.md:
+        306-329 — never reap a peer that is still registered)"""
+        active_ids = {a["id"] for a in await self.get_active(shard)}
+
+        def mutate(st):
+            deposed = st.get("deposed") or []
+            if zonename is not None:
+                keep, dropped = [], []
+                for d in deposed:
+                    if d.get("zoneId") == zonename or \
+                            d.get("id") == zonename:
+                        dropped.append(d)
+                    else:
+                        keep.append(d)
+                if not dropped:
+                    raise AdmError("%s not in deposed list" % zonename)
+            else:
+                keep = [d for d in deposed if d["id"] in active_ids]
+                dropped = [d for d in deposed
+                           if d["id"] not in active_ids]
+            for d in dropped:
+                if d["id"] in active_ids:
+                    raise AdmError(
+                        "peer %s is still registered; will not reap"
+                        % d["id"])
+            if not dropped:
+                raise AdmError("nothing to reap")
+            st["deposed"] = keep
+            return st
+        return await self._update_state(shard, mutate)
+
+    async def set_onwm(self, shard: str, mode: str) -> dict:
+        """(lib/adm.js:1148-1209)"""
+        if mode not in ("on", "off"):
+            raise AdmError("mode must be 'on' or 'off'")
+
+        def mutate(st):
+            current = bool(st.get("oneNodeWriteMode"))
+            if mode == "on":
+                if current:
+                    raise AdmError("already in one-node-write mode")
+                if st.get("sync") or st.get("async"):
+                    raise AdmError("cannot enable one-node-write mode "
+                                   "with standbys in the topology")
+                st["oneNodeWriteMode"] = True
+            else:
+                if not current:
+                    raise AdmError("not in one-node-write mode")
+                st.pop("oneNodeWriteMode", None)
+            return st
+        return await self._update_state(shard, mutate)
+
+    async def state_backfill(self, shard: str) -> dict:
+        """Create an initial (frozen) state from the current election
+        order when none exists — the v1→v2 migration analogue
+        (lib/adm.js:1231-1312)."""
+        state, _ = await self.get_state(shard)
+        if state is not None:
+            raise AdmError("state already exists for shard %s" % shard)
+        actives = await self.get_active(shard)
+        if not actives:
+            raise AdmError("no active peers in shard %s" % shard)
+        actives.sort(key=lambda a: a["seq"])
+
+        def info(a):
+            d = {"id": a["id"]}
+            d.update(a.get("data") or {})
+            d.setdefault("zoneId", a["id"])
+            return d
+
+        new = {
+            "generation": 0,
+            "initWal": "0/0000000",
+            "primary": info(actives[0]),
+            "sync": info(actives[1]) if len(actives) > 1 else None,
+            "async": [info(a) for a in actives[2:]],
+            "deposed": [],
+            "freeze": {"date": _now_iso(),
+                       "reason": "manatee-adm state-backfill"},
+        }
+        from manatee_tpu.coord.api import Op
+        data = json.dumps(new).encode()
+        await self._client.mkdirp(self._shard_path(shard) + "/history")
+        await self._client.multi([
+            Op.create(self._shard_path(shard) + "/history/0-", data,
+                      sequential=True),
+            Op.create(self._shard_path(shard) + "/state", data),
+        ])
+        return new
+
+    # -- promote --
+
+    async def promote(self, shard: str, *, role: str, zonename: str,
+                      async_index: int | None = None,
+                      lag_to_ignore: float = DEFAULT_LAG_TO_IGNORE,
+                      ignore_warnings: bool = False,
+                      wait: bool = True,
+                      wait_timeout: float = PROMOTE_EXPIRY_S + 10) -> dict:
+        """(lib/adm.js:1693-2040, docs/man/manatee-adm.md:346-419)"""
+        details = await self.load_cluster_details(shard)
+        if details.errors:
+            raise AdmError("cluster has errors; not promoting: %s"
+                           % "; ".join(details.errors))
+        lags = [p.lag for p in details.peers.values()
+                if p.lag is not None]
+        if not ignore_warnings:
+            if details.warnings:
+                raise AdmError("cluster has warnings; use -y to "
+                               "override: %s"
+                               % "; ".join(details.warnings))
+            if any(l > lag_to_ignore for l in lags):
+                raise AdmError("replication lag exceeds %ss; use -y to "
+                               "override" % lag_to_ignore)
+
+        st = details.state
+        if role == "sync":
+            target = st.get("sync")
+            if target is None or target.get("zoneId") != zonename:
+                raise AdmError("the sync is not %r (topology changed?)"
+                               % zonename)
+            promote = {"id": target["id"], "role": "sync"}
+        elif role == "async":
+            asyncs = st.get("async") or []
+            if async_index is None:
+                if len(asyncs) != 1:
+                    raise AdmError("--asyncIndex required with %d asyncs"
+                                   % len(asyncs))
+                async_index = 0
+            if async_index < 0:
+                raise AdmError("asyncIndex must be >= 0")
+            if async_index >= len(asyncs) or \
+                    asyncs[async_index].get("zoneId") != zonename:
+                raise AdmError(
+                    "async[%d] is not %r (topology changed?)"
+                    % (async_index, zonename))
+            promote = {"id": asyncs[async_index]["id"], "role": "async",
+                       "asyncIndex": async_index}
+        else:
+            raise AdmError("role must be 'sync' or 'async'")
+
+        promote["generation"] = st["generation"]
+        promote["expireTime"] = _now_iso(
+            datetime.datetime.now(datetime.timezone.utc)
+            + datetime.timedelta(seconds=PROMOTE_EXPIRY_S))
+
+        def mutate(s):
+            if s.get("generation") != promote["generation"]:
+                raise AdmError("topology changed while composing the "
+                               "promotion request")
+            s["promote"] = promote
+            return s
+        await self._update_state(shard, mutate)
+
+        if not wait:
+            return promote
+        # watch until the request is acted on (promote object removed)
+        deadline = asyncio.get_event_loop().time() + wait_timeout
+        while asyncio.get_event_loop().time() < deadline:
+            s, _ = await self.get_state(shard)
+            if s is not None and "promote" not in s:
+                return promote
+            await asyncio.sleep(1.0)
+        raise AdmError("promotion request was not acted on (it may "
+                       "have been ignored; see clear-promote)")
+
+    async def clear_promote(self, shard: str) -> dict:
+        """(lib/adm.js:2004-2040)"""
+        def mutate(st):
+            if "promote" not in st:
+                raise AdmError("no promotion request present")
+            st.pop("promote", None)
+            return st
+        return await self._update_state(shard, mutate)
+
+    # -- check-lock --
+
+    async def check_lock(self, path: str) -> bool:
+        """True if the lock node EXISTS (lib/adm.js:2049-2086)."""
+        stat = await self._client.exists(path)
+        return stat is not None

@@ -1,0 +1,618 @@
+"""manatee-adm — the operator CLI.
+
+Reference parity: bin/manatee-adm (cmdln subcommands, 1536 lines) with
+the same command set, column registry/aliases/defaults (:1151-1232),
+tabular output (:1330-1419), cluster-issue printing and exit-code
+contracts (verify exits non-zero on ANY issue, :466-477), plus the man
+page semantics (docs/man/manatee-adm.md).
+
+Environment: SHARD, COORD_ADDR (the ZK_IPS analogue),
+MANATEE_SITTER_CONFIG, MANATEE_ADM_TEST_STATE
+(docs/man/manatee-adm.md:502-515).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+from manatee_tpu import __version__
+from manatee_tpu.adm import (
+    AdmClient,
+    AdmError,
+    ClusterDetails,
+    DEFAULT_LAG_TO_IGNORE,
+    pg_duration,
+)
+
+# ---- column registry (bin/manatee-adm:1151-1232) ----
+
+ALL_COLUMNS = {
+    "peername": {"label": "PEERNAME", "width": 36},
+    "peerabbr": {"label": "PEER", "width": 8},
+    "role":     {"label": "ROLE", "width": 8},
+    "ip":       {"label": "IP", "width": 16},
+    "pg-online": {"label": "PG", "width": 4},
+    "pg-repl":  {"label": "REPL", "width": 5},
+    "pg-sent":  {"label": "SENT", "width": 13},
+    "pg-write": {"label": "WRITE", "width": 13},
+    "pg-flush": {"label": "FLUSH", "width": 13},
+    "pg-replay": {"label": "REPLAY", "width": 13},
+    "pg-lag":   {"label": "LAG", "width": 6},
+}
+COLUMN_ALIASES = {"zonename": "peername", "zoneabbr": "peerabbr"}
+PEERS_DFL = ["role", "peername", "ip"]
+PGSTATUS_DFL = ["role", "peerabbr", "pg-online", "pg-repl", "pg-sent",
+                "pg-flush", "pg-replay", "pg-lag"]
+PGSTATUS_WIDE_DFL = ["role", "peername", "pg-online", "pg-repl",
+                     "pg-sent", "pg-flush", "pg-replay", "pg-lag"]
+
+
+def extract_columns(names: list[str]) -> list[dict]:
+    out = []
+    for n in names:
+        n = COLUMN_ALIASES.get(n, n)
+        if n not in ALL_COLUMNS:
+            raise AdmError("unknown column: %r" % n)
+        col = dict(ALL_COLUMNS[n])
+        col["name"] = n
+        out.append(col)
+    return out
+
+
+def row_for_peer(role: str, peer) -> dict:
+    """(rowForPeer, bin/manatee-adm:1377-1419)"""
+    rv = {
+        "role": role,
+        "peerabbr": peer.label,
+        "peername": str(peer.ident.get("zoneId", "?")),
+        "ip": str(peer.ident.get("ip", "-")),
+    }
+    if peer.pgerr is not None:
+        rv.update({"pg-online": "fail", "pg-repl": "-", "pg-sent": "-",
+                   "pg-write": "-", "pg-flush": "-", "pg-replay": "-",
+                   "pg-lag": "-"})
+        return rv
+    rv["pg-online"] = "ok"
+    rv["pg-lag"] = pg_duration(peer.lag)
+    repl = peer.repl
+    if repl is None or not repl.get("sync_state"):
+        rv.update({"pg-repl": "-", "pg-sent": "-", "pg-write": "-",
+                   "pg-flush": "-", "pg-replay": "-"})
+        return rv
+    rv["pg-repl"] = repl["sync_state"]
+    rv["pg-sent"] = repl.get("sent_lsn") or "-"
+    rv["pg-write"] = repl.get("write_lsn") or "-"
+    rv["pg-flush"] = repl.get("flush_lsn") or "-"
+    rv["pg-replay"] = repl.get("replay_lsn") or "-"
+    return rv
+
+
+def emit_table(columns: list[dict], rows: list[dict], *,
+               omit_header: bool = False, out=None) -> None:
+    out = out or sys.stdout
+    if not omit_header:
+        parts = [c["label"].ljust(c["width"]) for c in columns]
+        out.write(" ".join(parts).rstrip() + "\n")
+    for row in rows:
+        parts = [str(row.get(c["name"], "-")).ljust(c["width"])
+                 for c in columns]
+        out.write(" ".join(parts).rstrip() + "\n")
+
+
+def print_cluster_table(details: ClusterDetails, columns: list[dict], *,
+                        role_filter: str | None = None,
+                        omit_header: bool = False, out=None) -> None:
+    rows = []
+    if role_filter in (None, "primary"):
+        rows.append(row_for_peer("primary",
+                                 details.peers[details.primary]))
+    if role_filter in (None, "sync") and details.sync is not None:
+        rows.append(row_for_peer("sync", details.peers[details.sync]))
+    if role_filter in (None, "async"):
+        for a in details.asyncs:
+            rows.append(row_for_peer("async", details.peers[a]))
+    if role_filter in (None, "deposed"):
+        for d in details.deposed:
+            rows.append(row_for_peer("deposed", details.peers[d]))
+    emit_table(columns, rows, omit_header=omit_header, out=out)
+
+
+def print_cluster_issues(details: ClusterDetails, stream, *,
+                         leading_nl: bool) -> None:
+    if leading_nl and (details.errors or details.warnings):
+        stream.write("\n")
+    for e in details.errors:
+        stream.write("error: %s\n" % e.split("\n")[0])
+    for w in details.warnings:
+        stream.write("warning: %s\n" % w.split("\n")[0])
+
+
+# ---- command implementations ----
+
+def _coord(args) -> str:
+    addr = args.coord or os.environ.get("COORD_ADDR") \
+        or os.environ.get("ZK_IPS")
+    if not addr:
+        die("coordination address required (-z or COORD_ADDR)")
+    return addr
+
+
+def _shard(args) -> str:
+    shard = getattr(args, "shard", None) or os.environ.get("SHARD")
+    if not shard:
+        die("shard name required (-s or SHARD)")
+    return shard
+
+
+def die(msg: str, code: int = 2) -> None:
+    sys.stderr.write("manatee-adm: %s\n" % msg)
+    sys.exit(code)
+
+
+async def _load_details(args) -> ClusterDetails:
+    canned = os.environ.get("MANATEE_ADM_TEST_STATE")
+    if canned:
+        from manatee_tpu.adm import load_test_state
+        return load_test_state(canned)
+    async with AdmClient(_coord(args)) as adm:
+        return await adm.load_cluster_details(_shard(args))
+
+
+def cmd_version(_args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_show(args) -> int:
+    async def go():
+        details = await _load_details(args)
+        print("coordination: %s" % (args.coord or
+                                    os.environ.get("COORD_ADDR", "-")))
+        print("cluster:     %s" % details.shard)
+        print("generation:  %s (%s)" % (details.generation,
+                                        details.initwal))
+        print("mode:        %s" % ("singleton (one-node-write)"
+                                   if details.singleton else "normal"))
+        if details.frozen:
+            print("freeze:      frozen since %s" % details.freeze_time)
+            print("freeze info: %s" % details.freeze_reason)
+        else:
+            print("freeze:      not frozen")
+        print("")
+        if args.verbose:
+            print_cluster_table(details, extract_columns(PEERS_DFL))
+            print("")
+        print_cluster_table(details, extract_columns(PGSTATUS_DFL))
+        print_cluster_issues(details, sys.stdout, leading_nl=True)
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_peers(args) -> int:
+    async def go():
+        details = await _load_details(args)
+        cols = extract_columns(args.columns.split(",")
+                               if args.columns else PEERS_DFL)
+        print_cluster_table(details, cols, role_filter=args.role,
+                            omit_header=args.omit_header)
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_pg_status(args) -> int:
+    async def go():
+        dfl = PGSTATUS_WIDE_DFL if args.wide else PGSTATUS_DFL
+        cols = extract_columns(args.columns.split(",")
+                               if args.columns else dfl)
+        count = args.count if args.count is not None else \
+            (0 if args.period else 1)
+        i = 0
+        while True:
+            details = await _load_details(args)
+            print_cluster_table(details, cols, role_filter=args.role,
+                                omit_header=args.omit_header)
+            print_cluster_issues(details, sys.stdout, leading_nl=True)
+            i += 1
+            if count and i >= count:
+                break
+            await asyncio.sleep(args.period or 1)
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_verify(args) -> int:
+    async def go():
+        try:
+            details = await _load_details(args)
+        except Exception:
+            print("error: failed to fetch cluster state")
+            return 1
+        print_cluster_issues(details, sys.stdout, leading_nl=False)
+        if details.errors or details.warnings:
+            return 1
+        if args.verbose:
+            print("all checks passed")
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_status(args) -> int:
+    """Deprecated JSON status across shards (bin/manatee-adm:203)."""
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            shards = [args.shard] if args.shard else \
+                await adm.list_shards()
+            out = {}
+            for sh in shards:
+                try:
+                    d = await adm.load_cluster_details(sh)
+                except AdmError:
+                    continue
+                entry = {}
+
+                def peerjson(pid):
+                    p = d.peers[pid]
+                    return {
+                        "zoneId": p.ident.get("zoneId"),
+                        "ip": p.ident.get("ip"),
+                        "pgUrl": p.ident.get("pgUrl"),
+                        "backupUrl": p.ident.get("backupUrl"),
+                        "online": p.online,
+                        "repl": p.repl or {},
+                        "lag": p.lag,
+                    }
+                entry["primary"] = peerjson(d.primary)
+                if d.sync:
+                    entry["sync"] = peerjson(d.sync)
+                for i, a in enumerate(d.asyncs):
+                    entry["async" + ("" if i == 0 else str(i))] = \
+                        peerjson(a)
+                for i, dep in enumerate(d.deposed):
+                    entry["deposed" + ("" if i == 0 else str(i))] = \
+                        peerjson(dep)
+                out[sh] = entry
+            print(json.dumps(out, indent=4))
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_zk_state(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            state, _ = await adm.get_state(_shard(args))
+            if state is None:
+                sys.stderr.write("manatee-adm: no cluster state for "
+                                 "shard %r\n" % _shard(args))
+                return 1
+            print(json.dumps(state, indent=4))
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_zk_active(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            active = await adm.get_active(_shard(args))
+            print(json.dumps(active, indent=4))
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_freeze(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            await adm.freeze(_shard(args), args.reason)
+            print("Frozen.")
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_unfreeze(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            await adm.unfreeze(_shard(args))
+            print("Unfrozen.")
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_reap(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            new = await adm.reap(_shard(args), args.zonename)
+            print("Reaped.  Deposed peers now: %s"
+                  % json.dumps(new.get("deposed", [])))
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_set_onwm(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            await adm.set_onwm(_shard(args), args.mode)
+            print("one-node-write mode: %s" % args.mode)
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_state_backfill(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            new = await adm.state_backfill(_shard(args))
+            print(json.dumps(new, indent=4))
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_promote(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            print("Promotion requested.  Watching until the request has "
+                  "been acknowledged and topology has changed.")
+            await adm.promote(
+                _shard(args), role=args.role, zonename=args.zonename,
+                async_index=args.asyncIndex,
+                lag_to_ignore=args.lagToIgnore,
+                ignore_warnings=args.yes)
+            print("Promotion complete.")
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_clear_promote(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            await adm.clear_promote(_shard(args))
+            print("Promotion request cleared.")
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_check_lock(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            held = await adm.check_lock(args.path)
+        # exit 1 when the lock exists (bin/manatee-adm:613-649)
+        return 1 if held else 0
+    return asyncio.run(go())
+
+
+def cmd_history(args) -> int:
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            hist = await adm.get_history(_shard(args))
+        if args.json:
+            for h in hist:
+                print(json.dumps(h))
+            return 0
+        cols = [
+            {"name": "time", "label": "TIME", "width": 24},
+            {"name": "generation", "label": "GEN", "width": 4},
+            {"name": "mode", "label": "MODE", "width": 9},
+            {"name": "freeze", "label": "FROZEN", "width": 6},
+            {"name": "annotation", "label": "SUMMARY", "width": 40},
+        ]
+        rows = []
+        for h in hist:
+            st = h["state"]
+            rows.append({
+                "time": h["time"],
+                "generation": h["generation"],
+                "mode": ("singleton" if st.get("oneNodeWriteMode")
+                         else "normal"),
+                "freeze": "yes" if st.get("freeze") else "no",
+                "annotation": h["annotation"] or "-",
+            })
+        emit_table(cols, rows)
+        return 0
+    return asyncio.run(go())
+
+
+def cmd_rebuild(args) -> int:
+    """Guarded rebuild flow (lib/adm.js:1319-1684): refuse on the
+    primary; deposed peers get their dataset destroyed and their deposed
+    entry removed; others get their dataset isolated.  The (restarted)
+    sitter then restores from its upstream; we watch the restore job."""
+    from manatee_tpu.shard import build_ident, build_storage
+    from manatee_tpu.utils.validation import load_json_config
+
+    async def go():
+        cfgpath = args.config or os.environ.get("MANATEE_SITTER_CONFIG")
+        if not cfgpath:
+            die("sitter config required (-c or MANATEE_SITTER_CONFIG)")
+        cfg = load_json_config(cfgpath, None, name="sitter config")
+        ident = build_ident(cfg)
+        storage = build_storage(cfg)
+        shard = cfg["shardPath"].rsplit("/", 1)[-1]
+
+        async with AdmClient(_coord(args)) as adm:
+            state, _ = await adm.get_state(shard)
+            if state is None:
+                die("no cluster state")
+            if state["primary"]["id"] == ident["id"]:
+                die("this peer is the primary; will not rebuild")
+            deposed_ids = [d["id"] for d in state.get("deposed") or []]
+            is_deposed = ident["id"] in deposed_ids
+
+            if not args.yes:
+                print("This operation will remove all local data and "
+                      "rebuild this peer from its upstream.")
+                answer = input("Are you sure you want to proceed? "
+                               "(yes/no): ")
+                if answer.strip().lower() not in ("y", "yes"):
+                    die("aborted")
+
+            ds = cfg["dataset"]
+            if is_deposed:
+                print("Removing deposed dataset")
+                if await storage.exists(ds):
+                    if await storage.is_mounted(ds):
+                        await storage.unmount(ds)
+                    await storage.destroy(ds, recursive=True)
+                def mutate(st):
+                    st["deposed"] = [d for d in st.get("deposed") or []
+                                     if d["id"] != ident["id"]]
+                    return st
+                await adm._update_state(shard, mutate)
+                print("Removed from deposed list")
+            else:
+                print("Attempting to isolate any existing dataset")
+                from manatee_tpu.backup.client import RestoreClient
+                rc = RestoreClient(storage, dataset=ds,
+                                   mountpoint=cfg["dataDir"])
+                name = await rc.isolate("rebuild")
+                print("Isolated existing dataset as: %s" % name
+                      if name else "No existing dataset detected.")
+
+            # watch the sitter recover naturally (restore progress via
+            # its status server, lib/adm.js:1550-1678)
+            import aiohttp
+            status = "http://%s:%d" % (cfg["ip"],
+                                       int(cfg["postgresPort"]) + 1)
+            print("Waiting for peer to rejoin and restore...")
+            deadline = time.monotonic() + args.timeout
+            last_pct = None
+            async with aiohttp.ClientSession() as http:
+                while time.monotonic() < deadline:
+                    try:
+                        async with http.get(
+                                status + "/restore",
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as r:
+                            job = (await r.json()).get("restore")
+                        if job and job.get("size"):
+                            pct = 100.0 * job.get("completed", 0) / \
+                                max(1, job["size"])
+                            if pct != last_pct:
+                                print("restore: %5.1f%%" % pct)
+                                last_pct = pct
+                        async with http.get(
+                                status + "/ping",
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as r:
+                            if r.status == 200:
+                                print("Peer is healthy again.")
+                                return 0
+                    except (aiohttp.ClientError, OSError,
+                            asyncio.TimeoutError):
+                        pass
+                    await asyncio.sleep(1.0)
+            die("timed out waiting for the peer to recover")
+        return 0
+    return asyncio.run(go())
+
+
+# ---- argument parsing ----
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="manatee-adm",
+        description="Administer a manatee HA-PostgreSQL shard")
+    p.add_argument("-z", "--coord", metavar="HOST:PORT",
+                   help="coordination service address "
+                        "(env: COORD_ADDR / ZK_IPS)")
+    sub = p.add_subparsers(dest="cmd", metavar="COMMAND")
+
+    def add(name, fn, help_, *, shard=True, aliases=()):
+        sp = sub.add_parser(name, help=help_, aliases=list(aliases))
+        sp.set_defaults(fn=fn)
+        if shard:
+            sp.add_argument("-s", "--shard", help="shard name "
+                                                  "(env: SHARD)")
+        return sp
+
+    add("version", cmd_version, "print version", shard=False)
+
+    sp = add("show", cmd_show, "show cluster summary")
+    sp.add_argument("-v", "--verbose", action="store_true")
+
+    sp = add("peers", cmd_peers, "list peers")
+    sp.add_argument("-o", "--columns")
+    sp.add_argument("-r", "--role")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
+
+    sp = add("pg-status", cmd_pg_status, "postgres status per peer")
+    sp.add_argument("-o", "--columns")
+    sp.add_argument("-r", "--role")
+    sp.add_argument("-w", "--wide", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
+    sp.add_argument("period", nargs="?", type=float, default=None)
+    sp.add_argument("count", nargs="?", type=int, default=None)
+
+    sp = add("verify", cmd_verify, "verify cluster health")
+    sp.add_argument("-v", "--verbose", action="store_true")
+
+    sp = add("status", cmd_status, "(deprecated) JSON status")
+    sp.set_defaults(shard=None)
+
+    add("zk-state", cmd_zk_state, "dump raw cluster state")
+    add("zk-active", cmd_zk_active, "dump active peers")
+
+    sp = add("freeze", cmd_freeze, "freeze the cluster")
+    sp.add_argument("-r", "--reason", required=True)
+
+    add("unfreeze", cmd_unfreeze, "unfreeze the cluster")
+
+    sp = add("reap", cmd_reap, "remove gone peers from the deposed list")
+    sp.add_argument("-n", "--zonename", default=None)
+
+    sp = add("set-onwm", cmd_set_onwm, "set one-node-write mode")
+    sp.add_argument("-m", "--mode", required=True,
+                    choices=["on", "off"])
+    sp.add_argument("-y", "--yes", action="store_true")
+
+    add("state-backfill", cmd_state_backfill,
+        "create initial state from election order")
+
+    sp = add("promote", cmd_promote, "request a peer promotion")
+    sp.add_argument("-n", "--zonename", required=True)
+    sp.add_argument("-r", "--role", required=True,
+                    choices=["sync", "async"])
+    sp.add_argument("-i", "--asyncIndex", type=int, default=None)
+    sp.add_argument("-l", "--lagToIgnore", type=float,
+                    default=DEFAULT_LAG_TO_IGNORE)
+    sp.add_argument("-y", "--yes", action="store_true")
+
+    add("clear-promote", cmd_clear_promote,
+        "clear an ignored promotion request")
+
+    sp = add("check-lock", cmd_check_lock,
+             "exit 1 if a lock node exists", shard=False)
+    sp.add_argument("-p", "--path", required=True)
+
+    sp = add("history", cmd_history, "annotated cluster state history")
+    sp.add_argument("-j", "--json", action="store_true")
+
+    sp = add("rebuild", cmd_rebuild, "rebuild this peer from upstream")
+    sp.add_argument("-c", "--config",
+                    help="sitter config (env: MANATEE_SITTER_CONFIG)")
+    sp.add_argument("-y", "--yes", action="store_true")
+    sp.add_argument("--timeout", type=float, default=3600.0)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        sys.exit(2)
+    try:
+        rc = args.fn(args)
+    except AdmError as e:
+        die(str(e), 1)
+    except KeyboardInterrupt:
+        sys.exit(130)
+    sys.exit(rc or 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,7 @@ class Stat:
     version: int
     ephemeral_owner: str | None = None
     num_children: int = 0
+    ctime: float = 0.0   # unix seconds at creation
 
 
 class CoordClient(abc.ABC):

@@ -266,6 +266,12 @@ class NetCoord(CoordClient):
 
     async def get(self, path: str, watch: WatchCb | None = None
                   ) -> tuple[bytes, int]:
+        data, version, _ctime = await self.get_full(path, watch)
+        return data, version
+
+    async def get_full(self, path: str, watch: WatchCb | None = None
+                       ) -> tuple[bytes, int, float]:
+        """get() plus the node's creation time — one round trip."""
         armed = self._arm("data", path, watch)
         try:
             res = await self._request({"op": "get", "path": path,
@@ -274,7 +280,8 @@ class NetCoord(CoordClient):
             if armed:
                 self._watches[("data", path)].remove(watch)
             raise
-        return base64.b64decode(res["data"]), res["version"]
+        return (base64.b64decode(res["data"]), res["version"],
+                res.get("ctime", 0.0))
 
     async def set(self, path: str, data: bytes, version: int = -1) -> int:
         return await self._request({
@@ -299,7 +306,8 @@ class NetCoord(CoordClient):
             return None
         return Stat(version=res["version"],
                     ephemeral_owner=res.get("ephemeral_owner"),
-                    num_children=res.get("num_children", 0))
+                    num_children=res.get("num_children", 0),
+                    ctime=res.get("ctime", 0.0))
 
     async def get_children(self, path: str, watch: WatchCb | None = None
                            ) -> list[str]:

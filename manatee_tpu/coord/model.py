@@ -34,6 +34,7 @@ class _Node:
     version: int = 0
     ephemeral_owner: str | None = None
     seq_counter: int = 0
+    ctime: float = field(default_factory=time.time)
     children: dict[str, "_Node"] = field(default_factory=dict)
 
 
@@ -215,7 +216,8 @@ class ZNodeTree:
             return None
         return Stat(version=node.version,
                     ephemeral_owner=node.ephemeral_owner,
-                    num_children=len(node.children))
+                    num_children=len(node.children),
+                    ctime=node.ctime)
 
     def get_children(self, path: str) -> list[str]:
         validate_path(path)

@@ -227,7 +227,9 @@ class CoordServer:
             data, version = tree.get(path)
             if req.get("watch"):
                 tree.add_watch(model.DATA, path, conn.watch_sink(model.DATA))
-            return {"data": _b64(data), "version": version}
+            stat = tree.exists(path)
+            return {"data": _b64(data), "version": version,
+                    "ctime": stat.ctime if stat else 0.0}
         if op == "set":
             return tree.set(path, _unb64(req.get("data")),
                             int(req.get("version", -1)))
@@ -242,7 +244,8 @@ class CoordServer:
                 return None
             return {"version": stat.version,
                     "ephemeral_owner": stat.ephemeral_owner,
-                    "num_children": stat.num_children}
+                    "num_children": stat.num_children,
+                    "ctime": stat.ctime}
         if op == "children":
             names = tree.get_children(path)
             if req.get("watch"):

@@ -306,6 +306,8 @@ class SimPgServer:
         try:
             cursor = from_lsn
             while True:
+                if ack_task.done():
+                    break   # standby hung up (EOF on the ack stream)
                 recs = self.wal.get_from(cursor)
                 for rec in recs:
                     writer.write((json.dumps(rec) + "\n").encode())
@@ -341,6 +343,8 @@ class SimPgServer:
             repl = []
             syncs = self.sync_names()
             for sid, st in self.downstreams.items():
+                if sid.endswith(":probe"):
+                    continue   # boot probes are not real standbys
                 repl.append({
                     "application_name": sid,
                     "state": "streaming",

@@ -69,9 +69,7 @@ log = logging.getLogger("manatee.state")
 RETRY_DELAY = 1.0
 
 
-def _now_iso() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+from manatee_tpu.utils import iso_ms as _now_iso  # noqa: E402
 
 
 def _iso_to_ts(s: str) -> float:

@@ -1,8 +1,23 @@
 """Utility layer (reference: lib/common.js, lib/confParser.js)."""
 
+import datetime as _dt
+
 from manatee_tpu.utils.executil import ExecError, ExecResult, run, run_sync
 from manatee_tpu.utils.pgversion import pg_strip_minor
 from manatee_tpu.utils.confparser import ConfFile
+
+
+def iso_ms(when: _dt.datetime | float | None = None) -> str:
+    """Millisecond-precision UTC ISO timestamp ('...T...%.3fZ') — the one
+    format used for freeze dates, promote expiry, and history times."""
+    if when is None:
+        dt = _dt.datetime.now(_dt.timezone.utc)
+    elif isinstance(when, (int, float)):
+        dt = _dt.datetime.fromtimestamp(when, _dt.timezone.utc)
+    else:
+        dt = when
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
 
 __all__ = [
     "ExecError",
@@ -11,4 +26,5 @@ __all__ = [
     "run_sync",
     "pg_strip_minor",
     "ConfFile",
+    "iso_ms",
 ]

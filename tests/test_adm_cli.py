@@ -1,0 +1,273 @@
+"""manatee-adm CLI tests.
+
+Golden-output tests with a fake cluster (test/tst.manateeAdm.js
+pattern): a MockState builder fabricates ClusterDetails-shaped JSON for
+healthy/broken clusters, fed to the REAL CLI process through the
+MANATEE_ADM_TEST_STATE env hook (lib/adm.js:662-745 analogue); stdout
+and exit codes are asserted exactly.  Usage-contract tests mirror
+test/tst.manateeAdmUsage.js.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_peer(name, ip, *, online=True, repl=None, lag=None, pgerr=None):
+    ident = {
+        "id": "%s:5432:12345" % ip,
+        "zoneId": name,
+        "ip": ip,
+        "pgUrl": "sim://%s:5432" % ip,
+        "backupUrl": "http://%s:12345" % ip,
+    }
+    return {
+        "ident": ident,
+        "label": name[:8],
+        "pgerr": pgerr,
+        "repl": repl,
+        "lag": lag,
+        "online": online,
+    }
+
+
+def repl_row(downstream_id, state="streaming", sync_state="sync"):
+    return {
+        "application_name": downstream_id,
+        "state": state,
+        "sent_lsn": "0/0000A000",
+        "write_lsn": "0/0000A000",
+        "flush_lsn": "0/0000A000",
+        "replay_lsn": "0/0000A000",
+        "sync_state": sync_state,
+    }
+
+
+class MockState:
+    """Builder for canned cluster-details JSON
+    (test/tst.manateeAdm.js:154-460 analogue)."""
+
+    def __init__(self):
+        self.primary = make_peer("primary0", "10.0.0.1")
+        self.sync = make_peer("sync0000", "10.0.0.2")
+        self.asyncs = [make_peer("async000", "10.0.0.3")]
+        self.deposed = []
+        self.generation = 3
+        self.initwal = "0/0000A000"
+        self.singleton = False
+        self.freeze = None
+
+    def wire_healthy(self):
+        self.primary["repl"] = repl_row(self.sync["ident"]["id"],
+                                        sync_state="sync")
+        self.sync["repl"] = repl_row(
+            self.asyncs[0]["ident"]["id"], sync_state="async") \
+            if self.asyncs else None
+        self.sync["lag"] = 0.0
+        for i, a in enumerate(self.asyncs):
+            nxt = self.asyncs[i + 1]["ident"]["id"] \
+                if i + 1 < len(self.asyncs) else None
+            a["repl"] = repl_row(nxt, sync_state="async") if nxt else None
+            a["lag"] = 1.0
+        return self
+
+    def to_json(self):
+        state = {
+            "generation": self.generation,
+            "initWal": self.initwal,
+            "primary": self.primary["ident"],
+            "sync": self.sync["ident"] if self.sync else None,
+            "async": [a["ident"] for a in self.asyncs],
+            "deposed": [d["ident"] for d in self.deposed],
+        }
+        if self.singleton:
+            state["oneNodeWriteMode"] = True
+        if self.freeze:
+            state["freeze"] = self.freeze
+        peers = {}
+        for p in [self.primary] + ([self.sync] if self.sync else []) \
+                + self.asyncs + self.deposed:
+            peers[p["ident"]["id"]] = p
+        return json.dumps({"shard": "1", "state": state, "peers": peers})
+
+
+def run_adm(args, state_json=None, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.pop("MANATEE_ADM_TEST_STATE", None)
+    if state_json is not None:
+        env["MANATEE_ADM_TEST_STATE"] = state_json
+        env["SHARD"] = "1"
+        env["COORD_ADDR"] = "127.0.0.1:1"   # unused with the hook
+    if env_extra:
+        env.update(env_extra)
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli"] + args,
+        capture_output=True, text=True, env=env, timeout=60)
+    return cp
+
+
+# ---- golden outputs ----
+
+def test_peers_healthy():
+    cp = run_adm(["peers"], MockState().wire_healthy().to_json())
+    assert cp.returncode == 0
+    assert cp.stdout == (
+        "ROLE     PEERNAME                             IP\n"
+        "primary  primary0                             10.0.0.1\n"
+        "sync     sync0000                             10.0.0.2\n"
+        "async    async000                             10.0.0.3\n"
+    )
+
+
+def test_pg_status_healthy():
+    cp = run_adm(["pg-status"], MockState().wire_healthy().to_json())
+    assert cp.returncode == 0
+    assert cp.stdout == (
+        "ROLE     PEER     PG   REPL  SENT          FLUSH         "
+        "REPLAY        LAG\n"
+        "primary  primary0 ok   sync  0/0000A000    0/0000A000    "
+        "0/0000A000    -\n"
+        "sync     sync0000 ok   async 0/0000A000    0/0000A000    "
+        "0/0000A000    0s\n"
+        "async    async000 ok   -     -             -             "
+        "-             1s\n"
+    )
+
+
+def test_verify_healthy_and_verbose():
+    st = MockState().wire_healthy().to_json()
+    cp = run_adm(["verify"], st)
+    assert cp.returncode == 0
+    assert cp.stdout == ""
+    cp = run_adm(["verify", "-v"], st)
+    assert cp.returncode == 0
+    assert cp.stdout == "all checks passed\n"
+
+
+def test_verify_sync_pg_down():
+    ms = MockState().wire_healthy()
+    ms.sync["pgerr"] = "connection refused"
+    ms.sync["online"] = False
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert 'cannot query postgres on sync' in cp.stdout
+
+
+def test_verify_repl_not_established():
+    ms = MockState().wire_healthy()
+    ms.primary["repl"] = None
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert 'downstream replication peer not connected' in cp.stdout
+
+
+def test_verify_repl_wrong_state():
+    ms = MockState().wire_healthy()
+    ms.primary["repl"]["state"] = "catchup"
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert 'expected state "streaming", found "catchup"' in cp.stdout
+
+
+def test_verify_wrong_sync_state():
+    ms = MockState().wire_healthy()
+    ms.primary["repl"]["sync_state"] = "async"
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert 'expected downstream replication to be "sync", but found ' \
+        '"async"' in cp.stdout
+
+
+def test_verify_warnings_deposed_and_no_asyncs():
+    ms = MockState()
+    ms.asyncs = []
+    ms.deposed = [make_peer("deposed0", "10.0.0.9", online=False,
+                            pgerr="down")]
+    ms.wire_healthy()
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert "warning: cluster has a deposed peer" in cp.stdout
+    assert "warning: cluster has no async peers" in cp.stdout
+
+
+def test_pg_status_deposed_row():
+    ms = MockState()
+    ms.deposed = [make_peer("deposed0", "10.0.0.9", online=False,
+                            pgerr="down")]
+    ms.wire_healthy()
+    cp = run_adm(["pg-status", "-H", "-r", "deposed"], ms.to_json())
+    assert cp.returncode == 0
+    assert cp.stdout.startswith("deposed  deposed0 fail -")
+
+
+def test_show_healthy_and_frozen():
+    ms = MockState().wire_healthy()
+    cp = run_adm(["show"], ms.to_json())
+    assert cp.returncode == 0
+    assert "generation:  3 (0/0000A000)" in cp.stdout
+    assert "mode:        normal" in cp.stdout
+    assert "freeze:      not frozen" in cp.stdout
+
+    ms.freeze = {"date": "2026-01-02T03:04:05Z", "reason": "by op"}
+    cp = run_adm(["show"], ms.to_json())
+    assert "freeze:      frozen since 2026-01-02T03:04:05Z" in cp.stdout
+    assert "freeze info: by op" in cp.stdout
+
+
+def test_show_singleton_warns_on_extra_peers():
+    ms = MockState()
+    ms.singleton = True
+    ms.sync = None
+    ms.asyncs = [make_peer("async000", "10.0.0.3")]
+    cp = run_adm(["verify"], ms.to_json())
+    assert cp.returncode == 1
+    assert "found 2 peers in singleton mode" in cp.stdout
+
+
+def test_peers_columns_and_role_filter():
+    st = MockState().wire_healthy().to_json()
+    cp = run_adm(["peers", "-o", "role,ip", "-r", "sync"], st)
+    assert cp.returncode == 0
+    assert cp.stdout == ("ROLE     IP\n"
+                         "sync     10.0.0.2\n")
+    # aliases work (zonename -> peername)
+    cp = run_adm(["peers", "-o", "zonename", "-H"], st)
+    assert cp.returncode == 0
+    assert cp.stdout.splitlines()[0] == "primary0"
+
+
+# ---- usage contract (tst.manateeAdmUsage.js analogue) ----
+
+def test_usage_unknown_command():
+    cp = run_adm(["frobnicate"])
+    assert cp.returncode == 2
+
+
+def test_usage_missing_required_options():
+    cp = run_adm(["freeze"], MockState().wire_healthy().to_json())
+    assert cp.returncode == 2
+    assert "reason" in cp.stderr
+
+    cp = run_adm(["promote"], MockState().wire_healthy().to_json())
+    assert cp.returncode == 2
+
+
+def test_usage_missing_coord():
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    for k in ("COORD_ADDR", "ZK_IPS", "MANATEE_ADM_TEST_STATE", "SHARD"):
+        env.pop(k, None)
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", "zk-state", "-s", "1"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert cp.returncode == 2
+    assert "coordination address required" in cp.stderr
+
+
+def test_version():
+    cp = run_adm(["version"])
+    assert cp.returncode == 0
+    assert cp.stdout.strip().count(".") == 2

@@ -1,0 +1,115 @@
+"""Live manatee-adm tests against a real cluster: operator operations
+(freeze/unfreeze/promote/reap/history/zk-state) through the actual CLI
+binary, with the cluster reacting underneath."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.harness import ClusterHarness
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def adm(cluster, *args, check=True):
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
+               SHARD="1")
+    env.pop("MANATEE_ADM_TEST_STATE", None)
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli"] + list(args),
+        capture_output=True, text=True, env=env, timeout=90)
+    if check and cp.returncode != 0:
+        raise AssertionError("adm %r failed rc=%d: %s %s"
+                             % (args, cp.returncode, cp.stdout,
+                                cp.stderr))
+    return cp
+
+
+def test_adm_live_operations(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+
+            def pred(st):
+                return (st.get("sync") is not None
+                        and len(st.get("async") or []) == 1)
+            await cluster.wait_for(pred, 45, "3-peer convergence")
+            primary = cluster.peer_by_id(
+                (await cluster.cluster_state())["primary"]["id"])
+            await cluster.wait_writable(primary, "pre-adm")
+
+            # pg-status against the live cluster
+            cp = adm(cluster, "pg-status")
+            assert "primary" in cp.stdout and "sync" in cp.stdout
+            assert "ok" in cp.stdout
+
+            # verify: exits 0 once the whole chain is established (the
+            # async may still be completing its restore right after the
+            # first write succeeds)
+            for _ in range(60):
+                cp = adm(cluster, "verify", "-v", check=False)
+                if cp.returncode == 0:
+                    break
+                await asyncio.sleep(1)
+            assert cp.returncode == 0, cp.stdout
+            assert "all checks passed" in cp.stdout
+
+            # zk-state dumps the real state
+            cp = adm(cluster, "zk-state")
+            st = json.loads(cp.stdout)
+            assert st["generation"] == 0
+
+            # freeze blocks failover
+            adm(cluster, "freeze", "-r", "maintenance test")
+            cp = adm(cluster, "show")
+            assert "freeze info: maintenance test" in cp.stdout
+            st = await cluster.cluster_state()
+            sync_peer = cluster.peer_by_id(st["sync"]["id"])
+            primary.kill()
+            await asyncio.sleep(cluster.session_timeout + 2.0)
+            st = await cluster.cluster_state()
+            assert st["primary"]["id"] == primary.ident  # frozen!
+
+            # unfreeze: takeover proceeds
+            adm(cluster, "unfreeze")
+            st = await cluster.wait_topology(primary=sync_peer)
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+            await cluster.wait_writable(sync_peer, "post-unfreeze")
+
+            # history shows the full story with annotations
+            cp = adm(cluster, "history")
+            assert "cluster setup for normal (multi-peer) mode" \
+                in cp.stdout
+            assert "cluster frozen: maintenance test" in cp.stdout
+            assert "cluster unfrozen" in cp.stdout
+            assert "took over as primary" in cp.stdout
+
+            # reap the dead deposed peer
+            adm(cluster, "reap")
+            st = await cluster.cluster_state()
+            assert st["deposed"] == []
+
+            # the old primary returns with DIVERGED data; it must be
+            # adopted as an async and rebuild itself from its upstream
+            primary.start()
+            st = await cluster.wait_for(
+                lambda s: [a["id"] for a in s.get("async") or []]
+                == [primary.ident], 45, "old primary readopted")
+
+            # promote the (only) async to sync through the CLI
+            st = await cluster.cluster_state()
+            azone = st["async"][0]["zoneId"]
+            cp = adm(cluster, "promote", "-r", "async", "-n", azone,
+                     "-y")
+            assert "Promotion complete." in cp.stdout
+            st = await cluster.cluster_state()
+            assert st["sync"]["zoneId"] == azone
+            await cluster.wait_writable(sync_peer, "post-promote")
+        finally:
+            await cluster.stop()
+    asyncio.run(go())

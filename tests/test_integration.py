@@ -19,7 +19,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def converged(cluster, n=3, timeout=45):
+async def converged(cluster, n=3, timeout=60):
     """Wait until the cluster has a primary, a sync, and n-2 asyncs, all
     writable; return (primary, sync, [asyncs]) as Peer objects."""
     def pred(st):
@@ -162,7 +162,8 @@ def test_everyone_dies(tmp_path):
             for p in cluster.peers:
                 p.start()
             # the durable state resumes: same primary and sync, same gen
-            st = await cluster.wait_topology(primary=primary, sync=sync)
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             timeout=60)
             assert st["generation"] == before["generation"]
             await cluster.wait_writable(primary, "after-resurrection")
             res = await sync.pg_query({"op": "select"})
