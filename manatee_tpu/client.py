@@ -1,0 +1,150 @@
+"""Manatee client library — topology watcher for database clients.
+
+Reference parity: the out-of-tree `node-manatee` package
+(package.json:51; usage README.md:62-89): clients watch the shard's
+cluster state and receive a ``topology`` event with the ORDERED list of
+PostgreSQL URLs (primary first, then sync, then asyncs) whenever it
+changes, plus a ``ready`` event after the first successful read.
+Applications connect to urls[0] for writes and may read from the rest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable
+
+from manatee_tpu.coord.api import CoordError, NoNodeError
+from manatee_tpu.coord.client import NetCoord
+
+log = logging.getLogger("manatee.client")
+
+
+def topology_urls(state: dict) -> list[str]:
+    """Ordered pg URLs from a cluster state (primary, sync, asyncs)."""
+    urls = [state["primary"]["pgUrl"]]
+    if state.get("sync"):
+        urls.append(state["sync"]["pgUrl"])
+    urls.extend(a["pgUrl"] for a in state.get("async") or [])
+    return urls
+
+
+class ManateeClient:
+    """Watches one shard and emits topology changes.
+
+    Events:
+      'ready'    (urls)  first successful topology read
+      'topology' (urls)  every subsequent change
+      'error'    (exc)   unrecoverable coordination failures
+    """
+
+    def __init__(self, *, coord_addr: str, shard: str,
+                 base_path: str = "/manatee",
+                 session_timeout: float = 30.0):
+        host, _, port = coord_addr.partition(":")
+        self._host = host
+        self._port = int(port or 2281)
+        self._path = "%s/%s/state" % (base_path.rstrip("/"), shard)
+        self._session_timeout = session_timeout
+        self._client: NetCoord | None = None
+        self._listeners: dict[str, list[Callable]] = {}
+        self._topology: list[str] | None = None
+        self._ready = False
+        self._closed = False
+        self._task: asyncio.Task | None = None
+
+    # -- events --
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def _emit(self, event: str, payload) -> None:
+        for cb in self._listeners.get(event, []):
+            try:
+                cb(payload)
+            except Exception:
+                log.exception("client listener for %s failed", event)
+
+    @property
+    def topology(self) -> list[str] | None:
+        return self._topology
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task:
+            self._task.cancel()
+        if self._client:
+            await self._client.close()
+
+    async def _run(self) -> None:
+        while not self._closed:
+            client = None
+            try:
+                client = NetCoord(self._host, self._port,
+                                  session_timeout=self._session_timeout)
+                await client.connect()
+                self._client = client
+                expired = asyncio.Event()
+                client.on_session_event(
+                    lambda ev: expired.set() if ev == "expired" else None)
+                await self._watch_loop(client, expired)
+            except asyncio.CancelledError:
+                return
+            except (CoordError, OSError) as e:
+                log.warning("client coordination error: %s; retrying", e)
+                self._emit("error", e)
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except (CoordError, OSError):
+                        pass
+            await asyncio.sleep(1.0)
+
+    async def _watch_loop(self, client: NetCoord,
+                          expired: asyncio.Event) -> None:
+        while not self._closed and not expired.is_set():
+            changed = asyncio.Event()
+            try:
+                data, _v = await client.get(self._path,
+                                            watch=lambda e: changed.set())
+            except NoNodeError:
+                stat = await client.exists(self._path,
+                                           watch=lambda e: changed.set())
+                if stat is None:
+                    await self._wait_either(changed, expired)
+                    continue
+                data, _v = await client.get(self._path)
+            try:
+                state = json.loads(data.decode())
+                urls = topology_urls(state)
+            except (ValueError, KeyError, TypeError):
+                # malformed or partial state (e.g. "primary": null from
+                # hand-edited tooling): skip, keep watching
+                await self._wait_either(changed, expired)
+                continue
+            if urls != self._topology:
+                self._topology = urls
+                if not self._ready:
+                    self._ready = True
+                    self._emit("ready", urls)
+                else:
+                    self._emit("topology", urls)
+            await self._wait_either(changed, expired)
+
+    @staticmethod
+    async def _wait_either(a: asyncio.Event, b: asyncio.Event) -> None:
+        ta = asyncio.ensure_future(a.wait())
+        tb = asyncio.ensure_future(b.wait())
+        try:
+            await asyncio.wait([ta, tb],
+                               return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            ta.cancel()
+            tb.cancel()

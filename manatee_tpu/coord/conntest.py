@@ -1,0 +1,49 @@
+"""Coordination-service connectivity smoke test.
+
+Reference parity: bin/zkConnTest.js — standalone check that a
+coordination address is reachable and serving (create/read/delete a
+scratch node), for use from provisioning scripts.
+
+Usage: python -m manatee_tpu.coord.conntest HOST:PORT
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+
+from manatee_tpu.coord.api import NodeExistsError
+from manatee_tpu.coord.client import NetCoord
+
+
+async def conntest(addr: str, timeout: float = 10.0) -> None:
+    host, _, port = addr.partition(":")
+    client = NetCoord(host, int(port or 2281), session_timeout=10)
+    await asyncio.wait_for(client.connect(), timeout)
+    path = "/conntest-%d" % int(time.time() * 1000)
+    try:
+        await client.create(path, b"ping", ephemeral=True)
+    except NodeExistsError:
+        pass
+    data, _ = await client.get(path)
+    assert data == b"ping"
+    await client.delete(path)
+    await client.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1:
+        print("usage: conntest HOST:PORT", file=sys.stderr)
+        sys.exit(2)
+    try:
+        asyncio.run(conntest(args[0]))
+    except Exception as e:
+        print("FAIL: %s" % e, file=sys.stderr)
+        sys.exit(1)
+    print("OK: %s is serving" % args[0])
+
+
+if __name__ == "__main__":
+    main()

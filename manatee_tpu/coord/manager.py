@@ -162,6 +162,7 @@ class ConsensusMgr:
         self._generation_of_setup += 1
         gen = self._generation_of_setup
         while not self._closed:
+            client = None
             try:
                 client = await self._factory()
                 self._client = client
@@ -175,7 +176,16 @@ class ConsensusMgr:
                 client.on_session_event(on_session)
                 await self._setup_data(client)
                 return
-            except CoordError as e:
+            except (CoordError, OSError) as e:
+                # OSError: transient TCP failures (refused, reset, SYN
+                # drops under load) must retry, not kill the daemon.
+                # Close the half-built client or its still-live session
+                # leaves a ghost ephemeral in the election.
+                if client is not None:
+                    try:
+                        await client.close()
+                    except (CoordError, OSError):
+                        pass
                 log.warning("coord setup failed (%s); retrying in %.1fs",
                             e, RETRY_DELAY)
                 await asyncio.sleep(RETRY_DELAY)

@@ -35,13 +35,32 @@ def free_port() -> int:
     return port
 
 
+def free_port_pair() -> int:
+    """A port P with P+1 also free — the sitter binds its status server
+    on postgresPort+1 (statusServer parity)."""
+    for _ in range(100):
+        s1 = socket.socket()
+        s1.bind(("127.0.0.1", 0))
+        p = s1.getsockname()[1]
+        s2 = socket.socket()
+        try:
+            s2.bind(("127.0.0.1", p + 1))
+        except OSError:
+            continue
+        finally:
+            s1.close()
+            s2.close()
+        return p
+    raise RuntimeError("no consecutive free port pair found")
+
+
 class Peer:
     def __init__(self, cluster: "ClusterHarness", idx: int):
         self.cluster = cluster
         self.idx = idx
         self.name = "peer%d" % idx
         self.root = cluster.root / self.name
-        self.pg_port = free_port()
+        self.pg_port = free_port_pair()
         self.status_port = self.pg_port + 1
         self.backup_port = free_port()
         self.zfs_port = free_port()

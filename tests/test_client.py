@@ -1,0 +1,79 @@
+"""Client-library tests: topology watching against a real coordd
+(node-manatee parity, README.md:62-89)."""
+
+import asyncio
+import json
+
+from manatee_tpu.client import ManateeClient, topology_urls
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.server import CoordServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_state(primary, sync=None, asyncs=(), gen=0):
+    def info(n):
+        return {"id": "%s:5432:1" % n, "zoneId": n, "ip": n,
+                "pgUrl": "sim://%s:5432" % n,
+                "backupUrl": "http://%s:1" % n}
+    return {
+        "generation": gen, "initWal": "0/0000000",
+        "primary": info(primary),
+        "sync": info(sync) if sync else None,
+        "async": [info(a) for a in asyncs],
+        "deposed": [],
+    }
+
+
+def test_topology_urls_ordering():
+    st = make_state("a", "b", ["c", "d"])
+    assert topology_urls(st) == [
+        "sim://a:5432", "sim://b:5432", "sim://c:5432", "sim://d:5432"]
+
+
+def test_client_ready_and_topology_events():
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            w = NetCoord("127.0.0.1", server.port, session_timeout=10)
+            await w.connect()
+            await w.mkdirp("/manatee/1")
+
+            events = []
+            client = ManateeClient(
+                coord_addr="127.0.0.1:%d" % server.port, shard="1")
+            client.on("ready", lambda u: events.append(("ready", u)))
+            client.on("topology", lambda u: events.append(("topology", u)))
+            await client.start()
+            await asyncio.sleep(0.3)
+            assert events == []   # no state yet
+
+            # state appears -> ready
+            await w.create("/manatee/1/state", json.dumps(
+                make_state("a", "b", ["c"])).encode())
+            for _ in range(50):
+                if events:
+                    break
+                await asyncio.sleep(0.05)
+            assert events[0][0] == "ready"
+            assert events[0][1][0] == "sim://a:5432"
+
+            # failover -> topology event with the new ordering
+            await w.set("/manatee/1/state", json.dumps(
+                make_state("b", "c", [], gen=1)).encode())
+            for _ in range(50):
+                if len(events) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert events[1][0] == "topology"
+            assert events[1][1] == ["sim://b:5432", "sim://c:5432"]
+            assert client.topology == ["sim://b:5432", "sim://c:5432"]
+
+            await client.close()
+            await w.close()
+        finally:
+            await server.stop()
+    run(go())

@@ -1,0 +1,109 @@
+"""Kill-storm and pairwise-death scenarios.
+
+Reference parity: test/integ.test.js — pairwise instantaneous deaths
+(:1285, :1505, :1720), sequenced deaths (:1925, :2208), and the
+MANATEE_207_* no-wait kill storms (:3158-3671).  Convergence budget 30s
+per transition (relaxed for full-suite load)."""
+
+import asyncio
+
+from tests.harness import ClusterHarness
+from tests.test_integration import converged
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_primary_and_sync_die_together(tmp_path):
+    """Pairwise instantaneous death (integ.test.js:1285): only the async
+    survives; it cannot take over (it is not the sync), so the cluster
+    holds until a peer returns; then the SYNC's return enables takeover.
+    We restart both dead peers and require reconvergence."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            primary.kill()
+            sync.kill()
+            # the async must NOT take over
+            await asyncio.sleep(cluster.session_timeout + 2.0)
+            st = await cluster.cluster_state()
+            assert st["primary"]["id"] == primary.ident
+            assert st["generation"] == gen0
+
+            # both return; the sync resumes its role, then (with its
+            # intact data) the cluster simply resumes
+            primary.start()
+            sync.start()
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             timeout=60)
+            assert st["generation"] == gen0
+            await cluster.wait_writable(primary, "after-double-death",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_sync_and_async_die_together(tmp_path):
+    """Pairwise death (integ.test.js:1505): primary survives alone and
+    the cluster holds (it cannot appoint a sync with nobody alive).
+    When the dead peers return with intact data, the original topology
+    resumes — no generation churn — and writes work again."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            sync.kill()
+            asyncs[0].kill()
+            await asyncio.sleep(cluster.session_timeout + 2.0)
+            st = await cluster.cluster_state()
+            assert st["primary"]["id"] == primary.ident  # no takeover
+
+            sync.start()
+            asyncs[0].start()
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             timeout=60)
+            assert st["generation"] == gen0
+            await cluster.wait_writable(primary, "after-pair-death",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_sequenced_kill_storm(tmp_path):
+    """MANATEE_207-style storm (integ.test.js:3158-3671): kill each
+    peer in sequence with no waiting between kills, restart them all,
+    and require convergence to a writable cluster."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            # storm: no waits between kills
+            for p in (asyncs[0], primary, sync):
+                p.kill()
+            for p in (primary, sync, asyncs[0]):
+                p.start()
+
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None, 60,
+                "post-storm topology")
+            new_primary = cluster.peer_by_id(st["primary"]["id"])
+            await cluster.wait_writable(new_primary, "after-storm",
+                                        timeout=60)
+            # no data loss of synchronously-committed writes
+            res = await new_primary.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
