@@ -1,0 +1,37 @@
+# Build/lint/test harness (reference: Makefile + tools/catest;
+# `make test` runs the whole suite, `make lint` style checks,
+# `make devcluster` generates a local 3-peer config tree).
+
+PYTHON ?= python3
+
+.PHONY: all test test-unit test-integ lint bench devcluster native clean
+
+all: lint test
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+test-unit:
+	$(PYTHON) -m pytest tests/ -x -q --ignore=tests/test_integration.py \
+	    --ignore=tests/test_killstorms.py --ignore=tests/test_adm_live.py
+
+test-integ:
+	$(PYTHON) -m pytest tests/test_integration.py tests/test_killstorms.py \
+	    tests/test_adm_live.py -x -q
+
+lint:
+	$(PYTHON) -m compileall -q manatee_tpu tools/mkdevcluster bench.py \
+	    __graft_entry__.py
+
+bench:
+	$(PYTHON) bench.py
+
+devcluster:
+	$(PYTHON) tools/mkdevcluster -n 3
+
+native:
+	$(MAKE) -C native
+
+clean:
+	rm -rf devconfs .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

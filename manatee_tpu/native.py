@@ -1,0 +1,87 @@
+"""ctypes loader for the native stream pump (native/streampump.cpp).
+
+The pump splices pipe->socket bytes in the kernel — the primitive for a
+native bulk-transfer path (SURVEY.md §7 names the snapshot streamer as
+the one native-code candidate).  It is NOT wired into the data plane
+yet: measured over loopback with a Python-side receiver the kernel path
+does not win (the receiver dominates at ~1 GB/s), and doing raw-fd I/O
+under an asyncio-owned socket safely requires detaching the transport.
+The primitive is built, tested (tests/test_native.py), and ready for a
+sender+receiver-native path when real-network numbers justify it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Callable
+
+_LIB_NAME = "libstreampump.so"
+_lib: ctypes.CDLL | None = None
+_load_tried = False
+
+_PROGRESS_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_longlong)
+
+
+def _find_lib() -> str | None:
+    env = os.environ.get("MANATEE_NATIVE_LIB")
+    if env:
+        return env if os.path.exists(env) else None
+    cand = Path(__file__).resolve().parent.parent / "native" / _LIB_NAME
+    return str(cand) if cand.exists() else None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def enabled() -> bool:
+    """available AND explicitly opted in via MANATEE_NATIVE=1."""
+    return bool(os.environ.get("MANATEE_NATIVE")) and available()
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_tried
+    if _load_tried:
+        return _lib
+    _load_tried = True
+    if os.environ.get("MANATEE_NO_NATIVE"):
+        return None
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.mnt_pump.restype = ctypes.c_longlong
+        lib.mnt_pump.argtypes = [ctypes.c_int, ctypes.c_int,
+                                 _PROGRESS_CB]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def pump(fd_in: int, fd_out: int,
+         progress: Callable[[int], bool] | None = None) -> int:
+    """Blocking pump fd_in -> fd_out until EOF.  Run it in a thread.
+    *progress(total)* returning True aborts.  Returns bytes pumped;
+    raises OSError on pump failure."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native pump not available")
+
+    if progress is not None:
+        def cb(total: int) -> int:
+            try:
+                return 1 if progress(total) else 0
+            except Exception:
+                return 1
+        c_cb = _PROGRESS_CB(cb)
+    else:
+        c_cb = _PROGRESS_CB(0)
+
+    res = lib.mnt_pump(fd_in, fd_out, c_cb)
+    if res < 0:
+        raise OSError(-res, os.strerror(-res))
+    return int(res)

@@ -300,8 +300,8 @@ class DirBackend(StorageBackend):
         except Exception as e:
             # receiver went away mid-stream: kill tar first, or reading its
             # stderr to EOF below would block on the full stdout pipe
-            proc.kill()
-            await proc.wait()
+            from manatee_tpu.utils.executil import reap_killed
+            await reap_killed(proc)
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
         err = await proc.stderr.read()
@@ -364,8 +364,8 @@ class DirBackend(StorageBackend):
             if progress_cb:
                 progress_cb(done, size)
         if stream_error is not None:
-            proc.kill()
-            await proc.wait()
+            from manatee_tpu.utils.executil import reap_killed
+            await reap_killed(proc)
             await self.destroy(dataset, recursive=True)
             raise StorageError("recv into %s aborted: %s"
                                % (dataset, stream_error)) from stream_error

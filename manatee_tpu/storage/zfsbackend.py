@@ -184,8 +184,8 @@ class ZfsBackend(StorageBackend):
             for t in (t_err, t_out):
                 t.cancel()
             await asyncio.gather(t_err, t_out, return_exceptions=True)
-            proc.kill()
-            await proc.wait()
+            from manatee_tpu.utils.executil import reap_killed
+            await reap_killed(proc)
             raise StorageError("zfs send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
         rc = await proc.wait()
@@ -224,8 +224,8 @@ class ZfsBackend(StorageBackend):
             if progress_cb:
                 progress_cb(done, None)
         if stream_error is not None:
-            proc.kill()
-            await proc.wait()
+            from manatee_tpu.utils.executil import reap_killed
+            await reap_killed(proc)
             raise StorageError("zfs recv into %s aborted: %s"
                                % (dataset, stream_error)) from stream_error
         try:

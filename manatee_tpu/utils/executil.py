@@ -93,6 +93,32 @@ async def _pump_stdin(proc: asyncio.subprocess.Process,
     proc.stdin.close()
 
 
+async def reap_killed(proc: asyncio.subprocess.Process) -> None:
+    """Kill *proc* and wait without deadlocking: asyncio's Process.wait()
+    only resolves once every pipe transport disconnects, so abandoned
+    stdout/stderr must be drained and stdin closed first."""
+    with_suppress = (BrokenPipeError, ConnectionResetError, OSError,
+                     RuntimeError)
+    try:
+        proc.kill()
+    except ProcessLookupError:
+        pass
+    if proc.stdin is not None:
+        try:
+            proc.stdin.close()
+        except with_suppress:
+            pass
+    for stream in (proc.stdout, proc.stderr):
+        if stream is None:
+            continue
+        try:
+            while await stream.read(65536):
+                pass
+        except with_suppress:
+            pass
+    await proc.wait()
+
+
 async def run(
     argv: list[str],
     *,

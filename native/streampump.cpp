@@ -1,0 +1,90 @@
+// streampump — zero-copy bulk stream pump for the backup data path.
+//
+// The reference's bulk transfer is `zfs send | socket` piped by the
+// kernel (lib/backupSender.js:172-180).  Our directory backend's sender
+// pumps tar's stdout into the peer socket; doing that byte-shoveling in
+// Python costs two userspace copies per chunk plus event-loop wakeups.
+// This pump uses splice(2) (pipe -> socket stays in the kernel) with a
+// read/write fallback, and reports progress through a callback that can
+// also abort the transfer.
+//
+// Build: make -C native   (produces libstreampump.so)
+// ABI (ctypes, see manatee_tpu/native.py):
+//   long long mnt_pump(int fd_in, int fd_out,
+//                      int (*progress)(long long total));
+//     returns total bytes pumped (>= 0), or -errno on failure;
+//     a nonzero return from the progress callback aborts with -ECANCELED.
+
+#include <cerrno>
+#include <cstdint>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#ifdef __linux__
+#include <sys/sendfile.h>
+#endif
+
+extern "C" {
+
+typedef int (*mnt_progress_cb)(long long total);
+
+static long long pump_rw(int fd_in, int fd_out, long long total,
+                         mnt_progress_cb progress) {
+    char buf[1 << 20];
+    for (;;) {
+        ssize_t n = read(fd_in, buf, sizeof(buf));
+        if (n == 0)
+            return total;
+        if (n < 0) {
+            if (errno == EINTR)
+                continue;
+            return -(long long)errno;
+        }
+        ssize_t off = 0;
+        while (off < n) {
+            ssize_t w = write(fd_out, buf + off, (size_t)(n - off));
+            if (w < 0) {
+                if (errno == EINTR)
+                    continue;
+                return -(long long)errno;
+            }
+            off += w;
+        }
+        total += n;
+        if (progress && progress(total))
+            return -(long long)ECANCELED;
+    }
+}
+
+long long mnt_pump(int fd_in, int fd_out, mnt_progress_cb progress) {
+    long long total = 0;
+
+#ifdef __linux__
+    // splice works when at least one side is a pipe; our sender feeds a
+    // pipe (tar stdout) into a socket.
+    struct stat st;
+    bool in_is_pipe = (fstat(fd_in, &st) == 0 && S_ISFIFO(st.st_mode));
+    if (in_is_pipe) {
+        for (;;) {
+            ssize_t n = splice(fd_in, nullptr, fd_out, nullptr, 1 << 20,
+                               SPLICE_F_MOVE | SPLICE_F_MORE);
+            if (n == 0)
+                return total;
+            if (n < 0) {
+                if (errno == EINTR)
+                    continue;
+                if (errno == EINVAL || errno == ENOSYS)
+                    break;  // fall back to read/write
+                return -(long long)errno;
+            }
+            total += n;
+            if (progress && progress(total))
+                return -(long long)ECANCELED;
+        }
+    }
+#endif
+    return pump_rw(fd_in, fd_out, total, progress);
+}
+
+}  // extern "C"

@@ -27,31 +27,29 @@ from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
 from manatee_tpu.storage import DirBackend              # noqa: E402
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def free_port_pair() -> int:
-    """A port P with P+1 also free — the sitter binds its status server
-    on postgresPort+1 (statusServer parity)."""
-    for _ in range(100):
-        s1 = socket.socket()
-        s1.bind(("127.0.0.1", 0))
-        p = s1.getsockname()[1]
-        s2 = socket.socket()
+def alloc_port_block(n: int) -> int:
+    """A contiguous block of *n* free ports BELOW the kernel's ephemeral
+    range (so in-flight connections cannot steal them between allocation
+    and daemon bind — the TOCTOU that made per-port allocation flaky).
+    Verified by binding the whole block at once."""
+    import random
+    for _ in range(300):
+        base = random.randrange(10000, 28000 - n)
+        socks = []
         try:
-            s2.bind(("127.0.0.1", p + 1))
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
         except OSError:
             continue
         finally:
-            s1.close()
-            s2.close()
-        return p
-    raise RuntimeError("no consecutive free port pair found")
+            for s in socks:
+                s.close()
+        if len(socks) == n:
+            return base
+    raise RuntimeError("no free port block of %d found" % n)
 
 
 class Peer:
@@ -60,10 +58,13 @@ class Peer:
         self.idx = idx
         self.name = "peer%d" % idx
         self.root = cluster.root / self.name
-        self.pg_port = free_port_pair()
-        self.status_port = self.pg_port + 1
-        self.backup_port = free_port()
-        self.zfs_port = free_port()
+        # 4 ports per peer from the cluster's reserved block:
+        # pg, status (= pg+1), backup, zfs
+        base = cluster.port_base + 1 + 4 * (idx - 1)
+        self.pg_port = base
+        self.status_port = base + 1
+        self.backup_port = base + 2
+        self.zfs_port = base + 3
         self.ip = "127.0.0.1"
         self.ident = "%s:%d:%d" % (self.ip, self.pg_port, self.backup_port)
         self.sitter_proc: subprocess.Popen | None = None
@@ -177,7 +178,9 @@ class ClusterHarness:
         self.shard_path = "/manatee/%s" % shard
         self.session_timeout = session_timeout
         self.singleton = singleton
-        self.coord_port = free_port()
+        # one block for everything: coord + 4 ports per peer
+        self.port_base = alloc_port_block(1 + 4 * n_peers)
+        self.coord_port = self.port_base
         self.coord_proc: subprocess.Popen | None = None
         self.peers = [Peer(self, i + 1) for i in range(n_peers)]
 

@@ -79,6 +79,34 @@ def test_sync_and_async_die_together(tmp_path):
     run(go())
 
 
+def test_sync_killed_before_replication_established(tmp_path):
+    """MANATEE_212 (integ.test.js:2491, :2737): kill the sync the moment
+    it is appointed, before replication is established; the primary's
+    catch-up wait must not wedge — it appoints a replacement and the
+    cluster becomes writable."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            # kill the sync as soon as the bootstrap names it, without
+            # waiting for catch-up/writability
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None, 60, "bootstrap")
+            sync = cluster.peer_by_id(st["sync"]["id"])
+            sync.kill()
+
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None
+                and s["sync"]["id"] != sync.ident
+                and s["generation"] >= 1,
+                60, "replacement sync")
+            primary = cluster.peer_by_id(st["primary"]["id"])
+            await cluster.wait_writable(primary, "post-212", timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
 def test_sequenced_kill_storm(tmp_path):
     """MANATEE_207-style storm (integ.test.js:3158-3671): kill each
     peer in sequence with no waiting between kills, restart them all,

@@ -1,0 +1,122 @@
+"""Native stream-pump tests: the splice primitive against pipes and a
+real TCP socket, progress reporting, and abort semantics.  (The pump is
+a standalone primitive; see manatee_tpu/native.py for why it is not yet
+wired into the backup data plane.)"""
+
+import os
+import socket
+import subprocess
+import threading
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu import native
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    not (REPO / "native" / "libstreampump.so").exists()
+    and subprocess.call(["make", "-C", str(REPO / "native")]) != 0,
+    reason="native lib not buildable")
+
+
+def test_pump_pipe_to_pipe():
+    r1, w1 = os.pipe()
+    r2, w2 = os.pipe()
+    payload = b"x" * 1_000_000
+
+    def feed():
+        os.write(w1, payload)
+        os.close(w1)
+
+    t = threading.Thread(target=feed)
+    t.start()
+    seen = []
+    out = bytearray()
+
+    def drain():
+        while True:
+            chunk = os.read(r2, 65536)
+            if not chunk:
+                return
+            out.extend(chunk)
+
+    t2 = threading.Thread(target=drain)
+    t2.start()
+    total = native.pump(r1, w2, lambda n: (seen.append(n), False)[1])
+    os.close(w2)
+    t.join()
+    t2.join()
+    os.close(r1)
+    os.close(r2)
+    assert total == len(payload)
+    assert bytes(out) == payload
+    assert seen and seen[-1] == len(payload)
+
+
+def test_pump_pipe_to_socket():
+    """The production shape: splice a pipe into a connected TCP socket."""
+    payload = os.urandom(3_000_000)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    received = bytearray()
+
+    def server():
+        conn, _ = srv.accept()
+        while True:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            received.extend(chunk)
+        conn.close()
+
+    ts = threading.Thread(target=server)
+    ts.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+
+    r_fd, w_fd = os.pipe()
+
+    def feed():
+        view = memoryview(payload)
+        while view:
+            n = os.write(w_fd, view[:65536])
+            view = view[n:]
+        os.close(w_fd)
+
+    tf = threading.Thread(target=feed)
+    tf.start()
+    total = native.pump(r_fd, cli.fileno())
+    cli.close()
+    tf.join()
+    ts.join()
+    os.close(r_fd)
+    srv.close()
+    assert total == len(payload)
+    assert bytes(received) == payload
+
+
+def test_pump_abort_via_progress():
+    r1, w1 = os.pipe()
+    r2, w2 = os.pipe()
+    os.write(w1, b"y" * 32_000)   # fits in the pipe buffer
+    with pytest.raises(OSError):
+        native.pump(r1, w2, lambda n: True)   # abort immediately
+    for fd in (r1, w1, r2, w2):
+        os.close(fd)
+
+
+def test_available_and_disable_env():
+    assert native.available()
+    os.environ["MANATEE_NO_NATIVE"] = "1"
+    native._load_tried = False
+    native._lib = None
+    try:
+        assert not native.available()
+    finally:
+        os.environ.pop("MANATEE_NO_NATIVE")
+        native._load_tried = False
+        native._lib = None
