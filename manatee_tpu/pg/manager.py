@@ -53,6 +53,10 @@ DEFAULTS = {
     "healthChkInterval": 1.0,
     "healthChkTimeout": 5.0,
     "replicationTimeout": 60.0,
+    # catch-up poll cadence; the reference hardwires 1 s
+    # (lib/postgresMgr.js:2429) — configurable here so failover time is
+    # not floored by the poll
+    "replPollInterval": 1.0,
     "singleton": False,
 }
 
@@ -271,7 +275,7 @@ class PostgresMgr:
                         float(self.cfg["replicationTimeout"])
             except PgError as e:
                 log.debug("catchup poll error: %s", e)
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(float(self.cfg["replPollInterval"]))
 
     # -- standby --
 

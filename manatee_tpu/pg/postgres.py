@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
+import re
 from pathlib import Path
 
 from manatee_tpu.pg.engine import Engine, PgError, PgQueryTimeout, parse_pg_url
@@ -41,6 +43,53 @@ DEFAULT_TEMPLATE = {
     "max_wal_senders": "10",
     "wal_keep_segments": "100",
 }
+
+
+_SCOPE_RE = re.compile(r"^(common|\d+(\.\d+)*)$")
+
+
+def merge_overrides(overrides: dict | None, version: str) -> dict:
+    """pg_overrides.json semantics (lib/postgresMgr.js:118-137, 527-560):
+    tunables are merged by scope, least to most specific —
+    ``common`` -> major (e.g. "9.6") -> full version (e.g. "9.6.3").
+    A dict with NO scope-shaped keys at all is treated as common; a
+    scoped dict contributes nothing for versions it does not mention."""
+    if not overrides:
+        return {}
+    if not any(_SCOPE_RE.match(str(k)) for k in overrides):
+        return dict(overrides)   # genuinely flat: all of it is 'common'
+    out: dict = {}
+    for scope in ("common", pg_strip_minor(version), version):
+        out.update(overrides.get(scope) or {})
+    return out
+
+
+def resolve_versioned_paths(base_dir: str, version: str) -> dict:
+    """Multi-version layout (resolveVersionedPaths,
+    lib/postgresMgr.js:569-634): binaries and data live in per-version
+    directories with a ``current`` symlink naming the active one:
+
+        <base>/<version>/bin/...     e.g. /opt/postgresql/12.0/bin
+        <base>/current -> <version>
+
+    Returns {"bin": ..., "version_dir": ..., "current": ...}."""
+    base = Path(base_dir)
+    vdir = base / version
+    return {
+        "bin": str(vdir / "bin"),
+        "version_dir": str(vdir),
+        "current": str(base / "current"),
+    }
+
+
+def set_current_version(base_dir: str, version: str) -> None:
+    """Repoint <base>/current at <version> atomically."""
+    base = Path(base_dir)
+    tmp = base / (".current-%d" % os.getpid())
+    if tmp.is_symlink() or tmp.exists():
+        tmp.unlink()
+    os.symlink(version, tmp)
+    os.replace(tmp, base / "current")
 
 
 def wal_function_names(major: str) -> dict:
@@ -80,9 +129,10 @@ class PostgresEngine(Engine):
         self.pg_user = pg_user
         self.use_sudo = use_sudo
         self.template = dict(template or DEFAULT_TEMPLATE)
-        # pg_overrides.json-style tunables merged over the template
+        # pg_overrides.json-style tunables merged over the template by
+        # scope: common -> major -> full version
         # (lib/postgresMgr.js:118-137, 527-560)
-        self.template.update(overrides or {})
+        self.template.update(merge_overrides(overrides, version))
 
     def _cmd(self, name: str) -> str:
         return str(self.bin / name) if self.bin else name
@@ -163,7 +213,6 @@ class PostgresEngine(Engine):
         argv = [self._cmd("psql"), "-h", host, "-p", str(port),
                 "-U", self.pg_user, "-d", "postgres",
                 "-At", "-F", "\x1f", "-c", sql]
-        import os
         env = dict(os.environ)
         env["PGCONNECT_TIMEOUT"] = str(int(timeout))
         try:

@@ -104,6 +104,7 @@ class Peer:
             "healthChkInterval": 0.3,
             "healthChkTimeout": 2,
             "replicationTimeout": 10,
+            "replPollInterval": 0.25,
             "oneNodeWriteMode": self.cluster.singleton,
         })
         (self.root / "sitter.json").write_text(json.dumps(sitter, indent=2))

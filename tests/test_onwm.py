@@ -1,0 +1,73 @@
+"""Singleton (one-node-write mode) lifecycle, end to end with real
+daemons: ONWM bootstrap, writes with no sync, and the documented
+ONWM -> HA transition flow (docs/user-guide.md:367-387)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.harness import ClusterHarness
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def adm(cluster, *args, check=True):
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
+               SHARD="1")
+    env.pop("MANATEE_ADM_TEST_STATE", None)
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli"] + list(args),
+        capture_output=True, text=True, env=env, timeout=90)
+    if check and cp.returncode != 0:
+        raise AssertionError("adm %r failed rc=%d: %s %s"
+                             % (args, cp.returncode, cp.stdout, cp.stderr))
+    return cp
+
+
+def test_onwm_lifecycle_to_ha(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=2, singleton=True)
+        try:
+            # start only the singleton peer
+            await cluster.start(peers=[0])
+            p1, p2 = cluster.peers
+            st = await cluster.wait_for(
+                lambda s: s.get("oneNodeWriteMode") is True, 45,
+                "onwm bootstrap")
+            assert st["primary"]["id"] == p1.ident
+            assert st["sync"] is None
+            assert st.get("freeze")          # auto-frozen
+            # writable immediately, no sync required
+            await cluster.wait_writable(p1, "onwm-write", timeout=45)
+
+            # documented ONWM -> HA flow: stop the sitter, flip the
+            # config, set-onwm off, unfreeze, restart, add a peer
+            p1.kill_sitter_only()
+            cfgpath = p1.root / "sitter.json"
+            cfg = json.loads(cfgpath.read_text())
+            cfg["oneNodeWriteMode"] = False
+            cfgpath.write_text(json.dumps(cfg, indent=2))
+
+            adm(cluster, "set-onwm", "-m", "off")
+            adm(cluster, "unfreeze")
+
+            cluster.singleton = False
+            p1.start()
+            await p2.write_configs()
+            p2.start()
+
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None
+                and not s.get("oneNodeWriteMode"), 60, "ha transition")
+            assert st["sync"]["id"] == p2.ident
+            await cluster.wait_writable(p1, "ha-write", timeout=60)
+            # the ONWM-era write survived
+            res = await p2.pg_query({"op": "select"})
+            assert "onwm-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    asyncio.run(go())

@@ -1,0 +1,130 @@
+"""PostgresEngine unit tests: conf generation by version, scoped
+overrides merge, versioned path resolution (no postgres binaries needed
+— these exercise the pure config logic, mirroring
+test/tst.postgresMgr.js)."""
+
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.pg.postgres import (
+    PostgresEngine,
+    merge_overrides,
+    resolve_versioned_paths,
+    set_current_version,
+    wal_function_names,
+)
+from manatee_tpu.utils import ConfFile
+
+
+def up(url="tcp://postgres@10.0.0.9:5432/postgres"):
+    return {"id": "10.0.0.9:5432:1", "pgUrl": url}
+
+
+def test_wal_function_names_by_major():
+    old = wal_function_names("9.6")
+    assert old["current"] == "pg_current_xlog_location()"
+    assert old["stat_sent"] == "sent_location"
+    new = wal_function_names("12")
+    assert new["current"] == "pg_current_wal_lsn()"
+    assert new["stat_sent"] == "sent_lsn"
+
+
+def test_merge_overrides_scopes():
+    ov = {
+        "common": {"shared_buffers": "'1GB'", "work_mem": "'8MB'"},
+        "9.6": {"work_mem": "'16MB'"},
+        "9.6.3": {"work_mem": "'32MB'", "extra": "on"},
+    }
+    # full version wins over major wins over common
+    assert merge_overrides(ov, "9.6.3") == {
+        "shared_buffers": "'1GB'", "work_mem": "'32MB'", "extra": "on"}
+    assert merge_overrides(ov, "9.6.9") == {
+        "shared_buffers": "'1GB'", "work_mem": "'16MB'"}
+    assert merge_overrides(ov, "12.0") == {"shared_buffers": "'1GB'",
+                                           "work_mem": "'8MB'"}
+    # flat dicts are 'common'
+    assert merge_overrides({"fsync": "off"}, "12.0") == {"fsync": "off"}
+    assert merge_overrides(None, "12.0") == {}
+    # scoped dict mentioning only OTHER versions contributes nothing
+    assert merge_overrides({"9.6": {"work_mem": "'16MB'"}}, "12.0") == {}
+
+
+def test_build_engine_versioned_layout(tmp_path):
+    from manatee_tpu.shard import build_engine
+    (tmp_path / "12.0" / "bin").mkdir(parents=True)
+    eng = build_engine({
+        "pgEngine": "postgres",
+        "pgVersion": "12.0",
+        "pgBaseDir": str(tmp_path),
+    })
+    assert eng.bin == tmp_path / "12.0" / "bin"
+    assert (tmp_path / "current").resolve().name == "12.0"
+
+
+def test_versioned_paths_and_current_symlink(tmp_path):
+    paths = resolve_versioned_paths(str(tmp_path), "12.0")
+    assert paths["bin"] == str(tmp_path / "12.0" / "bin")
+    (tmp_path / "12.0").mkdir()
+    (tmp_path / "9.6.3").mkdir()
+    set_current_version(str(tmp_path), "9.6.3")
+    assert (tmp_path / "current").resolve().name == "9.6.3"
+    set_current_version(str(tmp_path), "12.0")   # atomic repoint
+    assert (tmp_path / "current").resolve().name == "12.0"
+
+
+def test_conf_generation_pg12_primary_and_standby(tmp_path):
+    eng = PostgresEngine(version="12.0",
+                         overrides={"common": {"shared_buffers": "'2GB'"}})
+    d = tmp_path / "data"
+    d.mkdir()
+    # primary with a sync downstream
+    eng.write_config(str(d), host="0.0.0.0", port=5432, peer_id="me",
+                     read_only=True, sync_standby_ids=["peerB"],
+                     upstream=None)
+    conf = ConfFile.read(d / "postgresql.conf")
+    assert conf.get_unquoted("synchronous_standby_names") == '1 ("peerB")'
+    assert conf.get("default_transaction_read_only") == "on"
+    assert conf.get_unquoted("shared_buffers") == "2GB"
+    assert not (d / "recovery.conf").exists()
+    assert not (d / "standby.signal").exists()
+
+    # standby: PG>=12 uses standby.signal + primary_conninfo in the conf
+    eng.write_config(str(d), host="0.0.0.0", port=5432, peer_id="me",
+                     read_only=True, sync_standby_ids=[],
+                     upstream=up())
+    conf = ConfFile.read(d / "postgresql.conf")
+    assert (d / "standby.signal").exists()
+    ci = conf.get_unquoted("primary_conninfo")
+    assert "host=10.0.0.9" in ci and "application_name=me" in ci
+    assert "synchronous_standby_names" not in conf
+
+    # back to primary: recovery config dropped
+    eng.write_config(str(d), host="0.0.0.0", port=5432, peer_id="me",
+                     read_only=False, sync_standby_ids=[], upstream=None)
+    assert not (d / "standby.signal").exists()
+
+
+def test_conf_generation_pg96_recovery_conf(tmp_path):
+    eng = PostgresEngine(version="9.6.3")
+    d = tmp_path / "data"
+    d.mkdir()
+    eng.write_config(str(d), host="0.0.0.0", port=5432, peer_id="me",
+                     read_only=True, sync_standby_ids=[],
+                     upstream=up())
+    # PG<12: recovery.conf with standby_mode
+    rc = ConfFile.read(d / "recovery.conf")
+    assert rc.get_unquoted("standby_mode") == "on"
+    assert "host=10.0.0.9" in rc.get_unquoted("primary_conninfo")
+    assert not (d / "standby.signal").exists()
+
+
+def test_conf_generation_pg13_wal_keep_size(tmp_path):
+    eng = PostgresEngine(version="13.0")
+    d = tmp_path / "data"
+    d.mkdir()
+    eng.write_config(str(d), host="0.0.0.0", port=5432, peer_id="me",
+                     read_only=False, sync_standby_ids=[], upstream=None)
+    conf = ConfFile.read(d / "postgresql.conf")
+    assert "wal_keep_segments" not in conf
+    assert conf.get_unquoted("wal_keep_size") == "1600MB"
