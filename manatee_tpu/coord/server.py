@@ -40,6 +40,7 @@ from manatee_tpu.coord.api import (
     NotEmptyError,
     Op,
 )
+from manatee_tpu.utils.logutil import setup_logging
 
 log = logging.getLogger("manatee.coordd")
 
@@ -271,9 +272,7 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--port", type=int, default=2281)
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    setup_logging("manatee-coordd", args.verbose)
 
     async def run():
         server = CoordServer(args.host, args.port)

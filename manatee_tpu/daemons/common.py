@@ -6,9 +6,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import signal
+import sys
 
 from manatee_tpu.utils.logutil import setup_logging
-from manatee_tpu.utils.validation import load_json_config
+from manatee_tpu.utils.validation import ConfigError, load_json_config
 
 
 def parse_daemon_args(description: str, argv=None) -> argparse.Namespace:
@@ -25,7 +26,11 @@ def daemon_main(name: str, description: str, schema: dict | None,
     *run_coro_factory(cfg)* returns (start_coro, stop_coro_factory)."""
     args = parse_daemon_args(description, argv)
     setup_logging(name, args.verbose)
-    cfg = load_json_config(args.config, schema, name=name)
+    try:
+        cfg = load_json_config(args.config, schema, name=name)
+    except ConfigError as e:
+        sys.stderr.write("%s: %s\n" % (name, e))
+        sys.exit(2)
 
     async def run():
         stop_evt = asyncio.Event()

@@ -54,9 +54,23 @@ class BunyanFormatter(logging.Formatter):
 
 def setup_logging(name: str, verbose: int = 0,
                   stream=None) -> None:
-    """-v stacking: 0 = INFO, 1 = DEBUG (sitter.js:62-66)."""
+    """-v stacking: 0 = INFO, 1 = DEBUG (reference sitter.js:62-66).
+    The LOG_LEVEL env var (reference's daemon env knob,
+    docs/man/manatee-adm.md in /root/reference:502-515) sets the default
+    level, but an explicit -v always wins."""
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(BunyanFormatter(name))
     root = logging.getLogger()
     root.handlers[:] = [handler]
-    root.setLevel(logging.DEBUG if verbose else logging.INFO)
+    env_level = os.environ.get("LOG_LEVEL", "").upper()
+    if verbose:
+        level = logging.DEBUG
+    elif env_level in ("TRACE", "DEBUG"):
+        level = logging.DEBUG
+    elif env_level in ("INFO", "WARN", "WARNING", "ERROR", "FATAL"):
+        level = {"INFO": logging.INFO, "WARN": logging.WARNING,
+                 "WARNING": logging.WARNING, "ERROR": logging.ERROR,
+                 "FATAL": logging.CRITICAL}[env_level]
+    else:
+        level = logging.INFO
+    root.setLevel(level)

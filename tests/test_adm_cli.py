@@ -267,6 +267,26 @@ def test_usage_missing_coord():
     assert "coordination address required" in cp.stderr
 
 
+def test_pg_status_wide_and_repeat():
+    st = MockState().wire_healthy().to_json()
+    # wide: full peer names
+    cp = run_adm(["pg-status", "-w", "-H", "-r", "primary"], st)
+    assert cp.returncode == 0
+    assert cp.stdout.startswith("primary  primary0 ")
+    # repeat mode: PERIOD COUNT prints COUNT tables
+    cp = run_adm(["pg-status", "-H", "0.05", "3"], st)
+    assert cp.returncode == 0
+    assert cp.stdout.count("primary  primary0") == 3
+
+
+def test_status_json_with_canned_state():
+    st = MockState().wire_healthy()
+    cp = run_adm(["pg-status", "-o", "role,pg-online", "-H"],
+                 st.to_json())
+    assert cp.stdout.splitlines() == [
+        "primary  ok", "sync     ok", "async    ok"]
+
+
 def test_version():
     cp = run_adm(["version"])
     assert cp.returncode == 0

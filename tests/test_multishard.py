@@ -1,0 +1,78 @@
+"""Multiple shards on one coordination service: isolation of state,
+election, and adm's shard listing (the reference's /manatee/<shard>
+namespace, lib/adm.js:107-122)."""
+
+import asyncio
+
+from manatee_tpu.adm import AdmClient
+from manatee_tpu.coord import ConsensusMgr, CoordSpace
+from manatee_tpu.coord.server import CoordServer
+from tests.test_state_machine import SimPeer, wait_for
+
+
+def test_two_shards_isolated():
+    async def go():
+        space = CoordSpace()
+        # shard 1 peers
+        a1 = SimPeer(space, "A1")
+        b1 = SimPeer(space, "B1")
+        # shard 2 peers on DIFFERENT paths
+        a2 = SimPeer(space, "A2")
+        b2 = SimPeer(space, "B2")
+        for p in (a2, b2):
+            p.zk._election_path = "/manatee/2/election"
+            p.zk._history_path = "/manatee/2/history"
+            p.zk._state_path = "/manatee/2/state"
+        for p in (a1, b1):
+            p.zk._election_path = "/manatee/1/election"
+            p.zk._history_path = "/manatee/1/history"
+            p.zk._state_path = "/manatee/1/state"
+        for p in (a1, b1, a2, b2):
+            await p.start()
+        await wait_for(lambda: a1.sm._state is not None, 10, "shard1")
+        await wait_for(lambda: a2.sm._state is not None, 10, "shard2")
+
+        st1, st2 = a1.sm._state, a2.sm._state
+        assert st1["primary"]["id"] == a1.ident
+        assert st2["primary"]["id"] == a2.ident
+        assert st1["sync"]["id"] == b1.ident
+        assert st2["sync"]["id"] == b2.ident
+        # killing shard 2's primary must not touch shard 1
+        await a2.kill()
+        await wait_for(
+            lambda: (b2.sm._state or {}).get("generation") == 1, 10,
+            "shard2 takeover")
+        assert a1.sm._state["generation"] == 0
+        for p in (a1, b1, b2):
+            await p.close()
+    asyncio.run(go())
+
+
+def test_adm_lists_shards_over_tcp():
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            from manatee_tpu.coord.client import NetCoord
+            w = NetCoord("127.0.0.1", server.port, session_timeout=10)
+            await w.connect()
+            import json
+            state = {"generation": 0, "initWal": "0/0000000",
+                     "primary": {"id": "x:1:1", "zoneId": "x",
+                                 "pgUrl": "sim://x:1",
+                                 "backupUrl": "http://x:1", "ip": "x"},
+                     "sync": None, "async": [], "deposed": []}
+            for shard in ("1", "2", "moray"):
+                await w.mkdirp("/manatee/%s" % shard)
+                await w.create("/manatee/%s/state" % shard,
+                               json.dumps(state).encode())
+            adm = AdmClient("127.0.0.1:%d" % server.port)
+            await adm.connect()
+            assert await adm.list_shards() == ["1", "2", "moray"]
+            st, _ = await adm.get_state("moray")
+            assert st["generation"] == 0
+            await adm.close()
+            await w.close()
+        finally:
+            await server.stop()
+    asyncio.run(go())
