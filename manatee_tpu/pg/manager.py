@@ -374,9 +374,30 @@ class PostgresMgr:
                 raise PgError("database exited rc=%d during boot" % rc)
             if await self.engine.health(self.host, self.port, 1.0):
                 self._online = True
+                # boot complete: only NOW is an exit "unexpected" —
+                # exits during boot are handled by this loop (and may
+                # legitimately mean "needs restore")
+                asyncio.ensure_future(self._watch_exit(self._proc))
                 return
             await asyncio.sleep(0.2)
         raise PgError("database did not come up within opsTimeout")
+
+    async def _watch_exit(self, proc: asyncio.subprocess.Process) -> None:
+        """Unexpected database death is unrecoverable: the reference logs
+        fatal and emits 'error' so the sitter exits and the supervisor
+        restarts the whole peer (lib/postgresMgr.js:1711-1755,
+        MANTA-997).  Deliberate stops null out self._proc first, so this
+        only fires for deaths we did not cause."""
+        await proc.wait()
+        if self._closed or self._proc is not proc:
+            return
+        self._proc = None
+        self._online = False
+        log.critical("%s: database exited unexpectedly (rc=%s); "
+                     "emitting error (crash-only: the peer should exit)",
+                     self.peer_id, proc.returncode)
+        self._emit("error", "postgres exited unexpectedly (rc=%s)"
+                   % proc.returncode)
 
     async def _stop(self) -> None:
         """SIGINT → SIGQUIT → SIGKILL escalation

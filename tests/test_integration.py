@@ -145,6 +145,45 @@ def test_add_fourth_peer(tmp_path):
     run(go())
 
 
+def test_database_child_death_kills_sitter_and_fails_over(tmp_path):
+    """MANTA-997 parity: the database process dying out from under the
+    sitter is unrecoverable — the sitter exits (crash-only) and the
+    cluster fails over."""
+    async def go():
+        import os
+        import signal as sig
+
+        import aiohttp
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            # find the primary's database pid via its status server
+            async with aiohttp.ClientSession() as http:
+                async with http.get("http://127.0.0.1:%d/ping"
+                                    % primary.status_port) as r:
+                    pid = (await r.json())["pg"]["pid"]
+            os.kill(pid, sig.SIGKILL)
+
+            # the sitter must exit on its own (no SIGKILL from us)...
+            for _ in range(100):
+                if primary.sitter_proc.poll() is not None:
+                    break
+                await asyncio.sleep(0.1)
+            assert primary.sitter_proc.poll() is not None
+
+            # ...and the cluster fails over to the sync
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            assert st["generation"] == gen0 + 1
+            await cluster.wait_writable(sync, "post-db-death",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
 def test_everyone_dies(tmp_path):
     """integ.test.js everyoneDies (:1068): kill all, restart, converge
     with data intact."""

@@ -204,18 +204,25 @@ def test_crash_only_stop_and_health_events(tmp_path):
         events = []
         m.on("unhealthy", lambda p: events.append(("unhealthy", p)))
         m.on("healthy", lambda p: events.append(("healthy", p)))
+        m.on("error", lambda p: events.append(("error", p)))
         try:
             await m.reconfigure({"role": "primary", "upstream": None,
                                  "downstream": None})
             await wait_until(lambda: m.online, what="online")
-            # database dies out from under us -> unhealthy event
+            # database dies out from under us -> fatal 'error' event
+            # (MANTA-997 parity: the sitter exits on this)
             m._proc.kill()
-            await wait_until(lambda: not m.online, what="unhealthy")
-            assert ("unhealthy", "not running") in events or \
-                any(e[0] == "unhealthy" for e in events)
-            # role none: stop is clean even when already dead
+            await wait_until(lambda: not m.online, what="offline")
+            await wait_until(
+                lambda: any(e[0] == "error" for e in events),
+                what="error event")
+            # a DELIBERATE stop must NOT produce an error event
+            errs_before = sum(1 for e in events if e[0] == "error")
             await m.reconfigure({"role": "none"})
             assert not m.running
+            await asyncio.sleep(0.3)
+            assert sum(1 for e in events
+                       if e[0] == "error") == errs_before
         finally:
             await m.close()
     run(go())
