@@ -1,0 +1,99 @@
+"""Interactive driver for PostgresMgr — the manual testing REPL.
+
+Reference parity: test/postgresMgrRepl.js (:62-109) — drive a peer's
+database manager directly against its sitter config, without the state
+machine: status / start (as primary) / standby URL / stop / xlog /
+health / insert / select / quit.
+
+Usage:  python -m manatee_tpu.pg.repl -f sitter.json
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+from manatee_tpu.daemons.common import parse_daemon_args
+from manatee_tpu.pg.engine import PgError
+from manatee_tpu.shard import Shard
+from manatee_tpu.utils.logutil import setup_logging
+from manatee_tpu.utils.validation import load_json_config
+
+HELP = """commands:
+  status                  manager status
+  start                   reconfigure as singleton primary
+  standby URL             reconfigure as sync of the peer at pg URL
+                          (e.g. sim://127.0.0.1:10002)
+  none                    stop the database (role none)
+  xlog                    current WAL position
+  health                  one health probe
+  insert VALUE            write a row (primary only)
+  select                  read all rows
+  quit
+"""
+
+
+async def repl(cfg: dict) -> None:
+    shard = Shard(cfg)   # build managers; do NOT start the state machine
+    pg = shard.pg
+    await pg.start_manager()
+    print("pg manager ready (%s); 'help' for commands" % pg.peer_id)
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line:
+            break
+        parts = line.strip().split(None, 1)
+        if not parts:
+            continue
+        cmd, arg = parts[0], (parts[1] if len(parts) > 1 else "")
+        try:
+            if cmd == "help":
+                print(HELP)
+            elif cmd == "status":
+                print(json.dumps(pg.status(), indent=2))
+            elif cmd == "start":
+                pg.cfg["singleton"] = True
+                await pg.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+                print("primary (singleton), writable")
+            elif cmd == "standby":
+                await pg.reconfigure({
+                    "role": "sync",
+                    "upstream": {"id": arg, "pgUrl": arg,
+                                 "backupUrl": ""},
+                    "downstream": None})
+                print("standby of %s" % arg)
+            elif cmd == "none":
+                await pg.reconfigure({"role": "none"})
+                print("stopped")
+            elif cmd == "xlog":
+                print(await pg.get_xlog_location())
+            elif cmd == "health":
+                ok = await pg.engine.health(pg.host, pg.port, 2.0)
+                print("healthy" if ok else "UNHEALTHY")
+            elif cmd == "insert":
+                print(await pg._local_query(
+                    {"op": "insert", "value": arg}))
+            elif cmd == "select":
+                print(await pg._local_query({"op": "select"}))
+            elif cmd in ("quit", "exit"):
+                break
+            else:
+                print("unknown command %r; 'help' for help" % cmd)
+        except (PgError, Exception) as e:
+            print("error: %s" % e)
+    await pg.close()
+
+
+def main(argv=None) -> None:
+    args = parse_daemon_args("PostgresMgr interactive driver", argv)
+    setup_logging("pg-repl", args.verbose)
+    cfg = load_json_config(args.config, None, name="sitter config")
+    asyncio.run(repl(cfg))
+
+
+if __name__ == "__main__":
+    main()
