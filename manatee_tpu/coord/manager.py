@@ -316,6 +316,18 @@ class ConsensusMgr:
         if self._inited and not should_debounce:
             self._emit("activeChange", self.active)
 
+    async def refresh_cluster_state(self) -> None:
+        """Force a plain re-read of the state node (no new watch).  The
+        self-healing path for a lost watch: callers that observe a CAS
+        conflict call this so a stale cache cannot persist."""
+        if self._client is None:
+            return
+        try:
+            data, version = await self._client.get(self._state_path)
+        except CoordError:
+            return
+        self._handle_cluster_state(data, version)
+
     # ---- putClusterState ----
 
     async def put_cluster_state(self, state: dict, *,

@@ -486,6 +486,15 @@ class PeerStateMachine:
                 state, expected_version=expected_version)
         except (BadVersionError, NodeExistsError):
             log.info("state write lost a race (%s); deferring", why)
+            # refresh the cached state explicitly: if our watch was
+            # lost, waiting for it would spin on the same stale snapshot
+            refresh = getattr(self.zk, "refresh_cluster_state", None)
+            if refresh is not None:
+                try:
+                    await refresh()
+                except Exception:
+                    pass
+            await asyncio.sleep(0.05)
             self.kick()
             return False
         self._emit("stateWritten", state)

@@ -1,0 +1,64 @@
+"""Live test of the guarded `manatee-adm rebuild` flow: depose a
+primary, run rebuild on its host (dataset destroyed, deposed entry
+removed), restart the sitter, and watch it restore and rejoin —
+lib/adm.js:1319-1684 end to end."""
+
+import asyncio
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.harness import ClusterHarness
+from tests.test_integration import converged
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_rebuild_deposed_peer(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            # depose the primary the usual way
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync,
+                                             sync=asyncs[0])
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+            await cluster.wait_writable(sync, "pre-rebuild")
+
+            # restart the dead peer's sitter: it sees itself deposed and
+            # passivates (rebuild expects the sitter running so it can
+            # watch recovery)
+            primary.start()
+            await asyncio.sleep(1.0)
+
+            # operator: manatee-adm rebuild on the peer's "host"
+            env = dict(os.environ, PYTHONPATH=str(REPO),
+                       COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
+                       SHARD="1")
+            env.pop("MANATEE_ADM_TEST_STATE", None)
+            cp = subprocess.run(
+                [sys.executable, "-m", "manatee_tpu.cli", "rebuild",
+                 "-y", "-c", str(primary.root / "sitter.json"),
+                 "--timeout", "60"],
+                capture_output=True, text=True, env=env, timeout=120)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            assert "Removing deposed dataset" in cp.stdout
+            assert "Removed from deposed list" in cp.stdout
+            assert "Peer is healthy again." in cp.stdout
+
+            # the rebuilt peer is back in the topology as an async
+            st = await cluster.wait_for(
+                lambda s: [a["id"] for a in s.get("async") or []]
+                == [primary.ident] and not s.get("deposed"),
+                60, "rebuilt peer readopted")
+            await cluster.wait_writable(sync, "post-rebuild")
+            # and it actually has the data (restored from upstream)
+            res = await primary.pg_query({"op": "select"})
+            assert "pre-rebuild" in res["rows"]
+        finally:
+            await cluster.stop()
+    asyncio.run(go())

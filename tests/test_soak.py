@@ -74,6 +74,7 @@ def test_random_interleavings(seed):
             and clear deposed entries (rebuild/reap semantics)."""
             def mut(st):
                 st.pop("freeze", None)
+                st.pop("promote", None)   # clear-promote tidy
                 if reap:
                     st["deposed"] = []
             await edit_state(mut)
@@ -124,6 +125,35 @@ def test_random_interleavings(seed):
                     else:
                         st["freeze"] = {"date": "x", "reason": "soak"}
                 await edit_state(churn)
+            elif action < 0.8:
+                # operator promote churn: request a random promotion
+                # (sometimes already-stale by generation, sometimes
+                # expired — the machine must act on valid ones and
+                # ignore the rest without wedging)
+                import datetime as _dt
+                exp = (_dt.datetime.now(_dt.timezone.utc)
+                       + _dt.timedelta(
+                           seconds=rng.choice([-5, 30]))).strftime(
+                    "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+                def ask(st):
+                    asyncs_ = st.get("async") or []
+                    choices = []
+                    if st.get("sync"):
+                        choices.append(("sync", st["sync"]["id"], None))
+                    for i, a in enumerate(asyncs_):
+                        choices.append(("async", a["id"], i))
+                    if not choices:
+                        raise ValueError("nothing to promote")
+                    role, pid, idx = rng.choice(choices)
+                    pr = {"id": pid, "role": role,
+                          "generation": st["generation"] -
+                          rng.choice([0, 0, 1]),
+                          "expireTime": exp}
+                    if idx is not None:
+                        pr["asyncIndex"] = idx
+                    st["promote"] = pr
+                await edit_state(ask)
             await asyncio.sleep(rng.uniform(0.0, 0.05))
 
             # safety: generation never decreases in the durable state
