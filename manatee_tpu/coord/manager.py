@@ -75,10 +75,16 @@ class ConsensusMgr:
         path: str,
         ident: str,
         data: dict,
+        anti_entropy_interval: float = 30.0,
     ):
         """*ident* is the peer id (ip:pgPort:backupPort in the reference,
         lib/shard.js:39-54); *data* is the member payload (zoneId, ip,
-        pgUrl, backupUrl)."""
+        pgUrl, backupUrl).
+
+        *anti_entropy_interval*: cadence of a reconciliation pass that
+        plain-reads the state and membership regardless of watches, so a
+        lost one-shot watch can delay convergence by at most one period
+        (0 disables)."""
         self._factory = client_factory
         root = path.rstrip("/")
         self._election_path = root + "/election"
@@ -97,6 +103,8 @@ class ConsensusMgr:
         self._lock = asyncio.Lock()   # serializes watch handlers
         self._setup_task: asyncio.Task | None = None
         self._generation_of_setup = 0
+        self._anti_entropy_interval = anti_entropy_interval
+        self._anti_entropy_task: asyncio.Task | None = None
 
     # ---- events ----
 
@@ -147,14 +155,38 @@ class ConsensusMgr:
 
     async def start(self) -> None:
         await self._setup_client()
+        if self._anti_entropy_interval > 0:
+            self._anti_entropy_task = asyncio.ensure_future(
+                self._anti_entropy_loop())
 
     async def close(self) -> None:
         self._closed = True
+        if self._anti_entropy_task:
+            self._anti_entropy_task.cancel()
         if self._client:
             try:
                 await self._client.close()
             except CoordError:
                 pass
+
+    async def _anti_entropy_loop(self) -> None:
+        """Watch loss insurance: periodically reconcile from plain reads
+        (no new watches).  _handle_active debounces unchanged id lists
+        and _handle_cluster_state dedups by version, so a quiet pass
+        emits nothing."""
+        while not self._closed:
+            await asyncio.sleep(self._anti_entropy_interval)
+            client = self._client
+            if client is None or not self._inited:
+                continue
+            try:
+                async with self._lock:
+                    await self.refresh_cluster_state()
+                    names = await client.get_children(self._election_path)
+                    await self._handle_active(client, names)
+            except (CoordError, OSError, asyncio.CancelledError):
+                if self._closed:
+                    return
 
     async def _setup_client(self) -> None:
         """(Re)build the client and all coordination state — the analogue of

@@ -312,6 +312,49 @@ async def _election_names(space):
 
 # ---------- coordd server + NetCoord over real TCP ----------
 
+def test_anti_entropy_heals_lost_watches():
+    """Destroy a manager's armed watches (simulated watch loss); the
+    periodic reconciliation pass must still observe state and membership
+    changes within one interval."""
+    async def go():
+        space = CoordSpace()
+        mgrA = make_mgr(space, "A:1:1")
+        mgrA._anti_entropy_interval = 0.2
+        await mgrA.start()
+        mgrB = make_mgr(space, "B:1:1")
+        await mgrB.start()
+        await asyncio.sleep(0.05)
+        await mgrA.put_cluster_state({"generation": 0, "primary": "A"})
+        await asyncio.sleep(0.05)
+
+        # simulate total watch loss for A
+        space.tree._watches.clear()
+
+        changes = []
+        states = []
+        mgrA.on("activeChange", changes.append)
+        mgrA.on("clusterStateChange", states.append)
+
+        # membership and state change while A has no watches
+        mgrB._closed = True
+        space.expire(mgrB._client)
+        await mgrA.put_cluster_state({"generation": 1, "primary": "A"})
+        # ... which self-arms nothing; only anti-entropy can notice
+        c = space.client()
+        await c.connect()
+        import json as _json
+        data, v = await c.get("/shard/state")
+        st = _json.loads(data.decode())
+        st["generation"] = 2
+        await c.set("/shard/state", _json.dumps(st).encode(), v)
+
+        await asyncio.sleep(0.6)   # > one anti-entropy period
+        assert changes and [a["id"] for a in changes[-1]] == ["A:1:1"]
+        assert states and states[-1]["generation"] == 2
+        await mgrA.close()
+    run(go())
+
+
 def test_netcoord_basic_and_watch():
     async def go():
         server = CoordServer()
