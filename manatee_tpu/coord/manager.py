@@ -95,6 +95,7 @@ class ConsensusMgr:
 
         self._client: CoordClient | None = None
         self._inited = False
+        self._ready = False    # current client fully set up (joined)
         self._closed = False
         self._active: list[dict] = []
         self._cluster_state: dict | None = None
@@ -162,7 +163,13 @@ class ConsensusMgr:
     async def close(self) -> None:
         self._closed = True
         if self._anti_entropy_task:
+            # finish any in-flight pass before tearing the client down,
+            # so no callbacks fire after close() returns
             self._anti_entropy_task.cancel()
+            try:
+                await self._anti_entropy_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._client:
             try:
                 await self._client.close()
@@ -177,22 +184,30 @@ class ConsensusMgr:
         while not self._closed:
             await asyncio.sleep(self._anti_entropy_interval)
             client = self._client
-            if client is None or not self._inited:
+            # skip while a session rebuild is in flight: our own
+            # election node may not be re-created yet, and reporting
+            # membership without ourselves would be false
+            if client is None or not self._inited or not self._ready:
                 continue
             try:
                 async with self._lock:
-                    await self.refresh_cluster_state()
+                    if self._closed or client is not self._client \
+                            or not self._ready:
+                        continue
+                    await self.refresh_cluster_state(client)
                     names = await client.get_children(self._election_path)
                     await self._handle_active(client, names)
-            except (CoordError, OSError, asyncio.CancelledError):
-                if self._closed:
-                    return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("anti-entropy pass failed: %s", e)
 
     async def _setup_client(self) -> None:
         """(Re)build the client and all coordination state — the analogue of
         setupZkClient + setupData (lib/zookeeperMgr.js:488-586)."""
         self._generation_of_setup += 1
         gen = self._generation_of_setup
+        self._ready = False
         while not self._closed:
             client = None
             try:
@@ -207,6 +222,7 @@ class ConsensusMgr:
 
                 client.on_session_event(on_session)
                 await self._setup_data(client)
+                self._ready = True
                 return
             except (CoordError, OSError) as e:
                 # OSError: transient TCP failures (refused, reset, SYN
@@ -225,6 +241,7 @@ class ConsensusMgr:
     def _schedule_resetup(self) -> None:
         if self._setup_task and not self._setup_task.done():
             return
+        self._ready = False
         self._setup_task = asyncio.ensure_future(self._setup_client())
 
     async def _setup_data(self, client: CoordClient) -> None:
@@ -348,14 +365,16 @@ class ConsensusMgr:
         if self._inited and not should_debounce:
             self._emit("activeChange", self.active)
 
-    async def refresh_cluster_state(self) -> None:
+    async def refresh_cluster_state(self, client: CoordClient | None = None
+                                    ) -> None:
         """Force a plain re-read of the state node (no new watch).  The
         self-healing path for a lost watch: callers that observe a CAS
         conflict call this so a stale cache cannot persist."""
-        if self._client is None:
+        client = client if client is not None else self._client
+        if client is None:
             return
         try:
-            data, version = await self._client.get(self._state_path)
+            data, version = await client.get(self._state_path)
         except CoordError:
             return
         self._handle_cluster_state(data, version)
