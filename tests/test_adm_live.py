@@ -101,11 +101,20 @@ def test_adm_live_operations(tmp_path):
                 lambda s: [a["id"] for a in s.get("async") or []]
                 == [primary.ident], 45, "old primary readopted")
 
-            # promote the (only) async to sync through the CLI
+            # promote the (only) async to sync through the CLI; the
+            # cluster may still be settling (a transitioning peer's
+            # database is briefly unqueryable, which rightly blocks
+            # promotion), so retry until it is accepted
             st = await cluster.cluster_state()
             azone = st["async"][0]["zoneId"]
-            cp = adm(cluster, "promote", "-r", "async", "-n", azone,
-                     "-y")
+            for _ in range(30):
+                cp = adm(cluster, "promote", "-r", "async", "-n", azone,
+                         "-y", check=False)
+                if cp.returncode == 0:
+                    break
+                assert "cluster has errors" in cp.stderr, cp.stderr
+                await asyncio.sleep(1)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
             assert "Promotion complete." in cp.stdout
             st = await cluster.cluster_state()
             assert st["sync"]["zoneId"] == azone
