@@ -64,6 +64,54 @@ class ZNodeTree:
         self._watches: dict[tuple[str, str], list[WatchSink]] = {}
         self.sessions: dict[str, Session] = {}
         self._session_counter = 0
+        self.on_mutate: Callable[[], None] | None = None
+
+    def _mutated(self) -> None:
+        if self.on_mutate is not None:
+            self.on_mutate()
+
+    # ---- persistence (ZooKeeper-parity durability for coordd) ----
+
+    def to_snapshot(self) -> dict:
+        """Serializable view of the PERSISTENT tree.  Ephemerals are
+        dropped: after a server restart their sessions are gone, which
+        matches clients observing session expiry and re-registering."""
+        import base64
+
+        def walk(node: _Node) -> dict:
+            return {
+                "data": base64.b64encode(node.data).decode(),
+                "version": node.version,
+                "seq": node.seq_counter,
+                "ctime": node.ctime,
+                "children": {
+                    name: walk(child)
+                    for name, child in node.children.items()
+                    if child.ephemeral_owner is None
+                },
+            }
+
+        return {"v": 1, "root": walk(self._root)}
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "ZNodeTree":
+        import base64
+
+        def build(d: dict) -> _Node:
+            node = _Node(
+                data=base64.b64decode(d.get("data", "")),
+                version=int(d.get("version", 0)),
+                ctime=float(d.get("ctime", 0.0)) or time.time(),
+            )
+            node.seq_counter = int(d.get("seq", 0))
+            node.children = {name: build(c)
+                             for name, c in d.get("children", {}).items()}
+            return node
+
+        tree = cls()
+        if snap.get("v") == 1 and "root" in snap:
+            tree._root = build(snap["root"])
+        return tree
 
     # ---- sessions ----
 
@@ -168,6 +216,7 @@ class ZNodeTree:
             raise NodeExistsError(path)
         parent.children[name] = _Node(
             data=bytes(data), ephemeral_owner=ephemeral_owner)
+        self._mutated()
         self._fire(DATA, path, WatchEvent(EventType.CREATED, path))
         self._fire(CHILDREN, parent_path,
                    WatchEvent(EventType.CHILDREN_CHANGED, parent_path))
@@ -186,6 +235,7 @@ class ZNodeTree:
                                   % (path, version, node.version))
         node.data = bytes(data)
         node.version += 1
+        self._mutated()
         self._fire(DATA, path, WatchEvent(EventType.DATA_CHANGED, path))
         return node.version
 
@@ -203,6 +253,7 @@ class ZNodeTree:
             # ephemeral nodes cannot have children in ZK; defensive only
             raise NotEmptyError(path)
         del parent.children[name]
+        self._mutated()
         parent_path = path.rpartition("/")[0] or "/"
         self._fire(DATA, path, WatchEvent(EventType.DELETED, path))
         self._fire(CHILDREN, parent_path,

@@ -89,27 +89,92 @@ class _Conn:
 
 class CoordServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 tick: float = 0.25):
+                 tick: float = 0.25, data_dir: str | None = None):
+        """*data_dir*: when set, the persistent tree is snapshotted there
+        and reloaded on start (ZooKeeper-parity durability).  Ephemeral
+        nodes do not survive a restart — their sessions are gone, and
+        clients observe expiry and re-register."""
         self.host = host
         self.port = port
         self.tick = tick
-        self.tree = model.ZNodeTree()
+        self.data_dir = data_dir
+        self.tree = self._load_tree()
         self._server: asyncio.AbstractServer | None = None
         self._expiry_task: asyncio.Task | None = None
+        self._save_task: asyncio.Task | None = None
+        self._dirty = False
         self._conns: set[_Conn] = set()
         # session id -> live conn (one at a time)
         self._session_conns: dict[str, _Conn] = {}
+        if self.data_dir:
+            self.tree.on_mutate = self._mark_dirty
+
+    # ---- persistence ----
+
+    def _snapshot_path(self):
+        from pathlib import Path
+        return Path(self.data_dir) / "coordd-tree.json"
+
+    def _load_tree(self) -> model.ZNodeTree:
+        if not self.data_dir:
+            return model.ZNodeTree()
+        from pathlib import Path
+        Path(self.data_dir).mkdir(parents=True, exist_ok=True)
+        path = self._snapshot_path()
+        if not path.exists():
+            return model.ZNodeTree()
+        try:
+            snap = json.loads(path.read_text())
+            tree = model.ZNodeTree.from_snapshot(snap)
+            log.info("loaded coordination tree from %s", path)
+            return tree
+        except (ValueError, OSError) as e:
+            log.error("cannot load tree snapshot %s: %s; starting empty",
+                      path, e)
+            return model.ZNodeTree()
+
+    def _mark_dirty(self) -> None:
+        self._dirty = True
+        if self._save_task is None or self._save_task.done():
+            try:
+                self._save_task = asyncio.ensure_future(
+                    self._save_soon())
+            except RuntimeError:
+                self._save_now()   # no loop (tests): save synchronously
+
+    async def _save_soon(self) -> None:
+        # debounce bursts; one snapshot per 50ms of mutations
+        await asyncio.sleep(0.05)
+        self._save_now()
+
+    def _save_now(self) -> None:
+        if not self.data_dir or not self._dirty:
+            return
+        self._dirty = False
+        path = self._snapshot_path()
+        tmp = path.with_name(path.name + ".tmp")
+        try:
+            tmp.write_text(json.dumps(self.tree.to_snapshot()))
+            tmp.replace(path)
+        except OSError as e:
+            log.error("cannot persist tree snapshot: %s", e)
+            self._dirty = True
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, limit=MAX_LINE)
         self.port = self._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.ensure_future(self._expiry_loop())
-        log.info("coordd listening on %s:%d", self.host, self.port)
+        log.info("coordd listening on %s:%d%s", self.host, self.port,
+                 " (persistent: %s)" % self.data_dir
+                 if self.data_dir else "")
 
     async def stop(self) -> None:
         if self._expiry_task:
             self._expiry_task.cancel()
+        if self._save_task and not self._save_task.done():
+            self._save_task.cancel()
+        self._save_now()   # final flush
         # close live connections BEFORE wait_closed(): since 3.12 it waits
         # for every connection handler to finish
         for conn in list(self._conns):
@@ -270,12 +335,17 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="manatee coordination daemon")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=2281)
+    p.add_argument("--data-dir", default=None,
+                   help="persist the tree here (survives restarts)")
+    p.add_argument("--tick", type=float, default=0.25,
+                   help="session-expiry scan interval (seconds)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
     setup_logging("manatee-coordd", args.verbose)
 
     async def run():
-        server = CoordServer(args.host, args.port)
+        server = CoordServer(args.host, args.port, tick=args.tick,
+                             data_dir=args.data_dir)
         await server.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

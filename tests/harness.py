@@ -187,14 +187,27 @@ class ClusterHarness:
 
     # -- lifecycle --
 
-    async def start(self, *, peers: list[int] | None = None,
-                    stagger: float = 0.3) -> None:
+    def start_coordd(self) -> None:
         env = dict(os.environ, PYTHONPATH=str(REPO))
         logf = open(self.root / "coordd.log", "ab")
         self.coord_proc = subprocess.Popen(
             [sys.executable, "-m", "manatee_tpu.coord.server",
-             "--port", str(self.coord_port)],
+             "--port", str(self.coord_port),
+             "--data-dir", str(self.root / "coord-data")],
             stdout=logf, stderr=logf, env=env, start_new_session=True)
+
+    def kill_coordd(self) -> None:
+        if self.coord_proc and self.coord_proc.poll() is None:
+            try:
+                os.killpg(self.coord_proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self.coord_proc.wait(timeout=5)
+        self.coord_proc = None
+
+    async def start(self, *, peers: list[int] | None = None,
+                    stagger: float = 0.3) -> None:
+        self.start_coordd()
         await self._wait_port(self.coord_port)
         which = peers if peers is not None else range(len(self.peers))
         for i in which:
@@ -205,12 +218,7 @@ class ClusterHarness:
     async def stop(self) -> None:
         for p in self.peers:
             p.kill()
-        if self.coord_proc and self.coord_proc.poll() is None:
-            try:
-                os.killpg(self.coord_proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            self.coord_proc.wait(timeout=5)
+        self.kill_coordd()
 
     async def _wait_port(self, port: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout

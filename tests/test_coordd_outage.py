@@ -1,0 +1,53 @@
+"""Coordination-service outage scenarios (docs/test-plan.md §6): the
+coordination daemon is SIGKILLed and restarted from its on-disk
+snapshot.  The durable cluster state must survive, every peer must
+re-register, the topology must resume UNCHANGED (the cold-start grace
+prevents a spurious takeover), and writes must work again."""
+
+import asyncio
+
+from tests.harness import ClusterHarness
+from tests.test_integration import converged
+
+
+def test_coordd_crash_and_restart(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            before = await cluster.cluster_state()
+
+            # hard-kill the coordination daemon, stay down past every
+            # session timeout, then restart it from its snapshot
+            cluster.kill_coordd()
+            await asyncio.sleep(cluster.session_timeout + 1.0)
+            cluster.start_coordd()
+            await cluster._wait_port(cluster.coord_port)
+
+            # durable state survived the crash
+            st = await cluster.cluster_state()
+            assert st is not None
+            assert st["generation"] == before["generation"]
+            assert st["primary"]["id"] == before["primary"]["id"]
+
+            # peers re-register; NO takeover happens (grace: absence
+            # right after everyone re-joined is not death)
+            st = await cluster.wait_topology(primary=primary, sync=sync,
+                                             timeout=60)
+            assert st["generation"] == before["generation"]
+            await cluster.wait_writable(primary, "post-coordd-outage",
+                                        timeout=60)
+            # the pre-outage data is still there
+            res = await sync.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+
+            # ...and failover still works afterwards
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            assert st["generation"] == before["generation"] + 1
+            await cluster.wait_writable(sync, "post-outage-failover",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    asyncio.run(go())
