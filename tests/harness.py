@@ -193,7 +193,8 @@ class ClusterHarness:
         self.coord_proc = subprocess.Popen(
             [sys.executable, "-m", "manatee_tpu.coord.server",
              "--port", str(self.coord_port),
-             "--data-dir", str(self.root / "coord-data")],
+             "--data-dir", str(self.root / "coord-data"),
+             "--tick", "0.1"],
             stdout=logf, stderr=logf, env=env, start_new_session=True)
 
     def kill_coordd(self) -> None:
