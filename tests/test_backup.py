@@ -107,6 +107,31 @@ def test_restore_fails_cleanly_when_no_snapshot(tmp_path):
     run(go())
 
 
+def test_concurrent_restores(tmp_path):
+    """Two peers restore from the same backup server at once; the sender
+    processes jobs from the shared queue and both complete intact."""
+    async def go():
+        _s, _q, server, sender = await make_sender_side(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        try:
+            async def one(tag):
+                storage = DirBackend(tmp_path / ("dst-%s" % tag))
+                mnt = tmp_path / ("mnt-%s" % tag)
+                client = RestoreClient(storage, dataset="pg",
+                                       mountpoint=str(mnt),
+                                       poll_interval=0.1)
+                await asyncio.wait_for(client.restore(url), 30)
+                assert (mnt / "base.db").read_bytes() == b"P" * 200_000
+                return tag
+
+            done = await asyncio.gather(one("a"), one("b"))
+            assert sorted(done) == ["a", "b"]
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
 def test_backup_job_rest_api(tmp_path):
     async def go():
         import aiohttp

@@ -101,7 +101,11 @@ def test_random_interleavings(seed):
             peers.append(p)
             return p
 
-        for n in ("A", "B", "C", "D"):
+        # larger topologies for the high seeds: a 6-peer shard has a
+        # deeper async chain and more interleavings
+        names = ("A", "B", "C", "D", "E", "F") if seed >= 99 else \
+            ("A", "B", "C", "D")
+        for n in names:
             await spawn(n)
         await wait_for(lambda: any(p.sm._state for p in peers), 10,
                        "bootstrap")
