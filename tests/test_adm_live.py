@@ -64,6 +64,18 @@ def test_adm_live_operations(tmp_path):
             st = json.loads(cp.stdout)
             assert st["generation"] == 0
 
+            # zk-active lists deduplicated members with data
+            cp = adm(cluster, "zk-active")
+            active = json.loads(cp.stdout)
+            assert len(active) == 3
+            assert all("pgUrl" in a["data"] for a in active)
+
+            # the (deprecated) status command emits per-shard JSON
+            cp = adm(cluster, "status")
+            full = json.loads(cp.stdout)
+            assert "1" in full
+            assert full["1"]["primary"]["repl"]["sync_state"] == "sync"
+
             # freeze blocks failover
             adm(cluster, "freeze", "-r", "maintenance test")
             cp = adm(cluster, "show")
