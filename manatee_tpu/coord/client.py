@@ -89,6 +89,9 @@ class NetCoord(CoordClient):
             hello["session_timeout"] = self._timeout
         res = await self._request(hello)
         self._session_id = res["session_id"]
+        # adopt the server's (possibly floored) timeout so our reconnect
+        # give-up deadline matches the session's actual server lifetime
+        self._timeout = float(res.get("session_timeout", self._timeout))
         self._connected.set()
         if self._ping_task is None or self._ping_task.done():
             self._ping_task = asyncio.ensure_future(self._ping_loop())
@@ -256,6 +259,15 @@ class NetCoord(CoordClient):
         self._watches.setdefault((kind, path), []).append(watch)
         return True
 
+    def _disarm(self, kind: str, path: str, watch: WatchCb) -> None:
+        """Error-path cleanup of a just-armed watch.  Tolerant: the entry
+        may have been consumed concurrently by _deliver_watch /
+        _refire_watches / session expiry, and raising here would mask
+        the original CoordError."""
+        cbs = self._watches.get((kind, path))
+        if cbs and watch in cbs:
+            cbs.remove(watch)
+
     async def create(self, path: str, data: bytes = b"", *,
                      ephemeral: bool = False,
                      sequential: bool = False) -> str:
@@ -278,7 +290,7 @@ class NetCoord(CoordClient):
                                        "watch": armed})
         except CoordError:
             if armed:
-                self._watches[("data", path)].remove(watch)
+                self._disarm("data", path, watch)
             raise
         return (base64.b64decode(res["data"]), res["version"],
                 res.get("ctime", 0.0))
@@ -300,7 +312,7 @@ class NetCoord(CoordClient):
                                        "watch": armed})
         except CoordError:
             if armed:
-                self._watches[("data", path)].remove(watch)
+                self._disarm("data", path, watch)
             raise
         if res is None:
             return None
@@ -317,7 +329,7 @@ class NetCoord(CoordClient):
                                         "watch": armed})
         except CoordError:
             if armed:
-                self._watches[("children", path)].remove(watch)
+                self._disarm("children", path, watch)
             raise
     async def multi(self, ops: list[Op]) -> list:
         wire_ops = []

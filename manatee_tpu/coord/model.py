@@ -141,9 +141,17 @@ class ZNodeTree:
                 pass
 
     def expired_sessions(self, now: float | None = None) -> list[str]:
+        """Sessions past their deadline — including CONNECTED ones.
+
+        A hung-but-connected peer (SIGSTOP, stalled host, partition with
+        no RST) stops pinging but keeps its TCP socket; ZooKeeper expires
+        such sessions on heartbeat silence, and so must we, or the
+        cluster never fails over around a wedged peer.  Live clients ping
+        at timeout/3 (client.py _ping_loop), which refreshes last_seen.
+        """
         now = time.monotonic() if now is None else now
         return [sid for sid, s in self.sessions.items()
-                if not s.expired and not s.connected and s.deadline() <= now]
+                if not s.expired and s.deadline() <= now]
 
     def _ephemerals_of(self, sid: str) -> list[str]:
         out: list[str] = []

@@ -52,6 +52,9 @@ _ERR_NAMES = {
 }
 
 MAX_LINE = 8 * 1024 * 1024
+# per-connection outbound buffer cap; beyond this the subscriber is
+# considered stalled and its connection is aborted (ADVICE r1)
+MAX_BUFFERED = 16 * 1024 * 1024
 
 
 def _b64(data: bytes) -> str:
@@ -75,9 +78,28 @@ class _Conn:
         if not self.alive:
             return
         try:
+            buffered = self.writer.transport.get_write_buffer_size()
+        except (AttributeError, RuntimeError):
+            buffered = 0
+        if buffered > self.server.max_buffered:
+            # slow/stalled subscriber: watch pushes would otherwise
+            # buffer unboundedly inside coordd.  Sever it, as ZooKeeper
+            # does with slow clients; its session lives on until the
+            # timeout, so a healthy client reconnects.
+            self.sever()
+            return
+        try:
             self.writer.write((json.dumps(msg) + "\n").encode())
         except (ConnectionError, RuntimeError):
             self.alive = False
+
+    def sever(self) -> None:
+        """Kill the connection immediately (session untouched)."""
+        self.alive = False
+        try:
+            self.writer.transport.abort()
+        except (AttributeError, RuntimeError):
+            self.writer.close()
 
     def watch_sink(self, kind: str):
         def sink(event):
@@ -97,6 +119,7 @@ class CoordServer:
         self.host = host
         self.port = port
         self.tick = tick
+        self.max_buffered = MAX_BUFFERED
         self.data_dir = data_dir
         self.tree = self._load_tree()
         self._server: asyncio.AbstractServer | None = None
@@ -178,11 +201,7 @@ class CoordServer:
         # close live connections BEFORE wait_closed(): since 3.12 it waits
         # for every connection handler to finish
         for conn in list(self._conns):
-            conn.alive = False
-            try:
-                conn.writer.transport.abort()
-            except (AttributeError, RuntimeError):
-                conn.writer.close()
+            conn.sever()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -194,7 +213,11 @@ class CoordServer:
                 log.info("session %s expired", sid)
                 self.tree.expire_session(sid)
                 self.tree.sessions.pop(sid, None)
-                self._session_conns.pop(sid, None)
+                conn = self._session_conns.pop(sid, None)
+                if conn is not None:
+                    # hung-but-connected client: sever the socket so it
+                    # observes expiry instead of lingering half-alive
+                    conn.sever()
 
     # ---- per-connection ----
 
@@ -267,10 +290,14 @@ class CoordServer:
                 raise CoordError("session expired: %s" % sid)
             old = self._session_conns.get(sid)
             if old and old is not conn:
-                old.alive = False
-                old.writer.close()
+                old.sever()
         else:
-            timeout = float(req.get("session_timeout", 60.0))
+            # Floor: a timeout at or below the ping interval would
+            # perpetually expire healthy sessions now that connected
+            # sessions are subject to heartbeat expiry (ZK likewise
+            # clamps to a server-side minimum of 2 ticks).
+            timeout = max(float(req.get("session_timeout", 60.0)),
+                          4 * self.tick)
             sess = self.tree.create_session(timeout)
         sess.connected = True
         sess.last_seen = time.monotonic()

@@ -428,6 +428,81 @@ def test_netcoord_session_expiry_on_kill():
     run(go())
 
 
+def test_netcoord_hung_connected_session_expires():
+    """SIGSTOP-analog (ADVICE r1): the victim's TCP connection stays OPEN
+    but it stops pinging.  ZooKeeper expires such sessions on heartbeat
+    silence; so must coordd, or a wedged-but-connected peer holds its
+    election node forever and the cluster never fails over around it."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            victim = NetCoord("127.0.0.1", server.port, session_timeout=0.4)
+            survivor = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await victim.connect()
+            await survivor.connect()
+            await victim.mkdirp("/el")
+            await victim.create("/el/v-", b"d", ephemeral=True,
+                                sequential=True)
+            # SIGSTOP: silence the client without touching the socket
+            victim._closed = True
+            for t in (victim._read_task, victim._ping_task,
+                      victim._reconnect_task):
+                if t:
+                    t.cancel()
+
+            await asyncio.sleep(0.15)
+            assert await survivor.get_children("/el") != []   # not yet
+            await asyncio.sleep(0.6)
+            assert await survivor.get_children("/el") == []   # expired
+            # coordd severed the hung connection when it expired: only
+            # the survivor's session remains mapped, and the victim's
+            # socket saw EOF/RST
+            assert len(server._session_conns) == 1
+            assert (victim._reader.at_eof()
+                    or victim._reader.exception() is not None)
+            await survivor.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_slow_subscriber_severed_not_session():
+    """A subscriber whose outbound buffer exceeds the cap must be
+    severed on the next watch push (coordd memory stays bounded), while
+    its session survives until the normal timeout — ZK slow-client
+    semantics (ADVICE r1).  The buffer-size probe is patched on the live
+    transport: actually filling kernel socket buffers is nondeterministic
+    across hosts."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            slow = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            writer = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await slow.connect()
+            await writer.connect()
+            await writer.mkdirp("/w")
+            await slow.get_children("/w", watch=lambda e: None)
+
+            conn = server._session_conns[slow._session_id]
+            conn.writer.transport.get_write_buffer_size = \
+                lambda: server.max_buffered + 1
+
+            await writer.create("/w/n", b"x")   # fires the armed watch
+            await asyncio.sleep(0.2)
+            assert not conn.alive
+            assert conn.writer.transport.is_closing()
+            # session still alive (timeout 5s not elapsed) — a healthy
+            # client would reconnect and resume it
+            assert slow._session_id in server.tree.sessions
+            assert not server.tree.sessions[slow._session_id].expired
+            await writer.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
 def test_consensus_mgr_over_netcoord_failover_detection():
     """Full ConsensusMgr stack over real TCP: two peers join, one dies
     (socket abort), the other sees activeChange after session timeout."""
