@@ -289,9 +289,9 @@ class AdmClient:
     peer's database directly (lib/adm.js:81-209, 2166-2227)."""
 
     def __init__(self, coord_addr: str, *, base_path: str = "/manatee"):
-        host, _, port = coord_addr.partition(":")
-        self.host = host
-        self.port = int(port or 2281)
+        """*coord_addr*: 'host:port' or an ensemble connection string
+        'h1:p1,h2:p2' (zkCfg.connStr parity)."""
+        self.coord_addr = coord_addr
         self.base_path = base_path
         self._client: NetCoord | None = None
 
@@ -303,7 +303,7 @@ class AdmClient:
         await self.close()
 
     async def connect(self) -> None:
-        self._client = NetCoord(self.host, self.port, session_timeout=30)
+        self._client = NetCoord(self.coord_addr, session_timeout=30)
         await asyncio.wait_for(self._client.connect(), 10)
 
     async def close(self) -> None:

@@ -42,9 +42,7 @@ class ManateeClient:
     def __init__(self, *, coord_addr: str, shard: str,
                  base_path: str = "/manatee",
                  session_timeout: float = 30.0):
-        host, _, port = coord_addr.partition(":")
-        self._host = host
-        self._port = int(port or 2281)
+        self._coord_addr = coord_addr   # 'h:p' or ensemble 'h1:p1,h2:p2'
         self._path = "%s/%s/state" % (base_path.rstrip("/"), shard)
         self._session_timeout = session_timeout
         self._client: NetCoord | None = None
@@ -86,7 +84,7 @@ class ManateeClient:
         while not self._closed:
             client = None
             try:
-                client = NetCoord(self._host, self._port,
+                client = NetCoord(self._coord_addr,
                                   session_timeout=self._session_timeout)
                 await client.connect()
                 self._client = client

@@ -47,6 +47,12 @@ class SessionExpiredError(CoordError):
     pass
 
 
+class NotLeaderError(CoordError):
+    """The contacted ensemble member is a follower and refuses client
+    sessions; the client should rotate to the hinted leader address."""
+    pass
+
+
 class EventType(str, Enum):
     CREATED = "created"
     DELETED = "deleted"

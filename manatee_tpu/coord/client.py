@@ -31,6 +31,7 @@ from manatee_tpu.coord.api import (
     NodeExistsError,
     NoNodeError,
     NotEmptyError,
+    NotLeaderError,
     Op,
     SessionExpiredError,
     Stat,
@@ -45,18 +46,45 @@ _ERRS = {
     "NodeExistsError": NodeExistsError,
     "BadVersionError": BadVersionError,
     "NotEmptyError": NotEmptyError,
+    "NotLeaderError": NotLeaderError,
     "CoordError": CoordError,
 }
 
 RECONNECT_DELAY = 0.2
+HANDSHAKE_TIMEOUT = 5.0
 MAX_LINE = 8 * 1024 * 1024  # must match coordd's stream limit
 
 
+def parse_connstr(connstr: str, default_port: int = 2281
+                  ) -> list[tuple[str, int]]:
+    """'h1:p1,h2:p2,h3' -> [(h1,p1),(h2,p2),(h3,default)] — the shape of
+    the reference's zkCfg.connStr (etc/sitter.json)."""
+    addrs: list[tuple[str, int]] = []
+    for part in connstr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.partition(":")
+        addrs.append((host, int(port) if port else default_port))
+    if not addrs:
+        raise ValueError("empty connstr: %r" % connstr)
+    return addrs
+
+
 class NetCoord(CoordClient):
-    def __init__(self, host: str, port: int, *,
+    def __init__(self, host: str, port: int | None = None, *,
                  session_timeout: float = 60.0):
-        self.host = host
-        self.port = port
+        """*host* is either a single hostname (with *port*) or a full
+        comma-separated connection string 'h1:p1,h2:p2' covering a
+        coordd ensemble (parity: zkCfg.connStr,
+        /root/reference/etc/sitter.json).  The client rotates through
+        the addresses on connect/reconnect and honors not-leader
+        redirects from ensemble followers."""
+        if port is None:
+            self._addrs = parse_connstr(host)
+        else:
+            self._addrs = [(host, int(port))]
+        self._addr_idx = 0
         self._timeout = session_timeout
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -74,20 +102,93 @@ class NetCoord(CoordClient):
 
     # ---- lifecycle ----
 
+    @property
+    def host(self) -> str:
+        return self._addrs[self._addr_idx][0]
+
+    @property
+    def port(self) -> int:
+        return self._addrs[self._addr_idx][1]
+
+    def _rotate(self, hint: str | None = None) -> None:
+        """Advance to the next ensemble address — or jump straight to a
+        leader address hinted by a follower's refusal."""
+        if hint:
+            h, _, p = hint.partition(":")
+            try:
+                addr = (h, int(p))
+            except ValueError:
+                addr = None
+            if addr is not None:
+                if addr not in self._addrs:
+                    self._addrs.append(addr)
+                self._addr_idx = self._addrs.index(addr)
+                return
+        self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+
     async def connect(self) -> None:
-        await self._open_conn(resume=False)
+        last: Exception | None = None
+        attempts = 0
+        # bound re-evaluated each pass: a NotLeaderError redirect may
+        # APPEND the hinted leader address, and it deserves a try too
+        while attempts < len(self._addrs) + 1:
+            attempts += 1
+            try:
+                await self._open_conn(resume=False)
+                return
+            except (OSError, CoordError) as e:
+                last = e
+        if isinstance(last, CoordError):
+            raise last
+        raise ConnectionLossError(str(last)) from last
 
     async def _open_conn(self, resume: bool) -> None:
-        reader, writer = await asyncio.open_connection(
-            self.host, self.port, limit=MAX_LINE)
-        self._reader, self._writer = reader, writer
-        self._read_task = asyncio.ensure_future(self._read_loop(reader))
-        hello: dict = {"op": "hello"}
+        host, port = self._addrs[self._addr_idx]
+        try:
+            reader, writer = await asyncio.open_connection(
+                host, port, limit=MAX_LINE)
+        except OSError:
+            self._rotate()
+            raise
+        # Handshake inline, before the read loop owns the stream: a
+        # follower's not-leader refusal must rotate us without tripping
+        # the disconnect/reconnect machinery.  No watch pushes can
+        # arrive before the hello reply (no session yet).
+        hello: dict = {"op": "hello", "xid": 0}
         if resume and self._session_id:
             hello["session_id"] = self._session_id
         else:
             hello["session_timeout"] = self._timeout
-        res = await self._request(hello)
+        try:
+            writer.write((json.dumps(hello) + "\n").encode())
+            await writer.drain()
+            # bounded: a wedged-but-accepting member (SIGSTOP — the
+            # kernel still completes accepts) must not pin us forever
+            line = await asyncio.wait_for(reader.readline(), HANDSHAKE_TIMEOUT)
+        except (ConnectionError, RuntimeError, OSError,
+                asyncio.TimeoutError) as e:
+            writer.close()
+            self._rotate()
+            raise ConnectionLossError("handshake: %s" % e) from None
+        if not line:
+            writer.close()
+            self._rotate()
+            raise ConnectionLossError("handshake EOF")
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            writer.close()
+            self._rotate()
+            raise CoordError("bad handshake reply")
+        if not msg.get("ok"):
+            writer.close()
+            if msg.get("error") == "NotLeaderError":
+                self._rotate(hint=msg.get("leader"))
+                raise NotLeaderError(msg.get("msg", ""))
+            raise _ERRS.get(msg.get("error"), CoordError)(msg.get("msg", ""))
+        res = msg.get("result") or {}
+        self._reader, self._writer = reader, writer
+        self._read_task = asyncio.ensure_future(self._read_loop(reader))
         self._session_id = res["session_id"]
         # adopt the server's (possibly floored) timeout so our reconnect
         # give-up deadline matches the session's actual server lifetime
@@ -166,8 +267,8 @@ class NetCoord(CoordClient):
             await asyncio.sleep(RECONNECT_DELAY)
             try:
                 await self._open_conn(resume=True)
-            except (ConnectionLossError, OSError):
-                continue         # transient: retry until deadline
+            except (ConnectionLossError, NotLeaderError, OSError):
+                continue         # transient / rotated: retry until deadline
             except CoordError:
                 break            # server refused the session: expired
             self._refire_watches()

@@ -16,9 +16,31 @@ Wire protocol: newline-delimited JSON over TCP.
 
 Sessions: ``hello`` creates (or resumes) a session; a dropped TCP
 connection leaves the session alive until ``session_timeout`` elapses.
-In production this daemon would run as an ensemble; for the single-host
-deployments this rebuild targets it runs as one process (the reference
-likewise tolerates a single-node ZK in dev, docs/working-on-manatee.md).
+
+Ensemble mode (--ensemble/--ensemble-id) replicates coordd the way the
+reference assumes a ZooKeeper ensemble (etc/sitter.json zkCfg.connStr):
+
+- exactly one member is *leader* and accepts client sessions; followers
+  refuse hello with NotLeaderError + a leader hint, and clients rotate
+  (NetCoord multi-address).
+- the leader ships the persistent tree (snapshot + monotonic seq) to
+  followers on every mutation and awaits their acks; with >=3 members
+  mutations additionally require a connected majority (no-quorum
+  refusal), so a partitioned minority leader cannot diverge the state.
+- leadership: lowest-id member wins at cold start (after promote_grace
+  of probing for an existing leader), a follower promotes itself when
+  every lower-id member is unreachable for promote_grace, and a
+  returning member always joins an incumbent leader instead of
+  reclaiming (leader stickiness).  Dual leaders after a partition heal
+  resolve by (seq, lowest id).
+- ephemerals/sessions are deliberately NOT replicated: on failover
+  clients observe session loss and re-register — the same contract as
+  a coordd restart, and the recovery path ConsensusMgr already owns.
+
+This is snapshot-shipping primary/backup, not ZAB/Raft: it needs the
+quorum rule above for safety and trades some availability (a two-member
+ensemble cannot survive a partition safely).  The CoordClient interface
+stays narrow so a real ZK ensemble could back production via an adapter.
 """
 
 from __future__ import annotations
@@ -38,6 +60,7 @@ from manatee_tpu.coord.api import (
     NodeExistsError,
     NoNodeError,
     NotEmptyError,
+    NotLeaderError,
     Op,
 )
 from manatee_tpu.utils.logutil import setup_logging
@@ -55,6 +78,8 @@ MAX_LINE = 8 * 1024 * 1024
 # per-connection outbound buffer cap; beyond this the subscriber is
 # considered stalled and its connection is aborted (ADVICE r1)
 MAX_BUFFERED = 16 * 1024 * 1024
+# ops that change the persistent tree and must be replicated/quorum-gated
+_MUTATING = frozenset({"create", "set", "delete", "multi"})
 
 
 def _b64(data: bytes) -> str:
@@ -73,6 +98,9 @@ class _Conn:
         self.writer = writer
         self.session: model.Session | None = None
         self.alive = True
+        self.is_follower = False
+        self.follower_id: int | None = None
+        self.ack_waiters: dict[int, asyncio.Future] = {}
 
     def push(self, msg: dict) -> None:
         if not self.alive:
@@ -111,16 +139,32 @@ class _Conn:
 
 class CoordServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 tick: float = 0.25, data_dir: str | None = None):
+                 tick: float = 0.25, data_dir: str | None = None,
+                 ensemble: list[tuple[str, int]] | None = None,
+                 ensemble_id: int = 0, promote_grace: float = 2.0):
         """*data_dir*: when set, the persistent tree is snapshotted there
         and reloaded on start (ZooKeeper-parity durability).  Ephemeral
         nodes do not survive a restart — their sessions are gone, and
-        clients observe expiry and re-register."""
+        clients observe expiry and re-register.
+
+        *ensemble*: full member address list (including this server);
+        *ensemble_id* is this server's index into it.  See the module
+        docstring for the replication/leadership protocol."""
         self.host = host
         self.port = port
         self.tick = tick
         self.max_buffered = MAX_BUFFERED
         self.data_dir = data_dir
+        self.ensemble = ensemble
+        self.my_id = ensemble_id
+        self.promote_grace = promote_grace
+        self.role = "follower" if ensemble else "leader"
+        self.leader_addr: tuple[str, int] | None = None
+        self._seq = 0
+        self._follower_conns: set[_Conn] = set()
+        self._follow_task: asyncio.Task | None = None
+        self._probe_task: asyncio.Task | None = None
+        self._stopping = False
         self.tree = self._load_tree()
         self._server: asyncio.AbstractServer | None = None
         self._expiry_task: asyncio.Task | None = None
@@ -149,7 +193,9 @@ class CoordServer:
         try:
             snap = json.loads(path.read_text())
             tree = model.ZNodeTree.from_snapshot(snap)
-            log.info("loaded coordination tree from %s", path)
+            self._seq = int(snap.get("seq", 0))
+            log.info("loaded coordination tree from %s (seq %d)",
+                     path, self._seq)
             return tree
         except (ValueError, OSError) as e:
             log.error("cannot load tree snapshot %s: %s; starting empty",
@@ -177,7 +223,9 @@ class CoordServer:
         path = self._snapshot_path()
         tmp = path.with_name(path.name + ".tmp")
         try:
-            tmp.write_text(json.dumps(self.tree.to_snapshot()))
+            snap = self.tree.to_snapshot()
+            snap["seq"] = self._seq
+            tmp.write_text(json.dumps(snap))
             tmp.replace(path)
         except OSError as e:
             log.error("cannot persist tree snapshot: %s", e)
@@ -188,11 +236,19 @@ class CoordServer:
             self._handle_conn, self.host, self.port, limit=MAX_LINE)
         self.port = self._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.ensure_future(self._expiry_loop())
-        log.info("coordd listening on %s:%d%s", self.host, self.port,
+        if self.ensemble:
+            self._follow_task = asyncio.ensure_future(self._follow_loop())
+        log.info("coordd listening on %s:%d%s%s", self.host, self.port,
                  " (persistent: %s)" % self.data_dir
-                 if self.data_dir else "")
+                 if self.data_dir else "",
+                 " (ensemble id %d of %d)" % (self.my_id, len(self.ensemble))
+                 if self.ensemble else "")
 
     async def stop(self) -> None:
+        self._stopping = True
+        for t in (self._follow_task, self._probe_task):
+            if t:
+                t.cancel()
         if self._expiry_task:
             self._expiry_task.cancel()
         if self._save_task and not self._save_task.done():
@@ -248,6 +304,10 @@ class CoordServer:
         finally:
             conn.alive = False
             self._conns.discard(conn)
+            self._follower_conns.discard(conn)
+            for fut in conn.ack_waiters.values():
+                if not fut.done():
+                    fut.cancel()
             # the session survives the connection; watches don't
             self.tree.remove_watches_for(
                 lambda w: getattr(w, "__owner__", None) is conn)
@@ -263,14 +323,38 @@ class CoordServer:
         xid = req.get("xid")
         op = req.get("op")
         try:
+            if op == "sync_ack":
+                # follower ack of a replicated snapshot: resolve the
+                # waiter, no reply (acks must not generate traffic)
+                fut = conn.ack_waiters.pop(int(req.get("seq", -1)), None)
+                if fut and not fut.done():
+                    fut.set_result(True)
+                return
             if op == "hello":
                 result = self._op_hello(conn, req)
+            elif op == "sync_status":
+                result = self._op_sync_status()
+            elif op == "sync_hello":
+                result = self._op_sync_hello(conn, req)
             elif conn.session is None or conn.session.expired:
                 raise CoordError("no session (hello first)")
             else:
                 self.tree.touch_session(conn.session.id)
+                mutating = op in _MUTATING
+                if mutating:
+                    self._check_quorum()
                 result = self._op(conn, op, req)
+                if mutating:
+                    self._seq += 1
+                    acks = await self._replicate()
+                    self._check_commit_quorum(acks)
             conn.push({"xid": xid, "ok": True, "result": result})
+        except NotLeaderError as e:
+            reply = {"xid": xid, "ok": False, "error": "NotLeaderError",
+                     "msg": str(e)}
+            if self.leader_addr is not None:
+                reply["leader"] = "%s:%d" % self.leader_addr
+            conn.push(reply)
         except CoordError as e:
             conn.push({"xid": xid, "ok": False,
                        "error": _ERR_NAMES.get(type(e), "CoordError"),
@@ -283,6 +367,8 @@ class CoordServer:
                        "msg": "bad request: %s" % e})
 
     def _op_hello(self, conn: _Conn, req: dict):
+        if self.ensemble and self.role != "leader":
+            raise NotLeaderError("member %d is not the leader" % self.my_id)
         sid = req.get("session_id")
         if sid:
             sess = self.tree.sessions.get(sid)
@@ -357,6 +443,264 @@ class CoordServer:
             return tree.multi(ops, session_id=conn.session.id)
         raise CoordError("unknown op: %r" % op)
 
+    # ---- ensemble: leader side ----
+
+    def _op_sync_status(self) -> dict:
+        return {"role": self.role, "seq": self._seq, "id": self.my_id,
+                "leader": ("%s:%d" % self.leader_addr
+                           if self.leader_addr else None)}
+
+    def _op_sync_hello(self, conn: _Conn, req: dict) -> dict:
+        if self.role != "leader":
+            raise NotLeaderError("member %d is not the leader" % self.my_id)
+        fid = req.get("id")
+        # dedupe by member id: a resyncing follower's stale half-dead
+        # connection must not keep counting toward quorum
+        for old in list(self._follower_conns):
+            if old.follower_id == fid and old is not conn:
+                self._follower_conns.discard(old)
+                old.sever()
+        conn.is_follower = True
+        conn.follower_id = fid
+        self._follower_conns.add(conn)
+        log.info("follower %s joined (seq %d)", fid, self._seq)
+        snap = self.tree.to_snapshot()
+        return {"seq": self._seq, "snapshot": snap}
+
+    def _quorum_needed(self) -> int | None:
+        """Members (incl. self) that must hold a write, or None when no
+        quorum applies (standalone, or a 2-member ensemble — which has
+        no safe quorum smaller than itself; there we prioritize
+        availability and document the tradeoff)."""
+        if not self.ensemble or len(self.ensemble) < 3:
+            return None
+        return len(self.ensemble) // 2 + 1
+
+    def _check_quorum(self) -> None:
+        """Cheap pre-check: refuse mutations outright when not even a
+        majority of followers is connected."""
+        need = self._quorum_needed()
+        if need is not None and 1 + len(self._follower_conns) < need:
+            raise CoordError(
+                "no quorum: %d of %d ensemble members connected"
+                % (1 + len(self._follower_conns), len(self.ensemble)))
+
+    def _check_commit_quorum(self, acks: int) -> None:
+        """Post-replication check: an acked client write must exist on a
+        majority, or a partitioned minority leader could acknowledge
+        writes the eventual winner never saw.  The op is already applied
+        locally; refusing here makes the failure AMBIGUOUS to the client
+        (as in ZooKeeper connection loss) rather than silently lossy."""
+        need = self._quorum_needed()
+        if need is not None and 1 + acks < need:
+            raise CoordError(
+                "no quorum: write replicated to %d of %d members "
+                "(uncommitted; retry may see it applied)"
+                % (1 + acks, len(self.ensemble)))
+
+    async def _replicate(self) -> int:
+        """Ship the persistent tree at the current seq to every follower
+        and await acks; a follower that cannot ack within the timeout is
+        severed (it will resync with a fresh sync_hello).  Returns the
+        number of followers that acked."""
+        if not self._follower_conns:
+            return 0
+        seq = self._seq
+        snap = self.tree.to_snapshot()
+        msg = {"sync": {"seq": seq, "snapshot": snap}}
+        loop = asyncio.get_running_loop()
+        waiters: list[tuple[_Conn, asyncio.Future]] = []
+        for f in list(self._follower_conns):
+            fut = loop.create_future()
+            f.ack_waiters[seq] = fut
+            f.push(msg)
+            waiters.append((f, fut))
+        await asyncio.wait([w[1] for w in waiters], timeout=1.0)
+        acks = 0
+        for f, fut in waiters:
+            if fut.done() and not fut.cancelled():
+                acks += 1
+            else:
+                f.ack_waiters.pop(seq, None)
+                log.warning("follower not acking seq %d; severing", seq)
+                self._follower_conns.discard(f)
+                f.sever()
+        return acks
+
+    async def _leader_probe_loop(self) -> None:
+        """Leader heartbeat to followers + dual-leader resolution after a
+        partition heal: the leader with (higher seq, then lower id) wins;
+        the other steps down."""
+        interval = max(self.tick * 2, 0.5)
+        while not self._stopping and self.role == "leader":
+            await asyncio.sleep(interval)
+            for f in list(self._follower_conns):
+                f.push({"sync_ping": {"seq": self._seq}})
+            for idx, addr in enumerate(self.ensemble):
+                if idx == self.my_id:
+                    continue
+                st = await self._probe(addr)
+                if st and st.get("role") == "leader":
+                    if (st.get("seq", 0) > self._seq
+                            or (st.get("seq", 0) == self._seq
+                                and idx < self.my_id)):
+                        self._step_down("dual leader: member %d seq %s wins"
+                                        % (idx, st.get("seq")))
+                        break
+
+    def _become_leader(self) -> None:
+        log.warning("promoting to ensemble leader (id %d, seq %d)",
+                    self.my_id, self._seq)
+        self.role = "leader"
+        self.leader_addr = self.ensemble[self.my_id]
+        if self._probe_task is None or self._probe_task.done():
+            self._probe_task = asyncio.ensure_future(
+                self._leader_probe_loop())
+
+    def _step_down(self, why: str) -> None:
+        log.warning("stepping down from leader: %s", why)
+        self.role = "follower"
+        self.leader_addr = None
+        # sessions (and their ephemerals) die with leadership: clients
+        # observe expiry and re-register on the winning leader
+        for sid in list(self.tree.sessions):
+            self.tree.expire_session(sid)
+        self.tree.sessions.clear()
+        self._session_conns.clear()
+        self._follower_conns.clear()
+        for conn in list(self._conns):
+            conn.sever()
+        if self._follow_task is None or self._follow_task.done():
+            self._follow_task = asyncio.ensure_future(self._follow_loop())
+
+    # ---- ensemble: follower side ----
+
+    async def _probe(self, addr: tuple[str, int]) -> dict | None:
+        """One-shot sync_status request to another member; None if it
+        does not answer promptly."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr[0], addr[1]), 0.4)
+        except (OSError, asyncio.TimeoutError):
+            return None
+        try:
+            writer.write(b'{"op":"sync_status","xid":0}\n')
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 0.5)
+            msg = json.loads(line)
+            return msg.get("result")
+        except (OSError, ValueError, asyncio.TimeoutError, ConnectionError):
+            return None
+        finally:
+            try:
+                writer.close()
+            except RuntimeError:
+                pass
+
+    async def _follow_loop(self) -> None:
+        """Find and follow the leader; promote when no reachable member
+        outranks us for promote_grace.  Rank is (seq, then lowest id):
+        a member with a newer persisted tree must win the cold-start
+        election or its committed writes would be rolled back; among
+        equals the lowest id wins.  A reachable outranking non-leader
+        resets the clock — it is deciding too and will promote."""
+        interval = max(self.tick, 0.2)
+        unranked_since: float | None = None
+        while not self._stopping and self.role != "leader":
+            leader: tuple[str, int] | None = None
+            outranked = False
+            for idx, addr in enumerate(self.ensemble):
+                if idx == self.my_id:
+                    continue
+                st = await self._probe(addr)
+                if st is None:
+                    continue
+                if st.get("role") == "leader":
+                    leader = addr
+                    break
+                peer_seq = int(st.get("seq", 0))
+                if peer_seq > self._seq or \
+                        (peer_seq == self._seq and idx < self.my_id):
+                    outranked = True
+            if leader is not None:
+                unranked_since = None
+                try:
+                    await self._follow(leader)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.info("follow of %s:%d ended: %s",
+                             leader[0], leader[1], e)
+                # fall through to the sleep: a fast-failing follow must
+                # not busy-loop full-snapshot resyncs against the leader
+            elif outranked:
+                unranked_since = None
+            else:
+                now = time.monotonic()
+                if unranked_since is None:
+                    unranked_since = now
+                elif now - unranked_since >= self.promote_grace:
+                    self._become_leader()
+                    return
+            await asyncio.sleep(interval)
+
+    async def _follow(self, addr: tuple[str, int]) -> None:
+        """Stream snapshots from the leader until the connection dies or
+        we are no longer a follower."""
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr[0], addr[1], limit=MAX_LINE), 1.0)
+        try:
+            writer.write((json.dumps(
+                {"op": "sync_hello", "xid": 0,
+                 "id": self.my_id, "seq": self._seq}) + "\n").encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 2.0)
+            msg = json.loads(line)
+            if not msg.get("ok"):
+                raise CoordError("sync_hello refused: %s" % msg.get("msg"))
+            res = msg["result"]
+            # the full resync is authoritative: adopt the leader's tree
+            # even if our (possibly debounce-lost or divergent) seq is
+            # higher, or we would livelock re-resyncing forever
+            self._apply_sync(int(res["seq"]), res["snapshot"], force=True)
+            self.leader_addr = addr
+            log.info("following leader %s:%d (seq %d)",
+                     addr[0], addr[1], self._seq)
+            # leader pings every probe interval; silence means it is
+            # gone (or wedged) and we must re-elect
+            idle = max(2.0, 6 * self.tick)
+            while not self._stopping and self.role == "follower":
+                line = await asyncio.wait_for(reader.readline(), idle)
+                if not line:
+                    break
+                msg = json.loads(line)
+                if "sync" in msg:
+                    s = msg["sync"]
+                    self._apply_sync(int(s["seq"]), s["snapshot"])
+                    writer.write((json.dumps(
+                        {"op": "sync_ack", "seq": s["seq"]}) + "\n").encode())
+                    await writer.drain()
+                elif "sync_ping" in msg:
+                    if int(msg["sync_ping"].get("seq", -1)) != self._seq:
+                        break   # drifted; resync with a fresh sync_hello
+        finally:
+            self.leader_addr = None
+            try:
+                writer.close()
+            except RuntimeError:
+                pass
+
+    def _apply_sync(self, seq: int, snap: dict, *,
+                    force: bool = False) -> None:
+        if seq < self._seq and not force:
+            return
+        tree = model.ZNodeTree.from_snapshot(snap)
+        self.tree = tree
+        self._seq = seq
+        if self.data_dir:
+            tree.on_mutate = self._mark_dirty
+            self._mark_dirty()
+
 
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="manatee coordination daemon")
@@ -366,13 +710,29 @@ def main(argv: list[str] | None = None) -> None:
                    help="persist the tree here (survives restarts)")
     p.add_argument("--tick", type=float, default=0.25,
                    help="session-expiry scan interval (seconds)")
+    p.add_argument("--ensemble", default=None,
+                   help="full member list 'h1:p1,h2:p2,...' incl. this "
+                        "server (replicated mode)")
+    p.add_argument("--ensemble-id", type=int, default=0,
+                   help="this server's index into --ensemble")
+    p.add_argument("--promote-grace", type=float, default=2.0,
+                   help="seconds of lower-member unreachability before a "
+                        "follower promotes itself")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
     setup_logging("manatee-coordd", args.verbose)
 
+    ensemble = None
+    if args.ensemble:
+        from manatee_tpu.coord.client import parse_connstr
+        ensemble = parse_connstr(args.ensemble)
+
     async def run():
         server = CoordServer(args.host, args.port, tick=args.tick,
-                             data_dir=args.data_dir)
+                             data_dir=args.data_dir,
+                             ensemble=ensemble,
+                             ensemble_id=args.ensemble_id,
+                             promote_grace=args.promote_grace)
         await server.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

@@ -58,9 +58,9 @@ class Peer:
         self.idx = idx
         self.name = "peer%d" % idx
         self.root = cluster.root / self.name
-        # 4 ports per peer from the cluster's reserved block:
-        # pg, status (= pg+1), backup, zfs
-        base = cluster.port_base + 1 + 4 * (idx - 1)
+        # 4 ports per peer from the cluster's reserved block (after the
+        # coord members' ports): pg, status (= pg+1), backup, zfs
+        base = cluster.port_base + cluster.n_coord + 4 * (idx - 1)
         self.pg_port = base
         self.status_port = base + 1
         self.backup_port = base + 2
@@ -97,8 +97,7 @@ class Peer:
             "shardPath": self.cluster.shard_path,
             "zfsHost": self.ip,
             "zfsPort": self.zfs_port,
-            "coordCfg": {"host": "127.0.0.1",
-                         "port": self.cluster.coord_port,
+            "coordCfg": {"connStr": self.cluster.coord_connstr,
                          "sessionTimeout": self.cluster.session_timeout},
             "opsTimeout": 10,
             "healthChkInterval": 0.3,
@@ -174,42 +173,100 @@ class Peer:
 class ClusterHarness:
     def __init__(self, root: Path, *, n_peers: int = 3,
                  session_timeout: float = 2.0, singleton: bool = False,
-                 shard: str = "1"):
+                 shard: str = "1", n_coord: int = 1,
+                 coord_promote_grace: float = 1.0):
+        """*n_coord* > 1 runs a replicated coordd ensemble; peers get the
+        full connStr and rotate to the live leader (zkCfg.connStr
+        parity)."""
         self.root = Path(root)
         self.shard_path = "/manatee/%s" % shard
         self.session_timeout = session_timeout
         self.singleton = singleton
-        # one block for everything: coord + 4 ports per peer
-        self.port_base = alloc_port_block(1 + 4 * n_peers)
-        self.coord_port = self.port_base
-        self.coord_proc: subprocess.Popen | None = None
+        self.n_coord = n_coord
+        self.coord_promote_grace = coord_promote_grace
+        # one block for everything: coord members + 4 ports per peer
+        self.port_base = alloc_port_block(n_coord + 4 * n_peers)
+        self.coord_ports = [self.port_base + i for i in range(n_coord)]
+        self.coord_port = self.coord_ports[0]
+        self.coord_procs: list[subprocess.Popen | None] = [None] * n_coord
         self.peers = [Peer(self, i + 1) for i in range(n_peers)]
+
+    @property
+    def coord_connstr(self) -> str:
+        return ",".join("127.0.0.1:%d" % p for p in self.coord_ports)
 
     # -- lifecycle --
 
-    def start_coordd(self) -> None:
+    def start_coordd(self, idx: int | None = None) -> None:
         env = dict(os.environ, PYTHONPATH=str(REPO))
-        logf = open(self.root / "coordd.log", "ab")
-        self.coord_proc = subprocess.Popen(
-            [sys.executable, "-m", "manatee_tpu.coord.server",
-             "--port", str(self.coord_port),
-             "--data-dir", str(self.root / "coord-data"),
-             "--tick", "0.1"],
-            stdout=logf, stderr=logf, env=env, start_new_session=True)
+        which = range(self.n_coord) if idx is None else [idx]
+        for i in which:
+            logf = open(self.root / ("coordd%d.log" % i), "ab")
+            argv = [sys.executable, "-m", "manatee_tpu.coord.server",
+                    "--port", str(self.coord_ports[i]),
+                    "--data-dir", str(self.root / ("coord-data%d" % i)),
+                    "--tick", "0.1"]
+            if self.n_coord > 1:
+                argv += ["--ensemble", self.coord_connstr,
+                         "--ensemble-id", str(i),
+                         "--promote-grace", str(self.coord_promote_grace)]
+            self.coord_procs[i] = subprocess.Popen(
+                argv, stdout=logf, stderr=logf, env=env,
+                start_new_session=True)
 
-    def kill_coordd(self) -> None:
-        if self.coord_proc and self.coord_proc.poll() is None:
-            try:
-                os.killpg(self.coord_proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            self.coord_proc.wait(timeout=5)
-        self.coord_proc = None
+    def kill_coordd(self, idx: int | None = None) -> None:
+        which = range(self.n_coord) if idx is None else [idx]
+        for i in which:
+            proc = self.coord_procs[i]
+            if proc and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait(timeout=5)
+            self.coord_procs[i] = None
+
+    # legacy single-server attribute for existing tests
+    @property
+    def coord_proc(self):
+        return self.coord_procs[0]
+
+    async def coord_leader_idx(self, timeout: float = 15.0) -> int:
+        """Index of the ensemble member currently acting as leader."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for i, port in enumerate(self.coord_ports):
+                if self.coord_procs[i] is None:
+                    continue
+                st = await self._sync_status(port)
+                if st and st.get("role") == "leader":
+                    return i
+            await asyncio.sleep(0.1)
+        raise AssertionError("no coordd leader emerged")
+
+    async def _sync_status(self, port: int) -> dict | None:
+        try:
+            r, w = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 0.5)
+        except (OSError, asyncio.TimeoutError):
+            return None
+        try:
+            w.write(b'{"op":"sync_status","xid":0}\n')
+            await w.drain()
+            line = await asyncio.wait_for(r.readline(), 0.5)
+            return json.loads(line).get("result")
+        except (OSError, ValueError, asyncio.TimeoutError):
+            return None
+        finally:
+            w.close()
 
     async def start(self, *, peers: list[int] | None = None,
                     stagger: float = 0.3) -> None:
         self.start_coordd()
-        await self._wait_port(self.coord_port)
+        for port in self.coord_ports:
+            await self._wait_port(port)
+        if self.n_coord > 1:
+            await self.coord_leader_idx()   # wait for election
         which = peers if peers is not None else range(len(self.peers))
         for i in which:
             await self.peers[i].write_configs()
@@ -235,7 +292,7 @@ class ClusterHarness:
     # -- cluster state inspection --
 
     async def coord_client(self) -> NetCoord:
-        c = NetCoord("127.0.0.1", self.coord_port, session_timeout=30)
+        c = NetCoord(self.coord_connstr, session_timeout=30)
         await c.connect()
         return c
 

@@ -1,0 +1,262 @@
+"""coordd ensemble (replicated coordination service) tests.
+
+The reference assumes a replicated ZooKeeper ensemble behind
+zkCfg.connStr (/root/reference/etc/sitter.json); these tests drive the
+rebuild's coordd ensemble: leader election, snapshot replication,
+follower redirect of clients, leader failover with client re-session,
+leader stickiness on rejoin, and the mutation quorum.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from manatee_tpu.coord.api import CoordError, NotLeaderError
+from manatee_tpu.coord.client import NetCoord, parse_connstr
+from manatee_tpu.coord.server import CoordServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def start_ensemble(n=3, *, grace=0.3, tick=0.05, data_dirs=None):
+    ports = free_ports(n)
+    members = [("127.0.0.1", p) for p in ports]
+    servers = []
+    for i in range(n):
+        s = CoordServer("127.0.0.1", ports[i], tick=tick,
+                        ensemble=members, ensemble_id=i,
+                        promote_grace=grace,
+                        data_dir=data_dirs[i] if data_dirs else None)
+        await s.start()
+        servers.append(s)
+    return servers, members
+
+
+async def wait_for(pred, timeout=5.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def wait_leader_with_quorum(server, n_followers, timeout=8.0):
+    """Writes are refused until a majority of followers attach, so
+    tests (like real clients) wait for the quorum to form."""
+    return await wait_for(
+        lambda: server.role == "leader"
+        and len(server._follower_conns) >= n_followers, timeout)
+
+
+def connstr(members):
+    return ",".join("%s:%d" % m for m in members)
+
+
+def test_parse_connstr():
+    assert parse_connstr("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert parse_connstr("a") == [("a", 2281)]
+    assert parse_connstr(" a:1 , b ") == [("a", 1), ("b", 2281)]
+    with pytest.raises(ValueError):
+        parse_connstr("")
+
+
+def test_ensemble_elects_lowest_and_replicates():
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            assert servers[1].role == "follower"
+            assert servers[2].role == "follower"
+
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/state", b"gen0")
+            await c.set("/state", b"gen1", 0)
+            await c.create("/eph", b"e", ephemeral=True)
+            await c.close()
+
+            # persistent data replicated to both followers; ephemeral not
+            def replicated(s):
+                st = s.tree.exists("/state")
+                return st is not None and st.version == 1 \
+                    and s.tree.exists("/eph") is None
+            assert await wait_for(lambda: replicated(servers[1]))
+            assert await wait_for(lambda: replicated(servers[2]))
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_follower_redirects_client_to_leader():
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            # connstr listing ONLY followers: the hint must carry the
+            # client to the leader anyway
+            c = NetCoord(connstr(members[1:]), session_timeout=5)
+            await c.connect()
+            assert (c.host, c.port) == members[0]
+            await c.create("/via-redirect", b"x")
+            await c.close()
+            # direct hello at a follower is refused with the hint
+            r, w = await asyncio.open_connection(*members[1])
+            w.write(b'{"op":"hello","xid":1,"session_timeout":5}\n')
+            await w.drain()
+            import json
+            msg = json.loads(await r.readline())
+            assert msg["error"] == "NotLeaderError"
+            assert msg["leader"] == "%s:%d" % members[0]
+            w.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_leader_failover_preserves_state_and_allows_writes():
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/st", b"v0")
+            await c.create("/el", b"")
+            await c.create("/el/p-", b"d", ephemeral=True, sequential=True)
+            await c.close()
+
+            await servers[0].stop()   # leader dies
+            assert await wait_leader_with_quorum(servers[1], 1)
+
+            c2 = NetCoord(connstr(members), session_timeout=5)
+            await c2.connect()
+            assert (c2.host, c2.port) == members[1]
+            data, version = await c2.get("/st")
+            assert (data, version) == (b"v0", 0)
+            # the dead client's ephemeral did not survive failover —
+            # clients re-register, exactly like a coordd restart
+            assert await c2.get_children("/el") == []
+            # CAS writes proceed on the new leader
+            assert await c2.set("/st", b"v1", 0) == 1
+            await c2.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_returning_member_joins_incumbent_not_reclaims():
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            await servers[0].stop()
+            assert await wait_leader_with_quorum(servers[1], 1)
+            c = NetCoord(connstr(members[1:]), session_timeout=5)
+            await c.connect()
+            await c.create("/after-failover", b"y")
+            await c.close()
+
+            # member 0 comes back: must follow the incumbent, and catch
+            # up on the state written while it was away
+            s0 = CoordServer("127.0.0.1", members[0][1], tick=0.05,
+                             ensemble=members, ensemble_id=0,
+                             promote_grace=0.3)
+            await s0.start()
+            try:
+                assert await wait_for(
+                    lambda: s0.leader_addr == members[1], timeout=8)
+                assert s0.role == "follower"
+                assert await wait_for(
+                    lambda: s0.tree.exists("/after-failover") is not None)
+                # and it stays a follower (stickiness) well past grace
+                await asyncio.sleep(0.8)
+                assert s0.role == "follower"
+                assert servers[1].role == "leader"
+            finally:
+                await s0.stop()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_cold_start_elects_highest_seq_not_lowest_id(tmp_path):
+    """After a whole-ensemble crash, the member with the newest
+    persisted tree (highest seq) must win the election even if it has a
+    higher id — otherwise its committed writes would be rolled back by
+    an older lowest-id member."""
+    async def go():
+        import json as _json
+        dirs = [tmp_path / ("d%d" % i) for i in range(3)]
+        for d in dirs:
+            d.mkdir()
+        # member 2 crashed with a NEWER tree than members 0/1
+        from manatee_tpu.coord.model import ZNodeTree
+        old = ZNodeTree()
+        old.create("/st", b"old")
+        new = ZNodeTree()
+        new.create("/st", b"new")
+        for i, (tree, seq) in enumerate([(old, 3), (old, 3), (new, 5)]):
+            snap = tree.to_snapshot()
+            snap["seq"] = seq
+            (dirs[i] / "coordd-tree.json").write_text(_json.dumps(snap))
+        servers, members = await start_ensemble(
+            data_dirs=[str(d) for d in dirs])
+        try:
+            assert await wait_for(
+                lambda: any(s.role == "leader" for s in servers), timeout=8)
+            leader = next(s for s in servers if s.role == "leader")
+            assert leader.my_id == 2
+            # the stale members resynced to the newer tree
+            assert await wait_for(
+                lambda: all(s.tree.get("/st")[0] == b"new" for s in servers))
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_no_quorum_refuses_mutations_allows_reads():
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/q", b"q0")
+
+            await servers[1].stop()
+            await servers[2].stop()
+            assert await wait_for(
+                lambda: len(servers[0]._follower_conns) == 0)
+
+            with pytest.raises(CoordError) as ei:
+                await c.set("/q", b"q1", 0)
+            assert "quorum" in str(ei.value)
+            assert not isinstance(ei.value, NotLeaderError)
+            # reads still served (ZK serves local reads too)
+            assert (await c.get("/q"))[0] == b"q0"
+            await c.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
