@@ -31,6 +31,9 @@ from manatee_tpu.state.types import role_of
 from manatee_tpu.utils import iso_ms as _now_iso
 
 PG_QUERY_TIMEOUT = 1.0     # lib/adm.js:2203-2205
+# failure-prediction score at/above this raises an informational notice
+from manatee_tpu.health.telemetry import \
+    WARN_THRESHOLD as HEALTH_WARN_THRESHOLD  # noqa: E402
 PROMOTE_EXPIRY_S = 30.0    # lib/adm.js:1925-1926
 DEFAULT_LAG_TO_IGNORE = 5.0
 
@@ -80,6 +83,7 @@ class PeerStatus:
     repl: dict | None = None          # downstream pg_stat_replication row
     lag: float | None = None          # replay lag seconds (standbys)
     online: bool = False
+    health_score: float | None = None  # failure-prediction score [0,1]
 
     def __post_init__(self):
         if not self.label:
@@ -88,13 +92,14 @@ class PeerStatus:
     def to_dict(self) -> dict:
         return {"ident": self.ident, "label": self.label,
                 "pgerr": self.pgerr, "repl": self.repl, "lag": self.lag,
-                "online": self.online}
+                "online": self.online, "health_score": self.health_score}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PeerStatus":
         return cls(ident=d["ident"], label=d.get("label", ""),
                    pgerr=d.get("pgerr"), repl=d.get("repl"),
-                   lag=d.get("lag"), online=d.get("online", False))
+                   lag=d.get("lag"), online=d.get("online", False),
+                   health_score=d.get("health_score"))
 
 
 class ClusterDetails:
@@ -120,6 +125,11 @@ class ClusterDetails:
             if self.frozen else None
         self.errors: list[str] = []
         self.warnings: list[str] = []
+        # informational only: failure-prediction notices never gate
+        # promote nor flip verify's exit code — a probabilistic score
+        # must not block the operator who is promoting AWAY from a
+        # degrading peer, nor page monitoring on a transient
+        self.notices: list[str] = []
         self._load_errors()
 
     # -- serialization (MANATEE_ADM_TEST_STATE hook) --
@@ -141,6 +151,16 @@ class ClusterDetails:
     # -- error derivation (loadErrors, lib/adm.js:875-927) --
 
     def _load_errors(self) -> None:
+        # failure-prediction early warnings apply in every topology
+        # (incl. singleton) — before any early return below
+        for ps in self.peers.values():
+            if ps.health_score is not None and \
+                    ps.health_score >= HEALTH_WARN_THRESHOLD:
+                self.notices.append(
+                    "peer \"%s\" failure-prediction score %.2f "
+                    "(degrading before hard health timeout)"
+                    % (ps.label, ps.health_score))
+
         p = self.peers[self.primary]
         if p.pgerr:
             self.errors.append(
@@ -414,6 +434,30 @@ class AdmClient:
         # the row describing this peer's DOWNSTREAM (first repl row)
         repl = st.get("replication") or []
         ps.repl = repl[0] if repl else None
+        ps.health_score = await self._fetch_health_score(peer)
+
+    @staticmethod
+    async def _fetch_health_score(peer: dict) -> float | None:
+        """The failure-prediction score lives in the sitter, not the
+        database: read it from the peer's status server (pgPort+1),
+        best-effort — an old/absent sitter simply shows no score."""
+        try:
+            _s, host, pg_port = parse_pg_url(peer.get("pgUrl") or "")
+        except PgError:
+            return None
+        try:
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=PG_QUERY_TIMEOUT)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.get("http://%s:%d/state"
+                                    % (host, pg_port + 1)) as resp:
+                    if resp.status != 200:
+                        return None
+                    body = await resp.json()
+            score = body.get("healthScore")
+            return float(score) if score is not None else None
+        except Exception:
+            return None
 
     @staticmethod
     def _engine_for(peer: dict):

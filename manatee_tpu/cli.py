@@ -43,6 +43,10 @@ ALL_COLUMNS = {
     "pg-flush": {"label": "FLUSH", "width": 13},
     "pg-replay": {"label": "REPLAY", "width": 13},
     "pg-lag":   {"label": "LAG", "width": 6},
+    # failure-prediction score from each sitter's telemetry window
+    # (manatee_tpu/health); "-" when the peer predates the model or the
+    # window has not filled yet
+    "pg-pred":  {"label": "PRED", "width": 5},
 }
 COLUMN_ALIASES = {"zonename": "peername", "zoneabbr": "peerabbr"}
 PEERS_DFL = ["role", "peername", "ip"]
@@ -72,6 +76,8 @@ def row_for_peer(role: str, peer) -> dict:
         "peername": str(peer.ident.get("zoneId", "?")),
         "ip": str(peer.ident.get("ip", "-")),
     }
+    score = getattr(peer, "health_score", None)
+    rv["pg-pred"] = "-" if score is None else "%.2f" % score
     if peer.pgerr is not None:
         rv.update({"pg-online": "fail", "pg-repl": "-", "pg-sent": "-",
                    "pg-write": "-", "pg-flush": "-", "pg-replay": "-",
@@ -124,12 +130,17 @@ def print_cluster_table(details: ClusterDetails, columns: list[dict], *,
 
 def print_cluster_issues(details: ClusterDetails, stream, *,
                          leading_nl: bool) -> None:
-    if leading_nl and (details.errors or details.warnings):
+    notices = getattr(details, "notices", [])
+    if leading_nl and (details.errors or details.warnings or notices):
         stream.write("\n")
     for e in details.errors:
         stream.write("error: %s\n" % e.split("\n")[0])
     for w in details.warnings:
         stream.write("warning: %s\n" % w.split("\n")[0])
+    # informational (failure prediction): shown, but never affects the
+    # verify exit contract or the promote warning gate
+    for n in notices:
+        stream.write("notice: %s\n" % n.split("\n")[0])
 
 
 # ---- command implementations ----

@@ -1,27 +1,42 @@
-"""Predictive health scoring (JAX).
+"""Predictive health scoring.
 
 The reference's failure detection is purely reactive: a 1 s
 ``select current_time`` probe with a 5 s timeout
 (lib/postgresMgr.js:1550-1646) and coordination-session expiry.  This
-optional subsystem adds a learned early-warning score over health-probe
-telemetry windows (latencies, timeout counts, replication lag) so
-operators can be alerted before a peer trips the hard thresholds.  It is
+subsystem adds a learned early-warning score over health-probe telemetry
+windows (latencies, timeout counts, replication lag, WAL stalls, flaps)
+so operators are alerted before a peer trips the hard thresholds.  It is
 the only numerical workload in this control plane and the target of the
 driver's accelerator entry points (__graft_entry__.py).
+
+Split: training/prediction in JAX (predictor.py, health.train);
+in-daemon collection + inference in numpy (telemetry.py).  The predictor
+exports below are LAZY so that importing the control plane (which uses
+only telemetry) never pays a JAX import.
 """
 
-from manatee_tpu.health.predictor import (
-    HealthModel,
-    init_params,
-    predict,
-    train_step,
-    make_mesh_train_step,
+_PREDICTOR_EXPORTS = {
+    "HealthModel", "init_params", "predict", "train_step",
+    "make_mesh_train_step", "synthetic_batch",
+}
+
+__all__ = sorted(_PREDICTOR_EXPORTS | {
+    "TelemetryRing", "NumpyScorer", "normalize_tick",
+    "N_FEATURES", "WINDOW", "WARN_THRESHOLD",
+})
+
+from manatee_tpu.health.telemetry import (  # noqa: E402
+    N_FEATURES,
+    WINDOW,
+    WARN_THRESHOLD,
+    NumpyScorer,
+    TelemetryRing,
+    normalize_tick,
 )
 
-__all__ = [
-    "HealthModel",
-    "init_params",
-    "predict",
-    "train_step",
-    "make_mesh_train_step",
-]
+
+def __getattr__(name: str):
+    if name in _PREDICTOR_EXPORTS:
+        from manatee_tpu.health import predictor
+        return getattr(predictor, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

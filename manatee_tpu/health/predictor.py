@@ -2,11 +2,14 @@
 
 A small MLP scoring a window of health-probe telemetry per peer:
 features per probe tick are [latency_ms, timed_out, replication_lag_s,
-wal_rate, reconnects]; a window of W ticks is scored to a failure
-probability.  Everything is jittable, static-shaped, and batched so it
-maps onto accelerator matrix units; the training step is data-parallel
-over a ``jax.sharding.Mesh`` with replicated parameters and sharded
-batches (gradient psum inserted by the partitioner).
+wal_stall, reconnects] as produced by telemetry.normalize_tick (note
+wal_stall polarity: 1.0 = WAL stalled while lag accumulates = BAD);
+a window of W ticks is scored to a failure probability.  Everything is
+jittable, static-shaped, and batched so it maps onto accelerator matrix
+units; the training step is data-parallel over a ``jax.sharding.Mesh``
+with replicated parameters and sharded batches (gradient psum inserted
+by the partitioner).  Inference inside the daemons is numpy
+(telemetry.NumpyScorer) over weights exported by health.train.
 
 This is deliberately small: the control plane's job is HA PostgreSQL,
 and this model augments (never replaces) the reference's reactive
@@ -21,8 +24,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-N_FEATURES = 5     # latency_ms, timed_out, lag_s, wal_rate, reconnects
-WINDOW = 16        # probe ticks per scoring window
+from manatee_tpu.health.telemetry import N_FEATURES, WINDOW
+
 HIDDEN = 32
 
 
@@ -107,10 +110,34 @@ def make_mesh_train_step(mesh: jax.sharding.Mesh):
 
 def synthetic_batch(key: jax.Array, batch: int
                     ) -> tuple[jax.Array, jax.Array]:
-    """Plausible telemetry: failing peers show rising latency/timeouts."""
-    k1, k2 = jax.random.split(key)
-    base = jax.random.uniform(k1, (batch, WINDOW, N_FEATURES))
+    """Training data in the REAL normalized feature space produced by
+    telemetry.TelemetryRing (features: latency, timed_out, lag, wal
+    stall, reconnect flaps, each ~[0,1] — see telemetry.normalize_tick).
+
+    Healthy peers: small latencies (a few ms..tens of ms), no timeouts,
+    near-zero lag, no stall, no flaps.  Degrading peers: latency and lag
+    ramp across the window, timeouts and WAL stalls appear with rising
+    probability, occasional flaps — the signature of a database heading
+    for its hard healthChkTimeout.
+    """
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
     labels = (jax.random.uniform(k2, (batch,)) > 0.5).astype(jnp.float32)
-    trend = jnp.linspace(0.0, 1.0, WINDOW)[None, :, None]
-    windows = base + labels[:, None, None] * trend * 2.0
+    lab = labels[:, None]
+    trend = jnp.linspace(0.0, 1.0, WINDOW)[None, :]           # [1, W]
+    noise = jax.random.uniform(k1, (batch, WINDOW, N_FEATURES))
+
+    latency = 0.005 + 0.03 * noise[..., 0] \
+        + lab * trend * (0.3 + 0.7 * jax.random.uniform(k3, (batch, 1)))
+    p_timeout = lab * trend * 0.6
+    timed_out = (noise[..., 1] < p_timeout).astype(jnp.float32)
+    lag = 0.01 * noise[..., 2] \
+        + lab * trend * (0.4 + 0.6 * jax.random.uniform(k4, (batch, 1)))
+    stall = (noise[..., 3] < lab * trend * 0.5).astype(jnp.float32)
+    flaps = jnp.minimum(
+        lab * trend * jax.random.uniform(k5, (batch, 1)) * 0.8
+        + 0.02 * noise[..., 4], 1.0)
+
+    windows = jnp.stack(
+        [jnp.clip(latency, 0.0, 1.0), timed_out,
+         jnp.clip(lag, 0.0, 1.0), stall, flaps], axis=-1)
     return windows, labels

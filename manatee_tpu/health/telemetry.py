@@ -1,0 +1,135 @@
+"""Health-probe telemetry collection and in-daemon scoring.
+
+The accelerator side (predictor.py) trains the failure-prediction MLP in
+JAX; the control plane must not pay a JAX import (seconds of startup and
+hundreds of MB per sitter) to score one 16x5 window per second, so
+inference here is a pure-numpy forward pass over exported weights — the
+standard train-on-accelerator / deploy-to-edge split.
+
+Feature vector per probe tick (normalized to ~[0, 1]):
+
+  latency_ms  probe round-trip, /1000 clipped at 1 (1s+ latency == 1.0)
+  timed_out   1.0 if the probe timed out / failed outright
+  lag_s       standby replay lag, /10 clipped (10s+ lag == 1.0)
+  wal_stall   1 - wal_advance: 1.0 when the WAL made no progress this
+              tick while connected to an upstream (stalled replication),
+              0.0 for a healthy advancing WAL (primaries with no write
+              load report 0 — idle is not stall; see add())
+  reconnects  healthy<->unhealthy flaps in the window, /4 clipped
+
+The reference's reactive semantics (lib/postgresMgr.js:1550-1646: probe
+every healthChkInterval, declare unhealthy on healthChkTimeout) are kept
+verbatim in PostgresMgr; this model only ADDS an early-warning score
+surfaced via GET /state and `manatee-adm pg-status` warnings.
+"""
+
+from __future__ import annotations
+
+import collections
+from pathlib import Path
+
+import numpy as np
+
+# Model geometry lives HERE (the JAX-free module): predictor.py imports
+# these, never the other way around, so daemons and operator tooling can
+# collect/score telemetry without paying a JAX import.
+N_FEATURES = 5     # latency_ms, timed_out, lag_s, wal_stall, reconnects
+WINDOW = 16        # probe ticks per scoring window
+
+DEFAULT_WEIGHTS = Path(__file__).parent / "weights.npz"
+WARN_THRESHOLD = 0.8
+
+
+def normalize_tick(*, latency_ms: float, timed_out: bool, lag_s: float,
+                   wal_stalled: bool, reconnects: int) -> list[float]:
+    return [
+        min(max(latency_ms, 0.0) / 1000.0, 1.0),
+        1.0 if timed_out else 0.0,
+        min(max(lag_s, 0.0) / 10.0, 1.0),
+        1.0 if wal_stalled else 0.0,
+        min(max(reconnects, 0) / 4.0, 1.0),
+    ]
+
+
+class TelemetryRing:
+    """Last-WINDOW probe ticks for one database, oldest first."""
+
+    def __init__(self, window: int = WINDOW):
+        self.window = window
+        self._ticks: collections.deque[list[float]] = \
+            collections.deque(maxlen=window)
+        self._flaps: collections.deque[int] = collections.deque(maxlen=window)
+        self._last_wal: int | None = None
+        self._last_ok: bool | None = None
+
+    def add(self, *, latency_ms: float, timed_out: bool,
+            lag_s: float | None, wal_lsn: int | None,
+            in_recovery: bool) -> None:
+        ok = not timed_out
+        flap = 1 if (self._last_ok is not None
+                     and ok != self._last_ok) else 0
+        self._last_ok = ok
+        self._flaps.append(flap)
+        # WAL stall: a standby whose WAL is not advancing WHILE lag is
+        # accumulating (pending or severed replication).  A quiescent
+        # cluster's static WAL with zero lag is idle, not stalled.
+        stalled = bool(in_recovery and wal_lsn is not None
+                       and self._last_wal is not None
+                       and wal_lsn <= self._last_wal
+                       and (lag_s or 0.0) > 1.0)
+        if wal_lsn is not None:
+            self._last_wal = wal_lsn
+        self._ticks.append(normalize_tick(
+            latency_ms=latency_ms, timed_out=timed_out,
+            lag_s=lag_s or 0.0, wal_stalled=stalled,
+            reconnects=sum(self._flaps)))
+
+    def ready(self) -> bool:
+        return len(self._ticks) >= self.window // 2
+
+    def window_array(self) -> np.ndarray:
+        """[WINDOW, N_FEATURES], zero-padded at the OLD end."""
+        out = np.zeros((self.window, N_FEATURES), np.float32)
+        ticks = list(self._ticks)
+        if ticks:
+            out[-len(ticks):] = np.asarray(ticks, np.float32)
+        return out
+
+    def last_tick(self) -> list[float] | None:
+        return list(self._ticks[-1]) if self._ticks else None
+
+
+class NumpyScorer:
+    """Forward pass of predictor.HealthModel in numpy.
+
+    Weights come from an .npz exported by
+    ``python -m manatee_tpu.health.train`` (keys w1,b1,w2,b2,w3,b3).
+    Missing/corrupt weights disable scoring (score() -> None) rather
+    than degrading the control plane.
+    """
+
+    def __init__(self, weights_path: str | Path | None = None):
+        path = Path(weights_path or DEFAULT_WEIGHTS)
+        self._params: dict[str, np.ndarray] | None = None
+        try:
+            with np.load(path) as z:
+                self._params = {k: z[k].astype(np.float32)
+                                for k in ("w1", "b1", "w2", "b2",
+                                          "w3", "b3")}
+        except (OSError, KeyError, ValueError):
+            self._params = None
+
+    @property
+    def available(self) -> bool:
+        return self._params is not None
+
+    def score(self, window: np.ndarray) -> float | None:
+        """Failure probability for one [WINDOW, N_FEATURES] window."""
+        p = self._params
+        if p is None:
+            return None
+        x = window.reshape(1, -1).astype(np.float32)
+        h = np.maximum(x @ p["w1"] + p["b1"], 0.0)
+        h = np.maximum(h @ p["w2"] + p["b2"], 0.0)
+        logit = float((h @ p["w3"] + p["b3"])[0, 0])
+        return 1.0 / (1.0 + np.exp(-logit))

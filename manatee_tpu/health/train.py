@@ -1,0 +1,89 @@
+"""Train the failure-prediction model and export deployable weights.
+
+    python -m manatee_tpu.health.train [-o weights.npz] [--steps N]
+
+Training runs in JAX (data-parallel over every visible device via
+make_mesh_train_step — the accelerator path the driver dry-runs);
+the result is exported as a plain .npz that telemetry.NumpyScorer
+loads inside the sitter daemons without importing JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def train(steps: int = 300, batch: int = 256, lr: float = 5e-2, seed: int = 0):
+    import jax
+
+    from manatee_tpu.health.predictor import (
+        init_params,
+        make_mesh_train_step,
+        predict,
+        synthetic_batch,
+        train_step,
+    )
+
+    params = init_params(jax.random.PRNGKey(seed))
+    devices = jax.devices()
+    mesh = None
+    if len(devices) > 1:
+        from jax.sharding import Mesh
+        # the data axis must divide the batch or device_put rejects the
+        # sharding; use the largest device count that does
+        usable = max(d for d in range(1, len(devices) + 1)
+                     if batch % d == 0)
+        if usable > 1:
+            mesh = Mesh(np.array(devices[:usable]), axis_names=("data",))
+
+    key = jax.random.PRNGKey(seed + 1)
+    if mesh is not None:
+        with mesh:
+            step, data_sharding, repl = make_mesh_train_step(mesh)
+            params = jax.device_put(params, repl)
+            for i in range(steps):
+                key, sub = jax.random.split(key)
+                w, y = synthetic_batch(sub, batch)
+                w = jax.device_put(w, data_sharding)
+                y = jax.device_put(y, data_sharding)
+                params, loss = step(params, w, y, lr)
+    else:
+        for i in range(steps):
+            key, sub = jax.random.split(key)
+            w, y = synthetic_batch(sub, batch)
+            params, loss = train_step(params, w, y, lr)
+
+    # held-out accuracy
+    w, y = synthetic_batch(jax.random.PRNGKey(seed + 999), 2048)
+    acc = float(((predict(params, w) > 0.5) == (y > 0.5)).mean())
+    return params, float(loss), acc
+
+
+def export(params, path: str) -> None:
+    np.savez(path, **{k: np.asarray(v)
+                      for k, v in params._asdict().items()})
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-o", "--out", default=None,
+                   help="output .npz (default: packaged weights path)")
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=256)
+    args = p.parse_args(argv)
+
+    out = args.out
+    if out is None:
+        from manatee_tpu.health.telemetry import DEFAULT_WEIGHTS
+        out = str(DEFAULT_WEIGHTS)
+
+    params, loss, acc = train(steps=args.steps, batch=args.batch)
+    export(params, out)
+    print("trained %d steps: loss %.4f, held-out acc %.3f -> %s"
+          % (args.steps, loss, acc, out))
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,11 @@ class PostgresMgr:
         self._closed = False
         self._log_fh = None
 
+        from manatee_tpu.health.telemetry import NumpyScorer, TelemetryRing
+        self.telemetry = TelemetryRing()
+        self._scorer = NumpyScorer(self.cfg.get("healthModelWeights"))
+        self.health_score: float | None = None
+
     # ---- events ----
 
     def on(self, event: str, cb: Callable) -> None:
@@ -141,6 +146,8 @@ class PostgresMgr:
             "setup": self.engine.is_initialized(self.datadir),
             "role": (self._applied or {}).get("role"),
             "lastXlog": self._last_xlog,
+            "healthScore": self.health_score,
+            "healthTelemetry": self.telemetry.last_tick(),
         }
 
     # ---- queries ----
@@ -442,7 +449,13 @@ class PostgresMgr:
     # -- health --
 
     async def _health_loop(self) -> None:
-        """(lib/postgresMgr.js:1550-1646)"""
+        """Reactive semantics verbatim from the reference
+        (lib/postgresMgr.js:1550-1646): probe every healthChkInterval,
+        declare unhealthy when the probe fails/times out.  On top, each
+        tick feeds the telemetry ring (latency, timeout, lag, WAL
+        stall, flaps) and the failure-prediction score is refreshed —
+        an early-warning signal exposed at GET /state and by
+        `manatee-adm pg-status` long before the hard timeout trips."""
         interval = float(self.cfg["healthChkInterval"])
         timeout = float(self.cfg["healthChkTimeout"])
         while not self._closed:
@@ -452,10 +465,45 @@ class PostgresMgr:
                     self._online = False
                     self._emit("unhealthy", "not running")
                 continue
-            ok = await self.engine.health(self.host, self.port, timeout)
+            t0 = time.monotonic()
+            st: dict | None = None
+            try:
+                # wait_for bounds the WHOLE probe: engines may issue
+                # several sub-queries (PostgresEngine.status), and each
+                # getting its own healthChkTimeout would multiply the
+                # reference's detection latency contract
+                st = await asyncio.wait_for(
+                    self.engine.status(self.host, self.port, timeout),
+                    timeout)
+                ok = bool(st.get("ok"))
+            except (PgError, asyncio.TimeoutError):
+                ok = False
+            latency_ms = (time.monotonic() - t0) * 1000.0
+            self._record_telemetry(ok, latency_ms, st)
             if ok and not self._online:
                 self._online = True
                 self._emit("healthy", None)
             elif not ok and self._online:
                 self._online = False
                 self._emit("unhealthy", "health check failed")
+
+    def _record_telemetry(self, ok: bool, latency_ms: float,
+                          st: dict | None) -> None:
+        from manatee_tpu.state.types import parse_lsn
+        wal = None
+        lag = None
+        in_recovery = False
+        if st:
+            in_recovery = bool(st.get("in_recovery"))
+            lag = st.get("replay_lag_seconds")
+            try:
+                wal = parse_lsn(st["xlog_location"])
+            except (KeyError, ValueError, TypeError):
+                wal = None
+        self.telemetry.add(
+            latency_ms=latency_ms if ok else 1000.0,
+            timed_out=not ok, lag_s=lag, wal_lsn=wal,
+            in_recovery=in_recovery)
+        if self._scorer.available and self.telemetry.ready():
+            self.health_score = self._scorer.score(
+                self.telemetry.window_array())

@@ -99,6 +99,7 @@ def wal_function_names(major: str) -> dict:
         return {
             "current": "pg_current_wal_lsn()",
             "receive": "pg_last_wal_receive_lsn()",
+            "replay": "pg_last_wal_replay_lsn()",
             "replay_ts": "pg_last_xact_replay_timestamp()",
             "stat_sent": "sent_lsn",
             "stat_flush": "flush_lsn",
@@ -108,6 +109,7 @@ def wal_function_names(major: str) -> dict:
     return {
         "current": "pg_current_xlog_location()",
         "receive": "pg_last_xlog_receive_location()",
+        "replay": "pg_last_xlog_replay_location()",
         "replay_ts": "pg_last_xact_replay_timestamp()",
         "stat_sent": "sent_location",
         "stat_flush": "flush_location",
@@ -238,10 +240,17 @@ class PostgresEngine(Engine):
                 xlog = (await self._psql(
                     host, port, "SELECT %s;" % w["receive"],
                     timeout)).strip()
+                # a fully-caught-up standby reports 0 regardless of how
+                # long the cluster has been idle: bare
+                # now() - pg_last_xact_replay_timestamp() reads as
+                # ever-growing "lag" on a quiescent cluster (the
+                # reference documents this caveat; we fix it)
                 lag = (await self._psql(
                     host, port,
-                    "SELECT EXTRACT(EPOCH FROM (now() - %s));"
-                    % w["replay_ts"], timeout)).strip()
+                    "SELECT CASE WHEN %s = %s THEN 0 ELSE "
+                    "EXTRACT(EPOCH FROM (now() - %s)) END;"
+                    % (w["receive"], w["replay"], w["replay_ts"]),
+                    timeout)).strip()
                 lag_s = float(lag) if lag else None
             else:
                 xlog = (await self._psql(

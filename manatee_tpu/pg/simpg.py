@@ -98,6 +98,12 @@ class SimPgServer:
         self._server: asyncio.AbstractServer | None = None
         self._stopping = False
         self.last_replay_ts: float | None = None
+        # standby-side upstream link health: replay in simpg is
+        # synchronous on receive, so connected == caught up (lag 0);
+        # when the link is down, lag = time since last upstream contact
+        self._upstream_ok = False
+        self._upstream_contact: float | None = None
+        self._boot_ts = time.time()
 
     # ---- role helpers ----
 
@@ -221,6 +227,8 @@ class SimPgServer:
                                      % hello.get("error"))
                     sys.stderr.flush()
                     os._exit(3)
+                self._upstream_ok = True
+                self._upstream_contact = time.time()
                 while True:
                     line = await reader.readline()
                     if not line:
@@ -228,12 +236,15 @@ class SimPgServer:
                     rec = json.loads(line)
                     self.wal.append(rec["value"], rec.get("ts"))
                     self.last_replay_ts = time.time()
+                    self._upstream_contact = self.last_replay_ts
                     self._wake_repl_waiters()
                     ack = {"flush": self.wal.last_lsn}
                     writer.write((json.dumps(ack) + "\n").encode())
                     await writer.drain()
             except (OSError, ValueError, json.JSONDecodeError):
                 pass
+            finally:
+                self._upstream_ok = False
             await asyncio.sleep(0.2)
 
     # ---- serving connections ----
@@ -360,10 +371,16 @@ class SimPgServer:
                 "read_only": self.read_only,
                 "xlog_location": lsn_str(self.wal.last_lsn),
                 "replication": repl,
+                # caught-up standbys report 0 however long the cluster
+                # has been idle; a severed upstream link reports time
+                # since last contact (the signal that actually predicts
+                # trouble) — mirrors the receive==replay guard in the
+                # real engine's lag query
                 "replay_lag_seconds": (
-                    None if not self.in_recovery or
-                    self.last_replay_ts is None
-                    else max(0.0, time.time() - self.last_replay_ts)),
+                    None if not self.in_recovery
+                    else 0.0 if self._upstream_ok
+                    else max(0.0, time.time() - (
+                        self._upstream_contact or self._boot_ts))),
                 "version": VERSION,
             }
         if op == "insert":

@@ -60,7 +60,13 @@ class StatusServer:
         if self.state_machine is None:
             return web.json_response({"error": "no state machine"},
                                      status=503)
-        return web.json_response(self.state_machine.debug_state())
+        body = self.state_machine.debug_state()
+        if self.pg_mgr is not None:
+            # failure-prediction surface (health/telemetry.py): operators
+            # and adm warnings read the early-warning score from here
+            body["healthScore"] = self.pg_mgr.health_score
+            body["healthTelemetry"] = self.pg_mgr.telemetry.last_tick()
+        return web.json_response(body)
 
     async def _restore(self, _req: web.Request) -> web.Response:
         job = (self.restore_client.current_job
