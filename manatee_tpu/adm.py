@@ -408,36 +408,48 @@ class AdmClient:
             peers.append(state["sync"])
         peers.extend(state.get("async") or [])
         peers.extend(state.get("deposed") or [])
-        await asyncio.gather(*[
-            self._add_pg_status(p, peer_status, state) for p in peers])
+        import aiohttp
+        timeout = aiohttp.ClientTimeout(total=PG_QUERY_TIMEOUT)
+        async with aiohttp.ClientSession(timeout=timeout) as http:
+            await asyncio.gather(*[
+                self._add_pg_status(p, peer_status, state, http)
+                for p in peers])
         return ClusterDetails(shard, state, peer_status)
 
     async def _add_pg_status(self, peer: dict,
                              out: dict[str, PeerStatus],
-                             state: dict) -> None:
+                             state: dict, http) -> None:
         """(lib/adm.js:348-427: pg_stat_replication + replay lag with a
-        1 s timeout)"""
+        1 s timeout).  The database query and the sitter's health-score
+        fetch run concurrently; both are bounded by PG_QUERY_TIMEOUT."""
         ps = PeerStatus(ident=peer)
         out[peer["id"]] = ps
         engine = self._engine_for(peer)
         if engine is None:
             ps.pgerr = "unsupported pgUrl %r" % peer.get("pgUrl")
             return
-        try:
-            st = await engine.query_url(peer["pgUrl"], {"op": "status"},
-                                        PG_QUERY_TIMEOUT)
-        except (PgError, asyncio.TimeoutError, OSError) as e:
-            ps.pgerr = str(e)
+        st, ps.health_score = await asyncio.gather(
+            self._query_status(engine, peer),
+            self._fetch_health_score(peer, http))
+        if isinstance(st, str):
+            ps.pgerr = st
             return
         ps.online = True
         ps.lag = st.get("replay_lag_seconds")
         # the row describing this peer's DOWNSTREAM (first repl row)
         repl = st.get("replication") or []
         ps.repl = repl[0] if repl else None
-        ps.health_score = await self._fetch_health_score(peer)
 
     @staticmethod
-    async def _fetch_health_score(peer: dict) -> float | None:
+    async def _query_status(engine, peer: dict) -> dict | str:
+        try:
+            return await engine.query_url(peer["pgUrl"], {"op": "status"},
+                                          PG_QUERY_TIMEOUT)
+        except (PgError, asyncio.TimeoutError, OSError) as e:
+            return str(e)
+
+    @staticmethod
+    async def _fetch_health_score(peer: dict, http) -> float | None:
         """The failure-prediction score lives in the sitter, not the
         database: read it from the peer's status server (pgPort+1),
         best-effort — an old/absent sitter simply shows no score."""
@@ -446,14 +458,11 @@ class AdmClient:
         except PgError:
             return None
         try:
-            import aiohttp
-            timeout = aiohttp.ClientTimeout(total=PG_QUERY_TIMEOUT)
-            async with aiohttp.ClientSession(timeout=timeout) as sess:
-                async with sess.get("http://%s:%d/state"
-                                    % (host, pg_port + 1)) as resp:
-                    if resp.status != 200:
-                        return None
-                    body = await resp.json()
+            async with http.get("http://%s:%d/state"
+                                % (host, pg_port + 1)) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
             score = body.get("healthScore")
             return float(score) if score is not None else None
         except Exception:

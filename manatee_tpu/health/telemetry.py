@@ -116,7 +116,9 @@ class NumpyScorer:
                 self._params = {k: z[k].astype(np.float32)
                                 for k in ("w1", "b1", "w2", "b2",
                                           "w3", "b3")}
-        except (OSError, KeyError, ValueError):
+        except Exception:
+            # missing/truncated/corrupt weights (incl. BadZipFile) must
+            # disable scoring, never take the control plane down
             self._params = None
 
     @property

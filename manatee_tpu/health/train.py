@@ -16,6 +16,8 @@ import numpy as np
 
 
 def train(steps: int = 300, batch: int = 256, lr: float = 5e-2, seed: int = 0):
+    if steps < 1:
+        raise ValueError("steps must be >= 1")
     import jax
 
     from manatee_tpu.health.predictor import (

@@ -60,6 +60,11 @@ DEFAULTS = {
     "singleton": False,
 }
 
+# telemetry-status collection cadence, in health ticks: liveness probes
+# every tick stay single-query cheap; the (possibly multi-query) status
+# op for lag/WAL features runs on every Nth tick
+_STATUS_EVERY = 3
+
 
 class PostgresMgr:
     def __init__(self, *, engine: Engine, storage: StorageBackend,
@@ -458,27 +463,32 @@ class PostgresMgr:
         `manatee-adm pg-status` long before the hard timeout trips."""
         interval = float(self.cfg["healthChkInterval"])
         timeout = float(self.cfg["healthChkTimeout"])
+        tick = 0
         while not self._closed:
             await asyncio.sleep(interval)
+            tick += 1
             if not self.running:
                 if self._online:
                     self._online = False
                     self._emit("unhealthy", "not running")
                 continue
+            # LIVENESS keeps the reference's contract verbatim: one
+            # cheap probe per tick, healthChkTimeout bounding it
+            # (lib/postgresMgr.js:1550-1646)
             t0 = time.monotonic()
-            st: dict | None = None
-            try:
-                # wait_for bounds the WHOLE probe: engines may issue
-                # several sub-queries (PostgresEngine.status), and each
-                # getting its own healthChkTimeout would multiply the
-                # reference's detection latency contract
-                st = await asyncio.wait_for(
-                    self.engine.status(self.host, self.port, timeout),
-                    timeout)
-                ok = bool(st.get("ok"))
-            except (PgError, asyncio.TimeoutError):
-                ok = False
+            ok = await self.engine.health(self.host, self.port, timeout)
             latency_ms = (time.monotonic() - t0) * 1000.0
+            # TELEMETRY piggybacks on a subset of ticks (the status op
+            # may be several queries on a real engine); its failure
+            # never flips liveness — missing lag/wal is just unknown
+            st: dict | None = None
+            if ok and tick % _STATUS_EVERY == 0:
+                try:
+                    st = await asyncio.wait_for(
+                        self.engine.status(self.host, self.port, timeout),
+                        timeout)
+                except (PgError, asyncio.TimeoutError):
+                    st = None
             self._record_telemetry(ok, latency_ms, st)
             if ok and not self._online:
                 self._online = True

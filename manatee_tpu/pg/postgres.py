@@ -244,13 +244,24 @@ class PostgresEngine(Engine):
                 # long the cluster has been idle: bare
                 # now() - pg_last_xact_replay_timestamp() reads as
                 # ever-growing "lag" on a quiescent cluster (the
-                # reference documents this caveat; we fix it)
-                lag = (await self._psql(
-                    host, port,
-                    "SELECT CASE WHEN %s = %s THEN 0 ELSE "
-                    "EXTRACT(EPOCH FROM (now() - %s)) END;"
-                    % (w["receive"], w["replay"], w["replay_ts"]),
-                    timeout)).strip()
+                # reference documents this caveat; we fix it).  The 0
+                # short-circuit additionally requires a LIVE walreceiver
+                # — a severed replication link must read as growing lag,
+                # not as caught-up (receive goes static after the link
+                # dies, so receive==replay alone would mask it).
+                if float(self.major) >= 9.6:
+                    live = "EXISTS (SELECT 1 FROM pg_stat_wal_receiver)"
+                    lag_sql = ("SELECT CASE WHEN %s AND %s = %s THEN 0 "
+                               "ELSE EXTRACT(EPOCH FROM (now() - %s)) "
+                               "END;" % (live, w["receive"], w["replay"],
+                                         w["replay_ts"]))
+                else:
+                    # no pg_stat_wal_receiver before 9.6: keep the
+                    # reference's raw form (with its documented caveat)
+                    lag_sql = ("SELECT EXTRACT(EPOCH FROM (now() - %s));"
+                               % w["replay_ts"])
+                lag = (await self._psql(host, port, lag_sql,
+                                        timeout)).strip()
                 lag_s = float(lag) if lag else None
             else:
                 xlog = (await self._psql(

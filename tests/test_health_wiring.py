@@ -38,30 +38,32 @@ def make_mgr(tmp_path, **over):
                        config=cfg)
 
 
-class DegradingStatus:
-    """engine.status stand-in: a database sliding toward death — latency
+class DegradingDb:
+    """engine stand-in: a database sliding toward death — probe latency
     and replay lag ramp tick over tick, WAL replay stalls — but every
     probe still SUCCEEDS (the hard timeout never trips)."""
 
     def __init__(self):
         self.tick = 0
 
-    async def __call__(self, host, port, timeout):
+    async def health(self, host, port, timeout):
         self.tick += 1
-        t = self.tick
-        await asyncio.sleep(0)   # stay async-shaped, but fast
+        await asyncio.sleep(0)
+        return True
+
+    async def status(self, host, port, timeout):
+        await asyncio.sleep(0)
         return {
             "ok": True,
             "in_recovery": True,
             "xlog_location": "0/0000100",          # never advances
-            "replay_lag_seconds": 0.2 * t,          # ramping lag
+            "replay_lag_seconds": 0.2 * self.tick,  # ramping lag
             "replication": [],
-            "_latency_ms": 20.0 * t,                # see patch below
         }
 
 
 def test_degrading_peer_scores_above_threshold_before_hard_timeout(tmp_path):
-    """Drive the REAL _health_loop with a degrading status source: the
+    """Drive the REAL _health_loop with a degrading database: the
     prediction score must cross the warning threshold while the peer is
     still 'online' (no unhealthy event — the hard timeout never fired)."""
     async def go():
@@ -71,19 +73,15 @@ def test_degrading_peer_scores_above_threshold_before_hard_timeout(tmp_path):
         mgr._online = True
         mgr._proc = types.SimpleNamespace(returncode=None,
                                           pid=0)  # "running"
-        deg = DegradingStatus()
-
-        async def status(host, port, timeout):
-            st = await deg.__call__(host, port, timeout)
-            # simulate the probe round-trip cost without sleeping
-            await asyncio.sleep(0)
-            return st
-        mgr.engine.status = status
-        # latency is measured by the loop; inject it deterministically
+        deg = DegradingDb()
+        mgr.engine.health = deg.health
+        mgr.engine.status = deg.status
+        # latency is measured around engine.health; inject the ramp
+        # deterministically instead of sleeping real time
         orig = mgr._record_telemetry
 
         def record(ok, latency_ms, st):
-            orig(ok, (st or {}).get("_latency_ms", latency_ms), st)
+            orig(ok, 20.0 * deg.tick, st)
         mgr._record_telemetry = record
 
         task = asyncio.ensure_future(mgr._health_loop())
@@ -113,11 +111,15 @@ def test_healthy_peer_scores_low(tmp_path):
         mgr._proc = types.SimpleNamespace(returncode=None)
         lsn = [0x100]
 
+        async def health(host, port, timeout):
+            return True
+
         async def status(host, port, timeout):
             lsn[0] += 0x40
             return {"ok": True, "in_recovery": True,
                     "xlog_location": "0/%07X" % lsn[0],
                     "replay_lag_seconds": 0.02, "replication": []}
+        mgr.engine.health = health
         mgr.engine.status = status
         task = asyncio.ensure_future(mgr._health_loop())
         try:

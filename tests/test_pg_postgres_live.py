@@ -1,0 +1,199 @@
+"""LIVE real-PostgreSQL suite (VERDICT r1 #3).
+
+Runs the full PostgresEngine/PostgresMgr lifecycle against REAL
+postgres/initdb/psql binaries: initdb -> primary up -> sync streams via
+real WAL replication -> SIGKILL the primary -> standby takeover.
+
+SKIPS LOUDLY when no binaries are present (this dev image has none —
+the fake-binary suite test_pg_postgres_fake.py covers the manager paths
+there).  Point PG_BIN_DIR at a PostgreSQL bin directory (>=12) or put
+the binaries on PATH to run it:
+
+    PG_BIN_DIR=/usr/lib/postgresql/16/bin python -m pytest \
+        tests/test_pg_postgres_live.py -v
+"""
+
+import asyncio
+import getpass
+import os
+import re
+import shutil
+import socket
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.pg.manager import PostgresMgr
+from manatee_tpu.pg.postgres import PostgresEngine
+from manatee_tpu.storage import DirBackend
+from manatee_tpu.utils.executil import run as xrun
+
+
+def _find_bin_dir() -> str | None:
+    env = os.environ.get("PG_BIN_DIR")
+    if env and (Path(env) / "postgres").exists():
+        return env
+    for name in ("postgres", "initdb", "psql", "pg_basebackup"):
+        if shutil.which(name) is None:
+            return None
+    return str(Path(shutil.which("postgres")).parent)
+
+
+BIN_DIR = _find_bin_dir()
+
+pytestmark = pytest.mark.skipif(
+    BIN_DIR is None,
+    reason="REAL POSTGRESQL BINARIES NOT FOUND: set PG_BIN_DIR or put "
+           "postgres/initdb/psql/pg_basebackup on PATH to run the live "
+           "engine suite (this image has none; the fake-binary suite "
+           "covers the manager paths)")
+
+
+def _pg_version() -> str:
+    import subprocess
+    out = subprocess.run([str(Path(BIN_DIR) / "postgres"), "--version"],
+                         capture_output=True, text=True).stdout
+    m = re.search(r"(\d+(?:\.\d+)+)", out)
+    return m.group(1) if m else "12.0"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_mgr(tmp_path, name, **over):
+    port = free_port()
+    user = getpass.getuser()
+    engine = PostgresEngine(pg_bin_dir=BIN_DIR, version=_pg_version(),
+                            pg_user=user, use_sudo=False)
+
+    async def basebackup_restore(upstream):
+        """The live analogue of the backup-plane restore: clone the
+        upstream with pg_basebackup (trust auth on 127.0.0.1 is the
+        initdb default for replication)."""
+        from manatee_tpu.pg.engine import parse_pg_url
+        _s, host, uport = parse_pg_url(upstream["pgUrl"])
+        datadir = over.get("datadir") or str(tmp_path / name / "data")
+        shutil.rmtree(datadir, ignore_errors=True)
+        await xrun([str(Path(BIN_DIR) / "pg_basebackup"),
+                    "-h", host, "-p", str(uport), "-U", user,
+                    "-D", datadir, "-X", "stream"], timeout=120)
+
+    cfg = {
+        "peer_id": "127.0.0.1:%d:1" % port,
+        "host": "127.0.0.1",
+        "port": port,
+        "datadir": str(tmp_path / name / "data"),
+        "dataset": None,
+        "opsTimeout": 60,
+        "healthChkInterval": 0.5,
+        "healthChkTimeout": 5,
+        "replicationTimeout": 30,
+        "replPollInterval": 0.25,
+    }
+    cfg.update(over)
+    return PostgresMgr(engine=engine,
+                       storage=DirBackend(str(tmp_path / name / "store")),
+                       config=cfg, restore_fn=basebackup_restore)
+
+
+async def wait_for(pred, timeout=60.0, interval=0.25):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        try:
+            if await pred():
+                return True
+        except Exception:
+            pass
+        await asyncio.sleep(interval)
+    return False
+
+
+def test_initdb_primary_sync_kill_takeover(tmp_path):
+    """The headline live scenario: initdb a real primary, stream a real
+    sync from it, kill the primary, promote the sync, keep the data."""
+    async def go():
+        primary = make_mgr(tmp_path, "p1")
+        sync = make_mgr(tmp_path, "p2")
+        up_info = {"id": primary.peer_id,
+                   "pgUrl": "tcp://127.0.0.1:%d" % primary.port,
+                   "backupUrl": "http://127.0.0.1:1"}
+        down_info = {"id": sync.peer_id,
+                     "pgUrl": "tcp://127.0.0.1:%d" % sync.port,
+                     "backupUrl": "http://127.0.0.1:2"}
+        try:
+            # primary: initdb + boot, read-only until the sync attaches
+            await primary.reconfigure({"role": "primary",
+                                       "upstream": None,
+                                       "downstream": down_info})
+            assert primary.running
+
+            # sync: no local database -> restore (pg_basebackup) -> boot
+            await sync.reconfigure({"role": "sync", "upstream": up_info,
+                                    "downstream": None})
+            assert sync.running
+
+            # real streaming replication reaches 'streaming' and the
+            # primary flips writable (sent == flush)
+            writable = []
+            primary.on("writable", writable.append)
+            assert await wait_for(lambda: _streaming(primary, sync))
+            assert await wait_for(lambda: _writable(primary))
+
+            await primary._local_query({"op": "insert",
+                                        "value": "before-failover"})
+            # the row replicates to the sync
+            assert await wait_for(lambda: _has_row(sync,
+                                                   "before-failover"))
+
+            # SIGKILL the primary's postgres child (crash, not shutdown)
+            primary._proc.kill()
+            await asyncio.sleep(1.0)
+
+            # takeover: the sync becomes primary (ONWM so it is
+            # immediately writable; topology-level read-only gating is
+            # the state machine's job, exercised elsewhere)
+            sync.cfg["singleton"] = True
+            await sync.reconfigure({"role": "primary", "upstream": None,
+                                    "downstream": None})
+            assert await wait_for(lambda: _has_row(sync,
+                                                   "before-failover"))
+            await sync._local_query({"op": "insert",
+                                     "value": "after-failover"})
+            rows = (await sync._local_query({"op": "select"}))["rows"]
+            assert "before-failover" in rows and "after-failover" in rows
+        finally:
+            await primary.close()
+            await sync.close()
+    run(go())
+
+
+def _streaming(primary, sync):
+    async def check():
+        st = await primary._local_query({"op": "status"})
+        row = next((r for r in st.get("replication", [])
+                    if r["application_name"] == sync.peer_id), None)
+        return row is not None and row["state"] == "streaming"
+    return check()
+
+
+def _writable(mgr):
+    async def check():
+        st = await mgr._local_query({"op": "status"})
+        return not st["read_only"]
+    return check()
+
+
+def _has_row(mgr, value):
+    async def check():
+        rows = (await mgr._local_query({"op": "select"}))["rows"]
+        return value in rows
+    return check()
