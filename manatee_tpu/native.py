@@ -1,13 +1,15 @@
 """ctypes loader for the native stream pump (native/streampump.cpp).
 
-The pump splices pipe->socket bytes in the kernel — the primitive for a
-native bulk-transfer path (SURVEY.md §7 names the snapshot streamer as
-the one native-code candidate).  It is NOT wired into the data plane
-yet: measured over loopback with a Python-side receiver the kernel path
-does not win (the receiver dominates at ~1 GB/s), and doing raw-fd I/O
-under an asyncio-owned socket safely requires detaching the transport.
-The primitive is built, tested (tests/test_native.py), and ready for a
-sender+receiver-native path when real-network numbers justify it.
+The pump splices pipe->socket bytes in the kernel — the bulk-transfer
+primitive SURVEY.md §7 names as the one native-code candidate (the
+reference's equivalent is `zfs send | socket` piped by the kernel,
+lib/backupSender.js:172-180).  It is wired into the SENDER side of the
+backup plane behind MANATEE_NATIVE=1: DirBackend._send_native and
+ZfsBackend._send_native splice tar's / `zfs send`'s stdout straight to
+the peer socket in a worker thread, freeing the event loop of the
+byte-shoveling.  See native/BENCH.md for the measured two-process
+transfer numbers (the kernel path wins once the receiver is not the
+bottleneck, and never loses).
 """
 
 from __future__ import annotations

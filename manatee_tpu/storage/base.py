@@ -155,3 +155,16 @@ class StorageBackend(abc.ABC):
         snaps = [s for s in await self.list_snapshots(dataset)
                  if is_epoch_ms_snapshot(s.name)]
         return snaps[-1] if snaps else None
+
+
+async def flush_transport(writer: asyncio.StreamWriter,
+                          timeout: float = 30.0) -> None:
+    """Wait until the transport's write buffer is EMPTY.  drain() only
+    waits for the low-water mark, which is not enough when raw-fd I/O
+    (the native pump) is about to bypass the transport: any buffered
+    bytes would be interleaved after the raw writes."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while writer.transport.get_write_buffer_size() > 0:
+        if asyncio.get_running_loop().time() > deadline:
+            raise StorageError("transport buffer never drained")
+        await asyncio.sleep(0.005)

@@ -21,6 +21,7 @@ unmounted data really is invisible at the mountpoint, as with zfs.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import os
 import shutil
@@ -32,6 +33,7 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
+    flush_transport,
     snapshot_name_now,
 )
 
@@ -281,6 +283,11 @@ class DirBackend(StorageBackend):
         except Exception as e:
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
+        from manatee_tpu import native
+        if native.enabled() and writer.get_extra_info("socket") is not None:
+            await self._send_native(dataset, name, src, size, writer,
+                                    progress_cb)
+            return
         proc = await asyncio.create_subprocess_exec(
             "tar", "-C", str(src), "-cf", "-", ".",
             stdout=asyncio.subprocess.PIPE,
@@ -304,6 +311,74 @@ class DirBackend(StorageBackend):
             await reap_killed(proc)
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
+        err = await proc.stderr.read()
+        rc = await proc.wait()
+        if rc != 0:
+            raise StorageError("tar send failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))
+
+    async def _send_native(self, dataset: str, name: str, src,
+                           size: int | None,
+                           writer: asyncio.StreamWriter,
+                           progress_cb: ProgressCb | None) -> None:
+        """MANATEE_NATIVE=1 bulk path: tar's stdout is spliced into the
+        peer socket by the native pump (native/streampump.cpp) — the
+        kernel-piped transfer of the reference's `zfs send | socket`
+        (lib/backupSender.js:172-180) — leaving the event loop free.
+        The transport socket stays non-blocking (asyncio refuses
+        setblocking); the pump absorbs EAGAIN with poll(2)."""
+        import os
+
+        from manatee_tpu import native
+        from manatee_tpu.utils.executil import reap_killed
+
+        # drain() only waits for the low-water mark: the raw-fd pump
+        # must not start while the JSON header is still buffered in the
+        # transport, or tar bytes would precede it on the wire
+        await flush_transport(writer)
+
+        sock = writer.get_extra_info("socket")
+        rfd, wfd = os.pipe()
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                "tar", "-C", str(src), "-cf", "-", ".",
+                stdout=wfd, stderr=asyncio.subprocess.PIPE)
+        except Exception:
+            os.close(rfd)
+            os.close(wfd)
+            raise
+        os.close(wfd)   # pump sees EOF when tar exits
+
+        import threading
+        cancelled = threading.Event()
+
+        def progress(total: int) -> bool:
+            if progress_cb:
+                progress_cb(total, size)
+            return cancelled.is_set()
+
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(
+            None, native.pump, rfd, sock.fileno(), progress)
+        try:
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            # the fd must stay open until the pump THREAD exits, or a
+            # reused fd number would receive spliced bytes (silent
+            # corruption).  The abort flag + tar kill guarantee the
+            # thread returns promptly (bounded poll in wait_ready).
+            cancelled.set()
+            await reap_killed(proc)
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(fut, 10)
+            os.close(rfd)
+            raise
+        except OSError as e:
+            await reap_killed(proc)
+            os.close(rfd)
+            raise StorageError("native send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+        os.close(rfd)
         err = await proc.stderr.read()
         rc = await proc.wait()
         if rc != 0:

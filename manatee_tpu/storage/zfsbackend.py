@@ -29,6 +29,34 @@ _SIZE_RE = re.compile(r"^size\s+(\d+)", re.M)
 _TICK_RE = re.compile(r"^\d\d:\d\d:\d\d\s+(\d+)\s+", re.M)
 
 
+class _SendState:
+    """Mutable holder shared between the stderr watcher and the data
+    path: the stream size parsed from `zfs send -v -P`."""
+
+    def __init__(self):
+        self.size: int | None = None
+
+
+async def _watch_send_stderr(proc, state: "_SendState",
+                             err_chunks: list, progress_cb) -> None:
+    """Parse `zfs send -v -P` stderr: the size line plus per-second byte
+    ticks surfaced through *progress_cb* (lib/backupSender.js:114-136,
+    195-212).  Shared by the python and native send paths."""
+    while True:
+        line = await proc.stderr.readline()
+        if not line:
+            return
+        err_chunks.append(line)
+        text = line.decode("utf-8", "replace")
+        m = _SIZE_RE.match(text)
+        if m:
+            state.size = int(m.group(1))
+            continue
+        m = _TICK_RE.match(text)
+        if m and progress_cb:
+            progress_cb(int(m.group(1)), state.size)
+
+
 class ZfsBackend(StorageBackend):
     def __init__(self, zfs_cmd: str = "zfs"):
         self.zfs = zfs_cmd
@@ -139,30 +167,18 @@ class ZfsBackend(StorageBackend):
         writer: asyncio.StreamWriter,
         progress_cb: ProgressCb | None = None,
     ) -> None:
+        from manatee_tpu import native
+        if native.enabled() and writer.get_extra_info("socket") is not None:
+            await self._send_native(dataset, name, writer, progress_cb)
+            return
         proc = await asyncio.create_subprocess_exec(
             self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name),
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
             env={},
         )
-        size: int | None = None
+        state = _SendState()
         err_chunks: list[bytes] = []
-
-        async def watch_stderr():
-            nonlocal size
-            while True:
-                line = await proc.stderr.readline()
-                if not line:
-                    return
-                err_chunks.append(line)
-                text = line.decode("utf-8", "replace")
-                m = _SIZE_RE.match(text)
-                if m:
-                    size = int(m.group(1))
-                    continue
-                m = _TICK_RE.match(text)
-                if m and progress_cb:
-                    progress_cb(int(m.group(1)), size)
 
         async def pump_stdout():
             done = 0
@@ -174,9 +190,10 @@ class ZfsBackend(StorageBackend):
                 writer.write(chunk)
                 await writer.drain()
                 if progress_cb:
-                    progress_cb(done, size)
+                    progress_cb(done, state.size)
 
-        t_err = asyncio.ensure_future(watch_stderr())
+        t_err = asyncio.ensure_future(
+            _watch_send_stderr(proc, state, err_chunks, progress_cb))
         t_out = asyncio.ensure_future(pump_stdout())
         try:
             await asyncio.gather(t_err, t_out)
@@ -192,6 +209,81 @@ class ZfsBackend(StorageBackend):
         if rc != 0:
             raise StorageError("zfs send failed (rc=%d): %s"
                                % (rc, b"".join(err_chunks).decode("utf-8", "replace")))
+
+    async def _send_native(self, dataset: str, name: str,
+                           writer: asyncio.StreamWriter,
+                           progress_cb: ProgressCb | None) -> None:
+        """MANATEE_NATIVE=1: `zfs send` stdout is spliced to the peer
+        socket in the kernel (native/streampump.cpp) — the literal
+        kernel-piped transfer of lib/backupSender.js:172-180 — while the
+        -v/-P progress lines are still parsed from stderr on the loop."""
+        import contextlib
+        import os
+        import threading
+
+        from manatee_tpu import native
+        from manatee_tpu.utils.executil import reap_killed
+
+        await flush_transport(writer)   # no buffered bytes may remain
+        sock = writer.get_extra_info("socket")
+        rfd, wfd = os.pipe()
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name),
+                stdout=wfd, stderr=asyncio.subprocess.PIPE, env={})
+        except Exception:
+            os.close(rfd)
+            os.close(wfd)
+            raise
+        os.close(wfd)
+        state = _SendState()
+        err_chunks: list[bytes] = []
+
+        cancelled = threading.Event()
+
+        def pump_progress(_total: int) -> bool:
+            return cancelled.is_set()
+
+        # the transport socket stays non-blocking (asyncio refuses
+        # setblocking); the pump absorbs EAGAIN with poll(2)
+        loop = asyncio.get_running_loop()
+        t_err = asyncio.ensure_future(
+            _watch_send_stderr(proc, state, err_chunks, progress_cb))
+        fut = loop.run_in_executor(
+            None, native.pump, rfd, sock.fileno(), pump_progress)
+        try:
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            # keep rfd open until the pump THREAD exits, or a reused fd
+            # could receive spliced bytes (silent corruption); the abort
+            # flag + zfs kill bound the thread's exit
+            cancelled.set()
+            t_err.cancel()
+            await reap_killed(proc)
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(fut, 10)
+            os.close(rfd)
+            raise
+        except OSError as e:
+            t_err.cancel()
+            await reap_killed(proc)
+            os.close(rfd)
+            raise StorageError("native zfs send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+        os.close(rfd)
+        try:
+            await t_err
+        except Exception as e:
+            # a failing progress callback aborts the send, exactly as on
+            # the non-native path
+            await reap_killed(proc)
+            raise StorageError("zfs send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+        rc = await proc.wait()
+        if rc != 0:
+            raise StorageError(
+                "zfs send failed (rc=%d): %s"
+                % (rc, b"".join(err_chunks).decode("utf-8", "replace")))
 
     async def recv(
         self,

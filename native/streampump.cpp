@@ -18,6 +18,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <fcntl.h>
+#include <poll.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -29,6 +30,25 @@ extern "C" {
 
 typedef int (*mnt_progress_cb)(long long total);
 
+// Wait until *fd* is ready for *events*.  Non-blocking fds are the
+// normal case here: asyncio transport sockets refuse setblocking(true),
+// so the pump must absorb EAGAIN itself.  The wait is chunked so the
+// progress callback keeps firing even against a stalled peer — its
+// abort return is the owner's only way to stop a blocked pump thread.
+static int wait_ready(int fd, short events, mnt_progress_cb progress,
+                      long long total) {
+    struct pollfd p = {fd, events, 0};
+    for (;;) {
+        int r = poll(&p, 1, 500);
+        if (r > 0)
+            return 0;
+        if (r < 0 && errno != EINTR)
+            return -errno;
+        if (progress && progress(total))
+            return -ECANCELED;
+    }
+}
+
 static long long pump_rw(int fd_in, int fd_out, long long total,
                          mnt_progress_cb progress) {
     char buf[1 << 20];
@@ -39,6 +59,12 @@ static long long pump_rw(int fd_in, int fd_out, long long total,
         if (n < 0) {
             if (errno == EINTR)
                 continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int w = wait_ready(fd_in, POLLIN, progress, total);
+                if (w < 0)
+                    return (long long)w;
+                continue;
+            }
             return -(long long)errno;
         }
         ssize_t off = 0;
@@ -47,6 +73,12 @@ static long long pump_rw(int fd_in, int fd_out, long long total,
             if (w < 0) {
                 if (errno == EINTR)
                     continue;
+                if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                    int r = wait_ready(fd_out, POLLOUT, progress, total);
+                    if (r < 0)
+                        return (long long)r;
+                    continue;
+                }
                 return -(long long)errno;
             }
             off += w;
@@ -62,7 +94,7 @@ long long mnt_pump(int fd_in, int fd_out, mnt_progress_cb progress) {
 
 #ifdef __linux__
     // splice works when at least one side is a pipe; our sender feeds a
-    // pipe (tar stdout) into a socket.
+    // pipe (tar / zfs-send stdout) into a socket.
     struct stat st;
     bool in_is_pipe = (fstat(fd_in, &st) == 0 && S_ISFIFO(st.st_mode));
     if (in_is_pipe) {
@@ -74,6 +106,25 @@ long long mnt_pump(int fd_in, int fd_out, mnt_progress_cb progress) {
             if (n < 0) {
                 if (errno == EINTR)
                     continue;
+                if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                    // EAGAIN is ambiguous: full non-blocking socket or
+                    // empty non-blocking pipe.  Probe the socket with a
+                    // zero-timeout poll — if it is already writable the
+                    // stall must be the input side, so wait there;
+                    // otherwise wait for the socket to drain.  (Waiting
+                    // on both at once would spin when the socket is
+                    // writable but the pipe is empty.)
+                    struct pollfd po = {fd_out, POLLOUT, 0};
+                    int pr = poll(&po, 1, 0);
+                    if (pr < 0 && errno != EINTR)
+                        return -(long long)errno;
+                    int w = (pr > 0 && (po.revents & POLLOUT))
+                        ? wait_ready(fd_in, POLLIN, progress, total)
+                        : wait_ready(fd_out, POLLOUT, progress, total);
+                    if (w < 0)
+                        return (long long)w;
+                    continue;
+                }
                 if (errno == EINVAL || errno == ENOSYS)
                     break;  // fall back to read/write
                 return -(long long)errno;

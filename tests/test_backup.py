@@ -176,3 +176,33 @@ def test_backup_job_rest_api(tmp_path):
             await sender.stop()
             await server.stop()
     run(go())
+
+
+def test_restore_roundtrip_native_pump(tmp_path, monkeypatch):
+    """The same full restore (REST job + TCP stream) with the sender's
+    bytes moved by the native splice pump (MANATEE_NATIVE=1) — VERDICT
+    r1 #5's integration criterion.  Skips if the library cannot load."""
+    from manatee_tpu import native
+
+    if not native.available():
+        pytest.skip("native streampump not built")
+    monkeypatch.setenv("MANATEE_NATIVE", "1")
+
+    async def go():
+        src_storage, queue, server, sender = \
+            await make_sender_side(tmp_path)
+        dst_storage = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst_storage, dataset="pg",
+                               mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            url = "http://127.0.0.1:%d" % server.port
+            await asyncio.wait_for(client.restore(url), 15)
+            assert (mnt / "base.db").read_bytes() == b"P" * 200_000
+            assert client.current_job["done"] is True
+            assert client.current_job["completed"] > 0
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
