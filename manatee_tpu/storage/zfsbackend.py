@@ -21,6 +21,7 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
+    flush_transport,
 )
 from manatee_tpu.utils import ExecError, run
 
