@@ -31,7 +31,7 @@ from tests.harness import ClusterHarness  # noqa: E402
 
 BASELINE_BUDGET_S = 30.0   # test/integ.test.js:52 convergence budget
 RUNS = 3
-SESSION_TIMEOUT = 1.0
+SESSION_TIMEOUT = 0.75
 
 
 async def one_run(tmp: Path) -> float:

@@ -30,13 +30,12 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-import os
 import signal
 import time
 from pathlib import Path
 from typing import Awaitable, Callable
 
-from manatee_tpu.pg.engine import Engine, PgError, PgQueryTimeout
+from manatee_tpu.pg.engine import Engine, PgError
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
 
@@ -375,7 +374,8 @@ class PostgresMgr:
             *argv, stdout=self._log_fh, stderr=self._log_fh,
             env=self.engine.child_env())
         log.info("%s: started db pid=%d", self.peer_id, self._proc.pid)
-        deadline = time.monotonic() + float(self.cfg["opsTimeout"])
+        boot_start = time.monotonic()
+        deadline = boot_start + float(self.cfg["opsTimeout"])
         while time.monotonic() < deadline:
             if self._proc.returncode is not None:
                 rc = self._proc.returncode
@@ -391,7 +391,11 @@ class PostgresMgr:
                 # legitimately mean "needs restore")
                 asyncio.ensure_future(self._watch_exit(self._proc))
                 return
-            await asyncio.sleep(0.2)
+            # fine-grained early, coarser later: boot completes in tens
+            # of ms for the sim engine and this poll is squarely on the
+            # failover-to-writable path
+            await asyncio.sleep(
+                0.05 if time.monotonic() - boot_start < 2.0 else 0.2)
         raise PgError("database did not come up within opsTimeout")
 
     async def _watch_exit(self, proc: asyncio.subprocess.Process) -> None:

@@ -103,7 +103,7 @@ class Peer:
             "healthChkInterval": 0.3,
             "healthChkTimeout": 2,
             "replicationTimeout": 10,
-            "replPollInterval": 0.25,
+            "replPollInterval": 0.05,
             "oneNodeWriteMode": self.cluster.singleton,
         })
         (self.root / "sitter.json").write_text(json.dumps(sitter, indent=2))
@@ -326,7 +326,7 @@ class ClusterHarness:
                     return st
             except (KeyError, TypeError, IndexError):
                 pass
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(0.05)
         raise AssertionError("timed out waiting for %s; last state: %r"
                              % (what, last))
 
@@ -366,6 +366,6 @@ class ClusterHarness:
                     return
             except Exception as e:
                 last_err = e
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(0.05)
         raise AssertionError("peer %s never writable: %r"
                              % (peer.name, last_err))
