@@ -22,6 +22,10 @@ test-integ:
 lint:
 	$(PYTHON) -m compileall -q manatee_tpu tools/mkdevcluster bench.py \
 	    __graft_entry__.py
+	$(PYTHON) tools/lint
+
+train-health:
+	$(PYTHON) -m manatee_tpu.health.train
 
 bench:
 	$(PYTHON) bench.py

@@ -26,7 +26,7 @@ import logging
 
 import aiohttp
 
-from manatee_tpu.storage.base import StorageBackend, StorageError
+from manatee_tpu.storage.base import StorageBackend
 
 log = logging.getLogger("manatee.backup.client")
 

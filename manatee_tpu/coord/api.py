@@ -14,7 +14,7 @@ Semantics follow ZooKeeper (what lib/zookeeperMgr.js programs against):
 from __future__ import annotations
 
 import abc
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
 from typing import Callable
 

@@ -18,7 +18,6 @@ detection semantics.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

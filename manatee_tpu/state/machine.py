@@ -48,11 +48,10 @@ from __future__ import annotations
 import asyncio
 import datetime
 import logging
-from typing import Any, Callable
+from typing import Callable
 
 from manatee_tpu.coord.api import (
     BadVersionError,
-    CoordError,
     NodeExistsError,
 )
 from manatee_tpu.state.types import (

@@ -32,7 +32,6 @@ Invariants encoded by the reference's history annotator
 
 from __future__ import annotations
 
-from typing import Any
 
 ClusterState = dict   # JSON-shaped; helpers below
 PeerInfo = dict

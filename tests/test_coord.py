@@ -17,7 +17,6 @@ from manatee_tpu.coord import (
     BadVersionError,
     ConsensusMgr,
     CoordSpace,
-    MemoryCoord,
     NodeExistsError,
     NoNodeError,
     NotEmptyError,

@@ -5,7 +5,7 @@ namespace, lib/adm.js:107-122)."""
 import asyncio
 
 from manatee_tpu.adm import AdmClient
-from manatee_tpu.coord import ConsensusMgr, CoordSpace
+from manatee_tpu.coord import CoordSpace
 from manatee_tpu.coord.server import CoordServer
 from tests.test_state_machine import SimPeer, wait_for
 

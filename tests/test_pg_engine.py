@@ -3,9 +3,7 @@ overrides merge, versioned path resolution (no postgres binaries needed
 — these exercise the pure config logic, mirroring
 test/tst.postgresMgr.js)."""
 
-from pathlib import Path
 
-import pytest
 
 from manatee_tpu.pg.postgres import (
     PostgresEngine,

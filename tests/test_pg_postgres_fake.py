@@ -15,7 +15,6 @@ seeded pg_stat_replication fixtures.
 
 import asyncio
 import json
-import signal
 import socket
 from pathlib import Path
 

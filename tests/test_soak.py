@@ -20,7 +20,6 @@ import random
 import pytest
 
 from manatee_tpu.coord import CoordSpace
-from manatee_tpu.state.types import role_of, validate_transition
 from tests.test_state_machine import SimPeer, get_state, wait_for
 
 SEEDS = [1, 2, 7, 11, 23, 42, 99, 256, 1001, 1337]

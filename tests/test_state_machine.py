@@ -13,7 +13,6 @@ invariants encoded by the reference's history annotator
 import asyncio
 import datetime
 
-import pytest
 
 from manatee_tpu.coord import ConsensusMgr, CoordSpace
 from manatee_tpu.state.machine import PeerStateMachine
