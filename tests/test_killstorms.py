@@ -147,3 +147,233 @@ def test_sequenced_kill_storm(tmp_path):
         finally:
             await cluster.stop()
     run(go())
+
+
+def test_primary_and_async_die_together(tmp_path):
+    """Pairwise instantaneous death, third combination
+    (integ.test.js:1720): the sync takes over immediately (the async's
+    absence does not gate takeover), the old primary is deposed, and
+    when both dead peers return the deposed one stays deposed while the
+    async rejoins the chain."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            primary.kill()
+            asyncs[0].kill()
+            # the sync takes over and deposes the old primary — but with
+            # no standby available it correctly HOLDS writes (read-only
+            # until a new sync catches up; taking writes now would risk
+            # loss on the next failover)
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            assert st["generation"] == gen0 + 1
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+
+            primary.start()
+            asyncs[0].start()
+            # the async rejoins (as the new sync or async); the deposed
+            # ex-primary must NOT re-enter the replication chain
+            def recovered(s):
+                members = {s["primary"]["id"]}
+                if s.get("sync"):
+                    members.add(s["sync"]["id"])
+                members.update(a["id"] for a in s.get("async") or [])
+                return (s["primary"]["id"] == sync.ident
+                        and asyncs[0].ident in members
+                        and primary.ident not in members
+                        and [d["id"] for d in s["deposed"]]
+                        == [primary.ident])
+            st = await cluster.wait_for(recovered, 60,
+                                        "pa-death recovery")
+            await cluster.wait_writable(sync, "after-pa-recovery",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_sequenced_deaths_primary_then_primary(tmp_path):
+    """First sequenced-death ordering (integ.test.js:1925): kill the
+    primary, wait for the takeover to complete, then kill the NEW
+    primary; the chain must fail over twice, deposing both."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=4)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster, n=4)
+
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            await cluster.wait_writable(sync, "after-first", timeout=60)
+            second_sync = cluster.peer_by_id(st["sync"]["id"])
+
+            sync.kill()
+            st = await cluster.wait_topology(primary=second_sync,
+                                             timeout=60)
+            deposed = {d["id"] for d in st["deposed"]}
+            assert deposed == {primary.ident, sync.ident}
+            await cluster.wait_writable(second_sync, "after-second",
+                                        timeout=60)
+            # synchronously-committed data survived both failovers
+            res = await second_sync.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+            assert "after-first" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_sequenced_deaths_sync_then_sync(tmp_path):
+    """Second sequenced-death ordering (integ.test.js:2208): kill the
+    sync, wait for its replacement, then kill the replacement; each
+    death appoints the next async with a generation bump and no
+    deposals (the primary never changed)."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=4)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster, n=4)
+
+            sync.kill()
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None
+                and s["sync"]["id"] == asyncs[0].ident,
+                60, "first replacement sync")
+            await cluster.wait_writable(primary, "after-sync-death-1",
+                                        timeout=60)
+
+            asyncs[0].kill()
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None
+                and s["sync"]["id"] == asyncs[1].ident,
+                60, "second replacement sync")
+            assert st["primary"]["id"] == primary.ident
+            assert st["deposed"] == []
+            await cluster.wait_writable(primary, "after-sync-death-2",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_storm_restart_reverse_order(tmp_path):
+    """MANATEE_207 variant: kill every peer with no waiting, restart in
+    REVERSE join order (async first) — the cold-start logic must not
+    depend on the original ordering, and synchronously-committed writes
+    must survive."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            for p in (primary, sync, asyncs[0]):
+                p.kill()
+            for p in (asyncs[0], sync, primary):
+                p.start()
+
+            # the pre-storm state node survives in coordd, so a static
+            # topology predicate would match the STALE snapshot; follow
+            # the state's current primary until a write lands
+            import time as _time
+            deadline = _time.monotonic() + 90
+            new_primary = None
+            while _time.monotonic() < deadline:
+                st = await cluster.cluster_state()
+                if st and st.get("sync") is not None:
+                    cand = cluster.peer_by_id(st["primary"]["id"])
+                    try:
+                        res = await cand.pg_query(
+                            {"op": "insert",
+                             "value": "after-reverse-storm",
+                             "timeout": 3.0}, 5.0)
+                        if res.get("ok"):
+                            new_primary = cand
+                            break
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.25)
+            assert new_primary is not None, \
+                "never writable after reverse storm"
+            res = await new_primary.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_storm_primary_flap(tmp_path):
+    """MANATEE_207 variant: the primary dies and returns twice in rapid
+    succession with no waiting between actions; the cluster must settle
+    writable without wedging on the flapping peer's stale sessions."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            primary.kill()
+            primary.start()
+            primary.kill()
+            primary.start()
+
+            # depending on kill/session-timeout interleaving the flapper
+            # either keeps its role or is deposed mid-flap; follow the
+            # state's CURRENT primary until a synchronous write lands
+            import time as _time
+            deadline = _time.monotonic() + 90
+            new_primary = None
+            while _time.monotonic() < deadline:
+                st = await cluster.cluster_state()
+                if st and st.get("sync") is not None:
+                    cand = cluster.peer_by_id(st["primary"]["id"])
+                    try:
+                        res = await cand.pg_query(
+                            {"op": "insert", "value": "after-flap",
+                             "timeout": 3.0}, 5.0)
+                        if res.get("ok"):
+                            new_primary = cand
+                            break
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.25)
+            assert new_primary is not None, "never writable after flap"
+            res = await new_primary.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_coordd_leader_dies_during_failover(tmp_path):
+    """Coordination outage DURING a database failover (VERDICT r1 #6):
+    the PG primary and the coordd ensemble leader are SIGKILLed at the
+    same instant; peers must re-session to the promoted coordination
+    survivor and still complete the database takeover."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3, n_coord=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            gen0 = (await cluster.cluster_state())["generation"]
+
+            leader = await cluster.coord_leader_idx()
+            primary.kill()
+            cluster.kill_coordd(leader)
+
+            st = await cluster.wait_topology(primary=sync, timeout=90)
+            # coord failover wipes sessions, so the takeover may land in
+            # one bump (async re-registered in time) or two (sync=None
+            # takeover, then replacement-sync adoption)
+            assert st["generation"] >= gen0 + 1
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+            await cluster.wait_writable(sync, "after-dual-outage",
+                                        timeout=90)
+            res = await sync.pg_query({"op": "select"})
+            assert "setup-write" in res["rows"]
+        finally:
+            await cluster.stop()
+    run(go())
