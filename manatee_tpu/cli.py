@@ -292,6 +292,35 @@ def cmd_status(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_coord_status(args) -> int:
+    """Probe every coordination member in the connstr: role, seq,
+    leader hint — the ensemble-aware analogue of the reference's
+    zkConnTest smoke tool."""
+    async def go():
+        from manatee_tpu.coord.client import parse_connstr, sync_status
+        addrs = parse_connstr(_coord(args))
+        stats = await asyncio.gather(
+            *[sync_status(host, port, 2.0) for host, port in addrs])
+        rows = []
+        for (host, port), st in zip(addrs, stats):
+            rows.append({
+                "address": "%s:%d" % (host, port),
+                "state": "ok" if st else "unreachable",
+                "role": (st or {}).get("role", "-"),
+                "seq": str((st or {}).get("seq", "-")),
+                "leader": (st or {}).get("leader") or "-",
+            })
+        cols = [{"name": "address", "label": "ADDRESS", "width": 22},
+                {"name": "state", "label": "STATE", "width": 12},
+                {"name": "role", "label": "ROLE", "width": 9},
+                {"name": "seq", "label": "SEQ", "width": 8},
+                {"name": "leader", "label": "LEADER", "width": 22}]
+        emit_table(cols, rows, omit_header=args.omit_header)
+        # exit nonzero when no member is serving sessions
+        return 0 if any(r["role"] == "leader" for r in rows) else 1
+    return asyncio.run(go())
+
+
 def cmd_zk_state(args) -> int:
     async def go():
         async with AdmClient(_coord(args)) as adm:
@@ -565,6 +594,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("zk-state", cmd_zk_state, "dump raw cluster state")
     add("zk-active", cmd_zk_active, "dump active peers")
+    sp = add("coord-status", cmd_coord_status,
+             "probe coordination ensemble members", shard=False)
+    sp.add_argument("-H", "--omit-header", dest="omit_header",
+                    action="store_true", help="omit the header row")
 
     sp = add("freeze", cmd_freeze, "freeze the cluster")
     sp.add_argument("-r", "--reason", required=True)

@@ -71,6 +71,30 @@ def parse_connstr(connstr: str, default_port: int = 2281
     return addrs
 
 
+async def sync_status(host: str, port: int,
+                      timeout: float = 1.0) -> dict | None:
+    """One-shot sessionless status probe of a coordd member: {role, seq,
+    id, leader} — None if it does not answer promptly.  Used by ensemble
+    members for election probing and by `manatee-adm coord-status`."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout)
+    except (OSError, asyncio.TimeoutError):
+        return None
+    try:
+        writer.write(b'{"op":"sync_status","xid":0}\n')
+        await writer.drain()
+        line = await asyncio.wait_for(reader.readline(), timeout)
+        return json.loads(line).get("result")
+    except (OSError, ValueError, asyncio.TimeoutError):
+        return None
+    finally:
+        try:
+            writer.close()
+        except RuntimeError:
+            pass
+
+
 class NetCoord(CoordClient):
     def __init__(self, host: str, port: int | None = None, *,
                  session_timeout: float = 60.0):

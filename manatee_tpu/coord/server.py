@@ -578,24 +578,8 @@ class CoordServer:
     async def _probe(self, addr: tuple[str, int]) -> dict | None:
         """One-shot sync_status request to another member; None if it
         does not answer promptly."""
-        try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(addr[0], addr[1]), 0.4)
-        except (OSError, asyncio.TimeoutError):
-            return None
-        try:
-            writer.write(b'{"op":"sync_status","xid":0}\n')
-            await writer.drain()
-            line = await asyncio.wait_for(reader.readline(), 0.5)
-            msg = json.loads(line)
-            return msg.get("result")
-        except (OSError, ValueError, asyncio.TimeoutError, ConnectionError):
-            return None
-        finally:
-            try:
-                writer.close()
-            except RuntimeError:
-                pass
+        from manatee_tpu.coord.client import sync_status
+        return await sync_status(addr[0], addr[1], 0.5)
 
     async def _follow_loop(self) -> None:
         """Find and follow the leader; promote when no reachable member

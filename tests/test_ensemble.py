@@ -9,6 +9,7 @@ leader stickiness on rejoin, and the mutation quorum.
 
 import asyncio
 import socket
+from pathlib import Path
 
 import pytest
 
@@ -259,4 +260,46 @@ def test_no_quorum_refuses_mutations_allows_reads():
         finally:
             for s in servers:
                 await s.stop()
+    run(go())
+
+
+def test_coord_status_cli(tmp_path):
+    """`manatee-adm coord-status` probes every connstr member and exits
+    nonzero when no member is serving sessions."""
+    import os
+    import sys as _sys
+
+    async def run_cli(members):
+        # async subprocess: blocking here would freeze the event loop
+        # that the in-process ensemble members run on
+        env = dict(os.environ,
+                   PYTHONPATH=str(Path(__file__).parent.parent),
+                   COORD_ADDR=connstr(members))
+        proc = await asyncio.create_subprocess_exec(
+            _sys.executable, "-m", "manatee_tpu.cli", "coord-status",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE, env=env)
+        out, err = await proc.communicate()
+        return proc.returncode, out.decode(), err.decode()
+
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            rc, out, err = await run_cli(members)
+            assert rc == 0, err
+            lines = out.strip().splitlines()
+            assert lines[0].split() == ["ADDRESS", "STATE", "ROLE",
+                                        "SEQ", "LEADER"]
+            roles = [line.split()[2] for line in lines[1:]]
+            assert roles.count("leader") == 1
+            assert roles.count("follower") == 2
+        finally:
+            for s in servers:
+                await s.stop()
+        # all members down: nonzero exit (outside finally, so a primary
+        # failure above is not masked by this check)
+        rc, out, _err = await run_cli(members)
+        assert rc == 1
+        assert "unreachable" in out
     run(go())
