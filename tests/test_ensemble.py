@@ -303,3 +303,121 @@ def test_coord_status_cli(tmp_path):
         assert rc == 1
         assert "unreachable" in out
     run(go())
+
+
+def test_ensemble_soak_random_member_churn(tmp_path):
+    """Randomized churn soak: kill/restart ensemble members (with their
+    persisted state) while a client keeps CAS-incrementing a counter
+    through the connstr.  Invariants at the end: an ACKED write is never
+    lost (quorum commit), the surviving members converge to identical
+    trees, and the counter is monotonic."""
+    async def go():
+        import random
+        rng = random.Random(42)
+        dirs = [str(tmp_path / ("d%d" % i)) for i in range(3)]
+        servers, members = await start_ensemble(data_dirs=dirs)
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+
+            acked = 0
+            stop = asyncio.Event()
+
+            async def writer_loop():
+                nonlocal acked
+                client = None
+                from manatee_tpu.coord.api import NoNodeError
+                while not stop.is_set():
+                    try:
+                        if client is None or client._expired:
+                            client = NetCoord(connstr(members),
+                                              session_timeout=2)
+                            await client.connect()
+                        try:
+                            data, ver = await client.get("/ctr")
+                            # a corrupt counter must CRASH the writer
+                            # (int raises), not be masked as missing
+                            cur = int(data)
+                        except NoNodeError:
+                            cur, ver = None, None
+                        if cur is None:
+                            await client.create("/ctr", b"0")
+                            acked = max(acked, 0)
+                            continue
+                        await client.set("/ctr", str(cur + 1).encode(),
+                                         ver)
+                        # the ack means a majority holds cur+1
+                        acked = max(acked, cur + 1)
+                    except CoordError:
+                        # incl. failed connect(): NetCoord wraps raw
+                        # OSErrors, and a client that never had a
+                        # session gets no reconnect task — rebuild it
+                        client = None
+                        await asyncio.sleep(0.05)
+                    await asyncio.sleep(0.01)
+                if client is not None:
+                    try:
+                        await client.close()
+                    except CoordError:
+                        pass
+
+            wtask = asyncio.ensure_future(writer_loop())
+            # churn: stop a random member, wait, bring it back with its
+            # persisted tree; 8 rounds
+            for _ in range(8):
+                await asyncio.sleep(rng.uniform(0.4, 0.9))
+                i = rng.randrange(3)
+                await servers[i].stop()
+                await asyncio.sleep(rng.uniform(0.3, 0.8))
+                servers[i] = CoordServer(
+                    "127.0.0.1", members[i][1], tick=0.05,
+                    ensemble=members, ensemble_id=i,
+                    promote_grace=0.3, data_dir=dirs[i])
+                await servers[i].start()
+            stop.set()
+            await wtask
+
+            # a leader must re-emerge and serve the final value
+            final_box = [None]
+
+            async def read_final():
+                c = NetCoord(connstr(members), session_timeout=2)
+                try:
+                    await c.connect()
+                    final_box[0] = int((await c.get("/ctr"))[0])
+                    return True
+                except CoordError:
+                    return False
+                finally:
+                    try:
+                        await c.close()
+                    except CoordError:
+                        pass
+
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if await read_final():
+                    break
+                await asyncio.sleep(0.2)
+            final = final_box[0]
+            assert final is not None, "no leader after churn"
+            # no acked write lost
+            assert final >= acked, (final, acked)
+            assert acked > 3, "soak made no progress (acked=%d)" % acked
+
+            # EVERY member converges to the same non-None counter — a
+            # member missing the node entirely is divergence, not
+            # convergence
+            def converged_trees():
+                vals = []
+                for s in servers:
+                    try:
+                        vals.append(s.tree.get("/ctr")[0])
+                    except CoordError:
+                        vals.append(None)
+                return None not in vals and len(set(vals)) == 1
+            assert await wait_for(converged_trees, timeout=10), \
+                "members never converged"
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
