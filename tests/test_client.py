@@ -77,3 +77,55 @@ def test_client_ready_and_topology_events():
         finally:
             await server.stop()
     run(go())
+
+
+def test_client_survives_coord_leader_failover():
+    """node-manatee parity under ensemble HA: a DB client watching the
+    topology through an ensemble connstr must keep receiving topology
+    events after the coordination leader dies and a follower promotes."""
+    async def go():
+        from tests.test_ensemble import (
+            connstr,
+            start_ensemble,
+            wait_for,
+            wait_leader_with_quorum,
+        )
+
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            w = NetCoord(connstr(members), session_timeout=5)
+            await w.connect()
+            await w.mkdirp("/manatee/1")
+            await w.create("/manatee/1/state", json.dumps(
+                make_state("a", "b", ["c"])).encode())
+
+            events = []
+            client = ManateeClient(coord_addr=connstr(members),
+                                   shard="1", session_timeout=1.0)
+            client.on("topology", lambda u: events.append(u))
+            client.on("ready", lambda u: events.append(u))
+            await client.start()
+            assert await wait_for(lambda: bool(events), timeout=5)
+            assert events[0][0] == "sim://a:5432"
+
+            # the coordination leader dies; a follower promotes
+            await servers[0].stop()
+            assert await wait_leader_with_quorum(servers[1], 1)
+            await w.close()   # old writer died with the leader anyway
+
+            # a topology change written via the NEW leader must reach
+            # the client (which re-sessioned through its connstr)
+            w2 = NetCoord(connstr(members), session_timeout=5)
+            await w2.connect()
+            await w2.set("/manatee/1/state", json.dumps(
+                make_state("b", "c", [], gen=1)).encode(), -1)
+            assert await wait_for(
+                lambda: client.topology == ["sim://b:5432",
+                                            "sim://c:5432"], timeout=10)
+            await w2.close()
+            await client.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
