@@ -39,8 +39,13 @@ reference assumes a ZooKeeper ensemble (etc/sitter.json zkCfg.connStr):
 
 This is snapshot-shipping primary/backup, not ZAB/Raft: it needs the
 quorum rule above for safety and trades some availability (a two-member
-ensemble cannot survive a partition safely).  The CoordClient interface
-stays narrow so a real ZK ensemble could back production via an adapter.
+ensemble cannot survive a partition safely).  Each mutation ships the
+full persistent tree, whose size is dominated by the history audit
+trail — fine for a control plane where mutations are topology changes
+(a 10k-transition history is ~4MB per rare mutation); incremental op
+shipping is the known optimization if that assumption ever breaks.
+The CoordClient interface stays narrow so a real ZK ensemble could
+back production via an adapter.
 """
 
 from __future__ import annotations
