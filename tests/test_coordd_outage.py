@@ -92,3 +92,62 @@ def test_coordd_crash_and_restart(tmp_path):
         finally:
             await cluster.stop()
     asyncio.run(go())
+
+
+def test_quorum_loss_leaves_data_plane_running(tmp_path):
+    """Control-plane degradation is not a data-plane outage: with both
+    FOLLOWERS of a 3-member ensemble dead, the surviving leader keeps
+    sessions alive but refuses mutations (no quorum) — the existing
+    primary must keep accepting writes, no topology change can occur,
+    and once a follower returns (quorum restored) failover works
+    again."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3, n_coord=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+            before = await cluster.cluster_state()
+
+            leader = await cluster.coord_leader_idx()
+            followers = [i for i in range(3) if i != leader]
+            for i in followers:
+                cluster.kill_coordd(i)
+            await asyncio.sleep(1.0)
+
+            # data plane unaffected: synchronous writes still commit
+            await cluster.wait_writable(primary, "during-quorum-loss",
+                                        timeout=30)
+            res = await sync.pg_query({"op": "select"})
+            assert "during-quorum-loss" in res["rows"]
+
+            # control plane is read-only: killing an async changes
+            # nothing (the primary cannot write a new topology)
+            asyncs[0].kill()
+            await asyncio.sleep(cluster.session_timeout + 2.0)
+            st = await cluster.cluster_state()
+            assert st is not None
+            assert st["generation"] == before["generation"]
+            assert [a["id"] for a in st.get("async") or []] \
+                == [asyncs[0].ident]
+
+            # quorum returns: the pending topology change (dropping the
+            # dead async) lands
+            cluster.start_coordd(followers[0])
+            st = await cluster.wait_for(
+                lambda s: not s.get("async"), 60, "async dropped")
+
+            # bring the async back (the takeover below needs a standby
+            # for the new primary to enable writes against), then a
+            # subsequent failover still converges
+            asyncs[0].start()
+            st = await cluster.wait_for(
+                lambda s: [a["id"] for a in s.get("async") or []]
+                == [asyncs[0].ident], 60, "async rejoined")
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            assert st["generation"] > before["generation"]
+            await cluster.wait_writable(sync, "post-quorum-restore",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    asyncio.run(go())
