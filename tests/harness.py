@@ -27,6 +27,16 @@ from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
 from manatee_tpu.storage import DirBackend              # noqa: E402
 
 
+def cli_env(coord_addr: str, shard: str = "1") -> dict:
+    """Environment for invoking the manatee-adm CLI as a subprocess —
+    the ONE place the CLI's env contract (COORD_ADDR/SHARD/PYTHONPATH,
+    canned-state hook cleared) is encoded for tests."""
+    env = dict(os.environ, PYTHONPATH=str(REPO), COORD_ADDR=coord_addr,
+               SHARD=shard)
+    env.pop("MANATEE_ADM_TEST_STATE", None)
+    return env
+
+
 def alloc_port_block(n: int) -> int:
     """A contiguous block of *n* free ports BELOW the kernel's ephemeral
     range (so in-flight connections cannot steal them between allocation

@@ -4,7 +4,6 @@ binary, with the cluster reacting underneath."""
 
 import asyncio
 import json
-import os
 import subprocess
 import sys
 from pathlib import Path
@@ -15,13 +14,11 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def adm(cluster, *args, check=True):
-    env = dict(os.environ, PYTHONPATH=str(REPO),
-               COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
-               SHARD="1")
-    env.pop("MANATEE_ADM_TEST_STATE", None)
+    from tests.harness import cli_env
     cp = subprocess.run(
         [sys.executable, "-m", "manatee_tpu.cli"] + list(args),
-        capture_output=True, text=True, env=env, timeout=90)
+        capture_output=True, text=True,
+        env=cli_env(cluster.coord_connstr), timeout=90)
     if check and cp.returncode != 0:
         raise AssertionError("adm %r failed rc=%d: %s %s"
                              % (args, cp.returncode, cp.stdout,

@@ -1,0 +1,99 @@
+"""CLI coverage for state-backfill and check-lock against a real coordd
+(the two operator surfaces previously untested at any level)."""
+
+import asyncio
+import json
+import sys
+
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.server import CoordServer
+from tests.harness import cli_env
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def adm(port, *args):
+    # async variant: the coordd under test runs IN-PROCESS on this
+    # event loop, so a blocking subprocess.run would deadlock it
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "manatee_tpu.cli", *args,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+        env=cli_env("127.0.0.1:%d" % port))
+    out, err = await proc.communicate()
+    return proc.returncode, out.decode(), err.decode()
+
+
+def test_check_lock(tmp_path):
+    """check-lock exits 1 while the lock node exists, 0 once gone
+    (lib/adm.js:2049-2086 contract)."""
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            rc, _o, _e = await adm(server.port, "check-lock",
+                                   "-p", "/mylock")
+            assert rc == 0
+
+            w = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await w.connect()
+            await w.create("/mylock", b"held")
+            rc, _o, _e = await adm(server.port, "check-lock",
+                                   "-p", "/mylock")
+            assert rc == 1
+
+            await w.delete("/mylock")
+            rc, _o, _e = await adm(server.port, "check-lock",
+                                   "-p", "/mylock")
+            assert rc == 0
+            await w.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_state_backfill(tmp_path):
+    """state-backfill creates an initial FROZEN state from the election
+    order when none exists, refuses when one does, and writes the
+    history record atomically (lib/adm.js:1231-1312)."""
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            w = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await w.connect()
+            await w.mkdirp("/manatee/1/election")
+            for i, name in enumerate(["a", "b", "c"]):
+                await w.create(
+                    "/manatee/1/election/%s:5432:1-" % name,
+                    json.dumps({"zoneId": name, "ip": name,
+                                "pgUrl": "sim://%s:5432" % name,
+                                "backupUrl": "http://%s:1" % name}
+                               ).encode(),
+                    ephemeral=True, sequential=True)
+
+            rc, out, err = await adm(server.port, "state-backfill")
+            assert rc == 0, err
+            st = json.loads(out)
+            assert st["generation"] == 0
+            assert st["primary"]["id"] == "a:5432:1"   # join order
+            assert st["sync"]["id"] == "b:5432:1"
+            assert [x["id"] for x in st["async"]] == ["c:5432:1"]
+            assert st["freeze"]["reason"] == \
+                "manatee-adm state-backfill"
+
+            # visible via zk-state, and the audit record exists
+            rc, out, _e = await adm(server.port, "zk-state")
+            assert rc == 0 and json.loads(out)["generation"] == 0
+            hist = await w.get_children("/manatee/1/history")
+            assert len(hist) == 1
+
+            # refuses when state already exists
+            rc, _o, err = await adm(server.port, "state-backfill")
+            assert rc != 0
+            assert "already exists" in err
+            await w.close()
+        finally:
+            await server.stop()
+    run(go())
