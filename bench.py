@@ -31,12 +31,20 @@ from tests.harness import ClusterHarness  # noqa: E402
 
 BASELINE_BUDGET_S = 30.0   # test/integ.test.js:52 convergence budget
 RUNS = 3
-SESSION_TIMEOUT = 0.75
+# Heartbeat-silence bound (wedged/partitioned peers).  A SIGKILLed
+# primary is detected much sooner via the disconnect fast path below.
+SESSION_TIMEOUT = 1.0
+# FIN-to-expiry grace for crashed peers (coordCfg.disconnectGrace).
+# 0.35 is coordd's enforced floor (client reconnect delay 0.2s + slack,
+# so a transient drop can still resume); the kill below FINs
+# immediately and never resumes.
+DISCONNECT_GRACE = 0.35
 
 
 async def one_run(tmp: Path) -> float:
     cluster = ClusterHarness(tmp, n_peers=3,
-                             session_timeout=SESSION_TIMEOUT)
+                             session_timeout=SESSION_TIMEOUT,
+                             disconnect_grace=DISCONNECT_GRACE)
     try:
         await cluster.start()
         p1, p2, p3 = cluster.peers

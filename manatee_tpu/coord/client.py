@@ -97,19 +97,29 @@ async def sync_status(host: str, port: int,
 
 class NetCoord(CoordClient):
     def __init__(self, host: str, port: int | None = None, *,
-                 session_timeout: float = 60.0):
+                 session_timeout: float = 60.0,
+                 disconnect_grace: float | None = None):
         """*host* is either a single hostname (with *port*) or a full
         comma-separated connection string 'h1:p1,h2:p2' covering a
         coordd ensemble (parity: zkCfg.connStr,
         /root/reference/etc/sitter.json).  The client rotates through
         the addresses on connect/reconnect and honors not-leader
-        redirects from ensemble followers."""
+        redirects from ensemble followers.
+
+        *disconnect_grace* (opt-in fast crash detection): asks coordd to
+        expire this session after that much post-disconnect silence
+        instead of the full session timeout.  A SIGKILLed process FINs
+        immediately, so failover detection drops from session_timeout to
+        the grace; set it above the reconnect delay (0.2s) or a
+        transient drop can expire the session before it can be
+        resumed."""
         if port is None:
             self._addrs = parse_connstr(host)
         else:
             self._addrs = [(host, int(port))]
         self._addr_idx = 0
         self._timeout = session_timeout
+        self._disconnect_grace = disconnect_grace
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._session_id: str | None = None
@@ -183,6 +193,8 @@ class NetCoord(CoordClient):
             hello["session_id"] = self._session_id
         else:
             hello["session_timeout"] = self._timeout
+            if self._disconnect_grace is not None:
+                hello["disconnect_grace"] = self._disconnect_grace
         try:
             writer.write((json.dumps(hello) + "\n").encode())
             await writer.drain()
@@ -214,9 +226,12 @@ class NetCoord(CoordClient):
         self._reader, self._writer = reader, writer
         self._read_task = asyncio.ensure_future(self._read_loop(reader))
         self._session_id = res["session_id"]
-        # adopt the server's (possibly floored) timeout so our reconnect
-        # give-up deadline matches the session's actual server lifetime
+        # adopt the server's (possibly floored) values so our reconnect
+        # give-up deadline — and anything reasoning about the effective
+        # disconnect grace — matches what the server actually enforces
         self._timeout = float(res.get("session_timeout", self._timeout))
+        if res.get("disconnect_grace") is not None:
+            self._disconnect_grace = float(res["disconnect_grace"])
         self._connected.set()
         if self._ping_task is None or self._ping_task.done():
             self._ping_task = asyncio.ensure_future(self._ping_loop())
@@ -228,6 +243,16 @@ class NetCoord(CoordClient):
             if t:
                 t.cancel()
         if self._writer:
+            if not self._expired and self._connected.is_set():
+                # best-effort explicit session end, so our ephemerals
+                # vanish NOW instead of at session timeout — closing a
+                # ZooKeeper handle ends the session, and
+                # MemoryCoord.close() already matches that
+                try:
+                    self._writer.write(b'{"op":"goodbye","xid":0}\n')
+                    await self._writer.drain()
+                except (ConnectionError, RuntimeError, OSError):
+                    pass
             try:
                 self._writer.close()
                 await self._writer.wait_closed()

@@ -53,9 +53,22 @@ class Session:
     last_seen: float = field(default_factory=time.monotonic)
     connected: bool = True
     expired: bool = False
+    # Fast crash detection (opt-in): once the session's TCP connection
+    # has dropped, it may expire after this much silence instead of the
+    # full timeout.  A SIGKILLed peer's kernel sends FIN immediately, so
+    # the cluster can fail over in disconnect_grace rather than
+    # session_timeout — something ZooKeeper cannot distinguish (it treats
+    # disconnect and silence identically).  A partitioned-but-alive peer
+    # produces no FIN and still gets the full timeout.
+    disconnect_grace: float | None = None
+    disconnected_at: float | None = None
 
     def deadline(self) -> float:
-        return self.last_seen + self.timeout
+        d = self.last_seen + self.timeout
+        if (not self.connected and self.disconnect_grace is not None
+                and self.disconnected_at is not None):
+            d = min(d, self.disconnected_at + self.disconnect_grace)
+        return d
 
 
 class ZNodeTree:
@@ -115,10 +128,12 @@ class ZNodeTree:
 
     # ---- sessions ----
 
-    def create_session(self, timeout: float) -> Session:
+    def create_session(self, timeout: float,
+                       disconnect_grace: float | None = None) -> Session:
         self._session_counter += 1
         sid = "s%08x-%04d" % (int(time.time()) & 0xFFFFFFFF, self._session_counter)
-        s = Session(id=sid, timeout=timeout)
+        s = Session(id=sid, timeout=timeout,
+                    disconnect_grace=disconnect_grace)
         self.sessions[sid] = s
         return s
 

@@ -15,7 +15,16 @@ Wire protocol: newline-delimited JSON over TCP.
   watch push         {"watch": {"kind": "data", "type": "deleted", "path": "/a"}}
 
 Sessions: ``hello`` creates (or resumes) a session; a dropped TCP
-connection leaves the session alive until ``session_timeout`` elapses.
+connection leaves the session alive until ``session_timeout`` elapses —
+unless the client opted into a ``disconnect_grace``, in which case a
+*disconnected* session expires after that (shorter) grace.  The grace
+is the fast crash-detection path: a SIGKILLed peer's kernel FINs its
+socket immediately, so coordd can distinguish "process died" (FIN, then
+silence) from "process wedged or partitioned" (no FIN; full heartbeat
+timeout applies).  ZooKeeper cannot make this distinction — its clients
+talk through a session abstraction that deliberately hides connection
+state.  ``goodbye`` ends a session explicitly (ephemeral nodes vanish
+at once), matching ZooKeeper handle close.
 
 Ensemble mode (--ensemble/--ensemble-id) replicates coordd the way the
 reference assumes a ZooKeeper ensemble (etc/sitter.json zkCfg.connStr):
@@ -83,6 +92,13 @@ MAX_LINE = 8 * 1024 * 1024
 # per-connection outbound buffer cap; beyond this the subscriber is
 # considered stalled and its connection is aborted (ADVICE r1)
 MAX_BUFFERED = 16 * 1024 * 1024
+# floor for client-requested disconnect_grace: must outlive the
+# client's reconnect delay (plus connect/hello slack) or a transient
+# TCP drop expires the session before the first resume attempt can
+# happen.  Derived from the client constant so the two cannot drift.
+from manatee_tpu.coord.client import RECONNECT_DELAY  # noqa: E402
+
+MIN_DISCONNECT_GRACE = RECONNECT_DELAY + 0.15
 # ops that change the persistent tree and must be replicated/quorum-gated
 _MUTATING = frozenset({"create", "set", "delete", "multi"})
 
@@ -322,6 +338,7 @@ class CoordServer:
                 del self._session_conns[conn.session.id]
                 conn.session.connected = False
                 conn.session.last_seen = time.monotonic()
+                conn.session.disconnected_at = conn.session.last_seen
             writer.close()
 
     async def _dispatch(self, conn: _Conn, req: dict) -> None:
@@ -389,18 +406,40 @@ class CoordServer:
             # clamps to a server-side minimum of 2 ticks).
             timeout = max(float(req.get("session_timeout", 60.0)),
                           4 * self.tick)
-            sess = self.tree.create_session(timeout)
+            grace = req.get("disconnect_grace")
+            if grace is not None:
+                # must outlive the expiry tick and the client's
+                # reconnect delay, or a transient drop could never be
+                # resumed before the fast path expires it
+                grace = max(float(grace), 2 * self.tick,
+                            MIN_DISCONNECT_GRACE)
+            sess = self.tree.create_session(timeout,
+                                            disconnect_grace=grace)
         sess.connected = True
         sess.last_seen = time.monotonic()
+        sess.disconnected_at = None
         conn.session = sess
         self._session_conns[sess.id] = conn
-        return {"session_id": sess.id, "session_timeout": sess.timeout}
+        # report the EFFECTIVE (possibly floored) values so the client
+        # can reason from what the server will actually enforce
+        return {"session_id": sess.id, "session_timeout": sess.timeout,
+                "disconnect_grace": sess.disconnect_grace}
 
     def _op(self, conn: _Conn, op: str, req: dict):
         tree = self.tree
         path = req.get("path", "")
         if op == "ping":
             return "pong"
+        if op == "goodbye":
+            # explicit session end: ephemerals vanish NOW, like closing a
+            # ZooKeeper handle (and like MemoryCoord.close()).  Without
+            # this a cleanly-shut-down peer lingers in the election until
+            # its session times out.
+            sid = conn.session.id
+            tree.expire_session(sid)
+            tree.sessions.pop(sid, None)
+            self._session_conns.pop(sid, None)
+            return "bye"
         if op == "create":
             return tree.create(
                 path, _unb64(req.get("data")),

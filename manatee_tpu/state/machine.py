@@ -100,6 +100,11 @@ class PeerStateMachine:
         self.singleton = singleton
         self.takeover_grace = takeover_grace
         self._boot_time: float | None = None
+        # peer ids seen alive in membership since our own init: a
+        # disappearance we *witnessed* is death evidence (the failure
+        # detector expired it while we watched), so the cold-start
+        # absence-isn't-death grace does not apply to it
+        self._witnessed: set[str] = set()
 
         self._zk_ready = False
         self._pg_ready = False
@@ -136,19 +141,30 @@ class PeerStateMachine:
     # CAS version always matches the snapshot the decision was computed
     # from.
 
-    def _on_zk_init(self, _payload: dict) -> None:
+    def _witness(self, actives: list[dict] | None) -> None:
+        self._witnessed.update(a["id"] for a in actives or [])
+
+    def _on_zk_init(self, payload: dict) -> None:
         self._zk_ready = True
         if self._boot_time is None:
             self._boot_time = asyncio.get_event_loop().time()
+        self._witness((payload or {}).get("active"))
         self.kick()
 
-    def _on_session_rebuilt(self, _payload: dict) -> None:
+    def _on_session_rebuilt(self, payload: dict) -> None:
         # after a session expiry/rebuild the absence-isn't-death grace
-        # must re-arm: everyone just re-registered from scratch
+        # must re-arm: everyone just re-registered from scratch, so
+        # prior sightings are void — but the rebuilt membership snapshot
+        # counts as a fresh sighting (like the init payload), or a
+        # primary that re-registered and later dies would wrongly get
+        # the cold-start grace
         self._boot_time = asyncio.get_event_loop().time()
+        self._witnessed.clear()
+        self._witness((payload or {}).get("active"))
         self.kick()
 
-    def _on_active_change(self, _actives: list[dict]) -> None:
+    def _on_active_change(self, actives: list[dict]) -> None:
+        self._witness(actives)
         self.kick()
 
     def _on_cluster_state(self, _state: ClusterState) -> None:
@@ -425,9 +441,12 @@ class PeerStateMachine:
         if primary_alive and not promote_me:
             return False
 
-        if not primary_alive and not promote_me and self._boot_time:
+        if not primary_alive and not promote_me and self._boot_time \
+                and st["primary"]["id"] not in self._witnessed:
             # cold-start grace: shortly after boot, the primary's absence
-            # may mean it has not re-joined yet, not that it died
+            # may mean it has not re-joined yet, not that it died.  Only
+            # for primaries we never saw alive — a disappearance we
+            # witnessed (present in membership, then expired) is death.
             elapsed = asyncio.get_event_loop().time() - self._boot_time
             if elapsed < self.takeover_grace:
                 delay = self.takeover_grace - elapsed + 0.05

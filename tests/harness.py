@@ -108,7 +108,9 @@ class Peer:
             "zfsHost": self.ip,
             "zfsPort": self.zfs_port,
             "coordCfg": {"connStr": self.cluster.coord_connstr,
-                         "sessionTimeout": self.cluster.session_timeout},
+                         "sessionTimeout": self.cluster.session_timeout,
+                         "disconnectGrace":
+                             self.cluster.disconnect_grace},
             "opsTimeout": 10,
             "healthChkInterval": 0.3,
             "healthChkTimeout": 2,
@@ -184,13 +186,25 @@ class ClusterHarness:
     def __init__(self, root: Path, *, n_peers: int = 3,
                  session_timeout: float = 2.0, singleton: bool = False,
                  shard: str = "1", n_coord: int = 1,
-                 coord_promote_grace: float = 1.0):
+                 coord_promote_grace: float = 1.0,
+                 disconnect_grace: float | None = 0.4):
         """*n_coord* > 1 runs a replicated coordd ensemble; peers get the
         full connStr and rotate to the live leader (zkCfg.connStr
-        parity)."""
+        parity).
+
+        *disconnect_grace*: sitters opt into fast crash detection — a
+        SIGKILLed peer's session expires this long after its FIN instead
+        of after *session_timeout* (coordd floors it at 0.35s, above the
+        client reconnect delay).  On by default because the shipped
+        production config enables it, making the fast path the mainline
+        detection path — the bulk of the kill suites should exercise
+        what production runs.  None reverts to pure heartbeat expiry
+        (ZooKeeper semantics); the dedicated control test for that path
+        is test_integration.test_heartbeat_only_failover_with_grace_disabled."""
         self.root = Path(root)
         self.shard_path = "/manatee/%s" % shard
         self.session_timeout = session_timeout
+        self.disconnect_grace = disconnect_grace
         self.singleton = singleton
         self.n_coord = n_coord
         self.coord_promote_grace = coord_promote_grace

@@ -545,3 +545,117 @@ def test_consensus_mgr_over_netcoord_failover_detection():
         finally:
             await server.stop()
     run(go())
+
+
+def test_disconnect_grace_fast_expiry():
+    """Opt-in fast crash detection: a session whose TCP connection
+    dropped (SIGKILL -> FIN) expires after disconnect_grace, NOT the
+    full session timeout.  ZooKeeper cannot make this distinction; we
+    can because coordd sees the FIN directly."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            victim = NetCoord("127.0.0.1", server.port,
+                              session_timeout=5, disconnect_grace=0.3)
+            survivor = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await victim.connect()
+            await survivor.connect()
+            await victim.mkdirp("/el")
+            await victim.create("/el/v-", b"d", ephemeral=True,
+                                sequential=True)
+            # SIGKILL-analog: abort transport, no goodbye
+            victim._closed = True
+            for t in (victim._read_task, victim._ping_task):
+                if t:
+                    t.cancel()
+            victim._writer.transport.abort()
+
+            await asyncio.sleep(0.1)
+            assert await survivor.get_children("/el") != []   # inside grace
+            await asyncio.sleep(0.45)
+            # grace elapsed: expired long before the 5s session timeout
+            assert await survivor.get_children("/el") == []
+            await survivor.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_disconnect_grace_resume_within_grace():
+    """A transient connection drop resumed within the grace must NOT
+    expire the session — fast expiry is for FIN-then-silence, not for a
+    client that reconnects."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port,
+                         session_timeout=5, disconnect_grace=0.6)
+            await c.connect()
+            await c.mkdirp("/el")
+            await c.create("/el/v-", b"d", ephemeral=True, sequential=True)
+            sid = c._session_id
+            # transient drop: abort the transport but leave the client's
+            # reconnect machinery running (RECONNECT_DELAY 0.2 < grace)
+            c._writer.transport.abort()
+            await asyncio.sleep(0.4)
+            assert c._session_id == sid and not c._expired
+            assert await c.get_children("/el") != []
+            # and the session stays alive well past the original grace
+            await asyncio.sleep(0.5)
+            assert await c.get_children("/el") != []
+            await c.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_disconnect_grace_connected_session_gets_full_timeout():
+    """The grace only applies after a disconnect: a connected, pinging
+    session with a grace configured lives on normally."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port,
+                         session_timeout=1.0, disconnect_grace=0.25)
+            await c.connect()
+            await c.mkdirp("/el")
+            await c.create("/el/v-", b"d", ephemeral=True, sequential=True)
+            await asyncio.sleep(1.5)   # several grace periods
+            assert await c.get_children("/el") != []
+            await c.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_goodbye_removes_ephemerals_immediately():
+    """NetCoord.close() ends the session server-side (ZK handle-close
+    parity, matching MemoryCoord.close()): ephemerals vanish NOW, with
+    no session-timeout lingering, and the survivor's watch fires."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            leaver = NetCoord("127.0.0.1", server.port, session_timeout=60)
+            survivor = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await leaver.connect()
+            await survivor.connect()
+            await leaver.mkdirp("/el")
+            await leaver.create("/el/v-", b"d", ephemeral=True,
+                                sequential=True)
+            events = []
+            assert await survivor.get_children("/el",
+                                               watch=events.append) != []
+            sid = leaver._session_id
+            await leaver.close()
+            await asyncio.sleep(0.2)
+            assert await survivor.get_children("/el") == []
+            assert sid not in server.tree.sessions
+            assert events and events[0].type.value == "children_changed"
+            await survivor.close()
+        finally:
+            await server.stop()
+    run(go())

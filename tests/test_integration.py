@@ -210,3 +210,61 @@ def test_everyone_dies(tmp_path):
         finally:
             await cluster.stop()
     run(go())
+
+
+def test_fast_crash_failover_beats_session_timeout(tmp_path):
+    """disconnectGrace end to end: with a deliberately long (10s)
+    session timeout and a 0.4s grace, SIGKILLing the primary must yield
+    a writable cluster in a couple of seconds — achievable only via the
+    FIN fast path, since heartbeat expiry alone could not fire before
+    10s.  This is the design win over the reference's ZooKeeper-bound
+    detection floor (etc/sitter.json sessionTimeout 60s)."""
+    import time as _time
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, session_timeout=10.0,
+                                 disconnect_grace=0.4)
+        try:
+            await cluster.start()
+            primary, sync, _asyncs = await converged(cluster)
+
+            t0 = _time.monotonic()
+            primary.kill()
+            await cluster.wait_topology(primary=sync, timeout=8)
+            await cluster.wait_writable(sync, "fast-failover", timeout=8)
+            elapsed = _time.monotonic() - t0
+            # hard bound: well under the 10s session timeout (the CI
+            # budget leaves slack; typical is ~1s)
+            assert elapsed < 8.0, "failover took %.2fs" % elapsed
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_heartbeat_only_failover_with_grace_disabled(tmp_path):
+    """Control for the FIN fast path: with disconnectGrace disabled the
+    SIGKILLed primary's session must expire via pure heartbeat silence
+    (ZooKeeper semantics — the wedged/partitioned-peer path), and the
+    cluster must still converge end to end through the full sitter
+    stack."""
+    import time as _time
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, session_timeout=1.5,
+                                 disconnect_grace=None)
+        try:
+            await cluster.start()
+            primary, sync, _asyncs = await converged(cluster)
+
+            t0 = _time.monotonic()
+            primary.kill()
+            await cluster.wait_topology(primary=sync)
+            await cluster.wait_writable(sync, "heartbeat-failover")
+            elapsed = _time.monotonic() - t0
+            # cannot have been the fast path (disabled); must have taken
+            # at least roughly the heartbeat-silence bound
+            assert elapsed > 1.0, \
+                "failover in %.2fs with grace disabled?" % elapsed
+        finally:
+            await cluster.stop()
+    run(go())

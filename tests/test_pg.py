@@ -39,7 +39,10 @@ def make_mgr(tmp_path, name, *, singleton=False, dataset=None,
         "port": port,
         "datadir": str(tmp_path / name / "data"),
         "dataset": dataset,
-        "opsTimeout": 10.0,
+        # generous: on a loaded CI host a subprocess spawn alone can
+        # stall for seconds, and a boot-timeout flake here proves
+        # nothing about the manager
+        "opsTimeout": 30.0,
         "healthChkInterval": 0.2,
         "healthChkTimeout": 2.0,
         "replicationTimeout": 10.0,

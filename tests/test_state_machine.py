@@ -42,7 +42,8 @@ class SimPg:
 
 
 class SimPeer:
-    def __init__(self, space, name, *, singleton=False, timeout=60.0):
+    def __init__(self, space, name, *, singleton=False, timeout=60.0,
+                 takeover_grace=0.0):
         self.space = space
         self.name = name
         self.ident = "%s:5432:12345" % name
@@ -65,7 +66,8 @@ class SimPeer:
                                ident=self.ident, data=data)
         self.sm = PeerStateMachine(zk=self.zk, pg=self.pg,
                                    self_info=self.info,
-                                   singleton=singleton)
+                                   singleton=singleton,
+                                   takeover_grace=takeover_grace)
         self._last_state = None
 
         def check(state):
@@ -526,4 +528,58 @@ def test_degenerate_takeover_then_sync_added():
         no_violations(b, c)
         await b.close()
         await c.close()
+    asyncio.run(go())
+
+
+def test_witnessed_death_bypasses_cold_start_grace():
+    """The absence-isn't-death grace must not delay takeover from a
+    primary the sync SAW die: B watched A in the membership and then
+    watched it expire, which is death evidence, not boot ambiguity."""
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A")
+        b = SimPeer(space, "B", takeover_grace=30.0)
+        c = SimPeer(space, "C", takeover_grace=30.0)
+        await start_three(a, b, c)
+
+        await a.kill()
+        # with a 30s grace a non-witnessing sync would sit out the wait;
+        # the 5s budget only passes via the witnessed-death bypass
+        await wait_for(lambda: (b.sm._state or {}).get("generation") == 1,
+                       what="immediate takeover despite 30s grace")
+        st = await get_state(space)
+        assert st["primary"]["id"] == b.ident
+        no_violations(b, c)
+        await b.close()
+        await c.close()
+    asyncio.run(go())
+
+
+def test_unwitnessed_absence_still_defers_takeover():
+    """Control for the bypass: a sync that BOOTS into a cluster state
+    whose primary is absent (whole-cluster restart, sync back first)
+    never witnessed the death and must honor the grace — the primary
+    may simply not have re-joined yet."""
+    async def go():
+        space = CoordSpace()
+        a = SimPeer(space, "A")
+        b = SimPeer(space, "B")
+        c = SimPeer(space, "C")
+        await start_three(a, b, c)
+        await a.kill()
+        await b.kill()
+        await c.kill()
+
+        # sync restarts alone; primary A stays gone
+        b2 = SimPeer(space, "B", takeover_grace=0.8)
+        b2.pg.xlog = "0/0001000"
+        await b2.start()
+        await asyncio.sleep(0.4)
+        st = await get_state(space)
+        assert st["generation"] == 0            # inside grace: no takeover
+        assert st["primary"]["id"] == a.ident
+        await wait_for(lambda: (b2.sm._state or {}).get("generation") == 1,
+                       what="takeover after grace expires")
+        no_violations(b2)
+        await b2.close()
     asyncio.run(go())
