@@ -19,6 +19,13 @@ from enum import Enum
 from typing import Callable
 
 
+# Delay between a client's reconnect attempts after a dropped
+# connection.  Lives here (not in client.py) because the server derives
+# its disconnect-grace floor from it — both sides must agree or a
+# transient drop could expire a session before its first resume attempt.
+RECONNECT_DELAY = 0.2
+
+
 class CoordError(Exception):
     pass
 

@@ -23,6 +23,7 @@ import time
 from typing import Callable
 
 from manatee_tpu.coord.api import (
+    RECONNECT_DELAY,
     BadVersionError,
     ConnectionLossError,
     CoordClient,
@@ -50,7 +51,6 @@ _ERRS = {
     "CoordError": CoordError,
 }
 
-RECONNECT_DELAY = 0.2
 HANDSHAKE_TIMEOUT = 5.0
 MAX_LINE = 8 * 1024 * 1024  # must match coordd's stream limit
 

@@ -46,15 +46,17 @@ reference assumes a ZooKeeper ensemble (etc/sitter.json zkCfg.connStr):
   clients observe session loss and re-register — the same contract as
   a coordd restart, and the recovery path ConsensusMgr already owns.
 
-This is snapshot-shipping primary/backup, not ZAB/Raft: it needs the
-quorum rule above for safety and trades some availability (a two-member
-ensemble cannot survive a partition safely).  Each mutation ships the
-full persistent tree, whose size is dominated by the history audit
-trail — fine for a control plane where mutations are topology changes
-(a 10k-transition history is ~4MB per rare mutation); incremental op
-shipping is the known optimization if that assumption ever breaks.
-The CoordClient interface stays narrow so a real ZK ensemble could
-back production via an adapter.
+This is op-shipping primary/backup, not ZAB/Raft: it needs the quorum
+rule above for safety and trades some availability (a two-member
+ensemble cannot survive a partition safely).  A follower attaches with
+one full-snapshot resync (sync_hello), then receives each persistent
+mutation as the op itself — O(op), independent of tree/history size —
+and applies it in sequence, acking the seq.  Any gap, version
+conflict, or result mismatch on apply means divergence and triggers a
+fresh full resync; ephemeral-only mutations (election joins) touch no
+persistent state and are not shipped at all.  The CoordClient
+interface stays narrow so a real ZK ensemble could back production via
+an adapter.
 """
 
 from __future__ import annotations
@@ -69,6 +71,7 @@ import time
 
 from manatee_tpu.coord import model
 from manatee_tpu.coord.api import (
+    RECONNECT_DELAY,
     BadVersionError,
     CoordError,
     NodeExistsError,
@@ -95,9 +98,7 @@ MAX_BUFFERED = 16 * 1024 * 1024
 # floor for client-requested disconnect_grace: must outlive the
 # client's reconnect delay (plus connect/hello slack) or a transient
 # TCP drop expires the session before the first resume attempt can
-# happen.  Derived from the client constant so the two cannot drift.
-from manatee_tpu.coord.client import RECONNECT_DELAY  # noqa: E402
-
+# happen.  Derived from the shared api constant so the two cannot drift.
 MIN_DISCONNECT_GRACE = RECONNECT_DELAY + 0.15
 # ops that change the persistent tree and must be replicated/quorum-gated
 _MUTATING = frozenset({"create", "set", "delete", "multi"})
@@ -363,12 +364,19 @@ class CoordServer:
             else:
                 self.tree.touch_session(conn.session.id)
                 mutating = op in _MUTATING
+                mode = None
                 if mutating:
                     self._check_quorum()
+                    # classify BEFORE applying: an ephemeral delete
+                    # target is gone afterwards
+                    mode = self._replication_mode(op, req)
                 result = self._op(conn, op, req)
-                if mutating:
+                if mutating and mode is not None:
                     self._seq += 1
-                    acks = await self._replicate()
+                    if mode == "op":
+                        acks = await self._replicate_op(req, result)
+                    else:
+                        acks = await self._replicate_snapshot()
                     self._check_commit_quorum(acks)
             conn.push({"xid": xid, "ok": True, "result": result})
         except NotLeaderError as e:
@@ -542,16 +550,57 @@ class CoordServer:
                 "(uncommitted; retry may see it applied)"
                 % (1 + acks, len(self.ensemble)))
 
-    async def _replicate(self) -> int:
-        """Ship the persistent tree at the current seq to every follower
-        and await acks; a follower that cannot ack within the timeout is
+    def _replication_mode(self, op: str, req: dict) -> str | None:
+        """How a mutation reaches followers: 'op' (ship the op itself),
+        'snapshot' (rare fallback), or None (no persistent effect —
+        ephemerals live only on the leader, so there is nothing to
+        ship; election joins/leaves stay O(0) for the ensemble).
+
+        Unshipped ephemeral-sequential creates mean the counter of a
+        parent like election/ runs ahead on the leader; that is safe:
+        the counter only names EPHEMERAL children, which die with their
+        sessions at failover, so a promoted follower's lower counter
+        cannot collide with anything still alive."""
+        if op == "create":
+            return None if req.get("ephemeral") else "op"
+        if op in ("set", "delete"):
+            stat = self.tree.exists(req.get("path", ""))
+            if stat is not None and stat.ephemeral_owner is not None:
+                return None
+            return "op"
+        if op == "multi":
+            # our transactions (putClusterState) are persistent-only;
+            # a mixed one would leave ephemerals out of the shipped op,
+            # so fall back to the full snapshot for that case
+            if any(o.get("ephemeral") for o in req.get("ops", [])):
+                return "snapshot"
+            return "op"
+        return "op"
+
+    async def _replicate_op(self, req: dict, result) -> int:
+        """Ship one persistent mutation as the op itself — O(op), not
+        O(tree).  *result* rides along so followers can verify their
+        apply produced the same outcome (sequential names, versions)."""
+        wire = {k: req[k] for k in ("op", "path", "data", "version",
+                                    "sequential", "ops") if k in req}
+        return await self._ship(
+            {"sync_op": {"seq": self._seq, "req": wire, "expect": result}})
+
+    async def _replicate_snapshot(self) -> int:
+        """Ship the full persistent tree (follower attach + the rare
+        mixed-transaction fallback)."""
+        return await self._ship(
+            {"sync": {"seq": self._seq,
+                      "snapshot": self.tree.to_snapshot()}})
+
+    async def _ship(self, msg: dict) -> int:
+        """Push *msg* (carrying the current seq) to every follower and
+        await acks; a follower that cannot ack within the timeout is
         severed (it will resync with a fresh sync_hello).  Returns the
         number of followers that acked."""
         if not self._follower_conns:
             return 0
         seq = self._seq
-        snap = self.tree.to_snapshot()
-        msg = {"sync": {"seq": seq, "snapshot": snap}}
         loop = asyncio.get_running_loop()
         waiters: list[tuple[_Conn, asyncio.Future]] = []
         for f in list(self._follower_conns):
@@ -708,6 +757,25 @@ class CoordServer:
                     writer.write((json.dumps(
                         {"op": "sync_ack", "seq": s["seq"]}) + "\n").encode())
                     await writer.drain()
+                elif "sync_op" in msg:
+                    s = msg["sync_op"]
+                    seq = int(s["seq"])
+                    if seq != self._seq + 1:
+                        break   # gap: resync with a fresh sync_hello
+                    try:
+                        got = self._apply_op(s.get("req") or {})
+                    except CoordError as e:
+                        log.warning("replicated op failed (diverged?): "
+                                    "%s; resyncing", e)
+                        break
+                    if s.get("expect", got) != got:
+                        log.warning("replicated op result %r != leader's "
+                                    "%r; resyncing", got, s.get("expect"))
+                        break
+                    self._seq = seq
+                    writer.write((json.dumps(
+                        {"op": "sync_ack", "seq": seq}) + "\n").encode())
+                    await writer.drain()
                 elif "sync_ping" in msg:
                     if int(msg["sync_ping"].get("seq", -1)) != self._seq:
                         break   # drifted; resync with a fresh sync_hello
@@ -717,6 +785,32 @@ class CoordServer:
                 writer.close()
             except RuntimeError:
                 pass
+
+    def _apply_op(self, r: dict):
+        """Apply one leader-replicated persistent mutation to the local
+        tree.  Followers hold only the persistent tree: no sessions, no
+        ephemerals, no client watches.  Version checks run against OUR
+        tree — a BadVersionError here means we diverged from the leader
+        and the caller falls back to a full resync."""
+        op = r.get("op")
+        if op == "create":
+            return self.tree.create(r["path"], _unb64(r.get("data")),
+                                    sequential=bool(r.get("sequential")))
+        if op == "set":
+            return self.tree.set(r["path"], _unb64(r.get("data")),
+                                 int(r.get("version", -1)))
+        if op == "delete":
+            self.tree.delete(r["path"], int(r.get("version", -1)))
+            return None
+        if op == "multi":
+            ops = [Op(kind=o["kind"], path=o["path"],
+                      data=_unb64(o.get("data")),
+                      version=int(o.get("version", -1)),
+                      ephemeral=False,
+                      sequential=bool(o.get("sequential")))
+                   for o in r.get("ops", [])]
+            return self.tree.multi(ops, session_id=None)
+        raise CoordError("unknown replicated op: %r" % op)
 
     def _apply_sync(self, seq: int, snap: dict, *,
                     force: bool = False) -> None:

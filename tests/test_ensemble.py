@@ -421,3 +421,120 @@ def test_ensemble_soak_random_member_churn(tmp_path):
             for s in servers:
                 await s.stop()
     run(go())
+
+
+def test_op_shipping_fidelity_and_failover():
+    """Incremental op replication: a mixed workload (creates, sequential
+    creates, CAS sets, deletes, a putClusterState-style transaction,
+    interleaved ephemerals) must leave every follower's persistent tree
+    IDENTICAL to the leader's — data, versions, and the seq counters of
+    persistent-sequential parents — and a leader failover must surface
+    exactly that data on the new leader.  Parents of EPHEMERAL
+    sequential children (the election node) are allowed to differ in
+    counter only: those creates are never shipped, and their names
+    cannot collide across failovers because the ephemerals die with
+    their sessions."""
+    from manatee_tpu.coord.api import Op
+
+    def counterless(snap_node):
+        return {
+            "data": snap_node["data"], "version": snap_node["version"],
+            "children": {k: counterless(v)
+                         for k, v in snap_node["children"].items()},
+        }
+
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+
+            await c.mkdirp("/shard/history")
+            await c.create("/shard/state", b"gen0")
+            await c.create("/shard/election", b"")
+            # ephemerals interleave with persistent traffic
+            await c.create("/shard/election/p1-", b"m1",
+                           ephemeral=True, sequential=True)
+            seq_mid = servers[0]._seq
+            await c.create("/shard/election/p2-", b"m2",
+                           ephemeral=True, sequential=True)
+            # ephemeral-only mutations consume NO replication sequence
+            assert servers[0]._seq == seq_mid
+            await c.set("/shard/state", b"gen1", 0)
+            await c.multi([
+                Op.create("/shard/history/0000000000-", b"h0",
+                          sequential=True),
+                Op.set("/shard/state", b"gen2", 1),
+            ])
+            await c.create("/tmp-node", b"bye")
+            await c.delete("/tmp-node")
+
+            def trees_equal():
+                want = counterless(servers[0].tree.to_snapshot()["root"])
+                hist = servers[0].tree._resolve("/shard/history")
+                return all(
+                    counterless(s.tree.to_snapshot()["root"]) == want
+                    and s._seq == servers[0]._seq
+                    # persistent-sequential counters DO replicate
+                    and s.tree._resolve("/shard/history").seq_counter
+                    == hist.seq_counter
+                    for s in servers[1:])
+            assert await wait_for(trees_equal), "followers diverged"
+
+            # failover: the promoted follower serves the same data
+            await c.close()
+            await servers[0].stop()
+            assert await wait_for(
+                lambda: any(s.role == "leader" for s in servers[1:]),
+                timeout=8)
+            c2 = NetCoord(connstr(members[1:]), session_timeout=5)
+            await c2.connect()
+            data, ver = await c2.get("/shard/state")
+            assert (data, ver) == (b"gen2", 2)
+            hist = await c2.get_children("/shard/history")
+            assert hist == ["0000000000-0000000000"]
+            # old leader's ephemerals died with their sessions
+            assert await c2.get_children("/shard/election") == []
+            await c2.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_diverged_follower_resyncs_via_snapshot():
+    """A follower whose tree drifted (simulated by mutating it behind
+    the protocol's back) must fail the shipped op's version check,
+    fall back to a full-snapshot resync, and converge again."""
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/state", b"v0")
+
+            assert await wait_for(
+                lambda: servers[1].tree.exists("/state") is not None)
+            # corrupt follower 1: version now ahead of the leader's
+            servers[1].tree.set("/state", b"garbage", -1)
+
+            # next CAS write ships set(version=1): follower 1 sees v2,
+            # BadVersion -> resync
+            await c.set("/state", b"v1", 0)
+
+            def healed():
+                try:
+                    data, ver = servers[1].tree.get("/state")
+                except CoordError:
+                    return False
+                return (data, ver) == (b"v1", 1) \
+                    and servers[1]._seq == servers[0]._seq
+            assert await wait_for(healed, timeout=8), \
+                "diverged follower never resynced"
+            await c.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
