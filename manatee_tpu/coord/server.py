@@ -284,18 +284,21 @@ class CoordServer:
             self._server.close()
             await self._server.wait_closed()
 
+    def _expire_due_sessions(self) -> None:
+        for sid in self.tree.expired_sessions():
+            log.info("session %s expired", sid)
+            self.tree.expire_session(sid)
+            self.tree.sessions.pop(sid, None)
+            conn = self._session_conns.pop(sid, None)
+            if conn is not None:
+                # hung-but-connected client: sever the socket so it
+                # observes expiry instead of lingering half-alive
+                conn.sever()
+
     async def _expiry_loop(self) -> None:
         while True:
             await asyncio.sleep(self.tick)
-            for sid in self.tree.expired_sessions():
-                log.info("session %s expired", sid)
-                self.tree.expire_session(sid)
-                self.tree.sessions.pop(sid, None)
-                conn = self._session_conns.pop(sid, None)
-                if conn is not None:
-                    # hung-but-connected client: sever the socket so it
-                    # observes expiry instead of lingering half-alive
-                    conn.sever()
+            self._expire_due_sessions()
 
     # ---- per-connection ----
 
@@ -340,6 +343,13 @@ class CoordServer:
                 conn.session.connected = False
                 conn.session.last_seen = time.monotonic()
                 conn.session.disconnected_at = conn.session.last_seen
+                if conn.session.disconnect_grace is not None:
+                    # precise fast-path expiry: don't leave the grace
+                    # quantized by the periodic tick (a failover waits
+                    # on this deadline)
+                    asyncio.get_running_loop().call_later(
+                        conn.session.disconnect_grace + 0.005,
+                        self._expire_due_sessions)
             writer.close()
 
     async def _dispatch(self, conn: _Conn, req: dict) -> None:
@@ -569,11 +579,20 @@ class CoordServer:
                 return None
             return "op"
         if op == "multi":
-            # our transactions (putClusterState) are persistent-only;
-            # a mixed one would leave ephemerals out of the shipped op,
-            # so fall back to the full snapshot for that case
-            if any(o.get("ephemeral") for o in req.get("ops", [])):
-                return "snapshot"
+            # our transactions (putClusterState) are persistent-only; a
+            # transaction that CREATES an ephemeral, or sets/deletes an
+            # existing one, has effects followers must not (create) or
+            # cannot (set/delete a node they do not hold) apply — fall
+            # back to the full snapshot, which carries exactly the
+            # persistent outcome
+            for o in req.get("ops", []):
+                if o.get("ephemeral"):
+                    return "snapshot"
+                if o.get("kind") in ("set", "delete"):
+                    stat = self.tree.exists(o.get("path", ""))
+                    if stat is not None and \
+                            stat.ephemeral_owner is not None:
+                        return "snapshot"
             return "op"
         return "op"
 

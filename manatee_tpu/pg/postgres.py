@@ -123,13 +123,25 @@ class PostgresEngine(Engine):
     def __init__(self, *, pg_bin_dir: str = "", version: str = "12.0",
                  pg_user: str = "postgres", use_sudo: bool = True,
                  template: dict | None = None,
+                 template_file: str | None = None,
+                 hba_file: str | None = None,
                  overrides: dict | None = None):
+        """*template_file*: a shipped postgresql.conf to regenerate from
+        (etc/postgresql.conf; the reference always rewrites starting
+        from its shipped per-major template, lib/postgresMgr.js:
+        2278-2336) — takes precedence over *template*/DEFAULT_TEMPLATE.
+        *hba_file*: a shipped pg_hba.conf installed into the datadir
+        after initdb (lib/postgresMgr.js:1954-1956)."""
         self.bin = Path(pg_bin_dir) if pg_bin_dir else None
         self.version = version
         self.major = pg_strip_minor(version)
         self.pg_user = pg_user
         self.use_sudo = use_sudo
-        self.template = dict(template or DEFAULT_TEMPLATE)
+        if template_file:
+            self.template = dict(ConfFile.read(template_file).items())
+        else:
+            self.template = dict(template or DEFAULT_TEMPLATE)
+        self.hba_file = hba_file
         # pg_overrides.json-style tunables merged over the template by
         # scope: common -> major -> full version
         # (lib/postgresMgr.js:118-137, 527-560)
@@ -151,6 +163,32 @@ class PostgresEngine(Engine):
             await run(argv, timeout=300)
         except ExecError as e:
             raise PgError("initdb failed: %s" % e) from None
+        await self.install_hba(datadir)
+
+    async def install_hba(self, datadir: str) -> None:
+        """Replace the initdb-generated access-control file with the
+        shipped one (lib/postgresMgr.js:1954-1956 'installing access
+        control file').  Under use_sudo the datadir belongs to the
+        postgres user (mode 0700), so the copy must run as that user
+        too; otherwise an atomic write-and-rename, like replacefile
+        (lib/common.js:22-60)."""
+        if not self.hba_file:
+            return
+        dst = Path(datadir) / "pg_hba.conf"
+        if self.use_sudo:
+            try:
+                await run(["sudo", "-u", self.pg_user, "cp",
+                           str(self.hba_file), str(dst)], timeout=30)
+            except ExecError as e:
+                raise PgError("installing pg_hba.conf failed: %s"
+                              % e) from None
+            return
+        try:
+            tmp = dst.with_name(dst.name + ".tmp")
+            tmp.write_text(Path(self.hba_file).read_text())
+            tmp.replace(dst)
+        except OSError as e:
+            raise PgError("installing pg_hba.conf failed: %s" % e) from None
 
     def start_argv(self, datadir: str) -> list[str]:
         return [self._cmd("postgres"), "-D", str(datadir)]

@@ -659,3 +659,34 @@ def test_goodbye_removes_ephemerals_immediately():
         finally:
             await server.stop()
     run(go())
+
+
+def test_repeated_flaps_never_expire_graced_session():
+    """Flap storm: a client with a disconnect grace whose connection is
+    severed repeatedly (server-side aborts, e.g. load-balancer resets)
+    must resume its session every time — the grace floor guarantees a
+    reconnect attempt fits inside it, so flapping does NOT become
+    session churn and spurious failovers."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port,
+                         session_timeout=10, disconnect_grace=0.4)
+            await c.connect()
+            await c.mkdirp("/el")
+            await c.create("/el/me-", b"d", ephemeral=True,
+                           sequential=True)
+            sid = c._session_id
+            for _ in range(6):
+                conn = server._session_conns.get(sid)
+                assert conn is not None
+                conn.sever()                    # transient drop
+                await asyncio.sleep(0.3)        # < grace, > reconnect
+            # same session throughout, ephemeral intact
+            assert c._session_id == sid and not c._expired
+            assert await c.get_children("/el") != []
+            await c.close()
+        finally:
+            await server.stop()
+    run(go())

@@ -538,3 +538,45 @@ def test_diverged_follower_resyncs_via_snapshot():
             for s in servers:
                 await s.stop()
     run(go())
+
+
+def test_multi_touching_ephemeral_falls_back_to_snapshot():
+    """A transaction that deletes/sets an EPHEMERAL node cannot be
+    op-shipped (followers do not hold ephemerals): it must fall back to
+    snapshot replication and succeed for the client — not strand every
+    follower in a resync loop and fail the write on commit quorum."""
+    from manatee_tpu.coord.api import Op
+
+    async def go():
+        servers, members = await start_ensemble()
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.mkdirp("/el")
+            eph = await c.create("/el/e-", b"x", ephemeral=True,
+                                 sequential=True)
+            await c.create("/state", b"s0")
+
+            # txn: persistent CAS set + delete of the ephemeral
+            res = await c.multi([
+                Op.set("/state", b"s1", 0),
+                Op.delete(eph),
+            ])
+            assert res[0] == 1
+
+            def consistent():
+                try:
+                    return all(s.tree.get("/state") == (b"s1", 1)
+                               and s._seq == servers[0]._seq
+                               for s in servers)
+                except CoordError:
+                    return False
+            assert await wait_for(consistent), "followers diverged"
+            # leader's ephemeral really gone; followers never had it
+            assert servers[0].tree.exists(eph) is None
+            await c.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())

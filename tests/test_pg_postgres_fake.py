@@ -290,3 +290,39 @@ def test_crash_only_stop_escalation(tmp_path):
         finally:
             await mgr.close()
     run(go())
+
+
+def test_shipped_template_and_hba_install(tmp_path):
+    """etc/ template parity (lib/postgresMgr.js:2278-2336, :1954-1956):
+    postgresql.conf regenerates from the SHIPPED template file (manual
+    keys in it survive; unknown live-file edits are dropped), and the
+    shipped pg_hba.conf replaces initdb's generated one."""
+    repo_etc = Path(__file__).parent.parent / "etc"
+
+    async def go():
+        eng = PostgresEngine(
+            pg_bin_dir=FAKEBIN, use_sudo=False,
+            template_file=str(repo_etc / "postgresql.conf"),
+            hba_file=str(repo_etc / "pg_hba.conf"),
+            overrides={"common": {"work_mem": "'32MB'"}})
+        datadir = tmp_path / "data"
+        datadir.mkdir()
+        (datadir / "pg_hba.conf").write_text("# initdb-generated\n")
+        await eng.initdb(str(datadir))
+
+        # shipped hba replaced the generated one
+        hba = (datadir / "pg_hba.conf").read_text()
+        assert "replication" in hba and "initdb-generated" not in hba
+
+        eng.write_config(str(datadir), host="127.0.0.1", port=5555,
+                         peer_id="me", read_only=False,
+                         sync_standby_ids=[], upstream=None)
+        conf = ConfFile.read(datadir / "postgresql.conf")
+        # template keys came from the shipped file...
+        assert conf.get("wal_level") == "hot_standby"
+        assert conf.get("synchronous_commit") == "remote_write"
+        assert conf.get("full_page_writes") == "off"
+        # ...overrides merged on top, programmatic keys rewritten
+        assert conf.get("work_mem") == "'32MB'"
+        assert conf.get("port") == "5555"
+    run(go())
