@@ -4,7 +4,8 @@
 
 PYTHON ?= python3
 
-.PHONY: all test test-unit test-integ lint bench devcluster native clean
+.PHONY: all test test-unit test-integ lint bench devcluster native clean \
+    modelcheck
 
 all: lint test
 
@@ -23,6 +24,11 @@ lint:
 	$(PYTHON) -m compileall -q manatee_tpu tools/mkdevcluster bench.py \
 	    __graft_entry__.py
 	$(PYTHON) tools/lint
+
+# exhaustive interleaving exploration of the cluster state machine
+# (deeper than the bounded sweep `make test` runs)
+modelcheck:
+	$(PYTHON) -m manatee_tpu.state.modelcheck --config all --depth 6
 
 train-health:
 	$(PYTHON) -m manatee_tpu.health.train

@@ -105,6 +105,19 @@ def validate_transition(old: ClusterState | None,
     og, ng = old.get("generation", 0), new.get("generation", 0)
     if ng < og:
         problems.append("generation went backwards (%d -> %d)" % (og, ng))
+    try:
+        if compare_lsn(new.get("initWal", INITIAL_WAL),
+                       old.get("initWal", INITIAL_WAL)) < 0:
+            # initWal is the WAL position at generation start; a takeover
+            # stamps the taker's xlog, which the xlog-diverge guard keeps
+            # at/above the previous generation's mark — going backwards
+            # means a peer that never replicated this generation seized
+            # the primary role (docs/xlog-diverge.md)
+            problems.append("initWal went backwards (%s -> %s)"
+                            % (old.get("initWal"), new.get("initWal")))
+    except ValueError:
+        problems.append("unparseable initWal (%r -> %r)"
+                        % (old.get("initWal"), new.get("initWal")))
     if not old.get("oneNodeWriteMode") and new.get("oneNodeWriteMode"):
         problems.append("multi-peer -> singleton transition is unsupported")
     op, np_ = old.get("primary"), new.get("primary")
