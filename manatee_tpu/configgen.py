@@ -1,0 +1,136 @@
+"""Canonical per-peer config generation.
+
+Reference parity: tools/mksitterconfig (:25-81) holds the reference's
+canonical sitter-config template, and tools/mkdevsitters calls it per
+peer.  Here the template lives in the package so the production CLI
+(tools/mksitterconfig), the dev-cluster generator (tools/mkdevcluster),
+and the tests all build configs from one source of truth.
+
+Production defaults mirror etc/sitter.json / etc/backupserver.json /
+etc/snapshotter.json: health 1 s / 5 s, ops/replication timeouts 60 s,
+session timeout 60 s, disconnectGrace 10 s, hourly snapshots keeping
+50.
+"""
+
+from __future__ import annotations
+
+# production operational constants (etc/sitter.json)
+PROD_DEFAULTS = {
+    "opsTimeout": 60,
+    "healthChkInterval": 1,
+    "healthChkTimeout": 5,
+    "replicationTimeout": 60,
+    "sessionTimeout": 60,
+    "disconnectGrace": 10,
+    "pollInterval": 3600,
+    "snapshotNumber": 50,
+}
+
+
+def _common(*, name: str, ip: str, pg_port: int, backup_port: int,
+            dataset: str | None, data_dir: str,
+            storage_backend: str, storage_root: str | None,
+            pg_engine: str) -> dict:
+    cfg = {
+        "name": name,
+        "zoneId": name,
+        "ip": ip,
+        "postgresPort": pg_port,
+        "backupPort": backup_port,
+        "dataDir": data_dir,
+        "storageBackend": storage_backend,
+        "pgEngine": pg_engine,
+    }
+    if dataset is not None:
+        # backupserver/snapshotter schemas require a string dataset;
+        # omit the key entirely rather than emit null
+        cfg["dataset"] = dataset
+    if storage_root is not None:
+        cfg["storageRoot"] = storage_root
+    return cfg
+
+
+def build_sitter_config(*, name: str, ip: str, shard: str,
+                        coord_connstr: str,
+                        pg_port: int = 5432, backup_port: int = 12345,
+                        zfs_port: int | None = None,
+                        dataset: str | None = None,
+                        data_dir: str = "/manatee/pg/data",
+                        storage_backend: str = "zfs",
+                        storage_root: str | None = None,
+                        pg_engine: str = "postgres",
+                        pg_bin_dir: str | None = None,
+                        pg_version: str | None = None,
+                        pg_conf_template: str | None = None,
+                        pg_hba_file: str | None = None,
+                        singleton: bool = False,
+                        session_timeout: float | None = None,
+                        disconnect_grace: float | None = None) -> dict:
+    """The canonical sitter.json.  *coord_connstr* is ``host:port`` or
+    a comma-separated ensemble list; single addresses are emitted as
+    {host, port} (both shapes are accepted by the schema)."""
+    cfg = _common(name=name, ip=ip, pg_port=pg_port,
+                  backup_port=backup_port, dataset=dataset,
+                  data_dir=data_dir, storage_backend=storage_backend,
+                  storage_root=storage_root, pg_engine=pg_engine)
+    if pg_bin_dir is not None:
+        cfg["pgBinDir"] = pg_bin_dir
+    if pg_version is not None:
+        cfg["pgVersion"] = pg_version
+    if pg_conf_template is not None:
+        cfg["pgConfTemplate"] = pg_conf_template
+    if pg_hba_file is not None:
+        cfg["pgHbaFile"] = pg_hba_file
+
+    coord: dict = {
+        "sessionTimeout": (PROD_DEFAULTS["sessionTimeout"]
+                           if session_timeout is None else session_timeout),
+        "disconnectGrace": (PROD_DEFAULTS["disconnectGrace"]
+                            if disconnect_grace is None
+                            else disconnect_grace),
+    }
+    if "," in coord_connstr:
+        coord["connStr"] = coord_connstr
+    else:
+        host, sep, port = coord_connstr.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                "coordination address must be host:port or an "
+                "h1:p1,h2:p2,... connection string: %r" % coord_connstr)
+        coord["host"] = host
+        coord["port"] = int(port)
+
+    cfg.update({
+        "shardPath": "/manatee/%s" % shard,
+        "zfsHost": ip,
+        # status server is pgPort+1; the stream listener sits above it
+        "zfsPort": zfs_port if zfs_port is not None else pg_port + 2,
+        "coordCfg": coord,
+        "opsTimeout": PROD_DEFAULTS["opsTimeout"],
+        "healthChkInterval": PROD_DEFAULTS["healthChkInterval"],
+        "healthChkTimeout": PROD_DEFAULTS["healthChkTimeout"],
+        "replicationTimeout": PROD_DEFAULTS["replicationTimeout"],
+        "oneNodeWriteMode": bool(singleton),
+    })
+    return cfg
+
+
+def build_backupserver_config(sitter_cfg: dict) -> dict:
+    """backupserver.json shares the peer's identity/storage block (the
+    reference keeps backupPort identical across both files)."""
+    keys = ("name", "zoneId", "ip", "postgresPort", "backupPort",
+            "dataset", "dataDir", "storageBackend", "storageRoot",
+            "pgEngine")
+    return {k: sitter_cfg[k] for k in keys if k in sitter_cfg}
+
+
+def build_snapshotter_config(sitter_cfg: dict, *,
+                             poll_interval: float | None = None,
+                             snapshot_number: int | None = None) -> dict:
+    cfg = build_backupserver_config(sitter_cfg)
+    cfg["pollInterval"] = (PROD_DEFAULTS["pollInterval"]
+                           if poll_interval is None else poll_interval)
+    cfg["snapshotNumber"] = (PROD_DEFAULTS["snapshotNumber"]
+                             if snapshot_number is None
+                             else snapshot_number)
+    return cfg
