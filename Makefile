@@ -4,6 +4,9 @@
 
 PYTHON ?= python3
 
+# a failed recipe must not leave a fresh-looking partial target behind
+.DELETE_ON_ERROR:
+
 .PHONY: all test test-unit test-integ lint bench devcluster native clean \
     modelcheck
 
@@ -35,6 +38,13 @@ train-health:
 
 bench:
 	$(PYTHON) bench.py
+
+# roff man pages generated from the markdown source (reference:
+# Makefile:68-79)
+man: man/man1/manatee-adm.1
+man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

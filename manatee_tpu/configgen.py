@@ -89,16 +89,20 @@ def build_sitter_config(*, name: str, ip: str, shard: str,
                             if disconnect_grace is None
                             else disconnect_grace),
     }
-    if "," in coord_connstr:
-        coord["connStr"] = coord_connstr
-    else:
-        host, sep, port = coord_connstr.rpartition(":")
+    def parse_hostport(addr: str) -> tuple[str, int]:
+        host, sep, port = addr.rpartition(":")
         if not sep or not host or not port.isdigit():
             raise ValueError(
                 "coordination address must be host:port or an "
                 "h1:p1,h2:p2,... connection string: %r" % coord_connstr)
-        coord["host"] = host
-        coord["port"] = int(port)
+        return host, int(port)
+
+    if "," in coord_connstr:
+        for member in coord_connstr.split(","):
+            parse_hostport(member.strip())
+        coord["connStr"] = coord_connstr
+    else:
+        coord["host"], coord["port"] = parse_hostport(coord_connstr)
 
     cfg.update({
         "shardPath": "/manatee/%s" % shard,

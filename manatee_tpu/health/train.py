@@ -68,6 +68,83 @@ def export(params, path: str) -> None:
                       for k, v in params._asdict().items()})
 
 
+def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
+             healthy_ticks: int = 40, seed: int = 0) -> dict:
+    """Operationally meaningful evaluation through the DEPLOYED path:
+    feed simulated probe ticks through the same TelemetryRing +
+    NumpyScorer the sitter daemons run, and measure
+
+    * detection rate: fraction of degradation traces whose score
+      crosses WARN_THRESHOLD before the hard failure at ramp end;
+    * lead ticks: how many probe ticks of warning before the hard
+      failure (ticks == healthChkInterval, 1 s in production);
+    * false positives: healthy-trace ticks scored above threshold.
+
+    Degradation traces ramp latency/timeouts/lag/stalls over *ramp*
+    ticks, the same failure signature synthetic_batch trains on; the
+    hard failure (reference semantics: healthChkTimeout trips) is
+    placed at the end of the ramp.
+    """
+    from manatee_tpu.health.telemetry import (
+        WARN_THRESHOLD,
+        NumpyScorer,
+        TelemetryRing,
+    )
+
+    rng = np.random.default_rng(seed)
+    scorer = NumpyScorer(weights_path)
+    if not scorer.available:
+        raise RuntimeError("no usable weights at %r" % (weights_path,))
+
+    leads: list[int] = []
+    detected = 0
+    fp_ticks = 0
+    healthy_scored = 0
+
+    def healthy_tick(ring, lsn):
+        ring.add(latency_ms=5 + 25 * rng.random(), timed_out=False,
+                 lag_s=0.05 * rng.random(), wal_lsn=lsn,
+                 in_recovery=True)
+
+    for _ in range(n_traces):
+        ring = TelemetryRing()
+        lsn = 0
+        for _ in range(healthy_ticks):
+            lsn += int(1000 * (1 + rng.random()))
+            healthy_tick(ring, lsn)
+            if ring.ready():
+                s = scorer.score(ring.window_array())
+                healthy_scored += 1
+                if s is not None and s > WARN_THRESHOLD:
+                    fp_ticks += 1
+        # degradation: the same signature synthetic_batch trains on,
+        # ending in the hard failure at tick `ramp`
+        warn_at = None
+        for j in range(ramp):
+            f = (j + 1) / ramp
+            ring.add(
+                latency_ms=30 + 970 * f * rng.random(),
+                timed_out=rng.random() < 0.6 * f,
+                lag_s=10.0 * f * rng.random(),
+                wal_lsn=lsn,              # WAL stops advancing
+                in_recovery=True)
+            s = scorer.score(ring.window_array())
+            if warn_at is None and s is not None and s > WARN_THRESHOLD:
+                warn_at = j
+        if warn_at is not None:
+            detected += 1
+            leads.append(ramp - warn_at)
+
+    return {
+        "n_traces": n_traces,
+        "detection_rate": detected / n_traces,
+        "median_lead_ticks": float(np.median(leads)) if leads else 0.0,
+        "min_lead_ticks": min(leads) if leads else 0,
+        "false_positive_rate": (fp_ticks / healthy_scored
+                                if healthy_scored else 0.0),
+    }
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("-o", "--out", default=None,
@@ -85,6 +162,11 @@ def main(argv=None) -> None:
     export(params, out)
     print("trained %d steps: loss %.4f, held-out acc %.3f -> %s"
           % (args.steps, loss, acc, out))
+    ev = evaluate(out)
+    print("deployed-path eval: detection %.1f%%, median lead %g ticks "
+          "(min %d), healthy-tick FPR %.4f"
+          % (100 * ev["detection_rate"], ev["median_lead_ticks"],
+             ev["min_lead_ticks"], ev["false_positive_rate"]))
 
 
 if __name__ == "__main__":

@@ -55,6 +55,28 @@ def test_production_defaults_validate():
     assert snap["pollInterval"] == 3600 and snap["snapshotNumber"] == 50
 
 
+def test_malformed_ensemble_connstr_rejected():
+    import pytest
+    for bad in ("c1:2281,c2", "c1:2281,", "c1:2281,:99", "c1:x,c2:2"):
+        with pytest.raises(ValueError):
+            configgen.build_sitter_config(
+                name="p", ip="1.2.3.4", shard="1", coord_connstr=bad,
+                dataset="d")
+
+
+def test_sim_engine_config_omits_pg_paths(tmp_path):
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "mksitterconfig"),
+         "-n", "p", "-i", "1.2.3.4", "-s", "1", "-z", "c:2281",
+         "--backend", "dir", "--storage-root", "/tmp/store",
+         "--dataset", "manatee/pg", "--engine", "sim"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    cfg = json.loads(res.stdout)
+    for key in ("pgBinDir", "pgVersion", "pgConfTemplate", "pgHbaFile"):
+        assert key not in cfg, key
+
+
 def test_single_coord_address_emits_host_port():
     sitter = configgen.build_sitter_config(
         name="p", ip="10.0.0.1", shard="x", coord_connstr="coord:2281",
@@ -166,10 +188,13 @@ def test_mkdevcluster_tree_boots(tmp_path):
             "dev cluster never declared a topology"
         # the status server answers on pgPort+1 per the generated
         # config; /ping flips to 200 once the first health probe passes
+        # (fresh deadline: the topology wait may have consumed the
+        # first one on a loaded host)
         sitter1 = json.loads(
             (out / "sitter1" / "sitter.json").read_text())
         url = "http://127.0.0.1:%d/ping" % (sitter1["postgresPort"] + 1)
         status = None
+        deadline = time.time() + 15
         while time.time() < deadline:
             try:
                 status = urllib.request.urlopen(url, timeout=5).status

@@ -132,6 +132,19 @@ def test_healthy_peer_scores_low(tmp_path):
     run(go())
 
 
+def test_deployed_path_eval_quality():
+    """The packaged weights, measured through the deployed path (real
+    TelemetryRing + NumpyScorer): every simulated degradation must be
+    caught before its hard failure with useful lead time, and healthy
+    traces must not page."""
+    from manatee_tpu.health.train import evaluate
+
+    ev = evaluate(n_traces=60, seed=7)
+    assert ev["detection_rate"] >= 0.95, ev
+    assert ev["median_lead_ticks"] >= 3, ev
+    assert ev["false_positive_rate"] <= 0.01, ev
+
+
 def test_scorer_degrades_gracefully_without_weights(tmp_path):
     ring = TelemetryRing()
     for _ in range(16):

@@ -1,0 +1,54 @@
+"""tools/md2man renders the markdown man page to structurally sound
+roff (reference parity: man pages generated from markdown at build
+time, Makefile:68-79)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def render(md: str, tmp_path) -> str:
+    src = tmp_path / "page.md"
+    src.write_text(md)
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "md2man"), str(src)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    return res.stdout
+
+
+def test_renders_shipped_man_page(tmp_path):
+    out = render((REPO / "docs" / "man" / "manatee-adm.md").read_text(),
+                 tmp_path)
+    assert out.startswith(".TH PAGE 1")
+    for section in (".SH SYNOPSIS", ".SH DESCRIPTION", ".SH COMMANDS",
+                    ".SH ENVIRONMENT", ".SH EXIT STATUS"):
+        assert section in out, "missing %s" % section
+    # subcommands become subsections; code blocks become .nf/.fi
+    assert ".SS" in out and ".nf" in out and ".fi" in out
+    # the column-registry table survived as aligned text, with inline
+    # markdown stripped (no literal backticks in the man page)
+    assert "PEERNAME" in out
+    assert "`" not in out
+    # no unescaped bare markdown emphasis markers leak through
+    assert "**" not in out
+    # body lines that begin with '.' are guarded so roff does not eat
+    # them as requests (only macros we emit may start with '.')
+    known = (".TH", ".SH", ".SS", ".PP", ".IP", ".nf", ".fi")
+    for ln in out.splitlines():
+        if ln.startswith(".") :
+            assert ln.startswith(known), "unguarded request line: %r" % ln
+
+
+def test_span_and_table_rendering(tmp_path):
+    out = render(
+        "# t(1) — x\n\n**bold** and *it* and `code`\n\n"
+        "| a | b |\n|---|---|\n| one | two |\n", tmp_path)
+    assert "\\fBbold\\fR" in out
+    assert "\\fIit\\fR" in out
+    assert "\\fBcode\\fR" in out
+    assert "one" in out and "two" in out
+    # separator row dropped
+    assert "---" not in out
