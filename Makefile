@@ -8,7 +8,7 @@ PYTHON ?= python3
 .DELETE_ON_ERROR:
 
 .PHONY: all test test-unit test-integ lint bench devcluster native clean \
-    modelcheck
+    modelcheck chaos man train-health
 
 all: lint test
 
@@ -32,6 +32,11 @@ lint:
 # (deeper than the bounded sweep `make test` runs)
 modelcheck:
 	$(PYTHON) -m manatee_tpu.state.modelcheck --config all --depth 6
+
+# unscripted randomized storm against real processes + the real CLI
+# (MANATEE_CHAOS_SECONDS / MANATEE_CHAOS_SEED to vary)
+chaos:
+	MANATEE_CHAOS=1 $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
 
 train-health:
 	$(PYTHON) -m manatee_tpu.health.train

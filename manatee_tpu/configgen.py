@@ -89,20 +89,23 @@ def build_sitter_config(*, name: str, ip: str, shard: str,
                             if disconnect_grace is None
                             else disconnect_grace),
     }
-    def parse_hostport(addr: str) -> tuple[str, int]:
-        host, sep, port = addr.rpartition(":")
-        if not sep or not host or not port.isdigit():
-            raise ValueError(
-                "coordination address must be host:port or an "
-                "h1:p1,h2:p2,... connection string: %r" % coord_connstr)
-        return host, int(port)
-
+    # validate with the SAME parser the daemons run (bare hosts get the
+    # default port, empty members are skipped) so the generator never
+    # rejects a string the runtime accepts, or vice versa
+    from manatee_tpu.coord.client import parse_connstr
+    try:
+        members = parse_connstr(coord_connstr)
+    except ValueError as exc:
+        raise ValueError(
+            "coordination address must be host[:port] or an "
+            "h1:p1,h2:p2,... connection string (%s)" % exc) from None
+    if any(not host for host, _ in members):
+        raise ValueError(
+            "coordination address has an empty host: %r" % coord_connstr)
     if "," in coord_connstr:
-        for member in coord_connstr.split(","):
-            parse_hostport(member.strip())
         coord["connStr"] = coord_connstr
     else:
-        coord["host"], coord["port"] = parse_hostport(coord_connstr)
+        coord["host"], coord["port"] = members[0]
 
     cfg.update({
         "shardPath": "/manatee/%s" % shard,

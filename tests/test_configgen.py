@@ -55,9 +55,23 @@ def test_production_defaults_validate():
     assert snap["pollInterval"] == 3600 and snap["snapshotNumber"] == 50
 
 
-def test_malformed_ensemble_connstr_rejected():
+def test_connstr_validation_matches_runtime_parser():
     import pytest
-    for bad in ("c1:2281,c2", "c1:2281,", "c1:2281,:99", "c1:x,c2:2"):
+    # forms the runtime parser (coord.client.parse_connstr) accepts
+    # must be accepted here too: bare hosts default the port, empty
+    # members are skipped
+    for ok in ("c1:2281,c2", "c1,c2,c3", "c1:2281,"):
+        cfg = configgen.build_sitter_config(
+            name="p", ip="1.2.3.4", shard="1", coord_connstr=ok,
+            dataset="d")
+        assert cfg["coordCfg"]["connStr"] == ok
+    bare = configgen.build_sitter_config(
+        name="p", ip="1.2.3.4", shard="1", coord_connstr="coord1",
+        dataset="d")
+    assert bare["coordCfg"] == {
+        "host": "coord1", "port": 2281,
+        "sessionTimeout": 60, "disconnectGrace": 10}
+    for bad in ("c1:x,c2:2", ":99", "c1:2281,:99", ""):
         with pytest.raises(ValueError):
             configgen.build_sitter_config(
                 name="p", ip="1.2.3.4", shard="1", coord_connstr=bad,
@@ -113,15 +127,15 @@ def test_mksitterconfig_cli_writes_valid_tree(tmp_path):
         capture_output=True, text=True, timeout=60)
     assert res2.returncode == 0, res2.stderr
     _validate_all(json.loads(res2.stdout))
-    # a port-less coordination address is a clean usage error, not a
-    # traceback
+    # a malformed coordination address is a clean usage error, not a
+    # traceback (bare hosts are fine — the runtime defaults the port)
     res3 = subprocess.run(
         [sys.executable, str(REPO / "tools" / "mksitterconfig"),
-         "-n", "p", "-i", "1.2.3.4", "-s", "1", "-z", "coord1",
+         "-n", "p", "-i", "1.2.3.4", "-s", "1", "-z", "coord1:x",
          "--dataset", "d"],
         capture_output=True, text=True, timeout=60)
     assert res3.returncode == 2
-    assert "host:port" in res3.stderr and "Traceback" not in res3.stderr
+    assert "host[:port]" in res3.stderr and "Traceback" not in res3.stderr
 
 
 def test_mkdevcluster_tree_boots(tmp_path):
