@@ -7,6 +7,10 @@ Reference parity: lib/statusServer.js — restify server on
 - ``GET /ping``    200/503 from the PG health state (:78-97)
 - ``GET /state``   the state machine's debugState() (:100-109)
 - ``GET /restore`` the restore client's current job (:111-121)
+
+Beyond parity: ``GET /metrics`` exports the same facts in Prometheus
+text format (the reference predates that convention; its operators
+scrape bunyan logs).
 """
 
 from __future__ import annotations
@@ -32,7 +36,14 @@ class StatusServer:
         app.router.add_get("/ping", self._ping)
         app.router.add_get("/state", self._state)
         app.router.add_get("/restore", self._restore)
+        app.router.add_get("/metrics", self._metrics)
         self._app = app
+        # transition counter for /metrics: one increment per durable
+        # state write this peer made
+        self._transitions = 0
+        if state_machine is not None:
+            state_machine.on("stateWritten",
+                             lambda _st: self._count_transition())
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self._app)
@@ -48,7 +59,8 @@ class StatusServer:
             await self._runner.cleanup()
 
     async def _routes(self, _req: web.Request) -> web.Response:
-        return web.json_response(["/ping", "/state", "/restore"])
+        return web.json_response(["/ping", "/state", "/restore",
+                                  "/metrics"])
 
     async def _ping(self, _req: web.Request) -> web.Response:
         healthy = bool(self.pg_mgr and self.pg_mgr.online)
@@ -74,3 +86,75 @@ class StatusServer:
         if job is None:
             return web.json_response({"restore": None})
         return web.json_response({"restore": job})
+
+    def _count_transition(self) -> None:
+        self._transitions += 1
+
+    async def _metrics(self, _req: web.Request) -> web.Response:
+        """Prometheus text exposition of the peer's state."""
+        lines: list[str] = []
+
+        def metric(name, mtype, help_, samples):
+            """*samples*: value, or [(label_string, value), ...]."""
+            lines.append("# HELP manatee_%s %s" % (name, help_))
+            lines.append("# TYPE manatee_%s %s" % (name, mtype))
+            if not isinstance(samples, list):
+                samples = [("", samples)]
+            for labels, value in samples:
+                lines.append("manatee_%s%s %s" % (name, labels, value))
+
+        pg = self.pg_mgr
+        if pg is not None:
+            metric("pg_online", "gauge",
+                   "1 when the local database answers health probes",
+                   1 if pg.online else 0)
+            if pg.health_score is not None:
+                metric("health_score", "gauge",
+                       "learned failure-probability score in [0,1]",
+                       "%.4f" % pg.health_score)
+            tick = pg.telemetry.last_tick()
+            if tick:
+                # normalized feature vector of the last probe
+                # (telemetry.normalize_tick order)
+                names = ("latency", "timed_out", "lag", "wal_stall",
+                         "reconnects")
+                metric("probe_feature", "gauge",
+                       "normalized health-probe features, last tick",
+                       [('{feature="%s"}' % n, "%.4f" % v)
+                        for n, v in zip(names, tick)])
+        sm = self.state_machine
+        if sm is not None:
+            dbg = sm.debug_state()
+            st = dbg.get("clusterState") or {}
+            if "generation" in st:
+                metric("generation", "gauge",
+                       "durable cluster-state generation",
+                       st["generation"])
+            role = dbg.get("role") or "none"
+            metric("role", "gauge", "current durable role",
+                   [('{role="%s"}' % r, 1 if r == role else 0)
+                    for r in ("primary", "sync", "async", "deposed",
+                              "none")])
+            metric("frozen", "gauge",
+                   "1 when the cluster is frozen (no automatic "
+                   "transitions)", 1 if st.get("freeze") else 0)
+            metric("cluster_peers", "gauge",
+                   "peers in the durable topology incl. deposed",
+                   (1 if st.get("primary") else 0)
+                   + (1 if st.get("sync") else 0)
+                   + len(st.get("async") or [])
+                   + len(st.get("deposed") or []))
+            metric("state_transitions_total", "counter",
+                   "durable state writes made by this peer",
+                   self._transitions)
+        job = (self.restore_client.current_job
+               if self.restore_client else None)
+        if job is not None:
+            metric("restore_size_bytes", "gauge",
+                   "size of the in-flight restore stream",
+                   int(job.get("size") or 0))
+            metric("restore_done_bytes", "gauge",
+                   "bytes received by the in-flight restore",
+                   int(job.get("completed") or 0))
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")

@@ -145,6 +145,103 @@ def test_add_fourth_peer(tmp_path):
     run(go())
 
 
+def test_metrics_endpoint(tmp_path):
+    """GET /metrics (beyond-parity Prometheus surface) exports role,
+    generation, health, and transition counters that track reality."""
+    async def go():
+        import aiohttp
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            async def metrics(peer):
+                url = "http://127.0.0.1:%d/metrics" % peer.status_port
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url) as resp:
+                        assert resp.status == 200
+                        return await resp.text()
+
+            text = await metrics(primary)
+            assert 'manatee_role{role="primary"} 1' in text
+            assert "manatee_pg_online 1" in text
+            assert "manatee_generation 0" in text
+            assert "manatee_frozen 0" in text
+            assert "manatee_cluster_peers 3" in text
+            text = await metrics(sync)
+            assert 'manatee_role{role="sync"} 1' in text
+            assert 'manatee_role{role="primary"} 0' in text
+
+            # after a failover the new primary's metrics flip and its
+            # transition counter moved
+            primary.kill()
+            await cluster.wait_topology(primary=sync, timeout=60)
+            await cluster.wait_writable(sync, "metrics-check")
+            text = await metrics(sync)
+            assert 'manatee_role{role="primary"} 1' in text
+            assert "manatee_generation 1" in text
+            import re as _re
+            m = _re.search(r"manatee_state_transitions_total (\d+)",
+                           text)
+            assert m and int(m.group(1)) >= 1
+        finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_deep_chain_eight_peers(tmp_path):
+    """Scale check on the daisy chain (docs/user-guide.md:69-90 model):
+    an 8-peer shard — primary, sync, six cascading asyncs — must
+    bootstrap, replicate a write down the WHOLE chain, and survive a
+    mid-chain async death (upstream/downstream re-splice, no generation
+    bump) and a primary death (takeover promotes through the chain)."""
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=8)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster, n=8,
+                                                    timeout=120)
+            assert len(asyncs) == 6
+            await cluster.wait_writable(primary, "deep-chain")
+
+            # the write must cascade to the TAIL of the chain
+            tail = asyncs[-1]
+
+            async def tail_has_it():
+                try:
+                    res = await tail.pg_query({"op": "select"}, 3.0)
+                    return "deep-chain" in (res.get("rows") or [])
+                except Exception:
+                    return False
+            deadline = asyncio.get_event_loop().time() + 30
+            while not await tail_has_it():
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "write never cascaded to the chain tail"
+                await asyncio.sleep(0.25)
+
+            # mid-chain async dies: pruned with NO generation bump,
+            # chain re-splices around it
+            st0 = await cluster.cluster_state()
+            victim = asyncs[2]
+            victim.kill()
+            st = await cluster.wait_topology(
+                primary=primary, sync=sync,
+                asyncs=[a for a in asyncs if a is not victim])
+            assert st["generation"] == st0["generation"]
+            await cluster.wait_writable(primary, "after-mid-chain-death")
+
+            # primary dies: sync takes over, first async becomes sync
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync,
+                                             asyncs=None, timeout=60)
+            assert st["sync"]["id"] == asyncs[0].ident
+            assert [d["id"] for d in st["deposed"]] == [primary.ident]
+            await cluster.wait_writable(sync, "after-deep-takeover")
+        finally:
+            await cluster.stop()
+    run(go())
+
+
 def test_database_child_death_kills_sitter_and_fails_over(tmp_path):
     """MANTA-997 parity: the database process dying out from under the
     sitter is unrecoverable — the sitter exits (crash-only) and the
