@@ -1,0 +1,134 @@
+"""Tests for the operator/developer hand tools that previously had
+only manual smoke coverage: the connectivity test
+(coord/conntest.py, reference bin/zkConnTest.js parity) and the
+PostgresMgr REPL (pg/repl.py, reference test/postgresMgrRepl.js).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from tests.harness import alloc_port_block
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _env():
+    return dict(os.environ, PYTHONPATH=str(REPO))
+
+
+def _spawn_coordd(tmp_path, port):
+    with open(tmp_path / "coordd.log", "ab") as logf:
+        return subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.coord.server",
+             "--port", str(port)],
+            stdout=logf, stderr=logf, env=_env(),
+            start_new_session=True)
+
+
+def _wait_port(port, timeout=10.0):
+    import socket
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 1.0).close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise AssertionError("coordd never listened on %d" % port)
+
+
+def test_conntest_ok_and_fail(tmp_path):
+    port = alloc_port_block(1)
+    proc = _spawn_coordd(tmp_path, port)
+    try:
+        _wait_port(port)
+        res = subprocess.run(
+            [sys.executable, "-m", "manatee_tpu.coord.conntest",
+             "127.0.0.1:%d" % port],
+            capture_output=True, text=True, timeout=60, env=_env())
+        assert res.returncode == 0, (res.stdout, res.stderr)
+        assert "OK" in res.stdout
+
+        # and the scratch node was cleaned up
+        from manatee_tpu.coord.client import NetCoord
+
+        async def leftovers():
+            c = NetCoord("127.0.0.1", port, session_timeout=5)
+            await c.connect()
+            try:
+                return [n for n in await c.get_children("/")
+                        if n.startswith("conntest-")]
+            finally:
+                await c.close()
+        assert asyncio.run(leftovers()) == []
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+    # a dead address is a clean nonzero exit, not a hang/traceback exit
+    res = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.coord.conntest",
+         "127.0.0.1:1"],
+        capture_output=True, text=True, timeout=60, env=_env())
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr
+    # usage error
+    res = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.coord.conntest"],
+        capture_output=True, text=True, timeout=60, env=_env())
+    assert res.returncode == 2
+
+
+def test_repl_drives_manager(tmp_path):
+    """Script the REPL end-to-end: singleton start, write, read, xlog,
+    health, stop — the manual flow of test/postgresMgrRepl.js."""
+    base = alloc_port_block(5)
+    port = base
+    coordd = _spawn_coordd(tmp_path, port)
+    try:
+        _wait_port(port)
+        peer = tmp_path / "peer"
+        peer.mkdir()
+        store = str(peer / "store")
+        from manatee_tpu.storage import DirBackend
+        be = DirBackend(store)
+        asyncio.run(be.create("manatee"))
+        cfg = {
+            "name": "replpeer", "zoneId": "replpeer",
+            "ip": "127.0.0.1",
+            "postgresPort": base + 2, "backupPort": base + 1,
+            "shardPath": "/manatee/repl",
+            "dataDir": str(peer / "data"),
+            "dataset": "manatee/pg",
+            "storageBackend": "dir", "storageRoot": store,
+            "pgEngine": "sim",
+            "zfsHost": "127.0.0.1", "zfsPort": base + 4,
+            "coordCfg": {"host": "127.0.0.1", "port": port,
+                         "sessionTimeout": 10},
+            "opsTimeout": 30, "healthChkInterval": 0.5,
+            "healthChkTimeout": 3, "replicationTimeout": 30,
+            "oneNodeWriteMode": False,
+        }
+        cfgfile = peer / "sitter.json"
+        cfgfile.write_text(json.dumps(cfg))
+
+        script = ("status\nstart\ninsert hello-repl\nselect\nxlog\n"
+                  "health\nnone\nquit\n")
+        res = subprocess.run(
+            [sys.executable, "-m", "manatee_tpu.pg.repl",
+             "-f", str(cfgfile)],
+            input=script, capture_output=True, text=True, timeout=120,
+            env=_env())
+        out = res.stdout
+        assert res.returncode == 0, (out, res.stderr)
+        assert "pg manager ready" in out
+        assert "hello-repl" in out           # select echoed the row
+        assert "0/" in out                    # an xlog position printed
+    finally:
+        coordd.kill()
+        coordd.wait(timeout=10)
