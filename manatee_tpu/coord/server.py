@@ -163,7 +163,8 @@ class CoordServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  tick: float = 0.25, data_dir: str | None = None,
                  ensemble: list[tuple[str, int]] | None = None,
-                 ensemble_id: int = 0, promote_grace: float = 2.0):
+                 ensemble_id: int = 0, promote_grace: float = 2.0,
+                 metrics_port: int | None = None):
         """*data_dir*: when set, the persistent tree is snapshotted there
         and reloaded on start (ZooKeeper-parity durability).  Ephemeral
         nodes do not survive a restart — their sessions are gone, and
@@ -195,8 +196,19 @@ class CoordServer:
         self._conns: set[_Conn] = set()
         # session id -> live conn (one at a time)
         self._session_conns: dict[str, _Conn] = {}
-        if self.data_dir:
-            self.tree.on_mutate = self._mark_dirty
+        self.metrics_port = metrics_port
+        self._metrics_runner = None
+        self._mutations = 0
+        self._wire_tree(self.tree)
+
+    def _wire_tree(self, tree: model.ZNodeTree) -> None:
+        """One on_mutate hook per tree: count mutations (for /metrics)
+        and schedule persistence when a data dir is configured."""
+        def on_mutate():
+            self._mutations += 1
+            if self.data_dir:
+                self._mark_dirty()
+        tree.on_mutate = on_mutate
 
     # ---- persistence ----
 
@@ -260,6 +272,8 @@ class CoordServer:
         self._expiry_task = asyncio.ensure_future(self._expiry_loop())
         if self.ensemble:
             self._follow_task = asyncio.ensure_future(self._follow_loop())
+        if self.metrics_port is not None:
+            await self._start_metrics()
         log.info("coordd listening on %s:%d%s%s", self.host, self.port,
                  " (persistent: %s)" % self.data_dir
                  if self.data_dir else "",
@@ -268,6 +282,9 @@ class CoordServer:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._metrics_runner is not None:
+            await self._metrics_runner.cleanup()
+            self._metrics_runner = None
         for t in (self._follow_task, self._probe_task):
             if t:
                 t.cancel()
@@ -283,6 +300,73 @@ class CoordServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+
+    # ---- metrics (beyond-parity observability; ZooKeeper exposes the
+    # equivalent via four-letter words / its own Prometheus provider) ----
+
+    async def _start_metrics(self) -> None:
+        from aiohttp import web
+
+        async def metrics(_req):
+            return web.Response(text=self._render_metrics(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        self._metrics_runner = web.AppRunner(app)
+        await self._metrics_runner.setup()
+        site = web.TCPSite(self._metrics_runner, self.host,
+                           self.metrics_port)
+        await site.start()
+        if self.metrics_port == 0:
+            self.metrics_port = self._metrics_runner.addresses[0][1]
+        log.info("coordd metrics on %s:%d", self.host, self.metrics_port)
+
+    def _render_metrics(self) -> str:
+        from manatee_tpu.utils.prom import MetricsBuilder
+
+        b = MetricsBuilder("coordd")
+        b.metric("role", "gauge", "this member's current role",
+                 [('{role="%s"}' % r, 1 if r == self.role else 0)
+                  for r in ("leader", "follower")])
+        # a gauge, not a counter: followers jump to the leader's seq on
+        # resync and an ex-leader's seq can move backwards when it
+        # force-syncs to the incumbent — operators compare seqs ACROSS
+        # members, not rates
+        b.metric("seq", "gauge",
+                 "replication sequence position", self._seq)
+        b.metric("mutations_total", "counter",
+                 "tree mutations applied by this member",
+                 self._mutations)
+        b.metric("sessions", "gauge", "live client sessions",
+                 sum(1 for s in self.tree.sessions.values()
+                     if not s.expired))
+        b.metric("connections", "gauge", "open client connections",
+                 len(self._conns))
+        b.metric("followers_connected", "gauge",
+                 "follower members attached (leader only)",
+                 len(self._follower_conns))
+        if self.ensemble:
+            if self.role == "leader":
+                # only the leader commits, so only it has a quorum fact;
+                # followers omit the series rather than export a
+                # permanently-alarming 0
+                need = self._quorum_needed()
+                have = 1 + len(self._follower_conns)
+                b.metric("quorum_ok", "gauge",
+                         "1 when this leader can commit mutations",
+                         1 if (need is None or have >= need) else 0)
+            b.metric("ensemble_size", "gauge",
+                     "configured member count", len(self.ensemble))
+
+        def count_nodes(node) -> int:
+            return 1 + sum(count_nodes(c) for c in node.children.values())
+
+        b.metric("znodes", "gauge", "nodes in the tree (incl. root)",
+                 count_nodes(self.tree._root))
+        b.metric("watches", "gauge", "registered one-shot watches",
+                 sum(len(v) for v in self.tree._watches.values()))
+        return b.render()
 
     def _expire_due_sessions(self) -> None:
         for sid in self.tree.expired_sessions():
@@ -838,8 +922,8 @@ class CoordServer:
         tree = model.ZNodeTree.from_snapshot(snap)
         self.tree = tree
         self._seq = seq
+        self._wire_tree(tree)
         if self.data_dir:
-            tree.on_mutate = self._mark_dirty
             self._mark_dirty()
 
 
@@ -859,6 +943,9 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--promote-grace", type=float, default=2.0,
                    help="seconds of lower-member unreachability before a "
                         "follower promotes itself")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics on this port "
+                        "(default: disabled)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
     setup_logging("manatee-coordd", args.verbose)
@@ -873,7 +960,8 @@ def main(argv: list[str] | None = None) -> None:
                              data_dir=args.data_dir,
                              ensemble=ensemble,
                              ensemble_id=args.ensemble_id,
-                             promote_grace=args.promote_grace)
+                             promote_grace=args.promote_grace,
+                             metrics_port=args.metrics_port)
         await server.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

@@ -92,17 +92,10 @@ class StatusServer:
 
     async def _metrics(self, _req: web.Request) -> web.Response:
         """Prometheus text exposition of the peer's state."""
-        lines: list[str] = []
+        from manatee_tpu.utils.prom import MetricsBuilder
 
-        def metric(name, mtype, help_, samples):
-            """*samples*: value, or [(label_string, value), ...]."""
-            lines.append("# HELP manatee_%s %s" % (name, help_))
-            lines.append("# TYPE manatee_%s %s" % (name, mtype))
-            if not isinstance(samples, list):
-                samples = [("", samples)]
-            for labels, value in samples:
-                lines.append("manatee_%s%s %s" % (name, labels, value))
-
+        b = MetricsBuilder("manatee")
+        metric = b.metric
         pg = self.pg_mgr
         if pg is not None:
             metric("pg_online", "gauge",
@@ -156,5 +149,5 @@ class StatusServer:
             metric("restore_done_bytes", "gauge",
                    "bytes received by the in-flight restore",
                    int(job.get("completed") or 0))
-        return web.Response(text="\n".join(lines) + "\n",
+        return web.Response(text=b.render(),
                             content_type="text/plain")

@@ -1,0 +1,24 @@
+"""Minimal Prometheus text-exposition builder shared by the peer
+status server and coordd (one copy so format fixes land everywhere)."""
+
+from __future__ import annotations
+
+
+class MetricsBuilder:
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self.lines: list[str] = []
+
+    def metric(self, name: str, mtype: str, help_: str, samples) -> None:
+        """*samples*: a scalar value, or [(label_string, value), ...]
+        where label_string is e.g. '{role="leader"}'."""
+        full = "%s_%s" % (self.prefix, name)
+        self.lines.append("# HELP %s %s" % (full, help_))
+        self.lines.append("# TYPE %s %s" % (full, mtype))
+        if not isinstance(samples, list):
+            samples = [("", samples)]
+        for labels, value in samples:
+            self.lines.append("%s%s %s" % (full, labels, value))
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"

@@ -84,6 +84,56 @@ def test_conntest_ok_and_fail(tmp_path):
     assert res.returncode == 2
 
 
+def test_coordd_metrics_endpoint(tmp_path):
+    """coordd --metrics-port serves Prometheus series that move with
+    real activity (sessions, znodes, mutations)."""
+    import urllib.request
+
+    base = alloc_port_block(2)
+    port, mport = base, base + 1
+    with open(tmp_path / "coordd.log", "ab") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.coord.server",
+             "--port", str(port), "--metrics-port", str(mport)],
+            stdout=logf, stderr=logf, env=_env(),
+            start_new_session=True)
+    try:
+        _wait_port(port)
+        _wait_port(mport)
+
+        def scrape():
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics" % mport,
+                    timeout=5) as r:
+                return r.read().decode()
+
+        text = scrape()
+        assert 'coordd_role{role="leader"} 1' in text
+        assert "coordd_sessions 0" in text
+        assert "coordd_znodes 1" in text        # just the root
+
+        from manatee_tpu.coord.client import NetCoord
+
+        async def poke_and_scrape():
+            c = NetCoord("127.0.0.1", port, session_timeout=5)
+            await c.connect()
+            try:
+                await c.create("/metrics-poke", b"x")
+                return await asyncio.get_event_loop().run_in_executor(
+                    None, scrape)
+            finally:
+                await c.close()
+        text = asyncio.run(poke_and_scrape())
+        assert "coordd_sessions 1" in text
+        assert "coordd_znodes 2" in text
+        import re as _re
+        m = _re.search(r"coordd_mutations_total (\d+)", text)
+        assert m and int(m.group(1)) >= 1
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
 def test_repl_drives_manager(tmp_path):
     """Script the REPL end-to-end: singleton start, write, read, xlog,
     health, stop — the manual flow of test/postgresMgrRepl.js."""
