@@ -24,7 +24,10 @@ REPO = Path(__file__).resolve().parent.parent
 
 from manatee_tpu.coord.client import NetCoord           # noqa: E402
 from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
+from manatee_tpu.pg.postgres import PostgresEngine      # noqa: E402
 from manatee_tpu.storage import DirBackend              # noqa: E402
+
+FAKEPG_BIN = str(REPO / "tests" / "fakepg")
 
 
 def cli_env(coord_addr: str, shard: str = "1") -> dict:
@@ -100,8 +103,14 @@ class Peer:
             "dataDir": str(self.root / "data"),
             "storageBackend": "dir",
             "storageRoot": store_root,
-            "pgEngine": "sim",
+            "pgEngine": self.cluster.engine,
         }
+        if self.cluster.engine == "postgres":
+            # the real PostgresEngine driving the fakepg binaries — the
+            # production engine path under the full fault-injection
+            # stack (VERDICT r2 #1)
+            common["pgBinDir"] = FAKEPG_BIN
+            common["pgUseSudo"] = False
         sitter = dict(common)
         sitter.update({
             "shardPath": self.cluster.shard_path,
@@ -178,8 +187,8 @@ class Peer:
     # -- queries --
 
     async def pg_query(self, op: dict, timeout: float = 5.0) -> dict:
-        return await SimPgEngine().query(self.ip, self.pg_port, op,
-                                         timeout)
+        return await self.cluster.query_engine.query(
+            self.ip, self.pg_port, op, timeout)
 
 
 class ClusterHarness:
@@ -187,7 +196,8 @@ class ClusterHarness:
                  session_timeout: float = 2.0, singleton: bool = False,
                  shard: str = "1", n_coord: int = 1,
                  coord_promote_grace: float = 1.0,
-                 disconnect_grace: float | None = 0.4):
+                 disconnect_grace: float | None = 0.4,
+                 engine: str | None = None):
         """*n_coord* > 1 runs a replicated coordd ensemble; peers get the
         full connStr and rotate to the live leader (zkCfg.connStr
         parity).
@@ -200,8 +210,20 @@ class ClusterHarness:
         detection path — the bulk of the kill suites should exercise
         what production runs.  None reverts to pure heartbeat expiry
         (ZooKeeper semantics); the dedicated control test for that path
-        is test_integration.test_heartbeat_only_failover_with_grace_disabled."""
+        is test_integration.test_heartbeat_only_failover_with_grace_disabled.
+
+        *engine*: "sim" (default) or "postgres" — the latter runs every
+        peer's database through the real PostgresEngine against the
+        fakepg binaries (tests/fakepg/), so failovers/restores execute
+        pg/postgres.py end to end.  Defaults from $MANATEE_ENGINE so the
+        whole suite can be re-routed without edits."""
         self.root = Path(root)
+        self.engine = engine or os.environ.get("MANATEE_ENGINE", "sim")
+        if self.engine == "postgres":
+            self.query_engine: SimPgEngine | PostgresEngine = \
+                PostgresEngine(pg_bin_dir=FAKEPG_BIN, use_sudo=False)
+        else:
+            self.query_engine = SimPgEngine()
         self.shard_path = "/manatee/%s" % shard
         self.session_timeout = session_timeout
         self.disconnect_grace = disconnect_grace
