@@ -7,18 +7,30 @@ primary of a live 3-peer shard, how long until the cluster accepts
 benchmark numbers; its own integration suite's convergence budget is
 30 s on a single host (test/integ.test.js:52), with production failure
 detection bounded by a 60 s coordination-session timeout
-(etc/sitter.json).  This benchmark runs the full stack — coordination
-daemon, three sitters with database children, backup servers — on
-localhost with a 1 s session timeout, kills the primary, and measures
-wall-clock time until a synchronous write commits on the new primary.
+(etc/sitter.json).
 
-Prints ONE JSON line:
+Three configurations, full stack on localhost (coordination daemon(s),
+three sitters with database children, backup servers), 1 s session
+timeout, FIN fast-path crash detection:
+
+  - ensemble:                3-member replicated coordd — THE DEPLOYED
+                             CONFIGURATION (README recommends ensembles
+                             for production), and the number of record;
+  - single:                  one coordd (the dev/test topology);
+  - ensemble_hung_follower:  3-member coordd with one follower
+                             SIGSTOPped before the kill — quorum
+                             commit must keep takeover latency flat
+                             (coord/server.py _ship majority-ack).
+
+Prints ONE JSON line; "value" is the ensemble median:
   {"metric": "failover_to_writable", "value": <seconds>, "unit": "s",
-   "vs_baseline": <30.0 / value>}
+   "vs_baseline": <30.0 / value>, "configs": {...}}
 """
 
 import asyncio
 import json
+import os
+import signal
 import statistics
 import sys
 import tempfile
@@ -30,7 +42,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 from tests.harness import ClusterHarness  # noqa: E402
 
 BASELINE_BUDGET_S = 30.0   # test/integ.test.js:52 convergence budget
-RUNS = 3
+RUNS = int(os.environ.get("MANATEE_BENCH_RUNS", "3"))
 # Heartbeat-silence bound (wedged/partitioned peers).  A SIGKILLed
 # primary is detected much sooner via the disconnect fast path below.
 SESSION_TIMEOUT = 1.0
@@ -41,8 +53,9 @@ SESSION_TIMEOUT = 1.0
 DISCONNECT_GRACE = 0.35
 
 
-async def one_run(tmp: Path) -> float:
-    cluster = ClusterHarness(tmp, n_peers=3,
+async def one_run(tmp: Path, *, n_coord: int,
+                  hang_follower: bool = False) -> float:
+    cluster = ClusterHarness(tmp, n_peers=3, n_coord=n_coord,
                              session_timeout=SESSION_TIMEOUT,
                              disconnect_grace=DISCONNECT_GRACE)
     try:
@@ -52,28 +65,51 @@ async def one_run(tmp: Path) -> float:
                                     timeout=60)
         await cluster.wait_writable(p1, "pre-failover", timeout=60)
 
-        t0 = time.monotonic()
-        p1.kill()
-        await cluster.wait_topology(primary=p2, timeout=60)
-        await cluster.wait_writable(p2, "post-failover", timeout=60)
-        return time.monotonic() - t0
+        hung = None
+        if hang_follower:
+            leader = await cluster.coord_leader_idx()
+            hung = next(i for i in range(n_coord) if i != leader)
+            cluster.signal_coordd(hung, signal.SIGSTOP)
+        try:
+            t0 = time.monotonic()
+            p1.kill()
+            await cluster.wait_topology(primary=p2, timeout=60)
+            await cluster.wait_writable(p2, "post-failover", timeout=60)
+            return time.monotonic() - t0
+        finally:
+            if hung is not None:
+                cluster.signal_coordd(hung, signal.SIGCONT)
     finally:
         await cluster.stop()
 
 
-async def main() -> None:
+async def bench_config(name: str, **kw) -> float:
     times = []
     for i in range(RUNS):
         with tempfile.TemporaryDirectory(prefix="manatee-bench-") as d:
-            dt = await one_run(Path(d))
-            print("run %d: %.2fs" % (i + 1, dt), file=sys.stderr)
+            dt = await one_run(Path(d), **kw)
+            print("%s run %d: %.2fs" % (name, i + 1, dt),
+                  file=sys.stderr)
             times.append(dt)
-    value = statistics.median(times)
+    return statistics.median(times)
+
+
+async def main() -> None:
+    ensemble = await bench_config("ensemble", n_coord=3)
+    single = await bench_config("single", n_coord=1)
+    hung = await bench_config("ensemble_hung_follower", n_coord=3,
+                              hang_follower=True)
+    value = ensemble   # the deployed configuration is the one reported
     print(json.dumps({
         "metric": "failover_to_writable",
         "value": round(value, 3),
         "unit": "s",
         "vs_baseline": round(BASELINE_BUDGET_S / value, 2),
+        "configs": {
+            "ensemble": round(ensemble, 3),
+            "single": round(single, 3),
+            "ensemble_hung_follower": round(hung, 3),
+        },
     }))
 
 

@@ -185,6 +185,7 @@ class CoordServer:
         self.leader_addr: tuple[str, int] | None = None
         self._seq = 0
         self._follower_conns: set[_Conn] = set()
+        self._reap_tasks: set[asyncio.Task] = set()
         self._follow_task: asyncio.Task | None = None
         self._probe_task: asyncio.Task | None = None
         self._stopping = False
@@ -288,6 +289,8 @@ class CoordServer:
         for t in (self._follow_task, self._probe_task):
             if t:
                 t.cancel()
+        for t in list(self._reap_tasks):
+            t.cancel()
         if self._expiry_task:
             self._expiry_task.cancel()
         if self._save_task and not self._save_task.done():
@@ -698,9 +701,13 @@ class CoordServer:
 
     async def _ship(self, msg: dict) -> int:
         """Push *msg* (carrying the current seq) to every follower and
-        await acks; a follower that cannot ack within the timeout is
-        severed (it will resync with a fresh sync_hello).  Returns the
-        number of followers that acked."""
+        collect acks.  Returns as soon as enough followers for a commit
+        quorum have acked — a hung follower must not add its full fault
+        budget to every client write (a SIGSTOPped member once cost
+        every putClusterState, takeovers included, up to 1s here).
+        Laggards keep the rest of the fault budget in the background and
+        are severed if still silent (they resync with a fresh
+        sync_hello).  Returns the number of followers acked so far."""
         if not self._follower_conns:
             return 0
         seq = self._seq
@@ -711,17 +718,52 @@ class CoordServer:
             f.ack_waiters[seq] = fut
             f.push(msg)
             waiters.append((f, fut))
-        await asyncio.wait([w[1] for w in waiters], timeout=1.0)
+        need = self._quorum_needed()
+        # followers needed beyond ourselves; no-quorum ensembles (2
+        # members) keep wait-for-all semantics — there is no safe
+        # subset to commit on
+        need_f = len(waiters) if need is None else min(need - 1,
+                                                       len(waiters))
+        # the fault budget scales with tick (the reference's analogue is
+        # ZooKeeper's tick-derived timeouts), floored so a slow-but-live
+        # follower on a loaded host is not severed spuriously
+        deadline = loop.time() + max(4 * self.tick, 1.0)
+        pending = {fut for _f, fut in waiters}
         acks = 0
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, timeout=max(0.0, deadline - loop.time()),
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                break                      # deadline hit
+            acks += sum(1 for d in done if not d.cancelled())
+            if acks >= need_f:
+                break
+        laggards = [(f, fut) for f, fut in waiters if not fut.done()]
+        if laggards:
+            # strong refs: the loop holds tasks weakly and a GC'd
+            # reaper would leave hung followers connected forever
+            t = asyncio.ensure_future(
+                self._reap_laggards(seq, laggards, deadline))
+            self._reap_tasks.add(t)
+            t.add_done_callback(self._reap_tasks.discard)
+        return acks
+
+    async def _reap_laggards(self, seq: int,
+                             waiters: list, deadline: float) -> None:
+        """Give not-yet-acked followers the remainder of the fault
+        budget off the write path, then sever the still-silent ones."""
+        loop = asyncio.get_running_loop()
+        remaining = deadline - loop.time()
+        if remaining > 0:
+            await asyncio.wait([fut for _f, fut in waiters],
+                               timeout=remaining)
         for f, fut in waiters:
-            if fut.done() and not fut.cancelled():
-                acks += 1
-            else:
+            if not fut.done():
                 f.ack_waiters.pop(seq, None)
                 log.warning("follower not acking seq %d; severing", seq)
                 self._follower_conns.discard(f)
                 f.sever()
-        return acks
 
     async def _leader_probe_loop(self) -> None:
         """Leader heartbeat to followers + dual-leader resolution after a

@@ -260,6 +260,13 @@ class ClusterHarness:
                 argv, stdout=logf, stderr=logf, env=env,
                 start_new_session=True)
 
+    def signal_coordd(self, idx: int, sig: int) -> None:
+        """Send a signal (e.g. SIGSTOP/SIGCONT) to one ensemble member
+        — the partition-style fault the dual-leader tests inject."""
+        proc = self.coord_procs[idx]
+        if proc and proc.poll() is None:
+            os.killpg(proc.pid, sig)
+
     def kill_coordd(self, idx: int | None = None) -> None:
         which = range(self.n_coord) if idx is None else [idx]
         for i in which:
