@@ -128,12 +128,16 @@ def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
                 lag_s=10.0 * f * rng.random(),
                 wal_lsn=lsn,              # WAL stops advancing
                 in_recovery=True)
+            if not ring.ready():
+                continue   # the deployed path never scores a cold ring
             s = scorer.score(ring.window_array())
             if warn_at is None and s is not None and s > WARN_THRESHOLD:
                 warn_at = j
-        if warn_at is not None:
+        # lead counts ticks strictly BEFORE the hard failure (which
+        # fires on the final ramp tick, index ramp-1)
+        if warn_at is not None and warn_at < ramp - 1:
             detected += 1
-            leads.append(ramp - warn_at)
+            leads.append(ramp - 1 - warn_at)
 
     return {
         "n_traces": n_traces,
@@ -142,6 +146,102 @@ def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
         "min_lead_ticks": min(leads) if leads else 0,
         "false_positive_rate": (fp_ticks / healthy_scored
                                 if healthy_scored else 0.0),
+    }
+
+
+def evaluate_recorded(paths, weights_path=None, *,
+                      horizon: int = 8) -> dict:
+    """Evaluate the predictor on RECORDED traces — the JSONL files
+    PostgresMgr writes when telemetryDump is set (one line per probe
+    tick, raw ring inputs), captured from real chaos/integration runs.
+    Closes the sim-to-real loop: the synthetic eval above shows what
+    the model was taught; this shows how it does on what the deployed
+    path actually saw.
+
+    Labels come from the reference's own reactive semantics
+    (lib/postgresMgr.js:1550-1646): a hard failure is the first
+    timed-out probe after a healthy stretch — exactly the tick the
+    healthChkTimeout contract declares the database unhealthy.  A
+    useful warning is a score crossing WARN_THRESHOLD strictly before
+    that tick; a false positive is a warning with no hard failure
+    within *horizon* subsequent ticks.
+
+    Returns {n_traces, n_failures, detected, detection_rate,
+    median_lead_ticks, min_lead_ticks, false_positive_rate,
+    scored_ticks}.  Traces too short to score, or with no failure and
+    no warnings, still count toward scored_ticks/FP accounting.
+    """
+    import json as _json
+
+    from manatee_tpu.health.telemetry import (
+        WARN_THRESHOLD,
+        NumpyScorer,
+        TelemetryRing,
+    )
+
+    scorer = NumpyScorer(weights_path)
+    if not scorer.available:
+        raise RuntimeError("no usable weights at %r" % (weights_path,))
+
+    n_traces = 0
+    failures = 0
+    detected = 0
+    leads: list[int] = []
+    scored = 0
+    fp = 0
+
+    for path in paths:
+        ticks = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    ticks.append(_json.loads(line))
+        if not ticks:
+            continue
+        n_traces += 1
+        # replay through the deployed scoring path
+        ring = TelemetryRing()
+        warns: list[int] = []
+        timeouts: list[int] = []
+        for i, t in enumerate(ticks):
+            ring.add(latency_ms=float(t.get("latency_ms") or 0.0),
+                     timed_out=bool(t.get("timed_out")),
+                     lag_s=t.get("lag_s"),
+                     wal_lsn=t.get("wal_lsn"),
+                     in_recovery=bool(t.get("in_recovery")))
+            if t.get("timed_out"):
+                timeouts.append(i)
+            if not ring.ready():
+                continue
+            s = scorer.score(ring.window_array())
+            scored += 1
+            if s is not None and s > WARN_THRESHOLD:
+                warns.append(i)
+        # hard failures: first timeout of each failure episode (a
+        # timeout NOT immediately preceded by another timeout)
+        hard = [i for i in timeouts
+                if i == 0 or (i - 1) not in timeouts]
+        failures += len(hard)
+        for h in hard:
+            early = [w for w in warns if w < h and h - w <= horizon]
+            if early:
+                detected += 1
+                leads.append(h - max(early))
+        # false positives: warnings with no hard failure close behind
+        for w in warns:
+            if not any(0 < h - w <= horizon for h in hard):
+                fp += 1
+
+    return {
+        "n_traces": n_traces,
+        "n_failures": failures,
+        "detected": detected,
+        "detection_rate": (detected / failures) if failures else None,
+        "median_lead_ticks": float(np.median(leads)) if leads else 0.0,
+        "min_lead_ticks": min(leads) if leads else 0,
+        "false_positive_rate": (fp / scored) if scored else 0.0,
+        "scored_ticks": scored,
     }
 
 

@@ -100,6 +100,12 @@ class PostgresMgr:
         self.telemetry = TelemetryRing()
         self._scorer = NumpyScorer(self.cfg.get("healthModelWeights"))
         self.health_score: float | None = None
+        # recorded-trace capture (closes the predictor's sim-to-real
+        # loop): when telemetryDump names a file, every probe tick's RAW
+        # features land there as JSONL, so real chaos/integration runs
+        # produce evaluation/training data for health.train
+        self._telemetry_dump = self.cfg.get("telemetryDump")
+        self._dump_fh = None
 
     # ---- events ----
 
@@ -132,6 +138,8 @@ class PostgresMgr:
         await self._kill_proc()
         if self._log_fh:
             self._log_fh.close()
+        if self._dump_fh:
+            self._dump_fh.close()
 
     @property
     def online(self) -> bool:
@@ -521,3 +529,30 @@ class PostgresMgr:
         if self._scorer.available and self.telemetry.ready():
             self.health_score = self._scorer.score(
                 self.telemetry.window_array())
+        if self._telemetry_dump:
+            self._dump_tick(ok, latency_ms, lag, wal, in_recovery)
+
+    def _dump_tick(self, ok: bool, latency_ms: float, lag, wal,
+                   in_recovery: bool) -> None:
+        """One JSONL line per probe tick: the ring's RAW inputs plus the
+        liveness verdict, so offline evaluation can replay exactly what
+        the deployed path saw (health.train evaluate_recorded)."""
+        import json as _json
+        try:
+            if self._dump_fh is None:
+                self._dump_fh = open(self._telemetry_dump, "a")
+            self._dump_fh.write(_json.dumps({
+                "ts": round(time.time(), 3),
+                "peer": self.peer_id,
+                "latency_ms": round(latency_ms, 3),
+                "timed_out": not ok,
+                "lag_s": lag,
+                "wal_lsn": wal,
+                "in_recovery": in_recovery,
+                "online": self._online,
+                "score": (round(self.health_score, 4)
+                          if self.health_score is not None else None),
+            }) + "\n")
+            self._dump_fh.flush()
+        except OSError:
+            self._telemetry_dump = None   # capture must never hurt HA

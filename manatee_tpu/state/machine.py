@@ -70,6 +70,12 @@ RETRY_DELAY = 1.0
 
 from manatee_tpu.utils import iso_ms as _now_iso  # noqa: E402
 
+# Injection point for the model checker: explore() swaps this for a
+# zero-delay sleep so retry/backoff paths run at full speed WITHOUT
+# monkeypatching the process-global asyncio.sleep (which would silently
+# strip delays from unrelated asyncio code in the same process).
+_sleep = asyncio.sleep
+
 
 def _iso_to_ts(s: str) -> float:
     try:
@@ -232,7 +238,7 @@ class PeerStateMachine:
                 log.info("cluster-state CAS conflict; deferring")
             except Exception:
                 log.exception("state machine evaluation failed")
-                await asyncio.sleep(RETRY_DELAY)
+                await _sleep(RETRY_DELAY)
                 self._kick.set()
 
     # ---- the decision procedure ----
@@ -512,7 +518,7 @@ class PeerStateMachine:
                     await refresh()
                 except Exception:
                     pass
-            await asyncio.sleep(0.05)
+            await _sleep(0.05)
             self.kick()
             return False
         self._emit("stateWritten", state)
@@ -558,5 +564,5 @@ class PeerStateMachine:
             log.exception("pg reconfigure to %s failed; will retry",
                           cfg.get("role"))
             self._pg_target = None
-            await asyncio.sleep(RETRY_DELAY)
+            await _sleep(RETRY_DELAY)
             self.kick()

@@ -90,9 +90,9 @@ _ORIG_SLEEP = asyncio.sleep
 
 
 async def _fast_sleep(delay, result=None):
-    """Replaces asyncio.sleep during exploration: keep the yield point
-    (tasks must still get scheduled) but drop the wall-clock wait so the
-    machine's retry/backoff paths run at full speed."""
+    """Injected as machine._sleep during exploration: keep the yield
+    point (tasks must still get scheduled) but drop the wall-clock wait
+    so the machine's retry/backoff paths run at full speed."""
     return await _ORIG_SLEEP(0)
 
 
@@ -724,7 +724,8 @@ def explore(config: MCConfig, depth: int | None = None,
     res = MCResult(config=config.name)
     t0 = time.monotonic()
     logging.getLogger("manatee.state").setLevel(logging.CRITICAL)
-    patched, asyncio.sleep = asyncio.sleep, _fast_sleep
+    from manatee_tpu.state import machine as _machine
+    patched, _machine._sleep = _machine._sleep, _fast_sleep
     try:
         loop = asyncio.new_event_loop()
         try:
@@ -763,7 +764,7 @@ def explore(config: MCConfig, depth: int | None = None,
         finally:
             loop.close()
     finally:
-        asyncio.sleep = patched
+        _machine._sleep = patched
     res.seconds = time.monotonic() - t0
     return res
 

@@ -111,9 +111,10 @@ class StatusServer:
                 # (telemetry.normalize_tick order)
                 names = ("latency", "timed_out", "lag", "wal_stall",
                          "reconnects")
+                from manatee_tpu.utils.prom import label_str
                 metric("probe_feature", "gauge",
                        "normalized health-probe features, last tick",
-                       [('{feature="%s"}' % n, "%.4f" % v)
+                       [(label_str(feature=n), "%.4f" % v)
                         for n, v in zip(names, tick)])
         sm = self.state_machine
         if sm is not None:

@@ -4,6 +4,28 @@ status server and coordd (one copy so format fixes land everywhere)."""
 from __future__ import annotations
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format escaping for label VALUES: backslash,
+    double-quote, and newline must be escaped or a dynamic value (a
+    peer name, an error string) silently corrupts the exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def label_str(**kv) -> str:
+    """Render '{k="v",...}' with each value escaped.  Use this for any
+    label whose value is not a static ASCII literal."""
+    if not kv:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, escape_label_value(v)) for k, v in kv.items())
+
+
+def _escape_help(text: str) -> str:
+    # HELP lines escape backslash and newline (not quotes)
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class MetricsBuilder:
     def __init__(self, prefix: str):
         self.prefix = prefix
@@ -11,9 +33,10 @@ class MetricsBuilder:
 
     def metric(self, name: str, mtype: str, help_: str, samples) -> None:
         """*samples*: a scalar value, or [(label_string, value), ...]
-        where label_string is e.g. '{role="leader"}'."""
+        where label_string is e.g. '{role="leader"}' — build dynamic
+        ones with label_str() so the values are escaped."""
         full = "%s_%s" % (self.prefix, name)
-        self.lines.append("# HELP %s %s" % (full, help_))
+        self.lines.append("# HELP %s %s" % (full, _escape_help(help_)))
         self.lines.append("# TYPE %s %s" % (full, mtype))
         if not isinstance(samples, list):
             samples = [("", samples)]

@@ -113,6 +113,9 @@ class Peer:
             common["pgUseSudo"] = False
         sitter = dict(common)
         sitter.update({
+            # every run records real probe telemetry — chaos and
+            # integration traces feed health.train evaluate_recorded
+            "telemetryDump": str(self.root / "telemetry.jsonl"),
             "shardPath": self.cluster.shard_path,
             "zfsHost": self.ip,
             "zfsPort": self.zfs_port,
