@@ -36,6 +36,13 @@ import numpy as np
 N_FEATURES = 5     # latency_ms, timed_out, lag_s, wal_stall, reconnects
 WINDOW = 16        # probe ticks per scoring window
 
+# A failed probe enters the ring at this latency regardless of how fast
+# the failure itself was — a refused connection fails in ~1 ms but must
+# not look FAST to the model.  Shared by the deployed path
+# (pg/manager.py _record_telemetry) and the offline replay
+# (health/train.py evaluate_recorded) so they cannot diverge.
+FAILED_PROBE_LATENCY_MS = 1000.0
+
 DEFAULT_WEIGHTS = Path(__file__).parent / "weights.npz"
 WARN_THRESHOLD = 0.8
 

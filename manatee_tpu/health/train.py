@@ -163,21 +163,43 @@ def evaluate_recorded(paths, weights_path=None, *,
     timed-out probe after a healthy stretch — exactly the tick the
     healthChkTimeout contract declares the database unhealthy.  A
     useful warning is a score crossing WARN_THRESHOLD strictly before
-    that tick; a false positive is a warning with no hard failure
-    within *horizon* subsequent ticks.
+    that tick, scored on a window not already dominated by a previous
+    episode.  False positives are counted ONLY on healthy stretches:
+    ticks inside a failure episode (consecutive timeouts), within
+    *horizon* before a hard failure (that's the warning we want), or
+    within max(*horizon*, WINDOW) after an episode ends (the ring
+    still holds the outage for WINDOW ticks) are excluded from both
+    the FP numerator and denominator — an outage is one failure, not
+    twenty false alarms.
+
+    Replay is bit-faithful to the deployed path: the ring is fed the
+    same latency substitution PostgresMgr applies — both sites share
+    telemetry.FAILED_PROBE_LATENCY_MS, so a refused connection that
+    fails in ~1 ms replays exactly as the deployed path saw it.
 
     Returns {n_traces, n_failures, detected, detection_rate,
     median_lead_ticks, min_lead_ticks, false_positive_rate,
-    scored_ticks}.  Traces too short to score, or with no failure and
-    no warnings, still count toward scored_ticks/FP accounting.
+    scored_ticks, healthy_ticks, unscoreable_failures}.  Episodes that
+    begin before the ring was ever scoreable (database still booting
+    at trace start) are unscoreable_failures — reported, not counted
+    as misses.  Traces too short to score, or with no failure and no
+    warnings, still count toward FP accounting.
     """
     import json as _json
 
     from manatee_tpu.health.telemetry import (
+        FAILED_PROBE_LATENCY_MS,
         WARN_THRESHOLD,
+        WINDOW,
         NumpyScorer,
         TelemetryRing,
     )
+
+    # the ring still holds an ended episode's ticks for WINDOW ticks
+    # after it, so warnings there are the outage draining out of the
+    # window, not predictions — excluded regardless of how short a
+    # lead-time horizon the caller asked for
+    shadow = max(horizon, WINDOW)
 
     scorer = NumpyScorer(weights_path)
     if not scorer.available:
@@ -188,7 +210,9 @@ def evaluate_recorded(paths, weights_path=None, *,
     detected = 0
     leads: list[int] = []
     scored = 0
+    healthy_scored = 0
     fp = 0
+    unscoreable = 0
 
     for path in paths:
         ticks = []
@@ -203,35 +227,70 @@ def evaluate_recorded(paths, weights_path=None, *,
         # replay through the deployed scoring path
         ring = TelemetryRing()
         warns: list[int] = []
-        timeouts: list[int] = []
+        scored_at: list[int] = []
+        timeouts = [i for i, t in enumerate(ticks) if t.get("timed_out")]
         for i, t in enumerate(ticks):
-            ring.add(latency_ms=float(t.get("latency_ms") or 0.0),
-                     timed_out=bool(t.get("timed_out")),
+            timed_out = bool(t.get("timed_out"))
+            # deployed-path substitution (pg/manager.py
+            # _record_telemetry): failed probes enter the ring at the
+            # shared clamp, however fast the failure itself was
+            lat = (FAILED_PROBE_LATENCY_MS if timed_out
+                   else float(t.get("latency_ms") or 0.0))
+            ring.add(latency_ms=lat, timed_out=timed_out,
                      lag_s=t.get("lag_s"),
                      wal_lsn=t.get("wal_lsn"),
                      in_recovery=bool(t.get("in_recovery")))
-            if t.get("timed_out"):
-                timeouts.append(i)
             if not ring.ready():
                 continue
             s = scorer.score(ring.window_array())
             scored += 1
+            scored_at.append(i)
             if s is not None and s > WARN_THRESHOLD:
                 warns.append(i)
-        # hard failures: first timeout of each failure episode (a
-        # timeout NOT immediately preceded by another timeout)
-        hard = [i for i in timeouts
-                if i == 0 or (i - 1) not in timeouts]
+        # failure episodes: maximal runs of consecutive timeouts; the
+        # hard failure is each episode's FIRST tick
+        episodes: list[tuple[int, int]] = []
+        for i in timeouts:
+            if episodes and i == episodes[-1][1] + 1:
+                episodes[-1] = (episodes[-1][0], i)
+            else:
+                episodes.append((i, i))
+        # a failure is assessable only if at least one scored tick
+        # precedes it — every real trace begins with timed-out probes
+        # while the database is still booting, and no predictor can
+        # warn before the ring has ever been scoreable.  Those are
+        # reported, not counted as misses.
+        first_scored = scored_at[0] if scored_at else len(ticks)
+        hard = [start for start, _end in episodes
+                if start > first_scored]
+        unscoreable += sum(1 for start, _end in episodes
+                           if start <= first_scored)
         failures += len(hard)
+
+        def polluted(i: int) -> bool:
+            """Tick *i*'s window is dominated by an episode already in
+            progress or just ended — a warning there observes THAT
+            outage; crediting it as a prediction of the next one would
+            inflate detection whenever a flapping database produces
+            episodes within *horizon* of each other."""
+            return any(start <= i <= end + shadow
+                       for start, end in episodes)
+
         for h in hard:
-            early = [w for w in warns if w < h and h - w <= horizon]
+            early = [w for w in warns
+                     if w < h and h - w <= horizon and not polluted(w)]
             if early:
                 detected += 1
                 leads.append(h - max(early))
-        # false positives: warnings with no hard failure close behind
-        for w in warns:
-            if not any(0 < h - w <= horizon for h in hard):
-                fp += 1
+
+        def on_healthy_stretch(i: int) -> bool:
+            for start, end in episodes:
+                if start - horizon <= i <= end + shadow:
+                    return False
+            return True
+        healthy_scored += sum(1 for i in scored_at
+                              if on_healthy_stretch(i))
+        fp += sum(1 for w in warns if on_healthy_stretch(w))
 
     return {
         "n_traces": n_traces,
@@ -240,8 +299,11 @@ def evaluate_recorded(paths, weights_path=None, *,
         "detection_rate": (detected / failures) if failures else None,
         "median_lead_ticks": float(np.median(leads)) if leads else 0.0,
         "min_lead_ticks": min(leads) if leads else 0,
-        "false_positive_rate": (fp / scored) if scored else 0.0,
+        "false_positive_rate": (fp / healthy_scored
+                                if healthy_scored else 0.0),
         "scored_ticks": scored,
+        "healthy_ticks": healthy_scored,
+        "unscoreable_failures": unscoreable,
     }
 
 
@@ -251,7 +313,21 @@ def main(argv=None) -> None:
                    help="output .npz (default: packaged weights path)")
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--recorded", nargs="+", metavar="JSONL",
+                   help="skip training; evaluate the packaged weights "
+                        "(or -o) on recorded telemetry dumps and print "
+                        "one JSON result line")
+    p.add_argument("--horizon", type=int, default=8,
+                   help="ticks of lead counted as a useful warning "
+                        "(with --recorded)")
     args = p.parse_args(argv)
+
+    if args.recorded:
+        import json as _json
+        ev = evaluate_recorded(args.recorded, args.out,
+                               horizon=args.horizon)
+        print(_json.dumps(ev))
+        return
 
     out = args.out
     if out is None:

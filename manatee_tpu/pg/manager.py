@@ -96,7 +96,12 @@ class PostgresMgr:
         self._closed = False
         self._log_fh = None
 
-        from manatee_tpu.health.telemetry import NumpyScorer, TelemetryRing
+        from manatee_tpu.health.telemetry import (
+            FAILED_PROBE_LATENCY_MS,
+            NumpyScorer,
+            TelemetryRing,
+        )
+        self._failed_probe_latency_ms = FAILED_PROBE_LATENCY_MS
         self.telemetry = TelemetryRing()
         self._scorer = NumpyScorer(self.cfg.get("healthModelWeights"))
         self.health_score: float | None = None
@@ -523,7 +528,8 @@ class PostgresMgr:
             except (KeyError, ValueError, TypeError):
                 wal = None
         self.telemetry.add(
-            latency_ms=latency_ms if ok else 1000.0,
+            latency_ms=(latency_ms if ok
+                        else self._failed_probe_latency_ms),
             timed_out=not ok, lag_s=lag, wal_lsn=wal,
             in_recovery=in_recovery)
         if self._scorer.available and self.telemetry.ready():

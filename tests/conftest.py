@@ -17,3 +17,10 @@ except ImportError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Orphan containment (ctrun -o noorphan parity): every process this
+# session spawns — transitively, databases included — is stamped and
+# reaped at exit/SIGTERM, so an aborted run cannot strand a cluster.
+from tests import reaper  # noqa: E402
+
+reaper.install()

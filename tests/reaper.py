@@ -1,0 +1,99 @@
+"""Orphan containment for test runs (ctrun parity).
+
+The reference runs its integration suite under ``ctrun -o noorphan`` so
+every child process dies with the test (test/integ-test.sh:12-21).
+This is the same contract for this harness: conftest stamps a unique
+``MANATEE_TEST_SESSION`` marker into the session's environment before
+anything spawns; every child — sitters, backupservers, snapshotters,
+coordd members, their database children, CLI invocations — inherits it
+transitively, and :func:`sweep` kills whatever still carries it when
+the session ends (normal exit, crash, or SIGTERM).
+
+The pytest process itself is naturally excluded: ``/proc/<pid>/environ``
+is the environment at *exec* time, so setting ``os.environ`` after
+startup marks only descendants.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import uuid
+
+MARKER = "MANATEE_TEST_SESSION"
+
+
+def living(value: str) -> list[int]:
+    """Pids (excluding the caller) whose exec-time environment carries
+    ``MANATEE_TEST_SESSION=value``.  Unreadable or already-gone
+    processes are skipped."""
+    needle = ("%s=%s" % (MARKER, value)).encode()
+    me = os.getpid()
+    found: list[int] = []
+    for ent in os.listdir("/proc"):
+        if not ent.isdigit():
+            continue
+        pid = int(ent)
+        if pid == me:
+            continue
+        try:
+            with open("/proc/%d/environ" % pid, "rb") as fh:
+                env = fh.read()
+        except OSError:
+            continue
+        if needle in env.split(b"\0"):
+            found.append(pid)
+    return found
+
+
+def sweep(value: str) -> list[int]:
+    """SIGKILL every process :func:`living` finds.  Returns the pids
+    killed.  Purely best-effort."""
+    killed: list[int] = []
+    for pid in living(value):
+        try:
+            os.kill(pid, signal.SIGKILL)
+            killed.append(pid)
+        except OSError:
+            pass
+    return killed
+
+
+def install() -> str:
+    """Stamp this process's (future) children and arm the sweep.
+    Respects an inherited marker so a nested pytest (the reaper's own
+    test) keeps its parent's label and can be swept from outside;
+    returns the active marker value.
+
+    Only the marker's ORIGINATOR sweeps on normal exit — a nested
+    session that inherited its label shares it with every sibling the
+    parent spawned, and sweeping the shared label on one child's clean
+    exit would SIGKILL the others mid-run.  SIGTERM sweeps in both
+    cases: it means "abort this whole test session", and the victim
+    test relies on a terminated nested session reaping what it
+    transitively spawned."""
+    value = os.environ.get(MARKER)
+    originator = not value
+    if originator:
+        value = "%d-%s" % (os.getpid(), uuid.uuid4().hex[:12])
+        os.environ[MARKER] = value
+
+    def _reap() -> None:
+        sweep(value)
+
+    if originator:
+        atexit.register(_reap)
+
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _on_term(signum, frame):
+        _reap()
+        # chain: restore whatever was there and let the default
+        # disposition (or the previous handler) terminate the process
+        signal.signal(signal.SIGTERM, prev
+                      if callable(prev) else signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    return value

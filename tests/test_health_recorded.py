@@ -1,0 +1,259 @@
+"""Recorded-telemetry evaluation — the predictor's sim-to-real loop.
+
+Every harness-run sitter dumps one JSONL line per probe tick
+(telemetryDump, tests/harness.py); ``health.train.evaluate_recorded``
+replays those dumps through the deployed TelemetryRing + NumpyScorer
+path and scores the model against the reference's own reactive labels
+(lib/postgresMgr.js:1550-1646: the first timed-out probe of an episode
+is the hard failure).  These tests pin the replay semantics on canned
+traces, then harvest a REAL recorded failure from a live cluster whose
+primary database hangs (SIGSTOP — alive but unresponsive, the exact
+situation the healthChkTimeout contract exists for).
+"""
+
+import asyncio
+import json
+import os
+import signal
+
+import numpy as np
+
+from manatee_tpu.health.train import evaluate_recorded
+from tests.harness import ClusterHarness
+from tests.test_integration import converged
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def write_trace(path, ticks):
+    with open(path, "w") as fh:
+        for t in ticks:
+            fh.write(json.dumps(t) + "\n")
+    return str(path)
+
+
+def healthy(n, lsn0=0, latency=8.0):
+    return [{"latency_ms": latency, "timed_out": False, "lag_s": 0.02,
+             "wal_lsn": lsn0 + 1000 * i, "in_recovery": True}
+            for i in range(n)]
+
+
+def test_recorded_degradation_is_detected_with_lead(tmp_path):
+    """A recorded ramp — latency, timeouts, lag all climbing the way
+    synthetic_batch models degradation — must be caught strictly before
+    its hard failure, and the healthy prefix must not page."""
+    rng = np.random.default_rng(3)
+    ticks = healthy(40)
+    lsn = 40 * 1000
+    ramp = 12
+    for j in range(ramp):
+        f = (j + 1) / ramp
+        ticks.append({
+            "latency_ms": 30 + 970 * f * rng.random(),
+            "timed_out": bool(j == ramp - 1),   # hard failure at the end
+            "lag_s": 10.0 * f * rng.random(),
+            "wal_lsn": lsn,                      # WAL stops advancing
+            "in_recovery": True,
+        })
+    p = write_trace(tmp_path / "t.jsonl", ticks)
+    ev = evaluate_recorded([p])
+    assert ev["n_traces"] == 1
+    assert ev["n_failures"] == 1
+    assert ev["detected"] == 1, ev
+    assert ev["min_lead_ticks"] >= 1
+    assert ev["false_positive_rate"] == 0.0, ev
+
+
+def test_outage_ticks_are_not_false_positives(tmp_path):
+    """ADVICE r3 #1 regression: an abrupt 20-tick outage keeps the score
+    above threshold for the whole episode; those warning ticks are the
+    failure being OBSERVED, not predicted falsely — FP accounting must
+    exclude the episode and its recovery shadow, so a one-outage trace
+    reports one failure and zero false positives (not ~19)."""
+    ticks = healthy(30)
+    lsn = 30 * 1000
+    for _ in range(20):    # abrupt outage: no ramp precedes it
+        ticks.append({"latency_ms": 1.0, "timed_out": True, "lag_s": None,
+                      "wal_lsn": lsn, "in_recovery": True})
+    ticks += healthy(30, lsn0=lsn + 1000)
+    p = write_trace(tmp_path / "t.jsonl", ticks)
+    # default horizon: the recovery shadow is max(horizon, WINDOW), so
+    # ring-pollution warnings after the episode are excluded even when
+    # the caller asks for a short lead-time horizon
+    ev = evaluate_recorded([p])
+    assert ev["n_failures"] == 1
+    assert ev["false_positive_rate"] == 0.0, ev
+    # abrupt death has no precursor; an honest eval reports a miss
+    assert ev["detected"] == 0
+
+
+def test_flapping_episodes_do_not_self_detect(tmp_path):
+    """Review r4 regression: a flapping database produces episodes
+    within *horizon* of each other; warnings emitted while the ring is
+    still full of episode A must not be credited as having PREDICTED
+    episode B — with no genuine precursor before either, detection is
+    honestly zero."""
+    ticks = healthy(40)
+    lsn = 40 * 1000
+    outage = [{"latency_ms": 1.0, "timed_out": True, "lag_s": None,
+               "wal_lsn": lsn, "in_recovery": True}] * 5
+    ticks += outage                      # episode A
+    ticks += healthy(3, lsn0=lsn + 1000)  # brief flap back
+    ticks += outage                      # episode B, well inside horizon
+    ticks += healthy(30, lsn0=lsn + 5000)
+    p = write_trace(tmp_path / "t.jsonl", ticks)
+    ev = evaluate_recorded([p], horizon=8)
+    assert ev["n_failures"] == 2, ev
+    assert ev["detected"] == 0, ev
+    assert ev["false_positive_rate"] == 0.0, ev
+
+
+def test_startup_boot_timeouts_are_not_missed_failures(tmp_path):
+    """Every real trace begins with timed-out probes while the database
+    boots — before the ring was ever scoreable.  No predictor can warn
+    there, so those episodes must be reported as unscoreable, not
+    counted as detection misses."""
+    ticks = [{"latency_ms": 0.3, "timed_out": True, "lag_s": None,
+              "wal_lsn": None, "in_recovery": False}
+             for _ in range(3)]            # boot: db not up yet
+    ticks += healthy(40)
+    p = write_trace(tmp_path / "t.jsonl", ticks)
+    ev = evaluate_recorded([p])
+    assert ev["n_failures"] == 0, ev
+    assert ev["unscoreable_failures"] == 1
+    assert ev["detection_rate"] is None
+
+
+class SpyRing:
+    """TelemetryRing stand-in that records the raw kwargs each call
+    site feeds the ring — the observable for clamp parity."""
+
+    def __init__(self):
+        from manatee_tpu.health.telemetry import TelemetryRing
+        self.seen = []
+        self._real = TelemetryRing()
+
+    def add(self, **kw):
+        self.seen.append(kw)
+        return self._real.add(**kw)
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def test_replay_substitution_matches_deployed_clamp(tmp_path,
+                                                    monkeypatch):
+    """ADVICE r3 #2 regression: a connection-refused probe recorded at
+    ~1 ms must enter the replay ring at the SAME clamp the deployed
+    path applies — one shared constant, two call sites, verified by
+    spying on what each actually feeds the ring."""
+    import manatee_tpu.health.telemetry as T
+    from manatee_tpu.pg.engine import SimPgEngine
+    from manatee_tpu.pg.manager import PostgresMgr
+    from manatee_tpu.storage import DirBackend
+
+    # deployed site: PostgresMgr._record_telemetry on a failed probe
+    mgr = PostgresMgr(engine=SimPgEngine(),
+                      storage=DirBackend(str(tmp_path / "store")),
+                      config={"peer_id": "127.0.0.1:1:2",
+                              "host": "127.0.0.1", "port": 1,
+                              "datadir": str(tmp_path / "data"),
+                              "dataset": None})
+    spy_mgr = SpyRing()
+    mgr.telemetry = spy_mgr
+    mgr._record_telemetry(False, 1.0, None)   # refused in ~1 ms
+    assert spy_mgr.seen[-1]["latency_ms"] == T.FAILED_PROBE_LATENCY_MS
+
+    # replay site: evaluate_recorded over the recorded raw tick
+    spied = []
+    real_add = T.TelemetryRing.add
+
+    def spy_add(self, **kw):
+        spied.append(kw)
+        return real_add(self, **kw)
+    monkeypatch.setattr(T.TelemetryRing, "add", spy_add)
+    ticks = healthy(3) + [{"latency_ms": 1.0, "timed_out": True,
+                           "lag_s": None, "wal_lsn": 3000,
+                           "in_recovery": True}]
+    evaluate_recorded([write_trace(tmp_path / "t.jsonl", ticks)])
+    assert spied[-1]["timed_out"] is True
+    assert spied[-1]["latency_ms"] == T.FAILED_PROBE_LATENCY_MS
+    assert spied[0]["latency_ms"] == 8.0      # healthy ticks stay raw
+
+
+def test_eval_recorded_cli(tmp_path, capsys):
+    """`python -m manatee_tpu.health.train --recorded ...` is the
+    operator entry point: prints one JSON line, trains nothing."""
+    from manatee_tpu.health.train import main
+
+    p = write_trace(tmp_path / "t.jsonl", healthy(30))
+    main(["--recorded", p, "--horizon", "6"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["n_traces"] == 1
+    assert out["n_failures"] == 0
+
+
+def test_recorded_failure_from_live_cluster(tmp_path):
+    """Close the loop on a REAL trace: a live cluster's primary database
+    hangs (SIGSTOP — process alive, probes time out, /ping goes 503 per
+    the reference's healthChkTimeout contract); the recorded telemetry
+    must contain that hard failure, and evaluating the packaged weights
+    over ALL peers' dumps must page zero false positives on the healthy
+    stretches."""
+    import aiohttp
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, _sync, _asyncs = await converged(cluster)
+            # healthy warm-up: the ring is WINDOW(16) ticks deep at
+            # 0.3 s/tick, so scoring needs ~5 s of baseline before the
+            # hang for the pre-failure stretch to be scorable at all
+            await asyncio.sleep(6.0)
+            async with aiohttp.ClientSession() as http:
+                async with http.get("http://127.0.0.1:%d/ping"
+                                    % primary.status_port) as r:
+                    ping = await r.json()
+            pg_pid = ping["pg"]["pid"]
+            assert pg_pid
+            os.kill(pg_pid, signal.SIGSTOP)
+            try:
+                # ~15 timed-out probes at the harness's 0.3 s interval
+                # (healthChkTimeout 2 s bounds each)
+                async with aiohttp.ClientSession() as http:
+                    deadline = asyncio.get_event_loop().time() + 40
+                    got_503 = False
+                    while asyncio.get_event_loop().time() < deadline:
+                        try:
+                            async with http.get(
+                                    "http://127.0.0.1:%d/ping"
+                                    % primary.status_port) as r:
+                                if r.status == 503:
+                                    got_503 = True
+                                    break
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.3)
+                    assert got_503, "/ping never went 503 for hung pg"
+                await asyncio.sleep(3.0)   # accumulate episode ticks
+            finally:
+                os.kill(pg_pid, signal.SIGCONT)
+            # recovery ticks after the hang clears
+            await cluster.wait_writable(primary, "after-hang", timeout=60)
+            await asyncio.sleep(4.0)
+        finally:
+            await cluster.stop()
+
+    run(go())
+    traces = sorted(str(p) for p in tmp_path.glob("peer*/telemetry.jsonl"))
+    assert len(traces) == 3
+    ev = evaluate_recorded(traces, horizon=16)
+    assert ev["n_traces"] == 3
+    assert ev["n_failures"] >= 1, ev
+    assert ev["scored_ticks"] > 50
+    # the two healthy peers' entire traces + the victim's healthy
+    # stretches: the model must not page on any of them
+    assert ev["false_positive_rate"] <= 0.02, ev

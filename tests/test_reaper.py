@@ -1,0 +1,125 @@
+"""Orphan-containment proof (ctrun -o noorphan parity).
+
+The reference wraps its integration suite in ``ctrun -o noorphan`` so
+an aborted run cannot strand a cluster (test/integ-test.sh:12-21).
+These tests prove the same contract for this harness by actually
+aborting a nested pytest mid-integration:
+
+- SIGTERM: the nested session's own reaper handler sweeps everything it
+  transitively spawned before dying — zero marked processes survive.
+- SIGKILL: the handler never runs and orphans DO survive (that's what
+  makes the sweep observable), then an out-of-band ``reaper.sweep``
+  clears them — the recovery an operator (or the next session) has.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+from tests import reaper
+
+REPO = Path(__file__).resolve().parent.parent
+
+VICTIM_GATE = "MANATEE_REAPER_VICTIM"
+
+
+@pytest.mark.skipif(not os.environ.get(VICTIM_GATE),
+                    reason="victim body for the reaper tests")
+def test_victim_cluster_then_hang(tmp_path):
+    """Nested-session body: start a full 3-peer cluster, then hang so
+    the parent can abort this process mid-integration."""
+    import asyncio
+
+    from tests.harness import ClusterHarness
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        await cluster.start()
+        print("VICTIM_CLUSTER_UP", flush=True)
+        await asyncio.sleep(300)
+
+    asyncio.run(go())
+
+
+def spawn_victim(marker: str) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env[reaper.MARKER] = marker
+    env[VICTIM_GATE] = "1"
+    return subprocess.Popen(
+        [sys.executable, "-m", "pytest", "-x", "-q", "-s",
+         "-p", "no:cacheprovider",
+         "tests/test_reaper.py::test_victim_cluster_then_hang"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def wait_cluster_up(proc: subprocess.Popen, marker: str,
+                    timeout: float = 90.0) -> None:
+    """Block until the victim printed its sentinel and a real cluster
+    (coordd + sitters + backupservers ≥ 5 marked processes) is live."""
+    deadline = time.monotonic() + timeout
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "VICTIM_CLUSTER_UP" in line:
+            break
+        if proc.poll() is not None:
+            raise AssertionError("victim died early:\n"
+                                 + proc.stdout.read())
+    else:
+        raise AssertionError("victim never reported cluster up")
+    while time.monotonic() < deadline:
+        if len(reaper.living(marker)) >= 5:
+            return
+        time.sleep(0.2)
+    raise AssertionError("marked cluster processes never appeared: %r"
+                         % (reaper.living(marker),))
+
+
+def wait_none_living(marker: str, timeout: float = 15.0) -> list[int]:
+    deadline = time.monotonic() + timeout
+    left = reaper.living(marker)
+    while left and time.monotonic() < deadline:
+        time.sleep(0.2)
+        left = reaper.living(marker)
+    return left
+
+
+def test_sigterm_mid_integration_strands_nothing():
+    marker = "reap-term-" + uuid.uuid4().hex[:8]
+    proc = spawn_victim(marker)
+    try:
+        wait_cluster_up(proc, marker)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        left = wait_none_living(marker)
+        assert left == [], "stranded after SIGTERM: %r" % (left,)
+    finally:
+        proc.kill()
+        proc.wait()
+        reaper.sweep(marker)
+
+
+def test_sigkill_orphans_cleared_by_out_of_band_sweep():
+    marker = "reap-kill-" + uuid.uuid4().hex[:8]
+    proc = spawn_victim(marker)
+    try:
+        wait_cluster_up(proc, marker)
+        proc.kill()     # no handler runs: orphans MUST survive …
+        proc.wait(timeout=30)
+        time.sleep(1.0)
+        orphans = reaper.living(marker)
+        assert len(orphans) >= 5, "expected stranded cluster, got %r" \
+            % (orphans,)
+        killed = reaper.sweep(marker)   # … until swept from outside
+        assert set(killed) >= set(orphans)
+        left = wait_none_living(marker)
+        assert left == [], "stranded after sweep: %r" % (left,)
+    finally:
+        reaper.sweep(marker)
