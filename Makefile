@@ -7,8 +7,9 @@ PYTHON ?= python3
 # a failed recipe must not leave a fresh-looking partial target behind
 .DELETE_ON_ERROR:
 
-.PHONY: all test test-unit test-integ lint bench devcluster native clean \
-    modelcheck chaos man train-health
+.PHONY: all test test-unit test-integ test-integ-postgres lint bench \
+    devcluster native clean modelcheck chaos chaos-postgres man \
+    train-health eval-recorded
 
 all: lint test
 
@@ -21,6 +22,14 @@ test-unit:
 
 test-integ:
 	$(PYTHON) -m pytest tests/test_integration.py tests/test_killstorms.py \
+	    tests/test_adm_live.py -x -q
+
+# the same fault-injection tier, but every peer's database runs through
+# the real PostgresEngine against the fakepg binaries (MANATEE_ENGINE
+# re-routes the harness; tests/harness.py)
+test-integ-postgres:
+	MANATEE_ENGINE=postgres $(PYTHON) -m pytest \
+	    tests/test_integration.py tests/test_killstorms.py \
 	    tests/test_adm_live.py -x -q
 
 lint:
@@ -38,8 +47,19 @@ modelcheck:
 chaos:
 	MANATEE_CHAOS=1 $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
 
+chaos-postgres:
+	MANATEE_CHAOS=1 MANATEE_ENGINE=postgres \
+	    $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
+
 train-health:
 	$(PYTHON) -m manatee_tpu.health.train
+
+# evaluate the packaged predictor weights on recorded telemetry dumps
+# (telemetry.jsonl files an integration/chaos run leaves in its tmp
+# dirs); TRACES=<files> overrides the default glob
+eval-recorded:
+	$(PYTHON) -m manatee_tpu.health.train --recorded \
+	    $(or $(TRACES),$(wildcard /tmp/pytest-of-$(shell id -un)/pytest-*/test_*/peer*/telemetry.jsonl))
 
 bench:
 	$(PYTHON) bench.py

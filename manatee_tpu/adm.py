@@ -385,6 +385,7 @@ class AdmClient:
                 continue
             out.append({
                 "node": n,
+                "zkSeq": int(n.rsplit("-", 1)[1]),
                 "time": _now_iso(ctime) if ctime else "?",
                 "generation": state.get("generation"),
                 "state": state,
@@ -395,11 +396,41 @@ class AdmClient:
 
     # -- cluster details --
 
-    async def load_cluster_details(self, shard: str) -> ClusterDetails:
+    async def legacy_state(self, shard: str) -> dict:
+        """Topology under v1 semantics (lib/adm.js:226-337): derived
+        from the election-node order — first member primary, second
+        sync, the rest asyncs — instead of the persistent cluster
+        state.  The `status -l` view for diagnosing a cluster whose
+        state object is missing or disputed."""
+        actives = await self.get_active(shard)
+        if not actives:
+            raise AdmError("no active peers in shard %s" % shard)
+        actives.sort(key=lambda a: a["seq"])
+
+        def info(a):
+            d = {"id": a["id"]}
+            d.update(a.get("data") or {})
+            d.setdefault("zoneId", a["id"])
+            return d
+
+        return {
+            "generation": None,
+            "primary": info(actives[0]),
+            "sync": info(actives[1]) if len(actives) > 1 else None,
+            "async": [info(a) for a in actives[2:]],
+            "deposed": [],
+        }
+
+    async def load_cluster_details(self, shard: str, *,
+                                   legacy_order_mode: bool = False
+                                   ) -> ClusterDetails:
         canned = os.environ.get("MANATEE_ADM_TEST_STATE")
         if canned:
             return load_test_state(canned)
-        state, _v = await self.get_state(shard)
+        if legacy_order_mode:
+            state = await self.legacy_state(shard)
+        else:
+            state, _v = await self.get_state(shard)
         if state is None:
             raise AdmError("no cluster state for shard %r" % shard)
         peer_status: dict[str, PeerStatus] = {}
@@ -479,7 +510,12 @@ class AdmClient:
             return SimPgEngine()
         if scheme == "tcp":
             from manatee_tpu.pg.postgres import PostgresEngine
-            return PostgresEngine()
+            # psql from $MANATEE_PG_BIN_DIR when set (dev images keep
+            # the binaries out of PATH), else PATH; status queries
+            # never need sudo
+            return PostgresEngine(
+                pg_bin_dir=os.environ.get("MANATEE_PG_BIN_DIR", ""),
+                use_sudo=False)
         return None
 
     # -- state mutations (operator actions) --
@@ -527,24 +563,29 @@ class AdmClient:
             return st
         return await self._update_state(shard, mutate)
 
-    async def reap(self, shard: str, zonename: str | None = None) -> dict:
-        """Remove deposed entries that are gone (or the one named).
-        (lib/adm.js:1108-1146; safety per docs/man/manatee-adm.md:
-        306-329 — never reap a peer that is still registered)"""
+    async def reap(self, shard: str, zonename: str | None = None,
+                   ip: str | None = None) -> dict:
+        """Remove deposed entries that are gone (or the one named by
+        zonename or IP).  (lib/adm.js:1108-1146; safety per
+        docs/man/manatee-adm.md:306-329 — never reap a peer that is
+        still registered)"""
         active_ids = {a["id"] for a in await self.get_active(shard)}
 
         def mutate(st):
             deposed = st.get("deposed") or []
-            if zonename is not None:
+            if zonename is not None or ip is not None:
                 keep, dropped = [], []
                 for d in deposed:
-                    if d.get("zoneId") == zonename or \
-                            d.get("id") == zonename:
+                    if (zonename is not None
+                            and (d.get("zoneId") == zonename
+                                 or d.get("id") == zonename)) \
+                            or (ip is not None and d.get("ip") == ip):
                         dropped.append(d)
                     else:
                         keep.append(d)
                 if not dropped:
-                    raise AdmError("%s not in deposed list" % zonename)
+                    raise AdmError("%s not in deposed list"
+                                   % (zonename or ip))
             else:
                 keep = [d for d in deposed if d["id"] in active_ids]
                 dropped = [d for d in deposed
@@ -581,34 +622,46 @@ class AdmClient:
             return st
         return await self._update_state(shard, mutate)
 
-    async def state_backfill(self, shard: str) -> dict:
+    async def state_backfill(self, shard: str, *,
+                             dry_run: bool = False,
+                             precomputed: dict | None = None) -> dict:
         """Create an initial (frozen) state from the current election
         order when none exists — the v1→v2 migration analogue
-        (lib/adm.js:1231-1312)."""
+        (lib/adm.js:1231-1312).  *dry_run* computes and returns the
+        state without writing it (the CLI's confirmation preview);
+        *precomputed* writes EXACTLY the object the operator confirmed
+        instead of recomputing from an election that may have shifted
+        since the prompt (the reference previews and writes the same
+        object, lib/adm.js:1278-1296)."""
         state, _ = await self.get_state(shard)
         if state is not None:
             raise AdmError("state already exists for shard %s" % shard)
-        actives = await self.get_active(shard)
-        if not actives:
-            raise AdmError("no active peers in shard %s" % shard)
-        actives.sort(key=lambda a: a["seq"])
+        if precomputed is not None:
+            new = precomputed
+        else:
+            actives = await self.get_active(shard)
+            if not actives:
+                raise AdmError("no active peers in shard %s" % shard)
+            actives.sort(key=lambda a: a["seq"])
 
-        def info(a):
-            d = {"id": a["id"]}
-            d.update(a.get("data") or {})
-            d.setdefault("zoneId", a["id"])
-            return d
+            def info(a):
+                d = {"id": a["id"]}
+                d.update(a.get("data") or {})
+                d.setdefault("zoneId", a["id"])
+                return d
 
-        new = {
-            "generation": 0,
-            "initWal": "0/0000000",
-            "primary": info(actives[0]),
-            "sync": info(actives[1]) if len(actives) > 1 else None,
-            "async": [info(a) for a in actives[2:]],
-            "deposed": [],
-            "freeze": {"date": _now_iso(),
-                       "reason": "manatee-adm state-backfill"},
-        }
+            new = {
+                "generation": 0,
+                "initWal": "0/0000000",
+                "primary": info(actives[0]),
+                "sync": info(actives[1]) if len(actives) > 1 else None,
+                "async": [info(a) for a in actives[2:]],
+                "deposed": [],
+                "freeze": {"date": _now_iso(),
+                           "reason": "manatee-adm state-backfill"},
+            }
+        if dry_run:
+            return new
         from manatee_tpu.coord.api import Op
         data = json.dumps(new).encode()
         await self._client.mkdirp(self._shard_path(shard) + "/history")

@@ -253,7 +253,9 @@ def cmd_verify(args) -> int:
 
 
 def cmd_status(args) -> int:
-    """Deprecated JSON status across shards (bin/manatee-adm:203)."""
+    """Deprecated JSON status across shards (bin/manatee-adm:203).
+    -l/--legacyOrderMode derives topology from election order (v1
+    semantics, bin/manatee-adm:223-230) instead of cluster state."""
     async def go():
         async with AdmClient(_coord(args)) as adm:
             shards = [args.shard] if args.shard else \
@@ -261,7 +263,8 @@ def cmd_status(args) -> int:
             out = {}
             for sh in shards:
                 try:
-                    d = await adm.load_cluster_details(sh)
+                    d = await adm.load_cluster_details(
+                        sh, legacy_order_mode=args.legacy_order_mode)
                 except AdmError:
                     continue
                 entry = {}
@@ -364,7 +367,8 @@ def cmd_unfreeze(args) -> int:
 def cmd_reap(args) -> int:
     async def go():
         async with AdmClient(_coord(args)) as adm:
-            new = await adm.reap(_shard(args), args.zonename)
+            new = await adm.reap(_shard(args), args.zonename,
+                                 ip=args.ip)
             print("Reaped.  Deposed peers now: %s"
                   % json.dumps(new.get("deposed", [])))
         return 0
@@ -372,7 +376,22 @@ def cmd_reap(args) -> int:
 
 
 def cmd_set_onwm(args) -> int:
+    """Flipping one-node-write mode requires cluster downtime and the
+    sitter configs to agree with the state object — prompted unless -y
+    (lib/adm.js:1161-1186)."""
     async def go():
+        if not args.yes:
+            print("!!! WARNING !!!\n"
+                  "Enabling or disabling one-node-write mode requires "
+                  "cluster downtime,\nand the mode in every sitter "
+                  "config must match the cluster state object.\n"
+                  "!!! WARNING !!!", file=sys.stderr)
+            sys.stderr.write("Are you sure you want to proceed? "
+                             "(yes/no): ")
+            sys.stderr.flush()
+            answer = input()
+            if answer.strip().lower() not in ("y", "yes"):
+                die("aborted")
         async with AdmClient(_coord(args)) as adm:
             await adm.set_onwm(_shard(args), args.mode)
             print("one-node-write mode: %s" % args.mode)
@@ -381,9 +400,30 @@ def cmd_set_onwm(args) -> int:
 
 
 def cmd_state_backfill(args) -> int:
+    """Writes a brand-new cluster state derived from election order —
+    shown and confirmed before committing unless -y
+    (lib/adm.js:1278-1296)."""
     async def go():
+        preview = None
+        if not args.yes:
+            # compute the preview, then CLOSE the session before the
+            # blocking prompt: input() freezes the event loop, and an
+            # open session would heartbeat-expire under a slow operator
+            async with AdmClient(_coord(args)) as adm:
+                preview = await adm.state_backfill(_shard(args),
+                                                   dry_run=True)
+            print("Computed new cluster state:", file=sys.stderr)
+            print(json.dumps(preview, indent=4), file=sys.stderr)
+            # prompt on stderr: stdout carries the JSON result
+            sys.stderr.write("is this correct? (yes/no): ")
+            sys.stderr.flush()
+            answer = input()
+            if answer.strip().lower() not in ("y", "yes"):
+                die("aborted")
         async with AdmClient(_coord(args)) as adm:
-            new = await adm.state_backfill(_shard(args))
+            # write the object the operator confirmed, not a recompute
+            new = await adm.state_backfill(_shard(args),
+                                           precomputed=preview)
             print(json.dumps(new, indent=4))
         return 0
     return asyncio.run(go())
@@ -423,29 +463,50 @@ def cmd_check_lock(args) -> int:
 
 
 def cmd_history(args) -> int:
+    """Cluster state history (bin/manatee-adm:651-802): rows sorted by
+    coordination sequence (--sort zkSeq, default) or record time
+    (--sort time); per-role zone columns; -v appends the per-transition
+    SUMMARY annotation."""
+    def zone8(p):
+        return (p.get("zoneId") or p.get("id") or "-")[:8] if p else "-"
+
     async def go():
         async with AdmClient(_coord(args)) as adm:
             hist = await adm.get_history(_shard(args))
+        if args.sort == "time":
+            hist.sort(key=lambda h: h["time"])
         if args.json:
             for h in hist:
                 print(json.dumps(h))
             return 0
         cols = [
             {"name": "time", "label": "TIME", "width": 24},
-            {"name": "generation", "label": "GEN", "width": 4},
-            {"name": "mode", "label": "MODE", "width": 9},
-            {"name": "freeze", "label": "FROZEN", "width": 6},
-            {"name": "annotation", "label": "SUMMARY", "width": 40},
+            {"name": "generation", "label": "G#", "width": 2},
+            {"name": "mode", "label": "MODE", "width": 5},
+            {"name": "freeze", "label": "FRZ", "width": 3},
+            {"name": "primary", "label": "PRIMARY", "width": 8},
+            {"name": "sync", "label": "SYNC", "width": 8},
+            {"name": "async", "label": "ASYNC", "width": 8},
+            {"name": "deposed", "label": "DEPOSED", "width": 8},
         ]
+        if args.verbose:
+            cols.append({"name": "annotation", "label": "SUMMARY",
+                         "width": 40})
         rows = []
         for h in hist:
             st = h["state"]
+            asyncs = st.get("async") or []
+            deposed = st.get("deposed") or []
             rows.append({
                 "time": h["time"],
                 "generation": h["generation"],
-                "mode": ("singleton" if st.get("oneNodeWriteMode")
-                         else "normal"),
-                "freeze": "yes" if st.get("freeze") else "no",
+                "mode": ("singl" if st.get("oneNodeWriteMode")
+                         else "multi"),
+                "freeze": "frz" if st.get("freeze") else "-",
+                "primary": zone8(st.get("primary")),
+                "sync": zone8(st.get("sync")),
+                "async": ",".join(zone8(a) for a in asyncs) or "-",
+                "deposed": ",".join(zone8(d) for d in deposed) or "-",
                 "annotation": h["annotation"] or "-",
             })
         emit_table(cols, rows)
@@ -470,6 +531,19 @@ def cmd_rebuild(args) -> int:
         storage = build_storage(cfg)
         shard = cfg["shardPath"].rsplit("/", 1)[-1]
 
+        if not args.yes:
+            # prompt with NO session open: input() blocks the event
+            # loop, and an open session would heartbeat-expire under a
+            # slow operator.  The guard checks run on a fresh session
+            # after confirmation, so a topology change mid-prompt (this
+            # peer becoming primary) is still caught.
+            print("This operation will remove all local data and "
+                  "rebuild this peer from its upstream.")
+            answer = input("Are you sure you want to proceed? "
+                           "(yes/no): ")
+            if answer.strip().lower() not in ("y", "yes"):
+                die("aborted")
+
         async with AdmClient(_coord(args)) as adm:
             state, _ = await adm.get_state(shard)
             if state is None:
@@ -478,14 +552,6 @@ def cmd_rebuild(args) -> int:
                 die("this peer is the primary; will not rebuild")
             deposed_ids = [d["id"] for d in state.get("deposed") or []]
             is_deposed = ident["id"] in deposed_ids
-
-            if not args.yes:
-                print("This operation will remove all local data and "
-                      "rebuild this peer from its upstream.")
-                answer = input("Are you sure you want to proceed? "
-                               "(yes/no): ")
-                if answer.strip().lower() not in ("y", "yes"):
-                    die("aborted")
 
             ds = cfg["dataset"]
             if is_deposed:
@@ -591,6 +657,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("status", cmd_status, "(deprecated) JSON status")
     sp.set_defaults(shard=None)
+    sp.add_argument("-l", "--legacyOrderMode", action="store_true",
+                    dest="legacy_order_mode",
+                    help="derive topology from election order (v1 "
+                         "semantics) instead of cluster state")
 
     add("zk-state", cmd_zk_state, "dump raw cluster state")
     add("zk-active", cmd_zk_active, "dump active peers")
@@ -606,14 +676,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("reap", cmd_reap, "remove gone peers from the deposed list")
     sp.add_argument("-n", "--zonename", default=None)
+    sp.add_argument("-i", "--ip", default=None,
+                    help="the IP of the peer to reap")
 
     sp = add("set-onwm", cmd_set_onwm, "set one-node-write mode")
     sp.add_argument("-m", "--mode", required=True,
                     choices=["on", "off"])
     sp.add_argument("-y", "--yes", action="store_true")
 
-    add("state-backfill", cmd_state_backfill,
-        "create initial state from election order")
+    sp = add("state-backfill", cmd_state_backfill,
+             "create initial state from election order")
+    sp.add_argument("-y", "--yes", action="store_true",
+                    help="skip the confirmation prompt")
 
     sp = add("promote", cmd_promote, "request a peer promotion")
     sp.add_argument("-n", "--zonename", required=True)
@@ -633,6 +707,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("history", cmd_history, "annotated cluster state history")
     sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("--sort", choices=["zkSeq", "time"],
+                    default="zkSeq", metavar="SORTFIELD",
+                    help='sort field: "zkSeq" (default) or "time"')
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    help="include the per-transition SUMMARY column")
 
     sp = add("rebuild", cmd_rebuild, "rebuild this peer from upstream")
     sp.add_argument("-c", "--config",

@@ -36,12 +36,14 @@ reference assumes a ZooKeeper ensemble (etc/sitter.json zkCfg.connStr):
   followers on every mutation and awaits their acks; with >=3 members
   mutations additionally require a connected majority (no-quorum
   refusal), so a partitioned minority leader cannot diverge the state.
-- leadership: lowest-id member wins at cold start (after promote_grace
-  of probing for an existing leader), a follower promotes itself when
-  every lower-id member is unreachable for promote_grace, and a
-  returning member always joins an incumbent leader instead of
-  reclaiming (leader stickiness).  Dual leaders after a partition heal
-  resolve by (seq, lowest id).
+- leadership: highest (seq, lowest id) among a contacted QUORUM wins —
+  a follower promotes itself only after reaching a majority of members
+  and outranking all of them for promote_grace, so a laggard cut off
+  from the up-to-date members can never roll back a majority-acked
+  write (the same two-quorums-intersect argument ZooKeeper elections
+  rest on).  A returning member always joins an incumbent leader
+  instead of reclaiming (leader stickiness).  Dual leaders after a
+  partition heal resolve by (seq, lowest id).
 - ephemerals/sessions are deliberately NOT replicated: on failover
   clients observe session loss and re-register — the same contract as
   a coordd restart, and the recovery path ConsensusMgr already owns.
@@ -820,23 +822,35 @@ class CoordServer:
         return await sync_status(addr[0], addr[1], 0.5)
 
     async def _follow_loop(self) -> None:
-        """Find and follow the leader; promote when no reachable member
-        outranks us for promote_grace.  Rank is (seq, then lowest id):
-        a member with a newer persisted tree must win the cold-start
-        election or its committed writes would be rolled back; among
-        equals the lowest id wins.  A reachable outranking non-leader
-        resets the clock — it is deciding too and will promote."""
+        """Find and follow the leader; promote when, for promote_grace,
+        a QUORUM of members is reachable and none of them outranks us.
+        Rank is (seq, then lowest id): a member with a newer persisted
+        tree must win or its committed writes would be rolled back;
+        among equals the lowest id wins.  A reachable outranking
+        non-leader resets the clock — it is deciding too and will
+        promote.
+
+        The quorum-contact requirement is what makes election safe
+        against the double fault ZooKeeper also excludes: a
+        majority-acked write lives on ≥ quorum members, any two quorums
+        intersect, so a candidate that contacted a quorum and outranks
+        all of it cannot be missing an acked write — a laggard that can
+        only see a minority never self-promotes, no matter how long the
+        up-to-date members stay unreachable."""
         interval = max(self.tick, 0.2)
+        need = self._quorum_needed()
         unranked_since: float | None = None
         while not self._stopping and self.role != "leader":
             leader: tuple[str, int] | None = None
             outranked = False
+            reachable = 1                     # self
             for idx, addr in enumerate(self.ensemble):
                 if idx == self.my_id:
                     continue
                 st = await self._probe(addr)
                 if st is None:
                     continue
+                reachable += 1
                 if st.get("role") == "leader":
                     leader = addr
                     break
@@ -855,7 +869,7 @@ class CoordServer:
                              leader[0], leader[1], e)
                 # fall through to the sleep: a fast-failing follow must
                 # not busy-loop full-snapshot resyncs against the leader
-            elif outranked:
+            elif outranked or (need is not None and reachable < need):
                 unranked_since = None
             else:
                 now = time.monotonic()

@@ -262,6 +262,58 @@ class PostgresEngine(Engine):
             raise PgError(e.result.stderr.strip() or str(e)) from None
         return res.stdout
 
+    # one psql process per SQL statement is too slow for a status op
+    # that needs five of them: the health loops of a few peers plus a
+    # `verify` sweep would spend the whole box spawning interpreters
+    # (observed as alternating status-timeout ticks under chaos with
+    # engine=postgres).  psql >= 9.6 accepts repeated -c, one
+    # connection, results printed in order — so a multi-statement op
+    # costs ONE spawn, with marker rows delimiting the sections.
+    _SECTION_RS = "\x1e"
+
+    async def _psql_sections(self, host: str, port: int,
+                             sqls: list[str], timeout: float
+                             ) -> list[str]:
+        if float(self.major) < 9.6:
+            # pre-9.6 psql has no repeated -c: sequential fallback
+            return [await self._psql(host, port, s, timeout)
+                    for s in sqls]
+        # ON_ERROR_STOP: real psql's default is to CONTINUE past a
+        # failed -c and still exit 0 — a mid-batch error would leave an
+        # empty section that parses as wrong values (in_recovery False
+        # on a standby).  With it, psql exits nonzero at the first
+        # error, surfacing as PgError exactly like the single-statement
+        # path (the fake psql stops at the first error natively).
+        argv = [self._cmd("psql"), "-h", host, "-p", str(port),
+                "-U", self.pg_user, "-d", "postgres",
+                "-At", "-F", "\x1f", "-v", "ON_ERROR_STOP=1"]
+        for i, s in enumerate(sqls):
+            if i:
+                argv += ["-c", "SELECT '%s';" % self._SECTION_RS]
+            argv += ["-c", s]
+        env = dict(os.environ)
+        env["PGCONNECT_TIMEOUT"] = str(int(timeout))
+        try:
+            res = await run(argv, timeout=timeout, env=env)
+        except ExecError as e:
+            if "timeout" in e.result.stderr:
+                raise PgQueryTimeout(str(e)) from None
+            raise PgError(e.result.stderr.strip() or str(e)) from None
+        # NB: split on "\n" explicitly — str.splitlines() treats the
+        # \x1e record separator itself as a line boundary and would
+        # swallow the markers
+        out = res.stdout[:-1] if res.stdout.endswith("\n") else res.stdout
+        sections: list[list[str]] = [[]]
+        for line in out.split("\n"):
+            if line == self._SECTION_RS:
+                sections.append([])
+            else:
+                sections[-1].append(line)
+        if len(sections) != len(sqls):
+            raise PgError("psql returned %d sections for %d statements"
+                          % (len(sections), len(sqls)))
+        return ["\n".join(s) for s in sections]
+
     async def query(self, host: str, port: int, op: dict,
                     timeout: float = 5.0) -> dict:
         kind = op.get("op")
@@ -270,47 +322,49 @@ class PostgresEngine(Engine):
             await self._psql(host, port, "SELECT current_time;", timeout)
             return {"ok": True}
         if kind == "status":
-            in_rec = (await self._psql(
-                host, port, "SELECT pg_is_in_recovery();",
-                timeout)).strip() == "t"
-            if in_rec:
-                xlog = (await self._psql(
-                    host, port, "SELECT %s;" % w["receive"],
-                    timeout)).strip()
-                # a fully-caught-up standby reports 0 regardless of how
-                # long the cluster has been idle: bare
-                # now() - pg_last_xact_replay_timestamp() reads as
-                # ever-growing "lag" on a quiescent cluster (the
-                # reference documents this caveat; we fix it).  The 0
-                # short-circuit additionally requires a LIVE walreceiver
-                # — a severed replication link must read as growing lag,
-                # not as caught-up (receive goes static after the link
-                # dies, so receive==replay alone would mask it).
-                if float(self.major) >= 9.6:
-                    live = "EXISTS (SELECT 1 FROM pg_stat_wal_receiver)"
-                    lag_sql = ("SELECT CASE WHEN %s AND %s = %s THEN 0 "
-                               "ELSE EXTRACT(EPOCH FROM (now() - %s)) "
-                               "END;" % (live, w["receive"], w["replay"],
-                                         w["replay_ts"]))
-                else:
-                    # no pg_stat_wal_receiver before 9.6: keep the
-                    # reference's raw form (with its documented caveat)
-                    lag_sql = ("SELECT EXTRACT(EPOCH FROM (now() - %s));"
-                               % w["replay_ts"])
-                lag = (await self._psql(host, port, lag_sql,
-                                        timeout)).strip()
-                lag_s = float(lag) if lag else None
+            # the whole op is ONE psql spawn (see _psql_sections);
+            # role-dependent statements branch in SQL via CASE so the
+            # batch needs no round trip to learn the role first
+            in_rec_sql = "SELECT pg_is_in_recovery();"
+            xlog_sql = ("SELECT CASE WHEN pg_is_in_recovery() "
+                        "THEN %s ELSE %s END;"
+                        % (w["receive"], w["current"]))
+            # a fully-caught-up standby reports 0 regardless of how
+            # long the cluster has been idle: bare
+            # now() - pg_last_xact_replay_timestamp() reads as
+            # ever-growing "lag" on a quiescent cluster (the
+            # reference documents this caveat; we fix it).  The 0
+            # short-circuit additionally requires a LIVE walreceiver
+            # — a severed replication link must read as growing lag,
+            # not as caught-up (receive goes static after the link
+            # dies, so receive==replay alone would mask it).
+            if float(self.major) >= 9.6:
+                live = "EXISTS (SELECT 1 FROM pg_stat_wal_receiver)"
+                lag_expr = ("CASE WHEN %s AND %s = %s THEN 0 "
+                            "ELSE EXTRACT(EPOCH FROM (now() - %s)) END"
+                            % (live, w["receive"], w["replay"],
+                               w["replay_ts"]))
             else:
-                xlog = (await self._psql(
-                    host, port, "SELECT %s;" % w["current"],
-                    timeout)).strip()
-                lag_s = None
-            rows = await self._psql(
+                # no pg_stat_wal_receiver before 9.6: keep the
+                # reference's raw form (with its documented caveat)
+                lag_expr = ("EXTRACT(EPOCH FROM (now() - %s))"
+                            % w["replay_ts"])
+            lag_sql = ("SELECT CASE WHEN pg_is_in_recovery() "
+                       "THEN (%s)::text ELSE NULL END;" % lag_expr)
+            repl_sql = ("SELECT application_name, state, %s, %s, %s, "
+                        "%s, sync_state FROM pg_stat_replication;"
+                        % (w["stat_sent"], w["stat_write"],
+                           w["stat_flush"], w["stat_replay"]))
+            ro_sql = "SHOW default_transaction_read_only;"
+            sec = await self._psql_sections(
                 host, port,
-                "SELECT application_name, state, %s, %s, %s, %s, "
-                "sync_state FROM pg_stat_replication;"
-                % (w["stat_sent"], w["stat_write"], w["stat_flush"],
-                   w["stat_replay"]), timeout)
+                [in_rec_sql, xlog_sql, lag_sql, repl_sql, ro_sql],
+                timeout)
+            in_rec = sec[0].strip() == "t"
+            xlog = sec[1].strip()
+            lag = sec[2].strip()
+            lag_s = float(lag) if in_rec and lag else None
+            rows = sec[3]
             repl = []
             for line in rows.splitlines():
                 if not line.strip():
@@ -322,9 +376,7 @@ class PostgresEngine(Engine):
                     "flush_lsn": f[4], "replay_lsn": f[5],
                     "sync_state": f[6],
                 })
-            ro = (await self._psql(
-                host, port, "SHOW default_transaction_read_only;",
-                timeout)).strip() == "on"
+            ro = sec[4].strip() == "on"
             return {"ok": True, "in_recovery": in_rec,
                     "read_only": in_rec or ro,
                     "xlog_location": xlog or "0/0000000",

@@ -35,7 +35,9 @@ def cli_env(coord_addr: str, shard: str = "1") -> dict:
     the ONE place the CLI's env contract (COORD_ADDR/SHARD/PYTHONPATH,
     canned-state hook cleared) is encoded for tests."""
     env = dict(os.environ, PYTHONPATH=str(REPO), COORD_ADDR=coord_addr,
-               SHARD=shard)
+               SHARD=shard,
+               # tcp:// peers (engine=postgres runs) resolve psql here
+               MANATEE_PG_BIN_DIR=str(FAKEPG_BIN))
     env.pop("MANATEE_ADM_TEST_STATE", None)
     return env
 
@@ -135,7 +137,8 @@ class Peer:
         (self.root / "backupserver.json").write_text(
             json.dumps(backup, indent=2))
         snap = dict(common)
-        snap.update({"pollInterval": 3600, "snapshotNumber": 5})
+        snap.update({"pollInterval": self.cluster.snapshot_poll,
+                     "snapshotNumber": self.cluster.snapshot_number})
         (self.root / "snapshotter.json").write_text(
             json.dumps(snap, indent=2))
 
@@ -200,7 +203,9 @@ class ClusterHarness:
                  shard: str = "1", n_coord: int = 1,
                  coord_promote_grace: float = 1.0,
                  disconnect_grace: float | None = 0.4,
-                 engine: str | None = None):
+                 engine: str | None = None,
+                 snapshot_poll: float = 3600.0,
+                 snapshot_number: int = 5):
         """*n_coord* > 1 runs a replicated coordd ensemble; peers get the
         full connStr and rotate to the live leader (zkCfg.connStr
         parity).
@@ -234,6 +239,8 @@ class ClusterHarness:
         self.n_coord = n_coord
         self.coord_promote_grace = coord_promote_grace
         # one block for everything: coord members + 4 ports per peer
+        self.snapshot_poll = snapshot_poll
+        self.snapshot_number = snapshot_number
         self.port_base = alloc_port_block(n_coord + 4 * n_peers)
         self.coord_ports = [self.port_base + i for i in range(n_coord)]
         self.coord_port = self.coord_ports[0]

@@ -73,6 +73,16 @@ def test_adm_live_operations(tmp_path):
             assert "1" in full
             assert full["1"]["primary"]["repl"]["sync_state"] == "sync"
 
+            # -l derives the same topology from election order alone
+            # (v1 semantics) — peers joined in order, so it agrees
+            # with cluster state here
+            cp = adm(cluster, "status", "-l")
+            legacy = json.loads(cp.stdout)
+            assert legacy["1"]["primary"]["pgUrl"] \
+                == full["1"]["primary"]["pgUrl"]
+            assert legacy["1"]["sync"]["pgUrl"] \
+                == full["1"]["sync"]["pgUrl"]
+
             # freeze blocks failover
             adm(cluster, "freeze", "-r", "maintenance test")
             cp = adm(cluster, "show")
@@ -90,13 +100,30 @@ def test_adm_live_operations(tmp_path):
             assert [d["id"] for d in st["deposed"]] == [primary.ident]
             await cluster.wait_writable(sync_peer, "post-unfreeze")
 
-            # history shows the full story with annotations
+            # history: default table has the per-role columns but no
+            # SUMMARY (bin/manatee-adm:717-719 — verbose-only)
             cp = adm(cluster, "history")
+            assert "PRIMARY" in cp.stdout and "DEPOSED" in cp.stdout
+            assert "SUMMARY" not in cp.stdout
+            assert "cluster frozen" not in cp.stdout
+
+            # -v appends the annotated SUMMARY of the full story
+            cp = adm(cluster, "history", "-v")
             assert "cluster setup for normal (multi-peer) mode" \
                 in cp.stdout
             assert "cluster frozen: maintenance test" in cp.stdout
             assert "cluster unfrozen" in cp.stdout
             assert "took over as primary" in cp.stdout
+
+            # --sort accepts zkSeq|time and rejects anything else;
+            # JSON rows carry the coordination sequence for auditing
+            cp = adm(cluster, "history", "--sort", "time", "-j")
+            rows = [json.loads(ln) for ln in
+                    cp.stdout.strip().splitlines()]
+            assert all("zkSeq" in r for r in rows)
+            assert rows == sorted(rows, key=lambda r: r["time"])
+            cp = adm(cluster, "history", "--sort", "bogus", check=False)
+            assert cp.returncode != 0
 
             # reap the dead deposed peer
             adm(cluster, "reap")

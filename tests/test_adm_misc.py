@@ -14,14 +14,16 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def adm(port, *args):
+async def adm(port, *args, stdin: str | None = None):
     # async variant: the coordd under test runs IN-PROCESS on this
     # event loop, so a blocking subprocess.run would deadlock it
     proc = await asyncio.create_subprocess_exec(
         sys.executable, "-m", "manatee_tpu.cli", *args,
+        stdin=asyncio.subprocess.PIPE if stdin is not None else None,
         stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
         env=cli_env("127.0.0.1:%d" % port))
-    out, err = await proc.communicate()
+    out, err = await proc.communicate(
+        stdin.encode() if stdin is not None else None)
     return proc.returncode, out.decode(), err.decode()
 
 
@@ -73,7 +75,18 @@ def test_state_backfill(tmp_path):
                                ).encode(),
                     ephemeral=True, sequential=True)
 
-            rc, out, err = await adm(server.port, "state-backfill")
+            # prompted preview: answering anything but yes aborts and
+            # writes nothing (lib/adm.js:1278-1296)
+            rc, _o, err = await adm(server.port, "state-backfill",
+                                    stdin="no\n")
+            assert rc != 0
+            assert "Computed new cluster state" in err
+            children = await w.get_children("/manatee/1")
+            assert "state" not in children
+
+            # confirming through the prompt writes it
+            rc, out, err = await adm(server.port, "state-backfill",
+                                     stdin="yes\n")
             assert rc == 0, err
             st = json.loads(out)
             assert st["generation"] == 0
@@ -89,8 +102,8 @@ def test_state_backfill(tmp_path):
             hist = await w.get_children("/manatee/1/history")
             assert len(hist) == 1
 
-            # refuses when state already exists
-            rc, _o, err = await adm(server.port, "state-backfill")
+            # refuses when state already exists (-y skips the prompt)
+            rc, _o, err = await adm(server.port, "state-backfill", "-y")
             assert rc != 0
             assert "already exists" in err
             await w.close()

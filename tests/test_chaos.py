@@ -42,18 +42,12 @@ pytestmark = pytest.mark.skipif(
 REPO = Path(__file__).resolve().parent.parent
 
 
-def cli_env(cluster) -> dict:
-    env = dict(os.environ, PYTHONPATH=str(REPO),
-               COORD_ADDR=cluster.coord_connstr, SHARD="1")
-    env.pop("MANATEE_ADM_TEST_STATE", None)
-    return env
-
-
 def run_cli(cluster, *args, timeout=120):
+    from tests.harness import cli_env   # the ONE env contract
     return subprocess.run(
         [sys.executable, "-m", "manatee_tpu.cli", *args],
-        capture_output=True, text=True, env=cli_env(cluster),
-        timeout=timeout)
+        capture_output=True, text=True,
+        env=cli_env(cluster.coord_connstr), timeout=timeout)
 
 
 class Chaos:
@@ -228,7 +222,9 @@ def test_chaos(tmp_path):
                     break
                 await asyncio.sleep(2.0)
             assert ok, "never converged to verify-clean after chaos " \
-                "(last actions: %s)" % chaos.actions[-8:]
+                "(last actions: %s; last verify rc=%d:\n%s\n%s)" \
+                % (chaos.actions[-8:], cp.returncode, cp.stdout,
+                   cp.stderr)
             await chaos.verify_durability()
             print("chaos: survived %d actions, %d acked writes, "
                   "%d rebuilds" % (len(chaos.actions), len(chaos.acked),

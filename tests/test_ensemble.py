@@ -762,3 +762,70 @@ def test_hung_follower_does_not_stall_writes(tmp_path):
         finally:
             await cluster.stop()
     run(go())
+
+
+def test_laggard_cut_off_from_quorum_never_self_promotes(tmp_path):
+    """ADVICE r3 #3: election requires contacting a QUORUM and
+    outranking all of it (coord/server.py _follow_loop) — the same
+    two-quorums-intersect guarantee ZooKeeper elections give.  Build
+    the double fault: a follower goes down, a write commits on the
+    remaining majority, then THAT majority goes away and only the
+    laggard returns.  Grace-based election would let it promote and
+    roll back the acked write; it must instead wait, leaderless, until
+    a write-holding member is back — and then the write survives."""
+    dirs = [str(tmp_path / ("m%d" % i)) for i in range(3)]
+    async def go():
+        servers, members = await start_ensemble(
+            grace=0.3, data_dirs=dirs)
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/st", b"base")
+
+            await servers[2].stop()          # member 2 falls behind
+            assert await wait_for(
+                lambda: len(servers[0]._follower_conns) == 1)
+            # acked write on the majority {0, 1} only
+            assert await c.set("/st", b"acked-w", 0) == 1
+            await c.close()
+            # the whole majority goes away
+            await servers[1].stop()
+            await servers[0].stop()
+
+            # only the laggard returns: it can reach no quorum, so it
+            # must sit leaderless well past many promote_graces
+            s2 = CoordServer("127.0.0.1", members[2][1], tick=0.05,
+                             ensemble=members, ensemble_id=2,
+                             promote_grace=0.3, data_dir=dirs[2])
+            await s2.start()
+            await asyncio.sleep(2.0)         # > 6x promote_grace
+            assert s2.role != "leader", \
+                "laggard self-promoted while cut off from quorum"
+
+            # a write-holder comes back: the pair elects IT (higher
+            # seq), and the acked write is still there — not rolled
+            # back by the laggard
+            s1 = CoordServer("127.0.0.1", members[1][1], tick=0.05,
+                             ensemble=members, ensemble_id=1,
+                             promote_grace=0.3, data_dir=dirs[1])
+            await s1.start()
+            try:
+                assert await wait_for(lambda: s1.role == "leader",
+                                      timeout=8)
+                assert await wait_for(
+                    lambda: s2.role == "follower"
+                    and s2.tree.exists("/st") is not None, timeout=8)
+                assert s2.tree.get("/st")[0] == b"acked-w"
+                c2 = NetCoord(connstr(members[1:2]), session_timeout=5)
+                await c2.connect()
+                data, ver = await c2.get("/st")
+                assert (data, ver) == (b"acked-w", 1)
+                await c2.close()
+            finally:
+                await s1.stop()
+                await s2.stop()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())

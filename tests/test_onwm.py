@@ -52,7 +52,7 @@ def test_onwm_lifecycle_to_ha(tmp_path):
             cfg["oneNodeWriteMode"] = False
             cfgpath.write_text(json.dumps(cfg, indent=2))
 
-            adm(cluster, "set-onwm", "-m", "off")
+            adm(cluster, "set-onwm", "-m", "off", "-y")
             adm(cluster, "unfreeze")
 
             cluster.singleton = False

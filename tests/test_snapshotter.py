@@ -1,0 +1,222 @@
+"""SnapShotter service coverage (lib/snapShotter.js parity).
+
+Unit tier pins the service semantics on a DirBackend: ping-gated
+creation (:122-152), the 13-digit-epoch-only GC filter with keep-N
+(:251, :274-404), stuck-destroy accounting, and the fatal alarm when NO
+candidate can be deleted (:370-404).  The live tier starts the actual
+snapshotter DAEMON next to a serving cluster (testManatee.js:99-398
+spawns all three daemons per peer) and watches epoch-ms snapshots
+accumulate and GC while writes flow.
+"""
+
+import asyncio
+
+from manatee_tpu.snapshots import SnapShotter
+from manatee_tpu.storage import DirBackend
+from manatee_tpu.storage.base import StorageError, is_epoch_ms_snapshot
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def mk_storage(tmp_path, dataset="manatee/pg"):
+    st = DirBackend(str(tmp_path / "store"))
+    await st.create(dataset.partition("/")[0])   # pool root first
+    await st.create(dataset)
+    return st
+
+
+def test_create_snapshot_epoch_ms_named(tmp_path):
+    async def go():
+        st = await mk_storage(tmp_path)
+        shot = SnapShotter(st, dataset="manatee/pg")
+        taken = []
+        shot.on("snapshot", taken.append)
+        assert await shot.create_snapshot()
+        snaps = await st.list_snapshots("manatee/pg")
+        assert len(snaps) == 1
+        assert is_epoch_ms_snapshot(snaps[0].name)
+        assert taken and taken[0].name == snaps[0].name
+    run(go())
+
+
+def test_ping_gate_skips_snapshot_when_sitter_unhealthy(tmp_path):
+    """snapShotter.js:122-152: an unhealthy (or absent) sitter means
+    the database may be mid-restore — snapshotting then would archive
+    garbage, so the tick is skipped entirely."""
+    from aiohttp import web
+
+    async def go():
+        st = await mk_storage(tmp_path)
+        healthy = {"v": False}
+
+        async def ping(_req):
+            return web.json_response(
+                {"healthy": healthy["v"]},
+                status=200 if healthy["v"] else 503)
+
+        app = web.Application()
+        app.router.add_get("/ping", ping)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            shot = SnapShotter(
+                st, dataset="manatee/pg",
+                sitter_ping_url="http://127.0.0.1:%d/ping" % port)
+            assert not await shot.create_snapshot()      # 503 -> skip
+            assert await st.list_snapshots("manatee/pg") == []
+
+            healthy["v"] = True
+            assert await shot.create_snapshot()          # 200 -> taken
+            assert len(await st.list_snapshots("manatee/pg")) == 1
+
+            await runner.cleanup()                       # sitter gone
+            assert not await shot.create_snapshot()      # -> skip
+            assert len(await st.list_snapshots("manatee/pg")) == 1
+        finally:
+            import contextlib
+            with contextlib.suppress(Exception):
+                await runner.cleanup()   # idempotent double-cleanup
+    run(go())
+
+
+def test_cleanup_keeps_newest_n_and_only_touches_epoch_names(tmp_path):
+    """snapShotter.js:251, :274-404: GC never touches snapshots it did
+    not name (manual/operator snapshots), and keeps the newest N of the
+    13-digit-epoch ones."""
+    async def go():
+        st = await mk_storage(tmp_path)
+        epoch0 = 1700000000000
+        for i in range(6):
+            await st.snapshot("manatee/pg", str(epoch0 + i))
+        await st.snapshot("manatee/pg", "operator-backup")
+        await st.snapshot("manatee/pg", "1234")   # not 13 digits
+
+        shot = SnapShotter(st, dataset="manatee/pg", snapshot_number=3)
+        await shot.cleanup_once()
+        names = [s.name for s in await st.list_snapshots("manatee/pg")]
+        assert "operator-backup" in names
+        assert "1234" in names
+        kept = sorted(n for n in names if is_epoch_ms_snapshot(n))
+        assert kept == [str(epoch0 + i) for i in (3, 4, 5)]
+    run(go())
+
+
+def test_cleanup_noop_within_budget(tmp_path):
+    async def go():
+        st = await mk_storage(tmp_path)
+        for i in range(3):
+            await st.snapshot("manatee/pg", str(1700000000000 + i))
+        shot = SnapShotter(st, dataset="manatee/pg", snapshot_number=5)
+        await shot.cleanup_once()
+        assert len(await st.list_snapshots("manatee/pg")) == 3
+    run(go())
+
+
+def test_stuck_accounting_and_fatal_when_all_stuck(tmp_path):
+    """snapShotter.js:370-404: failed destroys are counted per
+    snapshot; if EVERY excess snapshot is undeletable the service
+    raises the fatal alarm (the reference aborts the process — here the
+    daemon layer owns process death, the service emits 'stuck')."""
+    async def go():
+        st = await mk_storage(tmp_path)
+        for i in range(4):
+            await st.snapshot("manatee/pg", str(1700000000000 + i))
+
+        real_destroy = st.destroy_snapshot
+        broken = {"all": True}
+
+        async def destroy(dataset, name):
+            if broken["all"] or name == str(1700000000000):
+                raise StorageError("EBUSY: snapshot is held")
+            return await real_destroy(dataset, name)
+        st.destroy_snapshot = destroy
+
+        shot = SnapShotter(st, dataset="manatee/pg", snapshot_number=1)
+        alarms = []
+        shot.on("stuck", alarms.append)
+
+        await shot.cleanup_once()                # all 3 excess stuck
+        assert alarms == [[str(1700000000000 + i) for i in range(3)]]
+        assert shot._stuck == {str(1700000000000 + i): 1
+                               for i in range(3)}
+
+        await shot.cleanup_once()                # attempts accumulate
+        assert shot._stuck[str(1700000000000)] == 2
+
+        broken["all"] = False                    # two become deletable
+        alarms.clear()
+        await shot.cleanup_once()
+        assert alarms == []                      # partial success: no alarm
+        names = [s.name for s in await st.list_snapshots("manatee/pg")]
+        # the permanently-stuck one survives, its accounting retained
+        assert str(1700000000000) in names
+        assert shot._stuck[str(1700000000000)] == 3
+        assert str(1700000000001) not in names
+        assert str(1700000000002) not in names
+    run(go())
+
+
+def test_live_snapshotter_daemon(tmp_path):
+    """Start the real snapshotter daemon beside a serving cluster's
+    primary (testManatee.js spawns all three daemons per peer; short
+    pollInterval, keep-3): epoch-ms snapshots accumulate, GC holds the
+    count at snapshotNumber while writes flow, and the kept set rolls
+    forward to the newest."""
+    from tests.harness import ClusterHarness
+    from tests.test_integration import converged
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3,
+                                 snapshot_poll=0.5, snapshot_number=3)
+        try:
+            await cluster.start()
+            primary, _sync, _asyncs = await converged(cluster)
+            await cluster.wait_writable(primary, "pre-snap")
+
+            # start the real snapshotter daemon on the primary
+            proc = primary._spawn(
+                "manatee_tpu.daemons.snapshotter",
+                str(primary.root / "snapshotter.json"),
+                "snapshotter.log")
+            try:
+                store = DirBackend(str(primary.root / "store"))
+
+                async def epoch_snaps():
+                    snaps = await store.list_snapshots("manatee/pg")
+                    return [s.name for s in snaps
+                            if is_epoch_ms_snapshot(s.name)]
+
+                # accumulation: reaches the keep budget while serving
+                deadline = asyncio.get_event_loop().time() + 30
+                while asyncio.get_event_loop().time() < deadline:
+                    await primary.pg_query(
+                        {"op": "insert", "value": "snap-era"})
+                    if len(await epoch_snaps()) >= 3:
+                        break
+                    await asyncio.sleep(0.3)
+                first_gen = await epoch_snaps()
+                assert len(first_gen) >= 3, first_gen
+
+                # GC: the count stays at snapshotNumber (+1 transient:
+                # creation and cleanup are independent loops, so the
+                # newest snapshot may not have been GC-swept yet) and
+                # the set ROLLS FORWARD (oldest dies, newest appears)
+                await asyncio.sleep(3.0)
+                later = await epoch_snaps()
+                assert 3 <= len(later) <= 4, later
+                assert min(later) > min(first_gen), (first_gen, later)
+            finally:
+                import contextlib
+                import signal as sig
+                with contextlib.suppress(ProcessLookupError):
+                    import os
+                    os.killpg(proc.pid, sig.SIGKILL)
+                proc.wait(timeout=5)
+        finally:
+            await cluster.stop()
+    run(go())
