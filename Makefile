@@ -51,8 +51,16 @@ chaos-postgres:
 	MANATEE_CHAOS=1 MANATEE_ENGINE=postgres \
 	    $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
 
+# reproduces the packaged weights: synthetic degradation batches plus
+# healthy-stretch negatives from three recorded chaos runs (seeds 1-3;
+# seeds 4-5 + the hang run stay held out — eval numbers in PARITY.md).
+# NB: run with PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu on dev images
+# where the default PYTHONPATH pulls in an accelerator sitecustomize.
 train-health:
-	$(PYTHON) -m manatee_tpu.health.train
+	$(PYTHON) -m manatee_tpu.health.train \
+	    --mix-recorded tests/data/recorded-chaos-r4/*.jsonl \
+	    tests/data/recorded-chaos-s2/*.jsonl \
+	    tests/data/recorded-chaos-s3/*.jsonl
 
 # evaluate the packaged predictor weights on recorded telemetry dumps
 # (telemetry.jsonl files an integration/chaos run leaves in its tmp

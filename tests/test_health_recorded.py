@@ -16,11 +16,15 @@ import json
 import os
 import signal
 
+from pathlib import Path
+
 import numpy as np
 
 from manatee_tpu.health.train import evaluate_recorded
 from tests.harness import ClusterHarness
 from tests.test_integration import converged
+
+REPO = Path(__file__).resolve().parent.parent
 
 
 def run(coro):
@@ -181,6 +185,96 @@ def test_replay_substitution_matches_deployed_clamp(tmp_path,
     assert spied[-1]["timed_out"] is True
     assert spied[-1]["latency_ms"] == T.FAILED_PROBE_LATENCY_MS
     assert spied[0]["latency_ms"] == 8.0      # healthy ticks stay raw
+
+
+def test_recorded_windows_labeling(tmp_path):
+    """recorded_windows extracts healthy-stretch windows as label-0
+    negatives and drops episode/shadow windows; positives only with
+    include_positives (off by default — storm kills are abrupt, their
+    pre-failure windows are label noise)."""
+    from manatee_tpu.health.telemetry import WINDOW
+    from manatee_tpu.health.train import recorded_windows
+
+    ticks = healthy(60)
+    lsn = 60 * 1000
+    for _ in range(5):
+        ticks.append({"latency_ms": 1.0, "timed_out": True,
+                      "lag_s": None, "wal_lsn": lsn,
+                      "in_recovery": True})
+    ticks += healthy(60, lsn0=lsn + 1000)
+    p = write_trace(tmp_path / "t.jsonl", ticks)
+
+    w, y = recorded_windows([p], horizon=8)
+    assert len(w) == len(y) and len(y) > 0
+    assert y.sum() == 0                       # negatives only
+    assert w.shape[1] == WINDOW
+    # pre-failure + episode + shadow windows are all excluded: the
+    # negative count is well below the scoreable tick count
+    scoreable = len(ticks) - (WINDOW // 2 - 1)
+    assert len(y) < scoreable - 5
+
+    w2, y2 = recorded_windows([p], horizon=8, include_positives=True)
+    assert y2.sum() == 8                      # the horizon window
+    assert len(w2) == len(y) + 8
+
+    # an empty/missing-tick dump yields empty arrays, not a crash
+    w3, y3 = recorded_windows([write_trace(tmp_path / "e.jsonl", [])])
+    assert len(w3) == 0 and len(y3) == 0
+
+
+def test_packaged_weights_clean_on_shipped_recorded_traces():
+    """The packaged weights (trained with chaos-trace negatives from
+    seeds 1-3, make train-health) must score ALL shipped recorded
+    traces — including the HELD-OUT storm seeds 4-5 and the
+    SIGSTOP-hang run the training never saw — with zero false
+    positives on healthy stretches, without losing the synthetic
+    degradation detection."""
+    import glob
+
+    from manatee_tpu.health.train import evaluate
+
+    held_out = sorted(
+        glob.glob(str(REPO / "tests/data/recorded-chaos-s4/*.jsonl")) +
+        glob.glob(str(REPO / "tests/data/recorded-chaos-s5/*.jsonl")) +
+        glob.glob(str(REPO / "tests/data/recorded-hang-r4/*.jsonl")))
+    assert len(held_out) == 11
+    ev = evaluate_recorded(held_out, horizon=16)
+    assert ev["false_positive_rate"] == 0.0, ev
+    assert ev["scored_ticks"] > 1500
+    # the synthetic eval now models the DEPLOYED cadence honestly
+    # (status only on every Nth successful probe, carried forward in
+    # between) — detection under it plateaus ~94%, a weaker bar than
+    # the dense-status harness that used to claim 100%
+    syn = evaluate()
+    assert syn["detection_rate"] >= 0.90, syn
+    assert syn["false_positive_rate"] == 0.0, syn
+
+
+def test_ring_carries_last_status_forward():
+    """The manager attaches the status op only to every Nth probe;
+    ticks without one must inherit the last observed lag/stall instead
+    of reading as healthy zeros — a no-timeout latency+lag ramp at
+    deployed cadence has to stay above the warning threshold."""
+    from manatee_tpu.health.telemetry import (
+        WARN_THRESHOLD,
+        NumpyScorer,
+        TelemetryRing,
+    )
+
+    scorer = NumpyScorer()
+    ring = TelemetryRing()
+    for i in range(40):
+        if i % 3 == 0:       # status tick: real lag/wal observation
+            ring.add(latency_ms=20.0 * i, timed_out=False,
+                     lag_s=0.2 * i, wal_lsn=100, in_recovery=True)
+        else:                # probe-only tick: unknown lag/wal
+            ring.add(latency_ms=20.0 * i, timed_out=False,
+                     lag_s=None, wal_lsn=None, in_recovery=True)
+    arr = ring.window_array()
+    # carried forward: no probe-only tick zeroed the lag feature
+    assert (arr[:, 2] > 0).all(), arr[:, 2]
+    s = scorer.score(arr)
+    assert s is not None and s > WARN_THRESHOLD, s
 
 
 def test_eval_recorded_cli(tmp_path, capsys):
