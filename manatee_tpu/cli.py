@@ -256,6 +256,9 @@ def cmd_status(args) -> int:
     """Deprecated JSON status across shards (bin/manatee-adm:203).
     -l/--legacyOrderMode derives topology from election order (v1
     semantics, bin/manatee-adm:223-230) instead of cluster state."""
+    print('note: "status" is deprecated. See "pg-status".',
+          file=sys.stderr)
+
     async def go():
         async with AdmClient(_coord(args)) as adm:
             shards = [args.shard] if args.shard else \

@@ -181,6 +181,13 @@ class Peer:
                     pass
         self.sitter_proc = self.backup_proc = self.snap_proc = None
 
+    def start_sitter_only(self) -> None:
+        """Respawn just the sitter (backupserver/snapshotter keep
+        running) — the fast-restart half of the MANATEE_206 scenario."""
+        self.sitter_proc = self._spawn(
+            "manatee_tpu.daemons.sitter",
+            str(self.root / "sitter.json"), "sitter.log")
+
     def kill_sitter_only(self, sig: int = signal.SIGKILL) -> None:
         if self.sitter_proc and self.sitter_proc.poll() is None:
             try:

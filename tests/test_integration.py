@@ -11,6 +11,8 @@ bootstrap, legitimately changing who declares the cluster.
 """
 
 import asyncio
+import json
+import sys
 
 from tests.harness import ClusterHarness
 
@@ -363,5 +365,88 @@ def test_heartbeat_only_failover_with_grace_disabled(tmp_path):
             assert elapsed > 1.0, \
                 "failover in %.2fs with grace disabled?" % elapsed
         finally:
+            await cluster.stop()
+    run(go())
+
+
+def test_stale_ephemeral_from_fast_restart_is_deduped(tmp_path):
+    """MANATEE_206 parity (integ.test.js:3044): a sitter SIGKILLed and
+    restarted BEFORE its old session expires leaves a stale election
+    ephemeral alongside its new one.  Membership must dedupe by peer id
+    (newest session wins, coord/manager.py parse_and_unique_actives),
+    the state machine must not treat the duplicate as a new peer, and
+    the cluster must stay converged once the stale node expires."""
+    from manatee_tpu.coord.client import NetCoord
+
+    async def go():
+        # heartbeat-only expiry with a widened session timeout: the
+        # stale ephemeral must outlive the respawned sitter's cold
+        # start (interpreter + connect) for the overlap to be
+        # observable even on a loaded host (the FIN fast path would
+        # reap it ~0.4 s after the SIGKILL)
+        cluster = ClusterHarness(tmp_path, n_peers=3,
+                                 session_timeout=5.0,
+                                 disconnect_grace=None)
+        w = None
+        try:
+            await cluster.start()
+            primary, sync, (a1,) = await converged(cluster)
+
+            w = NetCoord(cluster.coord_connstr, session_timeout=10)
+            await w.connect()
+
+            def ids_of(children):
+                return [c.rsplit("-", 1)[0] for c in children]
+
+            # fast-restart the async's sitter: SIGKILL (no goodbye),
+            # immediate respawn
+            a1.kill_sitter_only()
+            a1.start_sitter_only()
+
+            # overlap window: TWO election nodes for the same peer id
+            deadline = asyncio.get_event_loop().time() + 5
+            saw_dup = False
+            while asyncio.get_event_loop().time() < deadline:
+                ch = await w.get_children("/manatee/1/election")
+                if ids_of(ch).count(a1.ident) >= 2:
+                    saw_dup = True
+                    break
+                await asyncio.sleep(0.05)
+            assert saw_dup, "stale ephemeral never overlapped the new one"
+
+            # the deduplicated membership view stays at 3 peers with the
+            # NEWEST session winning for the duplicated id
+            from tests.harness import cli_env
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "manatee_tpu.cli", "zk-active",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                env=cli_env(cluster.coord_connstr))
+            out, _err = await proc.communicate()
+            active = json.loads(out)
+            assert [a["id"] for a in active].count(a1.ident) == 1
+            assert len(active) == 3
+
+            # the stale node expires; topology must be unchanged (no
+            # takeover, no depose — same primary and sync throughout)
+            await cluster.wait_for(
+                lambda st: st["primary"]["id"] == primary.ident
+                and st["sync"]["id"] == sync.ident
+                and [a["id"] for a in st.get("async") or []]
+                == [a1.ident]
+                and not st.get("deposed"),
+                30, "stale-ephemeral convergence")
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                ch = await w.get_children("/manatee/1/election")
+                if ids_of(ch).count(a1.ident) == 1:
+                    break
+                await asyncio.sleep(0.1)
+            ch = await w.get_children("/manatee/1/election")
+            assert ids_of(ch).count(a1.ident) == 1, ch
+            await cluster.wait_writable(primary, "post-stale-ephemeral")
+        finally:
+            if w is not None:
+                await w.close()
             await cluster.stop()
     run(go())
