@@ -46,6 +46,20 @@ class Engine(abc.ABC):
     """Driver for one local database instance plus remote status queries."""
 
     scheme = "?"
+    # True when a RUNNING standby can re-point its walreceiver at a new
+    # upstream via conf rewrite + reload (primary_conninfo became
+    # reloadable in PostgreSQL 13) — the failover-critical hop skips a
+    # full database restart
+    reloadable_upstream = False
+    # True when a RUNNING standby exits recovery in place after
+    # write_config(upstream=None) + reload — takeover without a
+    # database restart (pg_promote() semantics).  NB: this flag
+    # promises that conf rewrite + SIGHUP ALONE completes promotion;
+    # real postgres needs an explicit pg_promote()/pg_ctl promote call
+    # the manager does not make, so PostgresEngine must keep this False
+    # until such an engine op exists (it keeps the reference's restart
+    # path instead).  Demotion always restarts, like real postgres.
+    promotable_in_place = False
 
     # -- local cluster management --
 
@@ -104,6 +118,8 @@ class SimPgEngine(Engine):
     """Engine for the simulated postgres (manatee_tpu.pg.simpg)."""
 
     scheme = "sim"
+    reloadable_upstream = True   # simpg implements the PG13 semantics
+    promotable_in_place = True   # ... and pg_promote() (PG12+)
 
     def is_initialized(self, datadir: str) -> bool:
         from manatee_tpu.pg.simpg import VERSION_FILE

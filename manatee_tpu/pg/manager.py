@@ -230,19 +230,43 @@ class PostgresMgr:
 
     async def _primary(self, pgcfg: dict) -> None:
         """(lib/postgresMgr.js:1115-1184)"""
-        await self._stop()
-        await self._prepare_database()
         downstream = pgcfg.get("downstream")
         singleton = bool(self.cfg.get("singleton"))
         sync_ids = [downstream["id"]] if downstream else []
-        # read-only until the sync catches up — taking writes before
-        # the sync is established risks data loss on the next failover
-        self.engine.write_config(
-            self.datadir, host=self.host, port=self.port,
-            peer_id=self.peer_id,
-            read_only=not singleton,
-            sync_standby_ids=sync_ids, upstream=None)
-        await self._start()
+        # In-place promotion (pg_promote(), PostgreSQL 12+): a RUNNING
+        # standby taking over exits recovery via conf rewrite + reload —
+        # no database restart in the takeover path, and no down-window
+        # at all (strictly safer than the restart: there is no moment
+        # the WAL could gain a shutdown checkpoint).  Everything else —
+        # read-only-until-caught-up, the transition snapshot, the
+        # catchup watcher — is identical to the restart path.
+        # gate on HEALTH, not mere process liveness: a wedged-but-alive
+        # database would absorb the SIGHUP without acting on it, and
+        # only the restart path's kill escalation recovers it
+        if (self.running and self._online
+                and self.engine.promotable_in_place
+                and self._applied
+                and self._applied.get("role") in ("sync", "async")):
+            log.info("%s: promoting in place (reload, no restart)",
+                     self.peer_id)
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id,
+                read_only=not singleton,
+                sync_standby_ids=sync_ids, upstream=None)
+            self._reload()
+        else:
+            await self._stop()
+            await self._prepare_database()
+            # read-only until the sync catches up — taking writes
+            # before the sync is established risks data loss on the
+            # next failover
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id,
+                read_only=not singleton,
+                sync_standby_ids=sync_ids, upstream=None)
+            await self._start()
         await self._snapshot_safe()
         if downstream:
             self._catchup_task = asyncio.ensure_future(
@@ -309,6 +333,29 @@ class PostgresMgr:
     async def _standby(self, pgcfg: dict) -> None:
         """(lib/postgresMgr.js:1282-1460)"""
         upstream = pgcfg["upstream"]
+        # Live upstream re-point (PostgreSQL 13 semantics): a RUNNING
+        # standby whose upstream merely changed rewrites conf and
+        # reloads instead of restarting — this is the failover-critical
+        # hop (the new sync must attach to the new primary before
+        # writes re-enable), and skipping the database restart takes a
+        # process boot out of the takeover path.  If the new upstream
+        # refuses the stream (divergence), the database exits non-zero
+        # exactly as it would at boot, and crash-only supervision walks
+        # the restart/restore path.
+        # health-gated like the promotion fast path: a wedged process
+        # never handles the reload; only a restart recovers it
+        if (self.running and self._online
+                and self.engine.reloadable_upstream
+                and self._applied
+                and self._applied.get("role") in ("sync", "async")):
+            log.info("%s: re-pointing standby upstream to %s (reload, "
+                     "no restart)", self.peer_id, upstream.get("id"))
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id, read_only=True,
+                sync_standby_ids=[], upstream=upstream)
+            self._reload()
+            return
         try:
             await self._stop()
             await self._ensure_dataset_mounted(create=False)

@@ -142,6 +142,9 @@ class PostgresEngine(Engine):
         else:
             self.template = dict(template or DEFAULT_TEMPLATE)
         self.hba_file = hba_file
+        # primary_conninfo is reloadable from PostgreSQL 13: a running
+        # standby re-points its walreceiver without a restart
+        self.reloadable_upstream = float(self.major) >= 13
         # pg_overrides.json-style tunables merged over the template by
         # scope: common -> major -> full version
         # (lib/postgresMgr.js:118-137, 527-560)

@@ -23,8 +23,12 @@ exactly the PostgreSQL surface the control plane depends on
   inconsistent with) its upstream refuses to stream and exits, forcing
   the manager down its restore path (docs/xlog-diverge.md analogue);
 - postgres signal semantics: SIGINT = fast shutdown, SIGQUIT =
-  immediate, SIGHUP = reload (read_only + synchronous_standby_names
-  only, like pg's reloadable GUCs).
+  immediate, SIGHUP = reload of the reloadable GUCs — read_only,
+  synchronous_standby_names, and (modern-postgres parity)
+  primary_conninfo: a changed upstream re-points the walreceiver live
+  (PG13+), a REMOVED one promotes in place (pg_promote(), PG12+).
+  Demotion (gaining a primary_conninfo while running as primary) still
+  requires a restart, like real postgres.
 
 LSNs are rendered "0/XXXXXXX" like postgres so the control plane's LSN
 arithmetic (pg-lsn parity) is exercised for real.
@@ -138,11 +142,36 @@ class SimPgServer:
                 newconf = read_conf(self.datadir)
             except (OSError, json.JSONDecodeError):
                 return
-            # reloadable GUCs only (postgres parity): read_only,
-            # synchronous_standby_names
+            # reloadable GUCs (postgres parity): read_only,
+            # synchronous_standby_names — and, as of PostgreSQL 13,
+            # primary_conninfo: a running standby re-points its
+            # walreceiver at the new upstream without a restart
             self.conf["read_only"] = newconf.get("read_only")
             self.conf["synchronous_standby_names"] = \
                 newconf.get("synchronous_standby_names")
+            new_upstream = newconf.get("primary_conninfo")
+            if self.in_recovery and new_upstream and \
+                    new_upstream != self.conf.get("primary_conninfo"):
+                self.conf["primary_conninfo"] = new_upstream
+                if self._upstream_task:
+                    self._upstream_task.cancel()
+                self._upstream_ok = False
+                self._upstream_task = asyncio.ensure_future(
+                    self._stream_from_upstream())
+            elif self.in_recovery and not new_upstream:
+                # pg_promote() parity (PostgreSQL 12+): exit recovery
+                # IN PLACE — stop the walreceiver, keep the WAL and the
+                # process, start taking writes per read_only.  (The
+                # reverse, demoting a primary, still requires a restart
+                # — exactly like real postgres.)
+                self.conf["primary_conninfo"] = None
+                if self._upstream_task:
+                    self._upstream_task.cancel()
+                    self._upstream_task = None
+                self._upstream_ok = False
+                sys.stderr.write("simpg %s promoted in place\n"
+                                 % self.peer_id)
+                sys.stderr.flush()
             self._wake_repl_waiters()
 
         loop.add_signal_handler(signal.SIGINT, fast_shutdown)
@@ -244,7 +273,10 @@ class SimPgServer:
             except (OSError, ValueError, json.JSONDecodeError):
                 pass
             finally:
-                self._upstream_ok = False
+                # a cancelled ex-streamer (live upstream re-point) must
+                # not clobber the link state its replacement owns
+                if self._upstream_task is asyncio.current_task():
+                    self._upstream_ok = False
             await asyncio.sleep(0.2)
 
     # ---- serving connections ----

@@ -354,3 +354,175 @@ def test_dataset_mount_prepare_database(tmp_path):
         finally:
             await m.close()
     run(go())
+
+
+def test_live_upstream_repoint_without_restart(tmp_path):
+    """PostgreSQL-13 semantics on the failover-critical hop: a RUNNING
+    standby whose upstream changed re-points its walreceiver via conf
+    rewrite + SIGHUP — the SAME database process, no restart — and
+    replicates from the new upstream (manager._standby fast path;
+    simpg reload_conf)."""
+    async def go():
+        p1 = make_mgr(tmp_path, "prim1")
+        p2 = make_mgr(tmp_path, "prim2")
+        s = make_mgr(tmp_path, "stb")
+        wire_restores(p1, p2, s)
+        await p1.start_manager()
+        await p2.start_manager()
+        await s.start_manager()
+        try:
+            # two independent primaries; the standby follows p1 first
+            await p1.reconfigure({"role": "primary", "upstream": None,
+                                  "downstream": info_for(s)})
+            await s.reconfigure({"role": "sync", "upstream": info_for(p1),
+                                 "downstream": None})
+
+            async def writable(mgr):
+                async def attempt():
+                    try:
+                        await mgr._local_query(
+                            {"op": "insert", "value": "from-" + mgr.peer_id},
+                            5.0)
+                        return True
+                    except PgError:
+                        return False
+                await wait_until(attempt, what="%s writable" % mgr.peer_id)
+            await writable(p1)
+            pid_before = s._proc.pid
+
+            # p2 replicates p1's full history first (the real failover
+            # shape: the peer that becomes the new primary already
+            # CONTAINS the re-pointing standby's WAL), then promotes
+            await p2.reconfigure({"role": "async",
+                                  "upstream": info_for(p1),
+                                  "downstream": None})
+
+            async def p2_caught_up():
+                try:
+                    res = await p2._local_query({"op": "select"})
+                except PgError:
+                    return False
+                return "from-" + p1.peer_id in res["rows"]
+            await wait_until(p2_caught_up, what="p2 catch-up")
+            await p2.reconfigure({"role": "primary", "upstream": None,
+                                  "downstream": info_for(s)})
+
+            # re-point the running standby p1 -> p2
+            await s.reconfigure({"role": "sync", "upstream": info_for(p2),
+                                 "downstream": None})
+            assert s.running
+            assert s._proc.pid == pid_before, \
+                "standby restarted instead of re-pointing live"
+
+            await writable(p2)
+            res = await s._local_query({"op": "select"})
+            assert "from-" + p2.peer_id in res["rows"]
+        finally:
+            await p1.close()
+            await p2.close()
+            await s.close()
+    run(go())
+
+
+def test_in_place_promotion_without_restart(tmp_path):
+    """pg_promote() parity (PostgreSQL 12+): the sync takes over by
+    exiting recovery IN PLACE — same database process, WAL intact,
+    read-only until its new downstream catches up, then writable
+    (manager._primary fast path; simpg reload promotion)."""
+    async def go():
+        p = make_mgr(tmp_path, "prim")
+        s = make_mgr(tmp_path, "sync")
+        a = make_mgr(tmp_path, "asy")
+        wire_restores(p, s, a)
+        for m in (p, s, a):
+            await m.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": info_for(s)})
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": None})
+            await a.reconfigure({"role": "async", "upstream": info_for(s),
+                                 "downstream": None})
+
+            async def writable(mgr, val):
+                async def attempt():
+                    try:
+                        await mgr._local_query(
+                            {"op": "insert", "value": val}, 5.0)
+                        return True
+                    except PgError:
+                        return False
+                await wait_until(attempt, what="writable")
+            await writable(p, "pre-takeover")
+            pid_before = s._proc.pid
+
+            # the failover shape: primary dies, sync promotes with the
+            # old first-async as its new sync
+            await p.close()   # close() is idempotent (re-closed below)
+            await s.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": info_for(a)})
+            assert s.running
+            assert s._proc.pid == pid_before, \
+                "promotion restarted the database"
+            st = await s._local_query({"op": "status"})
+            assert st["in_recovery"] is False
+
+            # read-only gate until the new sync caught up, then writes
+            await writable(s, "post-takeover")
+            # the pre-takeover record survived promotion (WAL intact)
+            res = await s._local_query({"op": "select"})
+            assert "pre-takeover" in res["rows"]
+            # and synchronous replication reaches the new sync
+            res = await a._local_query({"op": "select"})
+            assert "post-takeover" in res["rows"]
+        finally:
+            for m in (p, s, a):
+                await m.close()
+    run(go())
+
+
+def test_wedged_standby_promotion_takes_restart_path(tmp_path):
+    """The fast paths are HEALTH-gated, not liveness-gated: a
+    wedged-but-alive database (SIGSTOP — process running, probes
+    failing) would absorb a promotion SIGHUP without acting on it, so
+    the manager must take the restart path, whose kill escalation
+    recovers the wedged process (review r4 regression)."""
+    import os
+    import signal as sig
+
+    async def go():
+        p = make_mgr(tmp_path, "prim")
+        s = make_mgr(tmp_path, "sync")
+        wire_restores(p, s)
+        await p.start_manager()
+        await s.start_manager()
+        try:
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": info_for(s)})
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": None})
+
+            async def online():
+                return s._online
+            await wait_until(online, what="standby online")
+            pid_before = s._proc.pid
+
+            os.kill(pid_before, sig.SIGSTOP)    # wedge: alive, deaf
+            async def unhealthy():
+                return not s._online
+            await wait_until(unhealthy, what="health to notice")
+
+            await s.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            assert s.running
+            assert s._proc.pid != pid_before, \
+                "fast path SIGHUPed a wedged database"
+            st = await s._local_query({"op": "status"})
+            assert st["in_recovery"] is False
+        finally:
+            import contextlib
+            with contextlib.suppress(ProcessLookupError):
+                os.kill(pid_before, sig.SIGCONT)
+            await p.close()
+            await s.close()
+    run(go())
