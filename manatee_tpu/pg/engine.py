@@ -51,15 +51,18 @@ class Engine(abc.ABC):
     # reloadable in PostgreSQL 13) — the failover-critical hop skips a
     # full database restart
     reloadable_upstream = False
-    # True when a RUNNING standby exits recovery in place after
-    # write_config(upstream=None) + reload — takeover without a
-    # database restart (pg_promote() semantics).  NB: this flag
-    # promises that conf rewrite + SIGHUP ALONE completes promotion;
-    # real postgres needs an explicit pg_promote()/pg_ctl promote call
-    # the manager does not make, so PostgresEngine must keep this False
-    # until such an engine op exists (it keeps the reference's restart
-    # path instead).  Demotion always restarts, like real postgres.
+    # True when a RUNNING standby can exit recovery without a restart:
+    # the manager writes the primary config, reloads, then awaits
+    # promote_in_place() (pg_promote(), PostgreSQL 12+).  Demotion
+    # always restarts, like real postgres.
     promotable_in_place = False
+
+    async def promote_in_place(self, host: str, port: int,
+                               timeout: float = 30.0) -> None:
+        """Finish an in-place promotion on the running server.  The
+        default is a no-op for engines whose conf reload already exits
+        recovery (simpg); PostgresEngine issues SELECT pg_promote()."""
+        return None
 
     # -- local cluster management --
 

@@ -60,6 +60,11 @@ DEFAULTS = {
     "singleton": False,
 }
 
+# bound on the in-place promotion call (pg_promote wait): far above the
+# sub-second healthy case, far below opsTimeout — a wedged server must
+# fail over to the restart path in seconds, not stall the takeover
+_PROMOTE_WAIT = 5.0
+
 # telemetry-status collection cadence, in health ticks: liveness probes
 # every tick stay single-query cheap; the (possibly multi-query) status
 # op for lag/WAL features runs on every Nth tick.  The canonical value
@@ -243,11 +248,12 @@ class PostgresMgr:
         # gate on HEALTH, not mere process liveness: a wedged-but-alive
         # database would absorb the SIGHUP without acting on it, and
         # only the restart path's kill escalation recovers it
+        promoted = False
         if (self.running and self._online
                 and self.engine.promotable_in_place
                 and self._applied
                 and self._applied.get("role") in ("sync", "async")):
-            log.info("%s: promoting in place (reload, no restart)",
+            log.info("%s: promoting in place (no restart)",
                      self.peer_id)
             self.engine.write_config(
                 self.datadir, host=self.host, port=self.port,
@@ -255,7 +261,20 @@ class PostgresMgr:
                 read_only=not singleton,
                 sync_standby_ids=sync_ids, upstream=None)
             self._reload()
-        else:
+            try:
+                # a healthy server promotes in well under a second; a
+                # short bound means a JUST-wedged one (health raced the
+                # gate) costs seconds before the restart fallback, not
+                # a full opsTimeout stall in the takeover path
+                await self.engine.promote_in_place(
+                    self.host, self.port, timeout=_PROMOTE_WAIT)
+                promoted = True
+            except (PgError, asyncio.TimeoutError) as e:
+                # fall back to the restart path, which recovers any
+                # server state the in-place attempt left behind
+                log.warning("%s: in-place promotion failed (%s); "
+                            "restarting instead", self.peer_id, e)
+        if not promoted:
             await self._stop()
             await self._prepare_database()
             # read-only until the sync catches up — taking writes

@@ -145,6 +145,9 @@ class PostgresEngine(Engine):
         # primary_conninfo is reloadable from PostgreSQL 13: a running
         # standby re-points its walreceiver without a restart
         self.reloadable_upstream = float(self.major) >= 13
+        # pg_promote() exists from PostgreSQL 12: takeover without a
+        # database restart (promote_in_place below)
+        self.promotable_in_place = float(self.major) >= 12
         # pg_overrides.json-style tunables merged over the template by
         # scope: common -> major -> full version
         # (lib/postgresMgr.js:118-137, 527-560)
@@ -247,6 +250,18 @@ class PostgresEngine(Engine):
                 })
                 rc.write(recovery)
         conf.write(d / "postgresql.conf")
+
+    async def promote_in_place(self, host: str, port: int,
+                               timeout: float = 30.0) -> None:
+        """SELECT pg_promote(wait := true): exit recovery on the
+        RUNNING server (PostgreSQL 12+) — the restart-free takeover.
+        Raises PgError if the server does not report promotion."""
+        out = (await self._psql(
+            host, port,
+            "SELECT pg_promote(true, %d);" % max(1, int(timeout)),
+            timeout + 5.0)).strip()
+        if out != "t":
+            raise PgError("pg_promote did not complete: %r" % out)
 
     # -- queries via psql --
 

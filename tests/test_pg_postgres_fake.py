@@ -411,3 +411,51 @@ def test_shipped_template_and_hba_install(tmp_path):
         assert conf.get("work_mem") == "'32MB'"
         assert conf.get("port") == "5555"
     run(go())
+
+
+def test_in_place_promotion_via_pg_promote(tmp_path):
+    """PG12+ takeover without a restart on the REAL engine: the manager
+    issues SELECT pg_promote(true, ...) against the (fake) binaries —
+    same database process, recovery markers dropped, recovery exited.
+    pg_promote on a server NOT in recovery errors exactly like real
+    postgres (the restart-fallback trigger), and a 9.2 engine reports
+    no in-place capability at all."""
+    async def go():
+        mgr = make_mgr(tmp_path)            # 12.0: promotable in place
+        up = {"id": "10.0.0.1:5432:1234", "pgUrl": "tcp://10.0.0.1:5432",
+              "backupUrl": "http://10.0.0.1:1234"}
+        try:
+            await mgr.reconfigure({"role": "primary", "upstream": None,
+                                   "downstream": None})
+            await mgr.reconfigure({"role": "sync", "upstream": up,
+                                   "downstream": None})
+
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline:
+                if mgr._online:
+                    break
+                await asyncio.sleep(0.1)
+            assert mgr._online
+            pid_before = mgr._proc.pid
+            assert (Path(mgr.datadir) / "standby.signal").exists()
+
+            await mgr.reconfigure({"role": "primary", "upstream": None,
+                                   "downstream": None})
+            assert mgr._proc.pid == pid_before, \
+                "promotion restarted the database"
+            st = await mgr._local_query({"op": "status"})
+            assert st["in_recovery"] is False
+            assert not (Path(mgr.datadir) / "standby.signal").exists()
+
+            # real-postgres semantics: pg_promote outside recovery is
+            # an ERROR — the signal the manager's fallback relies on
+            with pytest.raises(PgError):
+                await mgr.engine.promote_in_place(
+                    mgr.host, mgr.port, timeout=2.0)
+        finally:
+            await mgr.close()
+
+        # pre-pg_promote majors advertise no in-place capability
+        assert make_engine("9.2.4").promotable_in_place is False
+        assert make_engine("12.0").promotable_in_place is True
+    run(go())
