@@ -813,10 +813,13 @@ def test_laggard_cut_off_from_quorum_never_self_promotes(tmp_path):
             try:
                 assert await wait_for(lambda: s1.role == "leader",
                                       timeout=8)
+                # wait for the RESYNCED value: the laggard's own stale
+                # /st exists from the start, so existence alone races
+                # the snapshot adoption
                 assert await wait_for(
                     lambda: s2.role == "follower"
-                    and s2.tree.exists("/st") is not None, timeout=8)
-                assert s2.tree.get("/st")[0] == b"acked-w"
+                    and s2.tree.exists("/st") is not None
+                    and s2.tree.get("/st")[0] == b"acked-w", timeout=8)
                 c2 = NetCoord(connstr(members[1:2]), session_timeout=5)
                 await c2.connect()
                 data, ver = await c2.get("/st")

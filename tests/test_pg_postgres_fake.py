@@ -72,6 +72,16 @@ def conf_of(mgr) -> ConfFile:
         (Path(mgr.datadir) / "postgresql.conf").read_text())
 
 
+async def wait_online(mgr, timeout=20.0):
+    """Block until the manager's health loop marks the db online."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if mgr._online:
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError("%s never came online" % mgr.peer_id)
+
+
 def seed_repl(mgr, rows):
     (Path(mgr.datadir) / "fake_stat_replication").write_text(
         json.dumps(rows))
@@ -429,13 +439,7 @@ def test_in_place_promotion_via_pg_promote(tmp_path):
                                    "downstream": None})
             await mgr.reconfigure({"role": "sync", "upstream": up,
                                    "downstream": None})
-
-            deadline = asyncio.get_event_loop().time() + 20
-            while asyncio.get_event_loop().time() < deadline:
-                if mgr._online:
-                    break
-                await asyncio.sleep(0.1)
-            assert mgr._online
+            await wait_online(mgr)
             pid_before = mgr._proc.pid
             assert (Path(mgr.datadir) / "standby.signal").exists()
 
@@ -458,4 +462,39 @@ def test_in_place_promotion_via_pg_promote(tmp_path):
         # pre-pg_promote majors advertise no in-place capability
         assert make_engine("9.2.4").promotable_in_place is False
         assert make_engine("12.0").promotable_in_place is True
+    run(go())
+
+
+def test_live_upstream_repoint_pg13(tmp_path):
+    """PG13+: primary_conninfo is reloadable — the manager re-points a
+    RUNNING standby at a new upstream with conf rewrite + SIGHUP (the
+    engine advertises reloadable_upstream for major >= 13) — same
+    database process, standby markers intact, conninfo switched."""
+    async def go():
+        assert make_engine("13.0").reloadable_upstream is True
+        assert make_engine("12.0").reloadable_upstream is False
+
+        mgr = make_mgr(tmp_path, version="13.0")
+        up_a = {"id": "10.0.0.1:5432:1234", "pgUrl": "tcp://10.0.0.1:5432",
+                "backupUrl": "http://10.0.0.1:1234"}
+        up_b = {"id": "10.0.0.2:5432:1234", "pgUrl": "tcp://10.0.0.2:5432",
+                "backupUrl": "http://10.0.0.2:1234"}
+        try:
+            await mgr.reconfigure({"role": "primary", "upstream": None,
+                                   "downstream": None})
+            await mgr.reconfigure({"role": "sync", "upstream": up_a,
+                                   "downstream": None})
+            await wait_online(mgr)
+            pid_before = mgr._proc.pid
+
+            await mgr.reconfigure({"role": "sync", "upstream": up_b,
+                                   "downstream": None})
+            assert mgr._proc.pid == pid_before, \
+                "upstream change restarted the database"
+            assert (Path(mgr.datadir) / "standby.signal").exists()
+            assert "host=10.0.0.2" in conf_of(mgr).get("primary_conninfo")
+            st = await mgr._local_query({"op": "status"})
+            assert st["in_recovery"] is True
+        finally:
+            await mgr.close()
     run(go())
