@@ -59,6 +59,24 @@ fresh full resync; ephemeral-only mutations (election joins) touch no
 persistent state and are not shipped at all.  The CoordClient
 interface stays narrow so a real ZK ensemble could back production via
 an adapter.
+
+Durability (--data-dir): ZooKeeper's contract — the one manatee's
+deposed/generation records ride on (lib/zookeeperMgr.js:605-630,
+docs/xlog-diverge.md) — is that a mutation hits a quorum's fsynced
+transaction logs BEFORE it is acknowledged.  Same here: every
+persistent mutation is appended to a per-member op log
+(coordd-oplog.jsonl) and fsynced before the leader replies to the
+client, and before a follower acks the leader's sync_op (so a
+majority-acked write is on a majority of DISKS, not a majority of page
+caches).  The whole-tree JSON snapshot is demoted to a compaction
+artifact: the log rolls over to a fresh numbered segment and a snapshot
+covering the old ones is written in a worker thread every
+*snapshot_every* logged ops or 64 MB of log (ZooKeeper's
+snapCount/log-roll design), then the covered segments are deleted —
+per-mutation persistence cost is O(op), independent of tree/history
+size, exactly like replication.  Recovery = load snapshot, then replay
+segment entries with seq beyond it; a torn final line (crash
+mid-append, necessarily unacked) is discarded.
 """
 
 from __future__ import annotations
@@ -68,8 +86,10 @@ import asyncio
 import base64
 import json
 import logging
+import os
 import signal
 import time
+from pathlib import Path
 
 from manatee_tpu.coord import model
 from manatee_tpu.coord.api import (
@@ -114,6 +134,67 @@ def _unb64(s: str | None) -> bytes:
     return base64.b64decode(s) if s else b""
 
 
+def _wire_of(req: dict) -> dict:
+    """The replayable projection of a persistent mutation request — the
+    one format shared by the replication stream and the op log, so a
+    follower's log and the leader's log replay identically."""
+    return {k: req[k] for k in ("op", "path", "data", "version",
+                                "sequential", "ops") if k in req}
+
+
+def _seed_seq_counters(tree: model.ZNodeTree, req: dict,
+                       expect) -> None:
+    """Before replaying a logged sequential create, force its parent's
+    counter to reproduce the ACKED name.  Necessary because ephemeral
+    sequential creates (election joins) bump the same per-parent
+    counter but are never logged — replay without seeding would mint a
+    lower-numbered name than the one the client was acked and holds."""
+    pairs = []
+    if req.get("op") == "create" and req.get("sequential") \
+            and isinstance(expect, str):
+        pairs.append(expect)
+    elif req.get("op") == "multi" and isinstance(expect, list):
+        for o, e in zip(req.get("ops", []), expect):
+            if o.get("kind") == "create" and o.get("sequential") \
+                    and isinstance(e, str):
+                pairs.append(e)
+    for acked_path in pairs:
+        suffix = acked_path[-10:]
+        if not suffix.isdigit():
+            continue
+        parent_path = acked_path.rsplit("/", 1)[0] or "/"
+        try:
+            parent = tree._resolve(parent_path)
+        except CoordError:
+            continue        # parent created later in this very multi
+        parent.seq_counter = max(parent.seq_counter, int(suffix))
+
+
+def _apply_wire_op(tree: model.ZNodeTree, r: dict):
+    """Apply one wire-format persistent mutation to *tree* (no session:
+    ephemerals never ride this path).  Used by followers applying the
+    leader's stream and by op-log replay at startup."""
+    op = r.get("op")
+    if op == "create":
+        return tree.create(r["path"], _unb64(r.get("data")),
+                           sequential=bool(r.get("sequential")))
+    if op == "set":
+        return tree.set(r["path"], _unb64(r.get("data")),
+                        int(r.get("version", -1)))
+    if op == "delete":
+        tree.delete(r["path"], int(r.get("version", -1)))
+        return None
+    if op == "multi":
+        ops = [Op(kind=o["kind"], path=o["path"],
+                  data=_unb64(o.get("data")),
+                  version=int(o.get("version", -1)),
+                  ephemeral=False,
+                  sequential=bool(o.get("sequential")))
+               for o in r.get("ops", [])]
+        return tree.multi(ops, session_id=None)
+    raise CoordError("unknown replicated op: %r" % op)
+
+
 class _Conn:
     def __init__(self, server: "CoordServer", reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter):
@@ -124,6 +205,13 @@ class _Conn:
         self.alive = True
         self.is_follower = False
         self.follower_id: int | None = None
+        # seq the follower's attach snapshot covered: ops at or below
+        # it must not be re-shipped (the follower would see them as
+        # gaps).  They count toward commit quorum only once the
+        # follower has ACKED the attach snapshot as persisted
+        # (attach_acked) — until then it may not even have received it
+        self.attached_seq = -1
+        self.attach_acked = False
         self.ack_waiters: dict[int, asyncio.Future] = {}
 
     def push(self, msg: dict) -> None:
@@ -166,11 +254,19 @@ class CoordServer:
                  tick: float = 0.25, data_dir: str | None = None,
                  ensemble: list[tuple[str, int]] | None = None,
                  ensemble_id: int = 0, promote_grace: float = 2.0,
-                 metrics_port: int | None = None):
-        """*data_dir*: when set, the persistent tree is snapshotted there
-        and reloaded on start (ZooKeeper-parity durability).  Ephemeral
-        nodes do not survive a restart — their sessions are gone, and
-        clients observe expiry and re-register.
+                 metrics_port: int | None = None, fsync: bool = True,
+                 snapshot_every: int = 100_000):
+        """*data_dir*: when set, every persistent mutation is fsynced to
+        an op log there BEFORE it is acknowledged, with a periodic
+        whole-tree snapshot as the compaction artifact (see the module
+        docstring).  Ephemeral nodes do not survive a restart — their
+        sessions are gone, and clients observe expiry and re-register.
+
+        *fsync=False* trades crash durability for latency (dev only:
+        an acked write can vanish in a power loss, the failure mode
+        VERDICT r4 #1 calls a split-brain seed).  *snapshot_every*:
+        logged ops between compactions (ZooKeeper's snapCount default;
+        a 64 MB log-size bound triggers compaction too).
 
         *ensemble*: full member address list (including this server);
         *ensemble_id* is this server's index into it.  See the module
@@ -186,16 +282,58 @@ class CoordServer:
         self.role = "follower" if ensemble else "leader"
         self.leader_addr: tuple[str, int] | None = None
         self._seq = 0
+        # last seq actually PUSHED to followers: pings must advertise
+        # this, not self._seq — a mutation awaiting its log fsync has
+        # bumped self._seq but not shipped yet, and a ping carrying
+        # that unshipped seq would make every follower conclude it
+        # drifted and resync (cancelling in-flight acks)
+        self._shipped_seq = 0
         self._follower_conns: set[_Conn] = set()
         self._reap_tasks: set[asyncio.Task] = set()
         self._follow_task: asyncio.Task | None = None
         self._probe_task: asyncio.Task | None = None
         self._stopping = False
+        self.fsync = fsync
+        # stagger both compaction thresholds per member: ensemble
+        # members log the same seqs and bytes, so an unstaggered bound
+        # would make every member compact at the same instant — at a
+        # large tree that means simultaneous walk stalls and missed
+        # acks cluster-wide
+        self.snapshot_every = int(snapshot_every) \
+            + ensemble_id * max(1, int(snapshot_every) // 20)
+        self.snapshot_bytes = self.SNAPSHOT_BYTES \
+            + ensemble_id * (self.SNAPSHOT_BYTES // 20)
+        self._oplog_fh = None
+        self._oplog_bytes = 0    # bytes written to the current segment
+        self._log_count = 0      # entries in the current segment
+        self._synced_upto = 0    # bytes of it known fsynced
+        self._log_gen = 0        # bumped on rotation
+        self._fsync_task: asyncio.Task | None = None
+        self._snap_seq = 0       # seq the on-disk snapshot covers
+        # Epoch: bumped whenever the tree is REPLACED rather than
+        # mutated (resync from the leader) — it tags log segments so
+        # recovery can never replay a pre-resync segment on top of the
+        # adopted tree (the crash-between-install-and-unlink window).
+        self._persist_epoch = 0
+        # a failed append that the synchronous-snapshot fallback could
+        # not repair: refuse all further mutations rather than ack
+        # writes whose durability is a lie
+        self._wal_broken = False
+        # serializes whole-log-superseding persists: two concurrent
+        # mixed transactions must not race their epoch bumps, or one
+        # could ack on the strength of a snapshot that later fails
+        self._persist_lock = asyncio.Lock()
+        # orders op-log appends (entries must hit the file in seq
+        # order even though write+fsync run off the loop) and fences
+        # them against segment rotation — without this fence, an
+        # append during a superseding persist's epoch bump could land
+        # in a new-epoch segment that recovery deletes as stale if the
+        # crash comes before the snapshot installs (acked-write loss)
+        self._log_lock = asyncio.Lock()
+        self._compact_task: asyncio.Task | None = None
         self.tree = self._load_tree()
         self._server: asyncio.AbstractServer | None = None
         self._expiry_task: asyncio.Task | None = None
-        self._save_task: asyncio.Task | None = None
-        self._dirty = False
         self._conns: set[_Conn] = set()
         # session id -> live conn (one at a time)
         self._session_conns: dict[str, _Conn] = {}
@@ -205,68 +343,443 @@ class CoordServer:
         self._wire_tree(self.tree)
 
     def _wire_tree(self, tree: model.ZNodeTree) -> None:
-        """One on_mutate hook per tree: count mutations (for /metrics)
-        and schedule persistence when a data dir is configured."""
+        """One on_mutate hook per tree: count mutations (for /metrics).
+        Persistence does NOT hang off this hook — durable writes happen
+        at the ack points (_log_append / _persist_snapshot_now), and
+        ephemeral-only mutations need no persistence at all."""
         def on_mutate():
             self._mutations += 1
-            if self.data_dir:
-                self._mark_dirty()
         tree.on_mutate = on_mutate
 
-    # ---- persistence ----
+    # ---- persistence: fsynced op-log segments + snapshot compaction ----
+    #
+    # ZooKeeper's layout: an append-only transaction log (here: numbered
+    # JSONL segments, a new one per compaction) plus periodic whole-tree
+    # snapshots.  The ack path pays ONLY the O(op) append+fsync; the
+    # O(tree) snapshot runs rarely (snapshot_every ops or
+    # SNAPSHOT_BYTES of log, ZK snapCount-style), with serialization
+    # and disk I/O in a worker thread so a large history cannot stall
+    # the event loop (a stalled follower misses acks and gets severed).
+
+    SNAPSHOT_BYTES = 64 * 1024 * 1024
 
     def _snapshot_path(self):
-        from pathlib import Path
         return Path(self.data_dir) / "coordd-tree.json"
+
+    def _segment_path(self, start_seq: int):
+        return Path(self.data_dir) / (
+            "coordd-oplog-e%08d-%016d.jsonl"
+            % (self._persist_epoch, start_seq))
+
+    def _segments(self, *, epoch: int | None = None) -> list:
+        """Log segment paths for *epoch* (default: the current one),
+        oldest first."""
+        want = self._persist_epoch if epoch is None else epoch
+        out = []
+        for p in Path(self.data_dir).glob("coordd-oplog-*.jsonl"):
+            parts = p.stem.split("-")
+            try:
+                e, start = int(parts[-2][1:]), int(parts[-1])
+            except (ValueError, IndexError):
+                continue
+            if e == want:
+                out.append((start, p))
+        out.sort()
+        return [p for _s, p in out]
+
+    def _stale_files(self) -> list:
+        """Segments from other epochs (superseded by a resync snapshot)
+        and orphaned snapshot tmp files — safe to delete."""
+        out = []
+        for p in Path(self.data_dir).glob("coordd-oplog-*.jsonl"):
+            parts = p.stem.split("-")
+            try:
+                e = int(parts[-2][1:])
+            except (ValueError, IndexError):
+                out.append(p)
+                continue
+            if e != self._persist_epoch:
+                out.append(p)
+        out.extend(Path(self.data_dir).glob("coordd-tree.json.tmp*"))
+        return out
 
     def _load_tree(self) -> model.ZNodeTree:
         if not self.data_dir:
             return model.ZNodeTree()
-        from pathlib import Path
         Path(self.data_dir).mkdir(parents=True, exist_ok=True)
         path = self._snapshot_path()
-        if not path.exists():
-            return model.ZNodeTree()
-        try:
-            snap = json.loads(path.read_text())
-            tree = model.ZNodeTree.from_snapshot(snap)
-            self._seq = int(snap.get("seq", 0))
-            log.info("loaded coordination tree from %s (seq %d)",
-                     path, self._seq)
-            return tree
-        except (ValueError, OSError) as e:
-            log.error("cannot load tree snapshot %s: %s; starting empty",
-                      path, e)
-            return model.ZNodeTree()
-
-    def _mark_dirty(self) -> None:
-        self._dirty = True
-        if self._save_task is None or self._save_task.done():
+        tree = model.ZNodeTree()
+        if path.exists():
             try:
-                self._save_task = asyncio.ensure_future(
-                    self._save_soon())
-            except RuntimeError:
-                self._save_now()   # no loop (tests): save synchronously
+                snap = json.loads(path.read_text())
+                tree = model.ZNodeTree.from_snapshot(snap)
+                self._seq = int(snap.get("seq", 0))
+                self._persist_epoch = int(snap.get("epoch", 0))
+                log.info("loaded coordination tree from %s (seq %d, "
+                         "epoch %d)", path, self._seq,
+                         self._persist_epoch)
+            except (ValueError, OSError) as e:
+                # starting empty here would reset the epoch to 0 and
+                # DELETE the log segments (the one artifact an operator
+                # could recover from) as stale — refuse instead, like
+                # any other acked-write-losing malformation
+                raise RuntimeError(
+                    "tree snapshot %s exists but cannot be loaded "
+                    "(%s); refusing to start — restore the member or "
+                    "remove its data dir to resync it from the "
+                    "ensemble" % (path, e))
+        self._snap_seq = self._seq
+        self._replay_oplog(tree)
+        # crash leftovers: segments a resync snapshot superseded, and
+        # snapshot tmp files a cancelled compaction never installed
+        for p in self._stale_files():
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        return tree
 
-    async def _save_soon(self) -> None:
-        # debounce bursts; one snapshot per 50ms of mutations
-        await asyncio.sleep(0.05)
-        self._save_now()
+    def _replay_oplog(self, tree: model.ZNodeTree) -> None:
+        """Recovery: apply logged ops beyond the snapshot's seq, in
+        segment order (current epoch only — a pre-resync segment must
+        never replay on top of the adopted tree).  A torn final line of
+        the final segment (crash mid-append) was never acked and is
+        discarded.  ANY other malformation — mid-log corruption, a seq
+        gap, a failed apply — means acked writes would be silently
+        rolled back, so the server refuses to start (ZooKeeper's CRC'd
+        log makes the same call): the operator restores the member or
+        resyncs it from the ensemble."""
+        segments = self._segments()
+        replayed = 0
+        for path in segments:
+            raw = path.read_bytes()
+            parts = raw.split(b"\n")
+            # byte offset of each (possibly empty) part, for truncation
+            offsets, pos = [], 0
+            for part in parts:
+                offsets.append(pos)
+                pos += len(part) + 1
+            nonempty = [j for j, part in enumerate(parts) if part]
+            for i, j in enumerate(nonempty):
+                line = parts[j]
+                try:
+                    ent = json.loads(line)
+                    seq = int(ent["seq"])
+                    req = ent["req"]
+                except (ValueError, KeyError, TypeError):
+                    if path is segments[-1] and i == len(nonempty) - 1:
+                        # crash mid-append: discard AND truncate the
+                        # torn bytes, or the next append (which reuses
+                        # this very file when seqs line up) would
+                        # concatenate a good entry onto them, turning
+                        # an unacked torn tail into acked-write-eating
+                        # corruption on the restart after that
+                        log.warning("op log %s ends in a torn line; "
+                                    "truncating it (it was never "
+                                    "acked)", path.name)
+                        os.truncate(path, offsets[j])
+                        break
+                    raise RuntimeError(
+                        "op log %s is corrupt mid-stream (line %d): "
+                        "acked writes would be lost; refusing to "
+                        "start" % (path.name, i + 1))
+                if seq <= self._seq:
+                    continue        # superseded by the snapshot
+                if seq != self._seq + 1:
+                    raise RuntimeError(
+                        "op log gap: entry seq %d after %d in %s; "
+                        "acked writes would be lost; refusing to "
+                        "start" % (seq, self._seq, path.name))
+                expect = ent.get("expect")
+                try:
+                    _seed_seq_counters(tree, req, expect)
+                    got = _apply_wire_op(tree, req)
+                except CoordError as e:
+                    raise RuntimeError(
+                        "op log replay failed at seq %d in %s (%s); "
+                        "refusing to start" % (seq, path.name, e))
+                if "expect" in ent and got != expect:
+                    raise RuntimeError(
+                        "op log replay diverged at seq %d in %s: "
+                        "produced %r, acked %r; refusing to start"
+                        % (seq, path.name, got, expect))
+                self._seq = seq
+                replayed += 1
+        if replayed:
+            log.info("replayed %d op-log entries (now at seq %d)",
+                     replayed, self._seq)
 
-    def _save_now(self) -> None:
-        if not self.data_dir or not self._dirty:
+    def _fsync_data_dir(self) -> None:
+        """Make a rename/create in data_dir itself durable."""
+        if not self.fsync:
             return
-        self._dirty = False
-        path = self._snapshot_path()
-        tmp = path.with_name(path.name + ".tmp")
         try:
+            fd = os.open(self.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    async def _log_append(self, seq: int, wire: dict,
+                          expect=None) -> None:
+        """THE durability point: one JSONL entry, written and fsynced
+        before the caller acknowledges anything (leader → client,
+        follower → leader).  O(op), independent of tree size.
+
+        The buffered write runs in the loop (ordered: there is no
+        await between the caller's seq assignment and the write), then
+        the caller awaits a GROUP fsync in a worker thread — one
+        fsync covers every entry queued while the previous one ran,
+        so a slow disk neither stalls the event loop nor serializes
+        throughput to one-op-per-fsync (ZooKeeper's sync-thread
+        batching).  *expect* is the acked result, stored so replay can
+        verify (and, for sequential creates, reproduce) exactly what
+        was acknowledged.
+
+        A failed append may have left a partial line — a silent gap
+        that would poison every LATER fsynced entry at replay (replay
+        stops at the gap).  The fallback is a synchronous snapshot,
+        which re-covers this seq and supersedes the damaged segment;
+        if even that fails, the server marks persistence broken and
+        refuses further mutations rather than ack writes whose
+        durability is a lie."""
+        if not self.data_dir:
+            return
+        async with self._log_lock:
+            line = (json.dumps({"seq": seq, "req": wire,
+                                "expect": expect}) + "\n").encode()
+            try:
+                if self._oplog_fh is None:
+                    path = self._segment_path(seq)
+                    self._oplog_fh = open(path, "ab")
+                    self._oplog_bytes = path.stat().st_size
+                    self._log_count = 0
+                    self._synced_upto = self._oplog_bytes
+                    self._fsync_data_dir()
+                self._oplog_fh.write(line)
+                self._oplog_fh.flush()
+            except (OSError, ValueError) as e:
+                self._append_failed(seq, e)
+                return
+            self._oplog_bytes += len(line)
+            self._log_count += 1
+            gen, target = self._log_gen, self._oplog_bytes
+            if self._log_count >= self.snapshot_every \
+                    or self._oplog_bytes >= self.snapshot_bytes:
+                self._request_compaction()
+        if self.fsync:
+            try:
+                await self._log_fsync(gen, target)
+            except (OSError, ValueError) as e:
+                self._append_failed(seq, e)
+
+    def _append_failed(self, seq: int, e: Exception) -> None:
+        log.error("op-log append failed at seq %d (%s); falling back "
+                  "to a synchronous snapshot", seq, e)
+        if self._persist_snapshot_now():
+            return
+        self._wal_broken = True
+        raise CoordError("cannot persist mutation; refusing writes "
+                         "until restart") from None
+
+    async def _log_fsync(self, gen: int, target: int) -> None:
+        """Group commit: wait until the current segment is fsynced at
+        least to byte *target*.  Concurrent callers share in-flight
+        fsyncs; whoever finds none running starts one.  A generation
+        change means the segment was rotated — which only happens
+        after a quiesce (async paths) or a fsynced superseding
+        snapshot (sync paths), so our entry is durable either way."""
+        while self._log_gen == gen and self._synced_upto < target:
+            t = self._fsync_task
+            if t is None or t.done():
+                self._fsync_task = t = asyncio.ensure_future(
+                    self._fsync_once())
+            try:
+                await t
+            except (OSError, ValueError):
+                if self._log_gen == gen:
+                    raise      # genuine disk failure on OUR segment
+                # a synchronous rotation (snapshot fallback/shutdown)
+                # closed the fh under the fsync; the superseding
+                # snapshot covers every entry we were waiting on
+                return
+
+    async def _fsync_once(self) -> None:
+        fh = self._oplog_fh
+        if fh is None:
+            return
+        gen = self._log_gen
+        target = self._oplog_bytes
+        await asyncio.get_running_loop().run_in_executor(
+            None, os.fsync, fh.fileno())
+        if self._log_gen == gen:
+            # a SYNCHRONOUS rotation (append-failure fallback) may have
+            # swapped the segment under this fsync; crediting its byte
+            # target to the NEW segment would ack unsynced entries
+            self._synced_upto = max(self._synced_upto, target)
+
+    async def _quiesce_log(self) -> None:
+        """Under _log_lock: fsync everything written to the current
+        segment so rotation cannot strand flushed-but-unsynced entries
+        whose callers have been told (via gen change) they are safe."""
+        if self.fsync and self._oplog_fh is not None:
+            await self._log_fsync(self._log_gen, self._oplog_bytes)
+
+    def _rotate_segment(self) -> None:
+        """Close the current segment; the next append opens a fresh
+        one.  Cheap, runs at compaction start so appends made while the
+        snapshot is being written land in a segment it does not cover.
+        Callers on async paths quiesce the group fsync first."""
+        if self._oplog_fh is not None:
+            self._oplog_fh.close()
+            self._oplog_fh = None
+        self._log_gen += 1
+        self._log_count = 0
+        self._oplog_bytes = 0
+        self._synced_upto = 0
+
+    def _request_compaction(self) -> None:
+        # only ever called from _log_append (a coroutine), so a
+        # running loop is guaranteed
+        if self._compact_task is None or self._compact_task.done():
+            self._compact_task = asyncio.ensure_future(self._compact())
+
+    async def _compact(self) -> None:
+        """Write a snapshot covering everything logged so far, then drop
+        the covered segments.  Only the tree walk runs in the loop;
+        serialization + write + fsync run in a worker thread."""
+        await asyncio.sleep(0.05)          # debounce bursts
+        async with self._log_lock:
+            # the fence + quiesce guarantee every logged entry is
+            # fsynced before its segment becomes compaction-covered,
+            # and that the walk sees every logged mutation
+            await self._quiesce_log()
+            self._rotate_segment()
+            covered = self._segments()
+            epoch = self._persist_epoch
+            seq = self._seq
             snap = self.tree.to_snapshot()
-            snap["seq"] = self._seq
-            tmp.write_text(json.dumps(snap))
-            tmp.replace(path)
+            snap["seq"] = seq
+            snap["epoch"] = epoch
+        loop = asyncio.get_running_loop()
+        try:
+            tmp = await loop.run_in_executor(
+                None, self._write_snapshot_tmp, snap)
+        except OSError as e:
+            log.error("compaction snapshot failed: %s", e)
+            return
+        self._install_snapshot(tmp, seq, covered, epoch)
+
+    def _write_snapshot_tmp(self, snap: dict):
+        path = self._snapshot_path()
+        tmp = path.with_name("%s.tmp-%d-%d"
+                             % (path.name, snap["epoch"], snap["seq"]))
+        with open(tmp, "w") as f:
+            f.write(json.dumps(snap))
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        return tmp
+
+    def _install_snapshot(self, tmp, seq: int, covered: list,
+                          epoch: int, *, force: bool = False) -> bool:
+        """Rename a written snapshot into place and drop the segments
+        it covers.  If the world moved on while it was being written (a
+        forced resync adopted a different tree, or a newer snapshot
+        landed), it is stale and discarded — which still counts as
+        success for the caller's mutation: whatever superseded it
+        covers at least as much.  Returns False only on I/O failure."""
+        if not force and (epoch != self._persist_epoch
+                          or seq < self._snap_seq):
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            return True
+        try:
+            tmp.replace(self._snapshot_path())
+        except OSError as e:
+            log.error("cannot install snapshot: %s", e)
+            return False
+        self._fsync_data_dir()
+        self._snap_seq = seq
+        for p in covered:
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        self._fsync_data_dir()
+        return True
+
+    def _snapshot_prep(self) -> dict:
+        """Start a whole-log-superseding snapshot: bump the epoch (so
+        pre-existing segments and any in-flight compaction of the old
+        tree are dead on arrival) and capture a consistent view."""
+        self._persist_epoch += 1
+        self._rotate_segment()
+        snap = self.tree.to_snapshot()
+        snap["seq"] = self._seq
+        snap["epoch"] = self._persist_epoch
+        return snap
+
+    def _persist_snapshot_now(self) -> bool:
+        """Synchronous fsynced snapshot superseding the whole log — the
+        O(tree)-on-the-loop path, kept for non-async contexts (clean
+        shutdown, append-failure fallback, tests without a loop)."""
+        if not self.data_dir:
+            return True
+        snap = self._snapshot_prep()
+        covered = self._stale_files()
+        try:
+            tmp = self._write_snapshot_tmp(snap)
         except OSError as e:
             log.error("cannot persist tree snapshot: %s", e)
-            self._dirty = True
+            return False
+        return self._install_snapshot(tmp, self._seq, covered,
+                                      self._persist_epoch, force=True)
+
+    async def _persist_snapshot_async(self) -> bool:
+        """The same whole-log-superseding snapshot with serialization +
+        write + fsync in a worker thread — used on ack paths (mixed
+        transactions, follower resync) so a large tree cannot stall the
+        event loop and sever the rest of the ensemble.  Serialized via
+        _persist_lock; True means a snapshot covering our seq is
+        CONFIRMED installed (a successful ack may ride on it)."""
+        if not self.data_dir:
+            return True
+        async with self._persist_lock, self._log_lock:
+            # BOTH locks for the whole prep→write→install span: the
+            # epoch has been bumped but the new-epoch snapshot is not
+            # installed yet, so an append slipping in now would land in
+            # a new-epoch segment that recovery deletes as stale if we
+            # crash before the install — acked-write loss.  The log
+            # lock keeps appends out until the install completes.
+            await self._quiesce_log()
+            snap = self._snapshot_prep()
+            covered = self._stale_files()
+            epoch = self._persist_epoch
+            loop = asyncio.get_running_loop()
+            try:
+                tmp = await loop.run_in_executor(
+                    None, self._write_snapshot_tmp, snap)
+            except OSError as e:
+                log.error("cannot persist tree snapshot: %s", e)
+                return False
+            if epoch != self._persist_epoch:
+                # superseded while writing by a SYNCHRONOUS persist
+                # (async ones serialize on the lock).  It has already
+                # completed — so _snap_seq tells us whether it actually
+                # installed something covering our seq; only that
+                # justifies success on an ack path.
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
+                return self._snap_seq >= snap["seq"]
+            return self._install_snapshot(tmp, snap["seq"], covered,
+                                          epoch, force=True)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -295,9 +808,9 @@ class CoordServer:
             t.cancel()
         if self._expiry_task:
             self._expiry_task.cancel()
-        if self._save_task and not self._save_task.done():
-            self._save_task.cancel()
-        self._save_now()   # final flush
+        if self._compact_task and not self._compact_task.done():
+            self._compact_task.cancel()
+        self._persist_snapshot_now()   # final compaction (rotates too)
         # close live connections BEFORE wait_closed(): since 3.12 it waits
         # for every connection handler to finish
         for conn in list(self._conns):
@@ -446,11 +959,17 @@ class CoordServer:
         op = req.get("op")
         try:
             if op == "sync_ack":
-                # follower ack of a replicated snapshot: resolve the
+                # follower ack of a replicated op/snapshot: resolve the
                 # waiter, no reply (acks must not generate traffic)
-                fut = conn.ack_waiters.pop(int(req.get("seq", -1)), None)
+                seq = int(req.get("seq", -1))
+                fut = conn.ack_waiters.pop(seq, None)
                 if fut and not fut.done():
                     fut.set_result(True)
+                if conn.is_follower and seq >= conn.attached_seq:
+                    # the attach snapshot (or something after it) is
+                    # durably on the follower's disk: its attach seq
+                    # may now count toward commit quorums
+                    conn.attach_acked = True
                 return
             if op == "hello":
                 result = self._op_hello(conn, req)
@@ -465,6 +984,10 @@ class CoordServer:
                 mutating = op in _MUTATING
                 mode = None
                 if mutating:
+                    if self._wal_broken:
+                        raise CoordError(
+                            "persistence broken (earlier disk "
+                            "failure); refusing writes until restart")
                     self._check_quorum()
                     # classify BEFORE applying: an ephemeral delete
                     # target is gone afterwards
@@ -472,9 +995,25 @@ class CoordServer:
                 result = self._op(conn, op, req)
                 if mutating and mode is not None:
                     self._seq += 1
+                    # capture OUR seq now: the awaits below yield to
+                    # concurrent dispatches that bump self._seq further
+                    seq = self._seq
+                    # durability BEFORE the ack (and before replication,
+                    # so an acked write is never on followers' disks but
+                    # not ours): fsync the op to our log, or — for the
+                    # rare mixed transaction the log cannot replay
+                    # against a session-less tree — the full snapshot
                     if mode == "op":
-                        acks = await self._replicate_op(req, result)
+                        await self._log_append(seq, _wire_of(req),
+                                               result)
+                        acks = await self._replicate_op(seq, req,
+                                                        result)
                     else:
+                        if not await self._persist_snapshot_async():
+                            self._wal_broken = True
+                            raise CoordError(
+                                "cannot persist mutation; refusing "
+                                "writes until restart")
                         acks = await self._replicate_snapshot()
                     self._check_commit_quorum(acks)
             conn.push({"xid": xid, "ok": True, "result": result})
@@ -613,6 +1152,7 @@ class CoordServer:
                 old.sever()
         conn.is_follower = True
         conn.follower_id = fid
+        conn.attached_seq = self._seq
         self._follower_conns.add(conn)
         log.info("follower %s joined (seq %d)", fid, self._seq)
         snap = self.tree.to_snapshot()
@@ -685,23 +1225,28 @@ class CoordServer:
             return "op"
         return "op"
 
-    async def _replicate_op(self, req: dict, result) -> int:
+    async def _replicate_op(self, seq: int, req: dict, result) -> int:
         """Ship one persistent mutation as the op itself — O(op), not
-        O(tree).  *result* rides along so followers can verify their
-        apply produced the same outcome (sequential names, versions)."""
-        wire = {k: req[k] for k in ("op", "path", "data", "version",
-                                    "sequential", "ops") if k in req}
+        O(tree).  *seq* is the mutation's own seq, captured at its
+        bump (self._seq may have moved on while the caller awaited the
+        log fsync).  *result* rides along so followers can verify
+        their apply produced the same outcome (sequential names,
+        versions)."""
         return await self._ship(
-            {"sync_op": {"seq": self._seq, "req": wire, "expect": result}})
+            {"sync_op": {"seq": seq, "req": _wire_of(req),
+                         "expect": result}}, seq)
 
     async def _replicate_snapshot(self) -> int:
         """Ship the full persistent tree (follower attach + the rare
-        mixed-transaction fallback)."""
+        mixed-transaction fallback).  Ships the CURRENT tree+seq as a
+        consistent pair — a follower adopting a slightly newer
+        snapshot than this mutation is fine (it supersedes)."""
+        seq = self._seq
         return await self._ship(
-            {"sync": {"seq": self._seq,
-                      "snapshot": self.tree.to_snapshot()}})
+            {"sync": {"seq": seq,
+                      "snapshot": self.tree.to_snapshot()}}, seq)
 
-    async def _ship(self, msg: dict) -> int:
+    async def _ship(self, msg: dict, seq: int) -> int:
         """Push *msg* (carrying the current seq) to every follower and
         collect acks.  Returns as soon as enough followers for a commit
         quorum have acked — a hung follower must not add its full fault
@@ -710,12 +1255,22 @@ class CoordServer:
         Laggards keep the rest of the fault budget in the background and
         are severed if still silent (they resync with a fresh
         sync_hello).  Returns the number of followers acked so far."""
+        self._shipped_seq = max(self._shipped_seq, seq)
         if not self._follower_conns:
             return 0
-        seq = self._seq
         loop = asyncio.get_running_loop()
         waiters: list[tuple[_Conn, asyncio.Future]] = []
+        acks = 0
         for f in list(self._follower_conns):
+            if f.attached_seq >= seq:
+                # its attach snapshot already carried this op, so
+                # re-shipping would read as a gap on its side.  It
+                # counts toward the quorum only once it has ACKED that
+                # snapshot as persisted — before that it may not have
+                # received a byte of it.
+                if f.attach_acked:
+                    acks += 1
+                continue
             fut = loop.create_future()
             f.ack_waiters[seq] = fut
             f.push(msg)
@@ -724,15 +1279,14 @@ class CoordServer:
         # followers needed beyond ourselves; no-quorum ensembles (2
         # members) keep wait-for-all semantics — there is no safe
         # subset to commit on
-        need_f = len(waiters) if need is None else min(need - 1,
-                                                       len(waiters))
+        need_f = acks + len(waiters) if need is None \
+            else min(need - 1, acks + len(waiters))
         # the fault budget scales with tick (the reference's analogue is
         # ZooKeeper's tick-derived timeouts), floored so a slow-but-live
         # follower on a loaded host is not severed spuriously
         deadline = loop.time() + max(4 * self.tick, 1.0)
         pending = {fut for _f, fut in waiters}
-        acks = 0
-        while pending:
+        while pending and acks < need_f:
             done, pending = await asyncio.wait(
                 pending, timeout=max(0.0, deadline - loop.time()),
                 return_when=asyncio.FIRST_COMPLETED)
@@ -775,7 +1329,10 @@ class CoordServer:
         while not self._stopping and self.role == "leader":
             await asyncio.sleep(interval)
             for f in list(self._follower_conns):
-                f.push({"sync_ping": {"seq": self._seq}})
+                # advertise the last SHIPPED seq: self._seq may be
+                # ahead of the stream while a mutation awaits its log
+                # fsync, and an unshipped seq would read as drift
+                f.push({"sync_ping": {"seq": self._shipped_seq}})
             for idx, addr in enumerate(self.ensemble):
                 if idx == self.my_id:
                     continue
@@ -792,6 +1349,7 @@ class CoordServer:
         log.warning("promoting to ensemble leader (id %d, seq %d)",
                     self.my_id, self._seq)
         self.role = "leader"
+        self._shipped_seq = self._seq
         self.leader_addr = self.ensemble[self.my_id]
         if self._probe_task is None or self._probe_task.done():
             self._probe_task = asyncio.ensure_future(
@@ -896,9 +1454,17 @@ class CoordServer:
                 raise CoordError("sync_hello refused: %s" % msg.get("msg"))
             res = msg["result"]
             # the full resync is authoritative: adopt the leader's tree
-            # even if our (possibly debounce-lost or divergent) seq is
-            # higher, or we would livelock re-resyncing forever
-            self._apply_sync(int(res["seq"]), res["snapshot"], force=True)
+            # even if our (possibly divergent) seq is higher, or we
+            # would livelock re-resyncing forever
+            if not await self._apply_sync(int(res["seq"]),
+                                          res["snapshot"], force=True):
+                raise CoordError("cannot persist resynced tree")
+            # the attach snapshot is now durably ours: ack it, so the
+            # leader may count our attached_seq toward commit quorums
+            writer.write((json.dumps(
+                {"op": "sync_ack", "seq": int(res["seq"])})
+                + "\n").encode())
+            await writer.drain()
             self.leader_addr = addr
             log.info("following leader %s:%d (seq %d)",
                      addr[0], addr[1], self._seq)
@@ -912,17 +1478,26 @@ class CoordServer:
                 msg = json.loads(line)
                 if "sync" in msg:
                     s = msg["sync"]
-                    self._apply_sync(int(s["seq"]), s["snapshot"])
+                    # _apply_sync persists (fsynced) before we ack: a
+                    # majority-acked write must be on a majority of
+                    # DISKS, not page caches — no persist, no ack
+                    if not await self._apply_sync(int(s["seq"]),
+                                                  s["snapshot"]):
+                        break
                     writer.write((json.dumps(
                         {"op": "sync_ack", "seq": s["seq"]}) + "\n").encode())
                     await writer.drain()
                 elif "sync_op" in msg:
                     s = msg["sync_op"]
                     seq = int(s["seq"])
-                    if seq != self._seq + 1:
-                        break   # gap: resync with a fresh sync_hello
+                    wire = s.get("req")
+                    if seq != self._seq + 1 or not wire:
+                        # gap or malformed ship: never apply-and-log a
+                        # bad entry (it would poison our durable log);
+                        # resync with a fresh sync_hello
+                        break
                     try:
-                        got = self._apply_op(s.get("req") or {})
+                        got = self._apply_op(wire)
                     except CoordError as e:
                         log.warning("replicated op failed (diverged?): "
                                     "%s; resyncing", e)
@@ -932,11 +1507,19 @@ class CoordServer:
                                     "%r; resyncing", got, s.get("expect"))
                         break
                     self._seq = seq
+                    # fsync our log BEFORE acking the leader — our ack
+                    # is what lets it count us toward the commit quorum
+                    await self._log_append(seq, wire, got)
                     writer.write((json.dumps(
                         {"op": "sync_ack", "seq": seq}) + "\n").encode())
                     await writer.drain()
                 elif "sync_ping" in msg:
-                    if int(msg["sync_ping"].get("seq", -1)) != self._seq:
+                    # a HIGHER advertised seq means we missed data:
+                    # resync.  A lower one is normal — we may have
+                    # attached (sync_hello) ahead of what the leader
+                    # has shipped on the stream; divergence in that
+                    # direction is caught by the next sync_op apply.
+                    if int(msg["sync_ping"].get("seq", -1)) > self._seq:
                         break   # drifted; resync with a fresh sync_hello
         finally:
             self.leader_addr = None
@@ -951,36 +1534,26 @@ class CoordServer:
         ephemerals, no client watches.  Version checks run against OUR
         tree — a BadVersionError here means we diverged from the leader
         and the caller falls back to a full resync."""
-        op = r.get("op")
-        if op == "create":
-            return self.tree.create(r["path"], _unb64(r.get("data")),
-                                    sequential=bool(r.get("sequential")))
-        if op == "set":
-            return self.tree.set(r["path"], _unb64(r.get("data")),
-                                 int(r.get("version", -1)))
-        if op == "delete":
-            self.tree.delete(r["path"], int(r.get("version", -1)))
-            return None
-        if op == "multi":
-            ops = [Op(kind=o["kind"], path=o["path"],
-                      data=_unb64(o.get("data")),
-                      version=int(o.get("version", -1)),
-                      ephemeral=False,
-                      sequential=bool(o.get("sequential")))
-                   for o in r.get("ops", [])]
-            return self.tree.multi(ops, session_id=None)
-        raise CoordError("unknown replicated op: %r" % op)
+        return _apply_wire_op(self.tree, r)
 
-    def _apply_sync(self, seq: int, snap: dict, *,
-                    force: bool = False) -> None:
+    async def _apply_sync(self, seq: int, snap: dict, *,
+                          force: bool = False) -> bool:
+        """Adopt a leader-shipped tree and persist it durably (worker
+        thread for the serialization+fsync).  Returns False when the
+        persist failed — the caller must NOT ack: an ack claims the
+        write is on our disk."""
         if seq < self._seq and not force:
-            return
+            # a ship from the past means we diverged ahead of the
+            # leader: never ack it — resync instead
+            return False
         tree = model.ZNodeTree.from_snapshot(snap)
         self.tree = tree
         self._seq = seq
         self._wire_tree(tree)
-        if self.data_dir:
-            self._mark_dirty()
+        # the adopted tree supersedes whatever snapshot+log we held:
+        # persist it (fsynced, epoch-bumped) BEFORE the ack — the old
+        # log must never replay on top of the new snapshot
+        return await self._persist_snapshot_async()
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -988,7 +1561,14 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=2281)
     p.add_argument("--data-dir", default=None,
-                   help="persist the tree here (survives restarts)")
+                   help="persist the tree here (survives restarts): "
+                        "fsynced op log + compaction snapshots")
+    p.add_argument("--no-fsync", action="store_true",
+                   help="skip fsync on the op log/snapshot (dev only: "
+                        "acked writes may vanish on power loss)")
+    p.add_argument("--snapshot-every", type=int, default=100_000,
+                   help="logged ops between compaction snapshots "
+                        "(ZooKeeper snapCount parity)")
     p.add_argument("--tick", type=float, default=0.25,
                    help="session-expiry scan interval (seconds)")
     p.add_argument("--ensemble", default=None,
@@ -1017,7 +1597,9 @@ def main(argv: list[str] | None = None) -> None:
                              ensemble=ensemble,
                              ensemble_id=args.ensemble_id,
                              promote_grace=args.promote_grace,
-                             metrics_port=args.metrics_port)
+                             metrics_port=args.metrics_port,
+                             fsync=not args.no_fsync,
+                             snapshot_every=args.snapshot_every)
         await server.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

@@ -167,6 +167,20 @@ async def run(
             asyncio.gather(*tasks), timeout=timeout
         )
         await proc.wait()
+    except asyncio.CancelledError:
+        # the CALLER was cancelled (a watchdog/reconfigure racing this
+        # exec): the child must not be orphaned — kill and reap it,
+        # then let the cancellation propagate
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+        await asyncio.gather(_discard(proc.stdout), _discard(proc.stderr))
+        await proc.wait()
+        raise
     except (asyncio.TimeoutError, OutputLimitExceeded) as e:
         for t in tasks:
             t.cancel()

@@ -1,0 +1,599 @@
+"""Durable-before-ack coordination commits (VERDICT r4 #1).
+
+ZooKeeper fsyncs its transaction log on a quorum BEFORE acknowledging —
+that is the guarantee manatee's deposed/generation records ride on
+(/root/reference/lib/zookeeperMgr.js:605-630,
+/root/reference/docs/xlog-diverge.md:1-31).  These tests pin the same
+contract for coordd: an acknowledged mutation is on disk (fsynced op
+log) before the ack leaves the server, so a SIGKILL the instant after
+the ack — the old 50 ms debounce window — can no longer roll back
+acked cluster state.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from manatee_tpu.coord.api import Op
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.server import CoordServer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def oplog_bytes(data_dir: Path) -> int:
+    return sum(p.stat().st_size
+               for p in data_dir.glob("coordd-oplog-*.jsonl"))
+
+
+def oplog_seqs(data_dir: Path) -> list[int]:
+    out = []
+    for p in sorted(data_dir.glob("coordd-oplog-*.jsonl")):
+        out += [json.loads(line)["seq"]
+                for line in p.read_text().splitlines() if line]
+    return out
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def crash(server: CoordServer) -> None:
+    """Abandon a server WITHOUT stop(): no final snapshot flush, no
+    clean teardown — only what was already durably on disk survives,
+    exactly like a SIGKILL."""
+    for conn in list(server._conns):
+        conn.sever()
+    for t in (server._expiry_task, server._follow_task,
+              server._probe_task, server._compact_task):
+        if t:
+            t.cancel()
+    if server._server:
+        server._server.close()
+        await server._server.wait_closed()
+
+
+def test_acked_write_survives_crash_without_snapshot(tmp_path):
+    """The old failure mode: ack, then crash before the debounced
+    snapshot lands.  With the op log the acked write must be there on
+    restart even though NO snapshot was ever written."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"gen7")
+        await c.set("/state", b"gen8", 0)
+        await c.close()
+        await crash(server)
+
+        # no compaction ever ran: the log alone must carry the writes
+        assert not (tmp_path / "coordd-tree.json").exists()
+        assert oplog_bytes(tmp_path) > 0
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        data, version = reborn.tree.get("/state")
+        assert data == b"gen8" and version == 1
+        assert reborn._seq == 2
+    run(go())
+
+
+def test_put_cluster_state_survives_sigkill_after_ack(tmp_path):
+    """The done-criterion scenario over the REAL daemon: a
+    putClusterState-shaped transaction (history create + state CAS) is
+    acked, the coordd process is SIGKILLed immediately (well inside the
+    old debounce window), and the write survives restart."""
+    port = free_port()
+    data_dir = tmp_path / "coord-data"
+    logf = open(tmp_path / "coordd.log", "ab")
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    argv = [sys.executable, "-m", "manatee_tpu.coord.server",
+            "--port", str(port), "--data-dir", str(data_dir),
+            "--tick", "0.1"]
+
+    async def wait_port():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                return
+            except OSError:
+                await asyncio.sleep(0.05)
+        raise RuntimeError("coordd never came up")
+
+    async def go():
+        proc = subprocess.Popen(argv, stdout=logf, stderr=logf, env=env,
+                                start_new_session=True)
+        try:
+            await wait_port()
+            c = NetCoord("127.0.0.1:%d" % port, session_timeout=5)
+            await c.connect()
+            await c.mkdirp("/manatee/1/history")
+            state = json.dumps({"generation": 3,
+                                "deposed": [{"id": "old-primary"}]})
+            await c.create("/manatee/1/state", b"{}")
+            _, ver = await c.get("/manatee/1/state")
+            await c.multi([
+                Op.create("/manatee/1/history/3-", state.encode(),
+                          sequential=True),
+                Op.set("/manatee/1/state", state.encode(), ver),
+            ])
+            # the ack has returned: kill NOW, inside what used to be
+            # the 50 ms debounce window
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=5)
+            await c.close()
+        finally:
+            if proc.poll() is None:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=5)
+
+        proc = subprocess.Popen(argv, stdout=logf, stderr=logf, env=env,
+                                start_new_session=True)
+        try:
+            await wait_port()
+            c = NetCoord("127.0.0.1:%d" % port, session_timeout=5)
+            await c.connect()
+            data, _ = await c.get("/manatee/1/state")
+            got = json.loads(data.decode())
+            # the deposed marker — the record whose loss is a
+            # split-brain seed — survived the kill
+            assert got["deposed"] == [{"id": "old-primary"}]
+            assert got["generation"] == 3
+            hist = await c.get_children("/manatee/1/history")
+            assert len(hist) == 1
+            await c.close()
+        finally:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=5)
+
+    run(go())
+    logf.close()
+
+
+def test_compaction_truncates_log_and_recovery_uses_both(tmp_path):
+    """snapshot_every ops trigger a compaction snapshot, after which the
+    log restarts empty; recovery = snapshot + replay of the tail."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path),
+                             snapshot_every=8)
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"v0")
+        for i in range(8):            # reaches snapshot_every
+            await c.set("/state", b"v%d" % (i + 1), i)
+
+        # the debounced compaction lands; the covered segments vanish
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (tmp_path / "coordd-tree.json").exists() \
+                    and oplog_bytes(tmp_path) == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert (tmp_path / "coordd-tree.json").exists()
+        assert oplog_bytes(tmp_path) == 0
+
+        # a few more writes land in the fresh log (the replay tail)
+        await c.set("/state", b"v9", 8)
+        await c.set("/state", b"v10", 9)
+        await c.close()
+        await crash(server)
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        data, version = reborn.tree.get("/state")
+        assert data == b"v10" and version == 10
+        assert reborn._seq == 11      # 1 create + 10 sets
+    run(go())
+
+
+def test_torn_final_log_line_is_discarded(tmp_path):
+    """A crash mid-append leaves a torn last line; it was never acked,
+    so recovery must drop it and keep everything before it."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/a", b"1")
+        await c.create("/b", b"2")
+        await c.close()
+        await crash(server)
+
+        seg = sorted(tmp_path.glob("coordd-oplog-*.jsonl"))[-1]
+        with open(seg, "ab") as f:
+            f.write(b'{"seq": 3, "req": {"op": "create", "pa')  # torn
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        assert reborn.tree.get("/a")[0] == b"1"
+        assert reborn.tree.get("/b")[0] == b"2"
+        assert reborn._seq == 2
+    run(go())
+
+
+def test_follower_logs_before_acking(tmp_path):
+    """A follower's sync_op ack means "on my disk", not "in my memory":
+    the moment the client's write returns, the leader's log AND at
+    least a commit quorum of follower logs must contain it."""
+    from tests.test_ensemble import (
+        connstr,
+        start_ensemble,
+        wait_leader_with_quorum,
+    )
+
+    async def go():
+        dirs = [tmp_path / ("m%d" % i) for i in range(3)]
+        servers, members = await start_ensemble(
+            data_dirs=[str(d) for d in dirs])
+        try:
+            assert await wait_leader_with_quorum(servers[0], 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.create("/state", b"acked")
+            await c.close()
+
+            logs = [oplog_seqs(d) for d in dirs]
+            # leader fsynced before acking…
+            assert 1 in logs[0]
+            # …and so did enough followers for a commit quorum (the
+            # leader returns as soon as quorum-1 followers ack, so
+            # demand >= 1 of 2, not both)
+            assert sum(1 in lg for lg in logs[1:]) >= 1
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_full_ensemble_sigkill_storm_keeps_acked_state(tmp_path):
+    """Whole-ensemble power loss: every member SIGKILLed right after an
+    acked write, all restarted from disk — the acked state must be
+    what the reborn ensemble serves."""
+    n = 3
+    ports = [free_port() for _ in range(n)]
+    members = ",".join("127.0.0.1:%d" % p for p in ports)
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    logf = open(tmp_path / "coordd.log", "ab")
+
+    def spawn(i):
+        argv = [sys.executable, "-m", "manatee_tpu.coord.server",
+                "--port", str(ports[i]),
+                "--data-dir", str(tmp_path / ("m%d" % i)),
+                "--tick", "0.1", "--ensemble", members,
+                "--ensemble-id", str(i), "--promote-grace", "0.5"]
+        return subprocess.Popen(argv, stdout=logf, stderr=logf, env=env,
+                                start_new_session=True)
+
+    async def connect_any():
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            c = NetCoord(members, session_timeout=5)
+            try:
+                await asyncio.wait_for(c.connect(), 2.0)
+                return c
+            except Exception:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+        raise RuntimeError("no ensemble leader accepted a session")
+
+    async def go():
+        procs = [spawn(i) for i in range(n)]
+        try:
+            for round_no in range(3):
+                payload = b"storm-round-%d" % round_no
+                # retry until a commit quorum of followers has attached
+                # (the leader refuses mutations before that)
+                deadline = time.monotonic() + 20
+                while True:
+                    c = await connect_any()
+                    try:
+                        if round_no == 0:
+                            await c.create("/state", payload)
+                        else:
+                            _, ver = await c.get("/state")
+                            await c.set("/state", payload, ver)
+                        break
+                    except Exception:
+                        # ambiguous commit (applied locally, quorum
+                        # refused): a retry may see the write already
+                        # there — that counts as acked
+                        try:
+                            data, _ = await c.get("/state")
+                            if data == payload:
+                                break
+                        except Exception:
+                            pass
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+                    finally:
+                        await c.close()
+                # acked: kill EVERY member immediately
+                for p in procs:
+                    if p.poll() is None:
+                        os.killpg(p.pid, signal.SIGKILL)
+                for p in procs:
+                    p.wait(timeout=5)
+                procs = [spawn(i) for i in range(n)]
+                c = await connect_any()
+                data, _ = await c.get("/state")
+                assert data == payload, \
+                    "acked write lost in round %d" % round_no
+                await c.close()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    os.killpg(p.pid, signal.SIGKILL)
+                    p.wait(timeout=5)
+    run(go())
+    logf.close()
+
+
+def test_append_failure_falls_back_to_snapshot(tmp_path, monkeypatch):
+    """A failed log append must not leave a silent seq gap that poisons
+    every later fsynced entry at replay: the server falls back to a
+    synchronous snapshot covering the seq (code-review r5 finding)."""
+    from manatee_tpu.coord import server as server_mod
+
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"v0")
+
+        real_fsync = os.fsync
+        fail = {"on": True}
+
+        def flaky_fsync(fd):
+            if fail["on"]:
+                fail["on"] = False
+                raise OSError(28, "No space left on device")
+            return real_fsync(fd)
+
+        monkeypatch.setattr(server_mod.os, "fsync", flaky_fsync)
+        await c.set("/state", b"v1", 0)     # append fails -> snapshot
+        await c.set("/state", b"v2", 1)     # healthy append again
+        await c.close()
+        await crash(server)
+
+        # recovery must see BOTH writes — no gap, nothing rolled back
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        data, version = reborn.tree.get("/state")
+        assert data == b"v2" and version == 2
+        assert reborn._seq == 3
+    run(go())
+
+
+def test_stale_epoch_segments_never_replay(tmp_path):
+    """Crash window between resync-snapshot install and old-segment
+    unlink: pre-resync entries must not replay on top of the adopted
+    tree (code-review r5 finding).  Simulated by installing a
+    bumped-epoch snapshot at a LOWER seq while divergent old-epoch
+    segments remain on disk."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"diverged-1")
+        await c.set("/state", b"diverged-2", 0)
+        await c.set("/state", b"diverged-3", 1)
+        await c.close()
+        await crash(server)
+        assert len(oplog_seqs(tmp_path)) == 3
+
+        # the "adopted" tree: seq 2, epoch 1, value from the leader
+        from manatee_tpu.coord.model import ZNodeTree
+        adopted = ZNodeTree()
+        adopted.create("/state", b"leader-truth")
+        snap = adopted.to_snapshot()
+        snap["seq"] = 2
+        snap["epoch"] = 1
+        (tmp_path / "coordd-tree.json").write_text(json.dumps(snap))
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        data, _ = reborn.tree.get("/state")
+        assert data == b"leader-truth"      # divergent seq 3 NOT replayed
+        assert reborn._seq == 2
+        # the stale segments were cleaned up at startup
+        assert oplog_bytes(tmp_path) == 0
+    run(go())
+
+
+def test_mid_log_corruption_refuses_to_start(tmp_path):
+    """Corruption that is NOT a torn final line means acked writes
+    would be silently rolled back — the server must refuse to start
+    (code-review r5 finding)."""
+    import pytest
+
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/a", b"1")
+        await c.create("/b", b"2")
+        await c.close()
+        await crash(server)
+
+        seg = sorted(tmp_path.glob("coordd-oplog-*.jsonl"))[-1]
+        lines = seg.read_bytes().split(b"\n")
+        lines[0] = b'{"seq": 1, "req": GARBLED'   # corrupt MIDDLE entry
+        seg.write_bytes(b"\n".join(lines))
+
+        with pytest.raises(RuntimeError, match="corrupt"):
+            CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+    run(go())
+
+
+def test_orphaned_snapshot_tmp_cleaned_at_startup(tmp_path):
+    """A compaction cancelled mid-write leaks a coordd-tree.json.tmp-*
+    file; startup must clean it up (code-review r5 finding)."""
+    async def go():
+        (tmp_path / "coordd-tree.json.tmp-0-5").write_text("{}")
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        assert not list(tmp_path.glob("coordd-tree.json.tmp*"))
+        await server.start()
+        await server.stop()
+    run(go())
+
+
+def test_torn_tail_truncated_then_reused_segment_stays_clean(tmp_path):
+    """After a torn tail is discarded, the next append may reuse the
+    same segment file (same start seq); without truncation the new
+    acked entry would concatenate onto the torn bytes and be eaten on
+    the NEXT restart (code-review r5 finding)."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/a", b"1")
+        await c.close()
+        await crash(server)
+
+        seg = sorted(tmp_path.glob("coordd-oplog-*.jsonl"))[-1]
+        with open(seg, "ab") as f:
+            f.write(b'{"seq": 2, "req": {"op": "cre')       # torn
+
+        # restart 1: torn tail discarded AND truncated; a new acked
+        # write lands at seq 2 — possibly in the same segment file
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        assert server._seq == 1
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/b", b"2")
+        await c.close()
+        await crash(server)
+
+        # restart 2: BOTH acked writes must be there
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        assert reborn.tree.get("/a")[0] == b"1"
+        assert reborn.tree.get("/b")[0] == b"2"
+        assert reborn._seq == 2
+    run(go())
+
+
+def test_corrupt_snapshot_refuses_to_start(tmp_path):
+    """A snapshot that exists but cannot be loaded must refuse startup:
+    falling back to 'empty' would reset the epoch and delete the log
+    segments an operator could recover from (code-review r5 finding)."""
+    import pytest
+
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path),
+                             snapshot_every=1)
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"v0")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (tmp_path / "coordd-tree.json").exists():
+                break
+            await asyncio.sleep(0.02)
+        await c.set("/state", b"v1", 0)     # lands in the log tail
+        await c.close()
+        await crash(server)
+
+        snap = tmp_path / "coordd-tree.json"
+        snap.write_text(snap.read_text()[:40])     # bitrot
+
+        n_segments = len(list(tmp_path.glob("coordd-oplog-*.jsonl")))
+        with pytest.raises(RuntimeError, match="refusing to start"):
+            CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        # and it preserved the segments for the operator
+        assert len(list(tmp_path.glob("coordd-oplog-*.jsonl"))) \
+            == n_segments
+    run(go())
+
+
+def test_append_during_mixed_persist_window_survives(tmp_path,
+                                                     monkeypatch):
+    """A plain op racing a mixed transaction's whole-log-superseding
+    snapshot must not land in a new-epoch segment that dies with a
+    crash before the snapshot installs (code-review r5 finding): the
+    log fence holds appends until the install completes."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        real_write = server._write_snapshot_tmp
+
+        def slow_write(snap):
+            time.sleep(0.25)       # executor thread: widen the window
+            return real_write(snap)
+
+        monkeypatch.setattr(server, "_write_snapshot_tmp", slow_write)
+        await server.start()
+        c1 = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        c2 = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c1.connect()
+        await c2.connect()
+        await c1.create("/state", b"v0")
+
+        async def mixed():
+            # ephemeral inside a transaction -> snapshot-mode persist
+            await c1.multi([
+                Op.create("/eph", b"e", ephemeral=True),
+                Op.set("/state", b"mixed", 0),
+            ])
+
+        async def plain():
+            await asyncio.sleep(0.1)   # lands inside the write window
+            await c2.create("/plain", b"acked")
+
+        await asyncio.gather(mixed(), plain())
+        await c1.close()
+        await c2.close()
+        await crash(server)
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        assert reborn.tree.get("/state")[0] == b"mixed"
+        assert reborn.tree.get("/plain")[0] == b"acked"   # not lost
+    run(go())
+
+
+def test_sequential_replay_reproduces_acked_names(tmp_path):
+    """Ephemeral-sequential creates bump the same per-parent counter
+    as persistent ones but are never logged; replay must still mint
+    the exact names that were acked (code-review r5 finding)."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/x", b"")
+        # ephemeral-sequential (an election join): counter 0 -> 1,
+        # NOT logged
+        eph = await c.create("/x/e-", b"", ephemeral=True,
+                             sequential=True)
+        assert eph.endswith("0000000000")
+        # persistent-sequential: acked as ...0000000001
+        acked = await c.create("/x/n-", b"h", sequential=True)
+        assert acked.endswith("0000000001")
+        await c.close()
+        await crash(server)
+
+        reborn = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        # the acked name exists (naive replay would mint ...0000000000)
+        assert reborn.tree.get(acked)[0] == b"h"
+    run(go())
