@@ -650,12 +650,23 @@ class AdmClient:
                 d.setdefault("zoneId", a["id"])
                 return d
 
+            sync = info(actives[1]) if len(actives) > 1 else None
+            asyncs = [info(a) for a in actives[2:]]
+            # _rearrangeState parity (lib/adm.js:1251-1259): v1
+            # election order named the daisy chain head-first, but the
+            # backfilled v2 sync is the LAST async, with the old sync
+            # appended to the async list
+            if sync is not None and asyncs:
+                new_sync = asyncs.pop()
+                asyncs.append(sync)
+                sync = new_sync
+
             new = {
                 "generation": 0,
                 "initWal": "0/0000000",
                 "primary": info(actives[0]),
-                "sync": info(actives[1]) if len(actives) > 1 else None,
-                "async": [info(a) for a in actives[2:]],
+                "sync": sync,
+                "async": asyncs,
                 "deposed": [],
                 "freeze": {"date": _now_iso(),
                            "reason": "manatee-adm state-backfill"},

@@ -165,6 +165,17 @@ def die(msg: str, code: int = 2) -> None:
     sys.exit(code)
 
 
+def confirm_or_die(prompt: str = "") -> None:
+    """Read a yes/no answer; anything else — including EOF from a
+    scripted run without -y — is a clean 'aborted', not a traceback."""
+    try:
+        answer = input(prompt)
+    except EOFError:
+        answer = ""
+    if answer.strip().lower() not in ("y", "yes"):
+        die("aborted")
+
+
 async def _load_details(args) -> ClusterDetails:
     canned = os.environ.get("MANATEE_ADM_TEST_STATE")
     if canned:
@@ -392,9 +403,7 @@ def cmd_set_onwm(args) -> int:
             sys.stderr.write("Are you sure you want to proceed? "
                              "(yes/no): ")
             sys.stderr.flush()
-            answer = input()
-            if answer.strip().lower() not in ("y", "yes"):
-                die("aborted")
+            confirm_or_die()
         async with AdmClient(_coord(args)) as adm:
             await adm.set_onwm(_shard(args), args.mode)
             print("one-node-write mode: %s" % args.mode)
@@ -420,9 +429,7 @@ def cmd_state_backfill(args) -> int:
             # prompt on stderr: stdout carries the JSON result
             sys.stderr.write("is this correct? (yes/no): ")
             sys.stderr.flush()
-            answer = input()
-            if answer.strip().lower() not in ("y", "yes"):
-                die("aborted")
+            confirm_or_die()
         async with AdmClient(_coord(args)) as adm:
             # write the object the operator confirmed, not a recompute
             new = await adm.state_backfill(_shard(args),
@@ -542,10 +549,8 @@ def cmd_rebuild(args) -> int:
             # peer becoming primary) is still caught.
             print("This operation will remove all local data and "
                   "rebuild this peer from its upstream.")
-            answer = input("Are you sure you want to proceed? "
+            confirm_or_die("Are you sure you want to proceed? "
                            "(yes/no): ")
-            if answer.strip().lower() not in ("y", "yes"):
-                die("aborted")
 
         async with AdmClient(_coord(args)) as adm:
             state, _ = await adm.get_state(shard)

@@ -57,12 +57,30 @@ class Engine(abc.ABC):
     # always restarts, like real postgres.
     promotable_in_place = False
 
+    # True when a standby whose re-pointed stream is REFUSED (diverged)
+    # keeps running and retrying forever instead of exiting — real
+    # PostgreSQL walreceiver semantics.  The manager then arms a
+    # watchdog after each live re-point: if the stream never attaches
+    # to the new upstream within replicationTimeout it forces the
+    # restore path (ADVICE r4).  simpg/fakepg default to exit-on-
+    # refusal, where crash-only supervision already covers it.
+    lingering_repoint_failure = False
+
     async def promote_in_place(self, host: str, port: int,
                                timeout: float = 30.0) -> None:
         """Finish an in-place promotion on the running server.  The
         default is a no-op for engines whose conf reload already exits
         recovery (simpg); PostgresEngine issues SELECT pg_promote()."""
         return None
+
+    async def upstream_attached(self, host: str, port: int,
+                                upstream: dict,
+                                timeout: float = 5.0) -> bool:
+        """Is the walreceiver streaming from *upstream*?  Consulted by
+        the re-point watchdog; only meaningful for engines with
+        lingering_repoint_failure (PostgresEngine reads
+        pg_stat_wal_receiver)."""
+        return True
 
     # -- local cluster management --
 

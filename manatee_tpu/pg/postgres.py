@@ -251,6 +251,10 @@ class PostgresEngine(Engine):
                 rc.write(recovery)
         conf.write(d / "postgresql.conf")
 
+    # real walreceivers retry a refused stream forever (no exit): the
+    # manager's re-point watchdog polls upstream_attached instead
+    lingering_repoint_failure = True
+
     async def promote_in_place(self, host: str, port: int,
                                timeout: float = 30.0) -> None:
         """SELECT pg_promote(wait := true): exit recovery on the
@@ -262,6 +266,24 @@ class PostgresEngine(Engine):
             timeout + 5.0)).strip()
         if out != "t":
             raise PgError("pg_promote did not complete: %r" % out)
+
+    async def upstream_attached(self, host: str, port: int,
+                                upstream: dict,
+                                timeout: float = 5.0) -> bool:
+        """pg_stat_wal_receiver: streaming, and from the expected
+        host/port?  Empty result = no walreceiver at all."""
+        _s, uhost, uport = parse_pg_url(upstream["pgUrl"])
+        out = (await self._psql(
+            host, port,
+            "SELECT status || '\x1f' || conninfo "
+            "FROM pg_stat_wal_receiver;", timeout)).strip()
+        if not out:
+            return False
+        status, _sep, conninfo = out.partition("\x1f")
+        tokens = conninfo.split()
+        return (status == "streaming"
+                and "host=%s" % uhost in tokens
+                and "port=%d" % uport in tokens)
 
     # -- queries via psql --
 
@@ -287,7 +309,10 @@ class PostgresEngine(Engine):
     # engine=postgres).  psql >= 9.6 accepts repeated -c, one
     # connection, results printed in order — so a multi-statement op
     # costs ONE spawn, with marker rows delimiting the sections.
-    _SECTION_RS = "\x1e"
+    # The marker carries a fixed random token so no plausible result
+    # row (e.g. an adversarial application_name of "\x1e") can
+    # collide with it and shift the section split (ADVICE r4)
+    _SECTION_RS = "\x1e--manatee-section-9f4b2c17ab5e--"
 
     async def _psql_sections(self, host: str, port: int,
                              sqls: list[str], timeout: float

@@ -91,8 +91,10 @@ def test_state_backfill(tmp_path):
             st = json.loads(out)
             assert st["generation"] == 0
             assert st["primary"]["id"] == "a:5432:1"   # join order
-            assert st["sync"]["id"] == "b:5432:1"
-            assert [x["id"] for x in st["async"]] == ["c:5432:1"]
+            # _rearrangeState parity (lib/adm.js:1251-1259): the LAST
+            # async becomes the sync; the old sync joins the asyncs
+            assert st["sync"]["id"] == "c:5432:1"
+            assert [x["id"] for x in st["async"]] == ["b:5432:1"]
             assert st["freeze"]["reason"] == \
                 "manatee-adm state-backfill"
 
@@ -106,6 +108,36 @@ def test_state_backfill(tmp_path):
             rc, _o, err = await adm(server.port, "state-backfill", "-y")
             assert rc != 0
             assert "already exists" in err
+            await w.close()
+        finally:
+            await server.stop()
+    run(go())
+
+
+def test_prompt_eof_aborts_cleanly(tmp_path):
+    """ADVICE r4: a scripted run without -y whose stdin is closed must
+    abort with the clean 'aborted' message, not an EOFError
+    traceback."""
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            w = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await w.connect()
+            await w.mkdirp("/manatee/1/election")
+            await w.create(
+                "/manatee/1/election/a:5432:1-",
+                json.dumps({"zoneId": "a", "ip": "a",
+                            "pgUrl": "sim://a:5432"}).encode(),
+                ephemeral=True, sequential=True)
+
+            rc, _o, err = await adm(server.port, "state-backfill",
+                                    stdin="")        # immediate EOF
+            assert rc != 0
+            assert "aborted" in err
+            assert "Traceback" not in err
+            children = await w.get_children("/manatee/1")
+            assert "state" not in children
             await w.close()
         finally:
             await server.stop()

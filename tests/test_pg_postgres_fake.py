@@ -498,3 +498,226 @@ def test_live_upstream_repoint_pg13(tmp_path):
         finally:
             await mgr.close()
     run(go())
+
+
+async def attached_quietly(mgr, up) -> bool:
+    """upstream_attached, tolerating the mid-restart windows where the
+    server is not accepting connections at all."""
+    try:
+        return await mgr.engine.upstream_attached(
+            mgr.host, mgr.port, up)
+    except PgError:
+        return False
+
+
+def test_repoint_watchdog_forces_restore_on_lingering_refusal(tmp_path):
+    """ADVICE r4: real PostgreSQL's walreceiver retries a refused
+    stream FOREVER after a reload re-point — the standby lingers in
+    recovery looking healthy and the restore path never triggers.  The
+    manager's watchdog polls pg_stat_wal_receiver after each live
+    re-point and forces the restore path when the stream never
+    attaches.  fakepg's fake_linger_on_refusal knob models the real
+    (no-exit) semantics."""
+    import shutil
+
+    async def go():
+        prim_a = make_mgr(tmp_path, "prima", version="13.0",
+                          singleton=True)
+        prim_b = make_mgr(tmp_path, "primb", version="13.0",
+                          singleton=True)
+        standby = make_mgr(tmp_path, "stand", version="13.0",
+                           replicationTimeout=2.0)
+        events = []
+        standby.on("restoreStart", lambda up: events.append("start"))
+        standby.on("restoreDone", lambda up: events.append("done"))
+        restore_src = {"which": None}
+
+        async def restore(upstream):
+            src = prim_a if upstream["id"] == prim_a.peer_id else prim_b
+            restore_src["which"] = src
+            d = Path(standby.datadir)
+            if d.exists():
+                shutil.rmtree(d)
+            shutil.copytree(src.datadir, d)
+            # keep the real-PG linger semantics across the restore
+            (d / "fake_linger_on_refusal").touch()
+        standby.restore_fn = restore
+
+        def up_of(mgr):
+            return {"id": mgr.peer_id,
+                    "pgUrl": "tcp://%s:%d" % (mgr.host, mgr.port),
+                    "backupUrl": "http://127.0.0.1:1"}
+
+        try:
+            await prim_a.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            await prim_b.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            # A gets ahead of B: a standby of A is DIVERGED relative
+            # to B, so a re-point to B gets its stream refused
+            for i in range(3):
+                await prim_a._local_query(
+                    {"op": "insert", "value": "a%d" % i})
+
+            # standby attaches to A (blank -> restore from A)
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_a),
+                                       "downstream": None})
+            await wait_online(standby)
+            assert events == ["start", "done"]
+            events.clear()
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if await attached_quietly(standby, up_of(prim_a)):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("standby never attached to A")
+
+            # live re-point to the behind-A primary B: the stream is
+            # refused, but with real-PG semantics the process LINGERS
+            pid_before = standby._proc.pid
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_b),
+                                       "downstream": None})
+            assert standby._proc.pid == pid_before   # fast path taken
+            assert standby._repoint_task is not None
+
+            # the watchdog detects no attachment within
+            # replicationTimeout (2s) and forces the restore path
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if events == ["start", "done"] and \
+                        standby.running and \
+                        await attached_quietly(standby, up_of(prim_b)):
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise AssertionError(
+                    "watchdog never forced the restore (events=%r)"
+                    % events)
+            assert restore_src["which"] is prim_b
+            st = await standby._local_query({"op": "status"})
+            assert st["in_recovery"] is True
+        finally:
+            await standby.close()
+            await prim_a.close()
+            await prim_b.close()
+    run(go())
+
+
+def test_promote_wait_knob_is_configurable(tmp_path):
+    """VERDICT r4 weak #5: promoteWait is schema-tunable like every
+    comparable knob.  A tiny override must bound the in-place
+    promotion wait (a hung pg_promote falls back to the restart path
+    that much sooner)."""
+    async def go():
+        mgr = make_mgr(tmp_path, promoteWait=0.5)
+        assert float(mgr.cfg["promoteWait"]) == 0.5
+        seen = {}
+        real = mgr.engine.promote_in_place
+
+        async def spy(host, port, timeout=30.0):
+            seen["timeout"] = timeout
+            return await real(host, port, timeout=timeout)
+        mgr.engine.promote_in_place = spy
+
+        up = {"id": "10.0.0.1:5432:1", "pgUrl": "tcp://10.0.0.1:5432",
+              "backupUrl": "http://10.0.0.1:1"}
+        try:
+            await mgr.reconfigure({"role": "primary", "upstream": None,
+                                   "downstream": None})
+            await mgr.reconfigure({"role": "sync", "upstream": up,
+                                   "downstream": None})
+            await wait_online(mgr)
+            await mgr.reconfigure({"role": "primary", "upstream": None,
+                                   "downstream": None})
+            assert seen["timeout"] == 0.5
+        finally:
+            await mgr.close()
+    run(go())
+
+
+def test_boot_path_watchdog_catches_lingering_diverged_standby(tmp_path):
+    """code-review r5: the watchdog must arm on the BOOT path too — a
+    real postgres booting against a diverged upstream stays up in
+    recovery retrying forever (allow_restore_exit never fires), so
+    without a watchdog the restore would never trigger."""
+    import shutil
+
+    async def go():
+        prim_a = make_mgr(tmp_path, "prima", version="13.0",
+                          singleton=True)
+        prim_b = make_mgr(tmp_path, "primb", version="13.0",
+                          singleton=True)
+        standby = make_mgr(tmp_path, "stand", version="13.0",
+                           replicationTimeout=2.0)
+        events = []
+        standby.on("restoreStart", lambda up: events.append("start"))
+        standby.on("restoreDone", lambda up: events.append("done"))
+
+        async def restore(upstream):
+            src = prim_a if upstream["id"] == prim_a.peer_id else prim_b
+            d = Path(standby.datadir)
+            if d.exists():
+                shutil.rmtree(d)
+            shutil.copytree(src.datadir, d)
+            (d / "fake_linger_on_refusal").touch()
+        standby.restore_fn = restore
+
+        def up_of(mgr):
+            return {"id": mgr.peer_id,
+                    "pgUrl": "tcp://%s:%d" % (mgr.host, mgr.port),
+                    "backupUrl": "http://127.0.0.1:1"}
+
+        try:
+            await prim_a.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            await prim_b.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            for i in range(3):
+                await prim_a._local_query(
+                    {"op": "insert", "value": "a%d" % i})
+
+            # standby of A (restored, linger knob in place), then STOP
+            # it so the next transition takes the boot path
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_a),
+                                       "downstream": None})
+            await wait_online(standby)
+            await standby.reconfigure({"role": "none",
+                                       "upstream": None,
+                                       "downstream": None})
+            assert not standby.running
+            events.clear()
+
+            # boot as standby of the behind-A primary B: the boot
+            # probe lingers (real-PG), the child stays up in recovery,
+            # and ONLY the watchdog can notice the stream never
+            # attaches
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_b),
+                                       "downstream": None})
+            assert standby.running
+            assert standby._repoint_task is not None
+
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if events[:2] == ["start", "done"] and \
+                        standby.running and \
+                        await attached_quietly(standby, up_of(prim_b)):
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise AssertionError(
+                    "boot-path watchdog never forced the restore "
+                    "(events=%r)" % events)
+        finally:
+            await standby.close()
+            await prim_a.close()
+            await prim_b.close()
+    run(go())
