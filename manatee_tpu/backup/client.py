@@ -53,6 +53,10 @@ class RestoreClient:
         self.listen_port = listen_port
         self.poll_interval = poll_interval
         self.current_job: dict | None = None   # for GET /restore
+        # monotonically numbers restore attempts so observers (the
+        # rebuild CLI's RESTORE_RETRIES accounting, lib/adm.js:71) can
+        # distinguish a NEW failed attempt from the same failed job
+        self.attempts = 0
 
     async def isolate(self, prefix: str) -> str | None:
         """Move the current dataset out of the way; returns the isolated
@@ -95,8 +99,9 @@ class RestoreClient:
     async def _receive(self, backup_url: str) -> None:
         recv_done: asyncio.Future = asyncio.get_running_loop() \
             .create_future()
+        self.attempts += 1
         job: dict = {"done": False, "size": None, "completed": 0,
-                     "url": backup_url}
+                     "url": backup_url, "attempt": self.attempts}
         self.current_job = job
 
         def progress(done: int, total: int | None) -> None:

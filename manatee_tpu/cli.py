@@ -29,6 +29,10 @@ from manatee_tpu.adm import (
     pg_duration,
 )
 
+# rebuild gives a repeatedly-failing restore this many attempts before
+# aborting with a diagnosis (RESTORE_RETRIES, lib/adm.js:71)
+RESTORE_RETRIES = 5
+
 # ---- column registry (bin/manatee-adm:1151-1232) ----
 
 ALL_COLUMNS = {
@@ -584,13 +588,19 @@ def cmd_rebuild(args) -> int:
                       if name else "No existing dataset detected.")
 
             # watch the sitter recover naturally (restore progress via
-            # its status server, lib/adm.js:1550-1678)
+            # its status server, lib/adm.js:1550-1678); a restore that
+            # keeps FAILING is a diagnosis, not something to retry
+            # silently — count failed attempts and abort after
+            # RESTORE_RETRIES with escalating warnings (lib/adm.js:71,
+            # :1603-1630)
             import aiohttp
             status = "http://%s:%d" % (cfg["ip"],
                                        int(cfg["postgresPort"]) + 1)
             print("Waiting for peer to rejoin and restore...")
             deadline = time.monotonic() + args.timeout
             last_pct = None
+            failures = 0
+            failed_attempts: set[int] = set()
             async with aiohttp.ClientSession() as http:
                 while time.monotonic() < deadline:
                     try:
@@ -605,6 +615,22 @@ def cmd_rebuild(args) -> int:
                             if pct != last_pct:
                                 print("restore: %5.1f%%" % pct)
                                 last_pct = pct
+                        if job and job.get("done") == "failed" and \
+                                job.get("attempt") not in failed_attempts:
+                            failed_attempts.add(job.get("attempt"))
+                            failures += 1
+                            remaining = RESTORE_RETRIES - failures
+                            print("warning: restore attempt failed "
+                                  "(%s); %d attempt%s remaining"
+                                  % (job.get("error", "unknown error"),
+                                     remaining,
+                                     "" if remaining == 1 else "s"),
+                                  file=sys.stderr)
+                            if failures >= RESTORE_RETRIES:
+                                die("restore failed %d times; giving "
+                                    "up — investigate the upstream's "
+                                    "backup server and storage before "
+                                    "retrying" % failures)
                         async with http.get(
                                 status + "/ping",
                                 timeout=aiohttp.ClientTimeout(

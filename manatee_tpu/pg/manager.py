@@ -436,16 +436,22 @@ class PostgresMgr:
                 self._repoint_watchdog(pgcfg))
 
     async def _repoint_watchdog(self, pgcfg: dict) -> None:
-        """After a live re-point on a real-postgres engine, verify the
-        walreceiver actually attaches to the NEW upstream: a refused
-        stream (divergence) leaves the server running and retrying
-        forever, looking healthy in recovery while the restore path
-        never triggers (ADVICE r4).  No attachment within
-        replicationTimeout ⇒ force the full restore path."""
+        """After a standby transition on a real-postgres engine, verify
+        the walreceiver actually attaches to the NEW upstream: a
+        refused stream (divergence) leaves the server running and
+        retrying forever, looking healthy in recovery while the
+        restore path never triggers (ADVICE r4).  No attachment AND no
+        recovery progress within replicationTimeout ⇒ force the full
+        restore path.  Progress (the replay position advancing — e.g.
+        a returning standby chewing through a local pg_wal backlog
+        before it ever connects) extends the deadline, exactly like
+        the catchup loop's no-PROGRESS semantics: a healthy replaying
+        standby must never be wiped for being slow."""
         upstream = pgcfg["upstream"]
         poll = max(0.2, float(self.cfg["replPollInterval"]))
-        deadline = time.monotonic() + \
-            float(self.cfg["replicationTimeout"])
+        repl_timeout = float(self.cfg["replicationTimeout"])
+        deadline = time.monotonic() + repl_timeout
+        last_xlog: str | None = None
         while not self._closed and time.monotonic() < deadline:
             try:
                 if await self.engine.upstream_attached(
@@ -453,11 +459,20 @@ class PostgresMgr:
                     return
             except PgError:
                 pass
+            try:
+                res = await self._local_query({"op": "status"}, 5.0)
+                xlog = res.get("xlog_location")
+                if xlog is not None and xlog != last_xlog:
+                    if last_xlog is not None:
+                        deadline = time.monotonic() + repl_timeout
+                    last_xlog = xlog
+            except PgError:
+                pass
             await asyncio.sleep(poll)
         if self._closed:
             return
-        log.warning("%s: standby never attached to %s after live "
-                    "re-point; forcing the restore path",
+        log.warning("%s: standby never attached to %s (and made no "
+                    "recovery progress); forcing the restore path",
                     self.peer_id, upstream.get("id"))
         async with self._reconf_lock:
             # only if the topology has not moved on meanwhile
@@ -467,9 +482,16 @@ class PostgresMgr:
                 await self._standby(pgcfg, force_restore=True)
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
+                # the database is deliberately stopped at this point:
+                # swallowing the failure would park the peer out of
+                # the chain forever.  Crash-only escalation (MANTA-997
+                # parity): the sitter exits, supervision restarts the
+                # peer, and the boot path retries the restore.
                 log.exception("%s: forced restore after re-point "
                               "failure did not complete", self.peer_id)
+                self._emit("error",
+                           "forced restore failed: %s" % e)
 
     # -- database preparation --
 

@@ -377,12 +377,30 @@ class SimPgServer:
             if self.downstreams.get(standby_id) is st:
                 del self.downstreams[standby_id]
 
+    def _fake_lag(self) -> float | None:
+        try:
+            return float((self.datadir / "fake_lag")
+                         .read_text().strip())
+        except (OSError, ValueError):
+            return None
+
     async def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
+        slow = self.datadir / "fake_slow"
+        if slow.exists():
+            # gradual-degradation knob (fakepg parity): delay every
+            # reply by this many seconds — ramping it produces the
+            # latency-climb signature the health predictor fires on,
+            # which the operator playbook's scripted test drives
+            try:
+                await asyncio.sleep(float(slow.read_text().strip()))
+            except (ValueError, OSError):
+                pass
         if op == "health":
             # "select current_time" analogue
             return {"ok": True, "now": time.time()}
         if op == "status":
+            fake_lag = self._fake_lag()
             repl = []
             syncs = self.sync_names()
             for sid, st in self.downstreams.items():
@@ -407,9 +425,12 @@ class SimPgServer:
                 # has been idle; a severed upstream link reports time
                 # since last contact (the signal that actually predicts
                 # trouble) — mirrors the receive==replay guard in the
-                # real engine's lag query
+                # real engine's lag query.  A fake_lag file (fakepg
+                # parity) overrides it for degradation tests — only in
+                # recovery: a real primary can never report replay lag
                 "replay_lag_seconds": (
                     None if not self.in_recovery
+                    else fake_lag if fake_lag is not None
                     else 0.0 if self._upstream_ok
                     else max(0.0, time.time() - (
                         self._upstream_contact or self._boot_ts))),

@@ -113,6 +113,7 @@ class Peer:
             # stack (VERDICT r2 #1)
             common["pgBinDir"] = FAKEPG_BIN
             common["pgUseSudo"] = False
+            common["pgVersion"] = self.cluster.pg_version
         sitter = dict(common)
         sitter.update({
             # every run records real probe telemetry — chaos and
@@ -152,7 +153,12 @@ class Peer:
             stdout=logf, stderr=logf, env=env,
             start_new_session=True, cwd=str(self.root))
 
-    def start(self, *, snapshotter: bool = False) -> None:
+    def start(self, *, snapshotter: bool | None = None) -> None:
+        """*snapshotter=None* inherits the cluster-wide setting, so
+        storm/chaos revive paths bring back the FULL daemon trio the
+        reference fixture always runs (testManatee.js:99-398)."""
+        if snapshotter is None:
+            snapshotter = self.cluster.snapshotter
         self.sitter_proc = self._spawn(
             "manatee_tpu.daemons.sitter",
             str(self.root / "sitter.json"), "sitter.log")
@@ -211,6 +217,7 @@ class ClusterHarness:
                  coord_promote_grace: float = 1.0,
                  disconnect_grace: float | None = 0.4,
                  engine: str | None = None,
+                 snapshotter: bool = False,
                  snapshot_poll: float = 3600.0,
                  snapshot_number: int = 5):
         """*n_coord* > 1 runs a replicated coordd ensemble; peers get the
@@ -234,9 +241,16 @@ class ClusterHarness:
         whole suite can be re-routed without edits."""
         self.root = Path(root)
         self.engine = engine or os.environ.get("MANATEE_ENGINE", "sim")
+        # 13.0 by default: a modern deployment, where upstream
+        # re-points are a reload (reloadable primary_conninfo) and
+        # takeover is pg_promote — the round-4 fast paths run under
+        # the full fault tier.  MANATEE_PG_VERSION=12.0 re-runs the
+        # restart-era semantics.
+        self.pg_version = os.environ.get("MANATEE_PG_VERSION", "13.0")
         if self.engine == "postgres":
             self.query_engine: SimPgEngine | PostgresEngine = \
-                PostgresEngine(pg_bin_dir=FAKEPG_BIN, use_sudo=False)
+                PostgresEngine(pg_bin_dir=FAKEPG_BIN, use_sudo=False,
+                               version=self.pg_version)
         else:
             self.query_engine = SimPgEngine()
         self.shard_path = "/manatee/%s" % shard
@@ -246,6 +260,7 @@ class ClusterHarness:
         self.n_coord = n_coord
         self.coord_promote_grace = coord_promote_grace
         # one block for everything: coord members + 4 ports per peer
+        self.snapshotter = snapshotter
         self.snapshot_poll = snapshot_poll
         self.snapshot_number = snapshot_number
         self.port_base = alloc_port_block(n_coord + 4 * n_peers)

@@ -178,7 +178,12 @@ def test_chaos(tmp_path):
     seed = int(os.environ.get("MANATEE_CHAOS_SEED", "1"))
 
     async def go():
-        cluster = ClusterHarness(tmp_path, n_peers=4, n_coord=3)
+        # full daemon trio on every peer (testManatee.js parity): the
+        # snapshotter must keep snapshotting + GC'ing through the storm
+        # without spurious stuck alarms (VERDICT r4 #3)
+        cluster = ClusterHarness(tmp_path, n_peers=4, n_coord=3,
+                                 snapshotter=True, snapshot_poll=1.0,
+                                 snapshot_number=3)
         rng = random.Random(seed)
         chaos = Chaos(cluster, rng)
         try:
@@ -226,6 +231,31 @@ def test_chaos(tmp_path):
                 % (chaos.actions[-8:], cp.returncode, cp.stdout,
                    cp.stderr)
             await chaos.verify_durability()
+
+            # the snapshotter trio survived the storm: snapshots kept
+            # flowing, GC held the bound, no spurious stuck alarm
+            from manatee_tpu.storage import DirBackend
+            from manatee_tpu.storage.base import is_epoch_ms_snapshot
+            snapshotting_peers = 0
+            for peer in cluster.peers:
+                be = DirBackend(str(peer.root / "store"))
+                if not await be.exists("manatee/pg"):
+                    continue
+                snaps = [s for s in
+                         await be.list_snapshots("manatee/pg")
+                         if is_epoch_ms_snapshot(s.name)]
+                if snaps:
+                    snapshotting_peers += 1
+                assert len(snaps) <= cluster.snapshot_number + 2, \
+                    "%s: %d snapshots > keep-%d" \
+                    % (peer.name, len(snaps), cluster.snapshot_number)
+                slog = peer.root / "snapshotter.log"
+                if slog.exists():
+                    text = slog.read_text()
+                    assert "snapshots are stuck" not in text, \
+                        "%s: spurious stuck-snapshot alarm" % peer.name
+            assert snapshotting_peers >= 2, \
+                "snapshot stream dried up under chaos"
             print("chaos: survived %d actions, %d acked writes, "
                   "%d rebuilds" % (len(chaos.actions), len(chaos.acked),
                                    chaos.rebuilds), flush=True)

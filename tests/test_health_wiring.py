@@ -171,3 +171,105 @@ def test_cluster_details_warns_on_high_score():
     ps2 = PeerStatus(ident=ident, online=True, health_score=0.1)
     details2 = ClusterDetails("1", state, {"a": ps2})
     assert not any("failure-prediction" in n for n in details2.notices)
+
+
+def test_playbook_promote_away_from_degrading_sync(tmp_path):
+    """The operator playbook end to end (VERDICT r4 #8,
+    docs/trouble-shooting.md 'Failure-prediction notices'): a live
+    sync degrades (latency ramp, probes still succeeding), the
+    operator sees PRED cross the threshold in `pg-status` while
+    `verify` stays exit-0 with a notice, then promotes the healthy
+    async into the sync slot — a planned transition away from the
+    degrading peer, before any hard timeout fires."""
+
+    import subprocess
+    import sys
+
+    from tests.harness import ClusterHarness, cli_env
+    from tests.test_integration import converged
+
+    def cli(cluster, *args, timeout=30):
+        return subprocess.run(
+            [sys.executable, "-m", "manatee_tpu.cli", *args],
+            capture_output=True, text=True,
+            env=cli_env(cluster.coord_connstr), timeout=timeout)
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            # the sync starts sliding: probe latency ramps while every
+            # probe still succeeds (the hard timeout never trips)
+            slow = sync.root / "data" / "fake_slow"
+
+            # cap the ramp below the adm CLI's 1.0s query timeout:
+            # the peer must stay QUERYABLE (degrading, not dead) so
+            # verify reports a notice, not an error.  Latency AND
+            # replication lag climb together — the degradation
+            # signature the predictor trains on.
+            lag = sync.root / "data" / "fake_lag"
+
+            async def ramp():
+                for v in range(1, 25):
+                    slow.write_text(str(min(0.85, 0.08 * v)))
+                    lag.write_text(str(0.5 * v))
+                    await asyncio.sleep(1.0)
+            ramp_task = asyncio.ensure_future(ramp())
+
+            # playbook step 1: poll the operator surface until PRED on
+            # the sync crosses the warning threshold
+            try:
+                deadline = asyncio.get_event_loop().time() + 60
+                seen = None
+                while asyncio.get_event_loop().time() < deadline:
+                    cp = cli(cluster, "pg-status", "-H",
+                             "-o", "role,peername,pg-pred")
+                    for line in cp.stdout.splitlines():
+                        parts = line.split()
+                        if len(parts) >= 3 and parts[0] == "sync" \
+                                and parts[2] not in ("-", "?"):
+                            seen = float(parts[2])
+                    if seen is not None and \
+                            seen >= HEALTH_WARN_THRESHOLD:
+                        break
+                    await asyncio.sleep(1.0)
+                assert seen is not None and \
+                    seen >= HEALTH_WARN_THRESHOLD, \
+                    "sync PRED never crossed %.2f (last %r)" \
+                    % (HEALTH_WARN_THRESHOLD, seen)
+
+                # verify stays exit-0 with the advisory notice; the
+                # score wobbles tick to tick around the threshold, so
+                # poll (the ramp is still climbing underneath)
+                deadline = asyncio.get_event_loop().time() + 30
+                while True:
+                    cp = cli(cluster, "verify")
+                    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+                    if "failure-prediction score" in cp.stdout:
+                        break
+                    assert asyncio.get_event_loop().time() < deadline, \
+                        "verify never showed the advisory notice"
+                    await asyncio.sleep(1.0)
+            finally:
+                ramp_task.cancel()
+
+            # playbook step 3: planned promote of the healthy async
+            # into the sync slot (-y: the advisory must not block the
+            # operator acting on it; lag on a degraded peer may warn)
+            st = await cluster.cluster_state()
+            async_zone = st["async"][0]["zoneId"]
+            cp = cli(cluster, "promote", "-r", "async",
+                     "-n", async_zone, "-i", "0", "-y", timeout=60)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+
+            # the degraded peer is out of the commit path; writes flow
+            st = await cluster.wait_topology(primary=primary,
+                                             sync=asyncs[0],
+                                             asyncs=[sync], timeout=60)
+            await cluster.wait_writable(primary, "post-playbook",
+                                        timeout=60)
+        finally:
+            await cluster.stop()
+    run(go())

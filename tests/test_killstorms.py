@@ -377,3 +377,64 @@ def test_coordd_leader_dies_during_failover(tmp_path):
         finally:
             await cluster.stop()
     run(go())
+
+
+def test_storm_with_full_daemon_trio(tmp_path):
+    """VERDICT r4 #3: the reference fixture runs sitter + backupserver
+    + snapshotter on every peer in every scenario
+    (testManatee.js:99-398).  Run a takeover + kill storm with the
+    trio: snapshots must keep flowing and GC to the keep-N bound
+    across primary deaths, and the stuck-snapshot fatal alarm must
+    stay silent on healthy storage."""
+    from manatee_tpu.storage import DirBackend
+    from manatee_tpu.storage.base import is_epoch_ms_snapshot
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3, snapshotter=True,
+                                 snapshot_poll=0.5, snapshot_number=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            # takeover with the trio running
+            primary.kill()
+            st = await cluster.wait_topology(primary=sync, timeout=60)
+            await cluster.wait_writable(sync, "storm-trio-1",
+                                        timeout=60)
+
+            # storm: everyone dies at once, everyone returns (the
+            # snapshotters come back with their peers)
+            for p in (sync, asyncs[0]):
+                p.kill()
+            for p in (primary, sync, asyncs[0]):
+                p.start()
+            st = await cluster.wait_for(
+                lambda s: s.get("sync") is not None, 60,
+                "post-storm topology")
+            new_primary = cluster.peer_by_id(st["primary"]["id"])
+            await cluster.wait_writable(new_primary, "storm-trio-2",
+                                        timeout=60)
+
+            # let several snapshot + GC cycles run on the converged
+            # cluster, then check every live peer's snapshot stream
+            await asyncio.sleep(3.0)
+            for peer in cluster.peers:
+                be = DirBackend(str(peer.root / "store"))
+                if not await be.exists("manatee/pg"):
+                    continue    # rebuilt/deposed peer without data yet
+                snaps = [s for s in await be.list_snapshots("manatee/pg")
+                         if is_epoch_ms_snapshot(s.name)]
+                # snapshots flowed...
+                assert snaps, "%s took no snapshots" % peer.name
+                # ...and GC held the keep-N bound (small slack for the
+                # cycle in flight)
+                assert len(snaps) <= cluster.snapshot_number + 2, \
+                    "%s: %d snapshots > keep-%d" \
+                    % (peer.name, len(snaps), cluster.snapshot_number)
+                slog = (peer.root / "snapshotter.log").read_text()
+                assert "snapshots are stuck" not in slog, \
+                    "%s: spurious stuck-snapshot alarm" % peer.name
+                assert "manual intervention" not in slog
+        finally:
+            await cluster.stop()
+    run(go())
