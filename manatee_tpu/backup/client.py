@@ -100,8 +100,15 @@ class RestoreClient:
         recv_done: asyncio.Future = asyncio.get_running_loop() \
             .create_future()
         self.attempts += 1
+        import uuid
         job: dict = {"done": False, "size": None, "completed": 0,
-                     "url": backup_url, "attempt": self.attempts}
+                     "url": backup_url, "attempt": self.attempts,
+                     # globally unique, unlike the counter: a sitter
+                     # restart mid-rebuild resets attempts to 1, and
+                     # the CLI's failed-attempt dedup must not mistake
+                     # the new sitter's failures for already-counted
+                     # ones (code-review r5)
+                     "id": uuid.uuid4().hex}
         self.current_job = job
 
         def progress(done: int, total: int | None) -> None:

@@ -600,7 +600,7 @@ def cmd_rebuild(args) -> int:
             deadline = time.monotonic() + args.timeout
             last_pct = None
             failures = 0
-            failed_attempts: set[int] = set()
+            failed_attempts: set = set()   # job ids (or attempt #s)
             async with aiohttp.ClientSession() as http:
                 while time.monotonic() < deadline:
                     try:
@@ -615,9 +615,12 @@ def cmd_rebuild(args) -> int:
                             if pct != last_pct:
                                 print("restore: %5.1f%%" % pct)
                                 last_pct = pct
+                        job_key = job and (job.get("id")
+                                           or job.get("attempt"))
                         if job and job.get("done") == "failed" and \
-                                job.get("attempt") not in failed_attempts:
-                            failed_attempts.add(job.get("attempt"))
+                                job_key is not None and \
+                                job_key not in failed_attempts:
+                            failed_attempts.add(job_key)
                             failures += 1
                             remaining = RESTORE_RETRIES - failures
                             print("warning: restore attempt failed "

@@ -69,7 +69,18 @@ class SnapShotter:
 
     async def _create_loop(self) -> None:
         while True:
-            await self.create_snapshot()
+            try:
+                await self.create_snapshot()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the loops are self-rescheduling and must survive
+                # anything (snapShotter.js parity): a dataset being
+                # isolated/recreated under us mid-rebuild raced a
+                # cleanup pass into a raw OSError once, silently
+                # killing the task while its sibling kept running —
+                # snapshots then piled up unbounded (chaos seed 6)
+                log.exception("snapshot pass failed; continuing")
             await asyncio.sleep(self.poll_interval)
 
     async def create_snapshot(self) -> bool:
@@ -103,7 +114,12 @@ class SnapShotter:
     async def _cleanup_loop(self) -> None:
         while True:
             await asyncio.sleep(self.poll_interval)
-            await self.cleanup_once()
+            try:
+                await self.cleanup_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("cleanup pass failed; continuing")
 
     async def cleanup_once(self) -> None:
         try:

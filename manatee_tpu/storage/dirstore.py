@@ -237,12 +237,33 @@ class DirBackend(StorageBackend):
         return snaps
 
     async def destroy_snapshot(self, dataset: str, name: str) -> None:
-        meta = self._load_meta(dataset)
+        """Idempotent: the snapshotter's GC and a sitter's restore run
+        in SEPARATE processes, so the dataset (or just this snapshot)
+        can vanish between any two steps here — absence, however it
+        came about, means the deletion's goal is achieved (the
+        extended-storm race: a rebuild isolates/replaces the dataset
+        mid-GC-pass, and raising here fed the stuck-snapshot alarm
+        spuriously)."""
+        try:
+            meta = self._load_meta(dataset)
+        except StorageError:
+            return               # dataset replaced/renamed away
         if name not in meta["snaps"]:
-            raise StorageError("no such snapshot: %s@%s" % (dataset, name))
-        await asyncio.to_thread(
-            shutil.rmtree, self._dspath(dataset) / "@snapshots" / name)
-        del meta["snaps"][name]
+            return               # another pass (or a restore) got it
+        try:
+            await asyncio.to_thread(
+                shutil.rmtree,
+                self._dspath(dataset) / "@snapshots" / name)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise StorageError("cannot destroy snapshot %s@%s: %s"
+                               % (dataset, name, e)) from None
+        try:
+            meta = self._load_meta(dataset)
+        except StorageError:
+            return
+        meta["snaps"].pop(name, None)
         self._save_meta(dataset, meta)
 
     # ---- bulk streams ----

@@ -164,6 +164,21 @@ class Chaos:
             self.dead_coordd.append(idx)
             self.note("killed coordd %d" % idx)
 
+    async def coordd_blackout(self) -> None:
+        """Whole-ensemble power loss: SIGKILL every member at once,
+        restart them all from disk.  With durable-before-ack commits
+        (round 5) no acked cluster state may roll back — the
+        generation watermark and durability invariants check it."""
+        if self.dead_coordd:
+            return                   # partial outage already in play
+        for i in range(self.cluster.n_coord):
+            self.cluster.kill_coordd(i)
+        self.note("coordd blackout: all %d members killed"
+                  % self.cluster.n_coord)
+        await asyncio.sleep(self.rng.uniform(0.1, 0.8))
+        self.cluster.start_coordd()
+        self.note("coordd blackout: all members restarted")
+
     async def freeze_cycle(self) -> None:
         cp = run_cli(self.cluster, "freeze", "-r", "chaos", timeout=30)
         if cp.returncode == 0:
@@ -195,6 +210,7 @@ def test_chaos(tmp_path):
                 [chaos.kill_peer] * 3 +
                 [chaos.revive_peer] * 4 +
                 [chaos.coordd_churn] * 2 +
+                [chaos.coordd_blackout] * 1 +
                 [chaos.freeze_cycle] * 1 +
                 [chaos.try_write] * 5
             )
@@ -211,7 +227,10 @@ def test_chaos(tmp_path):
                 p = chaos.dead.pop()
                 p.start()
             run_cli(cluster, "unfreeze", timeout=30)
-            deadline = time.monotonic() + 120
+            # 180s: engine=postgres storms can end with CHAINED
+            # rebuilds (a peer restoring from a peer that is itself
+            # mid-rebuild must wait for its upstream's first snapshot)
+            deadline = time.monotonic() + 180
             ok = False
             while time.monotonic() < deadline:
                 st = await chaos.state()
