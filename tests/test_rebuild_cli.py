@@ -108,14 +108,19 @@ def test_rebuild_aborts_after_repeated_restore_failures(tmp_path):
         await w.create("/manatee/1/state", json.dumps(state).encode())
 
         # fake sitter status server: every poll reports a FRESH failed
-        # restore attempt; the peer never becomes healthy
+        # restore attempt — with attempt NUMBERS that repeat midway,
+        # as they do when the crash-only sitter restarts and its
+        # in-memory counter resets; the uuid job id is what keeps the
+        # accounting honest across that (code-review r5)
         polls = {"n": 0}
 
         async def restore_handler(_req):
             polls["n"] += 1
             return web.json_response({"restore": {
                 "done": "failed", "error": "recv exploded",
-                "attempt": polls["n"], "size": None, "completed": 0}})
+                "attempt": (polls["n"] - 1) % 2 + 1,   # 1,2,1,2,...
+                "id": "job-%d" % polls["n"],
+                "size": None, "completed": 0}})
 
         async def ping_handler(_req):
             return web.Response(status=503)

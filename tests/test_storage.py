@@ -255,3 +255,35 @@ def test_bad_dataset_names(be):
             with pytest.raises(StorageError):
                 await be.create(bad)
     run(go())
+
+
+def test_destroy_snapshot_idempotent_under_replacement_races(be, tmp_path):
+    """The snapshotter's GC and a sitter's restore run in separate
+    PROCESSES: the snapshot dir — or the whole dataset — can vanish
+    between any two steps of destroy_snapshot.  Absence means the
+    deletion's goal is achieved; raising here once fed the CRITICAL
+    stuck-snapshot alarm spuriously (found by the 600s chaos storm)."""
+    import shutil
+
+    async def go():
+        await be.create("pg")
+        s1 = await be.snapshot("pg")
+        s2 = await be.snapshot("pg", "manual")
+
+        # snapshot CONTENT vanished (another pass's rmtree won the
+        # race) but the meta entry is still there
+        shutil.rmtree(be._dspath("pg") / "@snapshots" / s1.name)
+        await be.destroy_snapshot("pg", s1.name)      # no raise
+        assert all(s.name != s1.name
+                   for s in await be.list_snapshots("pg"))
+
+        # meta entry already gone (concurrent pass completed fully)
+        await be.destroy_snapshot("pg", s1.name)      # no raise
+
+        # the whole dataset was replaced/renamed away mid-pass
+        await be.rename("pg", "isolated-pg")
+        await be.destroy_snapshot("pg", s2.name)      # no raise
+        # the isolated copy keeps its snapshot untouched
+        assert any(s.name == s2.name
+                   for s in await be.list_snapshots("isolated-pg"))
+    run(go())
