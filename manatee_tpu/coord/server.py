@@ -212,7 +212,11 @@ class _Conn:
         # (attach_acked) — until then it may not even have received it
         self.attached_seq = -1
         self.attach_acked = False
-        self.ack_waiters: dict[int, asyncio.Future] = {}
+        # seq -> ship waiters.  A LIST per seq: two concurrent ships
+        # can legitimately share one seq (a mixed-transaction snapshot
+        # pair captured after a concurrent op landed carries that op's
+        # seq), and a dict of bare futures would drop the first
+        self.ack_waiters: dict[int, list[asyncio.Future]] = {}
 
     def push(self, msg: dict) -> None:
         if not self.alive:
@@ -740,15 +744,25 @@ class CoordServer:
         return self._install_snapshot(tmp, self._seq, covered,
                                       self._persist_epoch, force=True)
 
-    async def _persist_snapshot_async(self) -> bool:
+    async def _persist_snapshot_async(self) -> tuple | None:
         """The same whole-log-superseding snapshot with serialization +
         write + fsync in a worker thread — used on ack paths (mixed
         transactions, follower resync) so a large tree cannot stall the
         event loop and sever the rest of the ensemble.  Serialized via
-        _persist_lock; True means a snapshot covering our seq is
-        CONFIRMED installed (a successful ack may ride on it)."""
+        _persist_lock; returns the (seq, snapshot) pair captured under
+        the locks — a CONFIRMED-installed consistent view an ack or a
+        replication ship may ride on — or None when the persist failed.
+        Callers that replicate the snapshot must ship THIS pair:
+        re-reading self._seq/tree after the await could pair this
+        mutation's ship with a concurrent later op's seq, colliding
+        with that op's own sync_op on the followers."""
         if not self.data_dir:
-            return True
+            # no persistence configured: the consistent pair is still
+            # what replication callers need (no await between the two
+            # reads, so they are atomic in the event loop)
+            snap = self.tree.to_snapshot()
+            snap["seq"] = self._seq
+            return (self._seq, snap)
         async with self._persist_lock, self._log_lock:
             # BOTH locks for the whole prep→write→install span: the
             # epoch has been bumped but the new-epoch snapshot is not
@@ -766,7 +780,7 @@ class CoordServer:
                     None, self._write_snapshot_tmp, snap)
             except OSError as e:
                 log.error("cannot persist tree snapshot: %s", e)
-                return False
+                return None
             if epoch != self._persist_epoch:
                 # superseded while writing by a SYNCHRONOUS persist
                 # (async ones serialize on the lock).  It has already
@@ -777,9 +791,13 @@ class CoordServer:
                     tmp.unlink()
                 except OSError:
                     pass
-                return self._snap_seq >= snap["seq"]
-            return self._install_snapshot(tmp, snap["seq"], covered,
-                                          epoch, force=True)
+                if self._snap_seq >= snap["seq"]:
+                    return (snap["seq"], snap)
+                return None
+            if self._install_snapshot(tmp, snap["seq"], covered,
+                                      epoch, force=True):
+                return (snap["seq"], snap)
+            return None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -932,9 +950,10 @@ class CoordServer:
             conn.alive = False
             self._conns.discard(conn)
             self._follower_conns.discard(conn)
-            for fut in conn.ack_waiters.values():
-                if not fut.done():
-                    fut.cancel()
+            for futs in conn.ack_waiters.values():
+                for fut in futs:
+                    if not fut.done():
+                        fut.cancel()
             # the session survives the connection; watches don't
             self.tree.remove_watches_for(
                 lambda w: getattr(w, "__owner__", None) is conn)
@@ -960,11 +979,17 @@ class CoordServer:
         try:
             if op == "sync_ack":
                 # follower ack of a replicated op/snapshot: resolve the
-                # waiter, no reply (acks must not generate traffic)
+                # waiters, no reply (acks must not generate traffic).
+                # Acks are CUMULATIVE: ships and acks ride one FIFO
+                # stream with persist-before-ack, so an ack at S proves
+                # a state covering every seq <= S is on the follower's
+                # disk — resolve all of them (a ship's own ack can
+                # arrive after a superseding one already proved it)
                 seq = int(req.get("seq", -1))
-                fut = conn.ack_waiters.pop(seq, None)
-                if fut and not fut.done():
-                    fut.set_result(True)
+                for s in [s for s in conn.ack_waiters if s <= seq]:
+                    for fut in conn.ack_waiters.pop(s):
+                        if not fut.done():
+                            fut.set_result(True)
                 if conn.is_follower and seq >= conn.attached_seq:
                     # the attach snapshot (or something after it) is
                     # durably on the follower's disk: its attach seq
@@ -1009,12 +1034,13 @@ class CoordServer:
                         acks = await self._replicate_op(seq, req,
                                                         result)
                     else:
-                        if not await self._persist_snapshot_async():
+                        pair = await self._persist_snapshot_async()
+                        if pair is None:
                             self._wal_broken = True
                             raise CoordError(
                                 "cannot persist mutation; refusing "
                                 "writes until restart")
-                        acks = await self._replicate_snapshot()
+                        acks = await self._replicate_snapshot(*pair)
                     self._check_commit_quorum(acks)
             conn.push({"xid": xid, "ok": True, "result": result})
         except NotLeaderError as e:
@@ -1236,15 +1262,16 @@ class CoordServer:
             {"sync_op": {"seq": seq, "req": _wire_of(req),
                          "expect": result}}, seq)
 
-    async def _replicate_snapshot(self) -> int:
-        """Ship the full persistent tree (follower attach + the rare
-        mixed-transaction fallback).  Ships the CURRENT tree+seq as a
-        consistent pair — a follower adopting a slightly newer
-        snapshot than this mutation is fine (it supersedes)."""
-        seq = self._seq
+    async def _replicate_snapshot(self, seq: int, snap: dict) -> int:
+        """Ship the full persistent tree (the rare mixed-transaction
+        fallback).  Ships the SAME (seq, snapshot) pair the persist
+        captured under the locks: re-reading self._seq/tree here — the
+        persist await yields to concurrent dispatches — could pair
+        this mutation's ship with a LATER op's seq, which would collide
+        with that op's own sync_op ship (duplicate seq on the stream)
+        and read as a gap on every follower."""
         return await self._ship(
-            {"sync": {"seq": seq,
-                      "snapshot": self.tree.to_snapshot()}}, seq)
+            {"sync": {"seq": seq, "snapshot": snap}}, seq)
 
     async def _ship(self, msg: dict, seq: int) -> int:
         """Push *msg* (carrying the current seq) to every follower and
@@ -1272,7 +1299,7 @@ class CoordServer:
                     acks += 1
                 continue
             fut = loop.create_future()
-            f.ack_waiters[seq] = fut
+            f.ack_waiters.setdefault(seq, []).append(fut)
             f.push(msg)
             waiters.append((f, fut))
         need = self._quorum_needed()
@@ -1316,7 +1343,14 @@ class CoordServer:
                                timeout=remaining)
         for f, fut in waiters:
             if not fut.done():
-                f.ack_waiters.pop(seq, None)
+                futs = f.ack_waiters.get(seq)
+                if futs is not None:
+                    try:
+                        futs.remove(fut)
+                    except ValueError:
+                        pass
+                    if not futs:
+                        del f.ack_waiters[seq]
                 log.warning("follower not acking seq %d; severing", seq)
                 self._follower_conns.discard(f)
                 f.sever()
@@ -1453,6 +1487,12 @@ class CoordServer:
             if not msg.get("ok"):
                 raise CoordError("sync_hello refused: %s" % msg.get("msg"))
             res = msg["result"]
+
+            async def ack(seq: int) -> None:
+                writer.write((json.dumps(
+                    {"op": "sync_ack", "seq": seq}) + "\n").encode())
+                await writer.drain()
+
             # the full resync is authoritative: adopt the leader's tree
             # even if our (possibly divergent) seq is higher, or we
             # would livelock re-resyncing forever
@@ -1461,10 +1501,7 @@ class CoordServer:
                 raise CoordError("cannot persist resynced tree")
             # the attach snapshot is now durably ours: ack it, so the
             # leader may count our attached_seq toward commit quorums
-            writer.write((json.dumps(
-                {"op": "sync_ack", "seq": int(res["seq"])})
-                + "\n").encode())
-            await writer.drain()
+            await ack(int(res["seq"]))
             self.leader_addr = addr
             log.info("following leader %s:%d (seq %d)",
                      addr[0], addr[1], self._seq)
@@ -1478,19 +1515,37 @@ class CoordServer:
                 msg = json.loads(line)
                 if "sync" in msg:
                     s = msg["sync"]
+                    seq = int(s["seq"])
+                    if seq <= self._seq:
+                        # concurrent dispatches on the leader can ship
+                        # a mixed-transaction snapshot pair CAPTURED
+                        # before ops this stream already delivered; our
+                        # state supersedes it (same leader, in-order
+                        # stream — mid-stream our seq only advances via
+                        # these ships) and everything up to our seq is
+                        # already fsynced, so the ack is honest.  Never
+                        # regress the tree onto it.
+                        await ack(seq)
+                        continue
                     # _apply_sync persists (fsynced) before we ack: a
                     # majority-acked write must be on a majority of
                     # DISKS, not page caches — no persist, no ack
-                    if not await self._apply_sync(int(s["seq"]),
-                                                  s["snapshot"]):
+                    if not await self._apply_sync(seq, s["snapshot"]):
                         break
-                    writer.write((json.dumps(
-                        {"op": "sync_ack", "seq": s["seq"]}) + "\n").encode())
-                    await writer.drain()
+                    await ack(seq)
                 elif "sync_op" in msg:
                     s = msg["sync_op"]
                     seq = int(s["seq"])
                     wire = s.get("req")
+                    if wire and seq <= self._seq:
+                        # already covered: a concurrent mixed
+                        # transaction's snapshot ship on this stream
+                        # carried this op's effect (its pair seq can
+                        # land at or past ours) and we persisted it —
+                        # ack-and-skip instead of reading it as a gap
+                        # and resyncing a healthy stream
+                        await ack(seq)
+                        continue
                     if seq != self._seq + 1 or not wire:
                         # gap or malformed ship: never apply-and-log a
                         # bad entry (it would poison our durable log);
@@ -1510,9 +1565,7 @@ class CoordServer:
                     # fsync our log BEFORE acking the leader — our ack
                     # is what lets it count us toward the commit quorum
                     await self._log_append(seq, wire, got)
-                    writer.write((json.dumps(
-                        {"op": "sync_ack", "seq": seq}) + "\n").encode())
-                    await writer.drain()
+                    await ack(seq)
                 elif "sync_ping" in msg:
                     # a HIGHER advertised seq means we missed data:
                     # resync.  A lower one is normal — we may have
@@ -1550,10 +1603,15 @@ class CoordServer:
         self.tree = tree
         self._seq = seq
         self._wire_tree(tree)
+        if not self.data_dir:
+            # memory-only member: nothing to persist, and the pair the
+            # no-data_dir persist branch would build is for replication
+            # callers — an O(tree) walk this path would just discard
+            return True
         # the adopted tree supersedes whatever snapshot+log we held:
         # persist it (fsynced, epoch-bumped) BEFORE the ack — the old
         # log must never replay on top of the new snapshot
-        return await self._persist_snapshot_async()
+        return await self._persist_snapshot_async() is not None
 
 
 def main(argv: list[str] | None = None) -> None:

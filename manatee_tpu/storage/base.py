@@ -118,7 +118,14 @@ class StorageBackend(abc.ABC):
         lib/snapShotter.js:241-248)."""
 
     @abc.abstractmethod
-    async def destroy_snapshot(self, dataset: str, name: str) -> None: ...
+    async def destroy_snapshot(self, dataset: str, name: str) -> None:
+        """MUST be idempotent under absence: the snapshot — or the
+        whole dataset — vanishing between a caller's list and this
+        call means the deletion's goal is achieved, not an error.  The
+        snapshotter's GC runs in a separate process from the sitter's
+        restore path, which isolates/renames datasets at will; a
+        backend that raises on absence feeds the stuck-snapshot alarm
+        spuriously during rebuilds."""
 
     # -- bulk streams (the zfs send/recv data path, §3.3 of SURVEY.md) --
 

@@ -151,7 +151,28 @@ class ZfsBackend(StorageBackend):
         return out
 
     async def destroy_snapshot(self, dataset: str, name: str) -> None:
-        await self._zfs("destroy", "%s@%s" % (dataset, name))
+        """Idempotent under absence (StorageBackend contract): the
+        snapshotter's GC and a sitter's restore run in SEPARATE
+        processes, so a rebuild can isolate/rename the whole dataset —
+        or another pass can destroy this snapshot — between the GC's
+        list and this destroy.  Absence means the deletion's goal is
+        achieved; raising instead fed the stuck-snapshot alarm
+        spuriously (the extended-storm race DirBackend hit; the zfs(8)
+        backend has the same window in production)."""
+        res = await self._zfs("destroy", "%s@%s" % (dataset, name),
+                              check=False)
+        if res.returncode == 0:
+            return
+        err = (res.stderr or "") + (res.stdout or "")
+        # illumos/OpenZFS wordings for the two absence shapes: missing
+        # snapshot ("could not find any snapshots to destroy" or
+        # "snapshot does not exist") vs missing/renamed dataset
+        # ("dataset does not exist")
+        if "does not exist" in err \
+                or "could not find any snapshots" in err:
+            return
+        raise StorageError("cannot destroy snapshot %s@%s: %s"
+                           % (dataset, name, err.strip()))
 
     # ---- bulk streams ----
 

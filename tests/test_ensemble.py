@@ -832,3 +832,102 @@ def test_laggard_cut_off_from_quorum_never_self_promotes(tmp_path):
             for s in servers:
                 await s.stop()
     run(go())
+
+
+def test_concurrent_mixed_txn_and_op_share_stream_without_resync(tmp_path):
+    """Regression: a mixed transaction's snapshot fallback persists in a
+    worker thread; a concurrent persistent op can land (tree applied,
+    seq bumped) during that window.  The snapshot ship must carry the
+    (seq, tree) pair CAPTURED under the persist locks — re-reading
+    self._seq at replicate time paired the transaction's ship with the
+    concurrent op's seq, which collided with that op's own sync_op on
+    every follower (duplicate seq read as a gap -> full resync of a
+    healthy stream) and clobbered the op's ack waiter (spurious
+    no-quorum failure of a committed write + laggard-sever of a live
+    follower)."""
+    import threading
+
+    from manatee_tpu.coord.api import Op
+
+    async def go():
+        dirs = [str(tmp_path / ("m%d" % i)) for i in range(3)]
+        servers, members = await start_ensemble(data_dirs=dirs)
+        try:
+            leader = servers[0]
+            assert await wait_leader_with_quorum(leader, 2)
+            c = NetCoord(connstr(members), session_timeout=5)
+            await c.connect()
+            await c.mkdirp("/el")
+            eph = await c.create("/el/e-", b"x", ephemeral=True,
+                                 sequential=True)
+            await c.create("/state", b"s0")
+            await c.create("/other", b"o0")
+
+            # any follower resync after setup shows up as a fresh
+            # sync_hello on the leader
+            resyncs = 0
+            orig_hello = leader._op_sync_hello
+
+            def counting_hello(conn, req):
+                nonlocal resyncs
+                resyncs += 1
+                return orig_hello(conn, req)
+
+            leader._op_sync_hello = counting_hello
+
+            # gate the leader's snapshot write so the concurrent op
+            # deterministically lands inside the persist window
+            entered = threading.Event()
+            release = threading.Event()
+            orig_write = leader._write_snapshot_tmp
+
+            def gated_write(snap):
+                entered.set()
+                release.wait(5)
+                return orig_write(snap)
+
+            leader._write_snapshot_tmp = gated_write
+
+            c2 = NetCoord(connstr(members), session_timeout=5)
+            await c2.connect()
+
+            # the mixed transaction (deletes an ephemeral -> snapshot
+            # replication) blocks inside the gated snapshot write...
+            t_txn = asyncio.ensure_future(c.multi([
+                Op.set("/state", b"s1", 0),
+                Op.delete(eph),
+            ]))
+            loop = asyncio.get_running_loop()
+            assert await loop.run_in_executor(None, entered.wait, 5)
+            # ...while a plain persistent op applies and bumps the seq,
+            # then queues on the log lock the persist holds
+            t_set = asyncio.ensure_future(c2.set("/other", b"o1", 0))
+            await asyncio.sleep(0.2)
+            leader._write_snapshot_tmp = orig_write
+            release.set()
+
+            # both writes commit -- no spurious no-quorum
+            res = await asyncio.wait_for(t_txn, 10)
+            assert res[0] == 1
+            assert await asyncio.wait_for(t_set, 10) == 1
+
+            def consistent():
+                try:
+                    return all(s.tree.get("/state") == (b"s1", 1)
+                               and s.tree.get("/other") == (b"o1", 1)
+                               and s._seq == leader._seq
+                               for s in servers)
+                except CoordError:
+                    return False
+
+            assert await wait_for(consistent), "followers diverged"
+            # a forced resync would reconnect within a tick or two
+            await asyncio.sleep(0.5)
+            assert resyncs == 0, \
+                "healthy follower stream was forced to resync"
+            await c.close()
+            await c2.close()
+        finally:
+            for s in servers:
+                await s.stop()
+    run(go())

@@ -147,6 +147,38 @@ def test_snapshots_and_backup_filter(tmp_path):
     run(go())
 
 
+def test_destroy_snapshot_idempotent_under_absence(tmp_path):
+    """StorageBackend contract: the GC daemon races sitter rebuilds in
+    another process, so the snapshot — or the whole dataset — can
+    vanish between list and destroy; absence is success, anything else
+    still raises (a permission error must not read as 'deleted')."""
+    async def go():
+        cmd, root = make_zfs_shim(tmp_path)
+        be = ZfsBackend(zfs_cmd=cmd)
+        await be.create("pg")
+        await be.snapshot("pg", "1700000000001")
+
+        # snapshot already gone
+        await be.destroy_snapshot("pg", "1700000000099")
+        # whole dataset renamed away mid-GC (the rebuild race)
+        await be.destroy_snapshot("gone-ds", "1700000000001")
+        # the real one still deletes
+        await be.destroy_snapshot("pg", "1700000000001")
+        assert await be.list_snapshots("pg") == []
+
+        # a non-absence failure still surfaces
+        async def fail_zfs(*args, check=True):
+            class R:
+                returncode = 1
+                stderr = "cannot destroy 'pg@x': permission denied"
+                stdout = ""
+            return R()
+        be._zfs = fail_zfs
+        with pytest.raises(StorageError):
+            await be.destroy_snapshot("pg", "x")
+    run(go())
+
+
 @pytest.mark.parametrize("native_on", [False, True],
                          ids=["python", "native"])
 def test_send_recv_roundtrip_with_progress(tmp_path, monkeypatch,
