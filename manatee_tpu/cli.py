@@ -622,6 +622,17 @@ def cmd_rebuild(args) -> int:
                                 job_key not in failed_attempts:
                             failed_attempts.add(job_key)
                             failures += 1
+                            if failures >= RESTORE_RETRIES:
+                                # no "0 attempts remaining" tease: the
+                                # final failure IS the abort — but its
+                                # error detail must not be dropped
+                                die("restore failed %d times (last: "
+                                    "%s); giving up — investigate the "
+                                    "upstream's backup server and "
+                                    "storage before retrying"
+                                    % (failures,
+                                       job.get("error",
+                                               "unknown error")))
                             remaining = RESTORE_RETRIES - failures
                             print("warning: restore attempt failed "
                                   "(%s); %d attempt%s remaining"
@@ -629,11 +640,6 @@ def cmd_rebuild(args) -> int:
                                      remaining,
                                      "" if remaining == 1 else "s"),
                                   file=sys.stderr)
-                            if failures >= RESTORE_RETRIES:
-                                die("restore failed %d times; giving "
-                                    "up — investigate the upstream's "
-                                    "backup server and storage before "
-                                    "retrying" % failures)
                         async with http.get(
                                 status + "/ping",
                                 timeout=aiohttp.ClientTimeout(

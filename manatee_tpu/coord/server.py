@@ -416,17 +416,32 @@ class CoordServer:
         if path.exists():
             try:
                 snap = json.loads(path.read_text())
+                if not isinstance(snap, dict) or snap.get("v") != 1 \
+                        or "root" not in snap \
+                        or "seq" not in snap or "epoch" not in snap:
+                    # from_snapshot is lenient (it returns an EMPTY
+                    # tree for an unrecognized shape — right for wire
+                    # adoption, catastrophic here: an empty tree with
+                    # epoch 0 deletes the log segments as stale).
+                    # seq/epoch are load-bearing for the same reason —
+                    # a v1+root snapshot MISSING them would default
+                    # the epoch to 0 and delete the real-epoch
+                    # segments as stale
+                    raise ValueError("unrecognized snapshot shape")
                 tree = model.ZNodeTree.from_snapshot(snap)
                 self._seq = int(snap.get("seq", 0))
                 self._persist_epoch = int(snap.get("epoch", 0))
                 log.info("loaded coordination tree from %s (seq %d, "
                          "epoch %d)", path, self._seq,
                          self._persist_epoch)
-            except (ValueError, OSError) as e:
-                # starting empty here would reset the epoch to 0 and
-                # DELETE the log segments (the one artifact an operator
-                # could recover from) as stale — refuse instead, like
-                # any other acked-write-losing malformation
+            except Exception as e:
+                # ANY malformation — bad JSON/IO (ValueError/OSError)
+                # or valid JSON of the wrong shape (KeyError/TypeError
+                # out of from_snapshot).  Starting empty here would
+                # reset the epoch to 0 and DELETE the log segments
+                # (the one artifact an operator could recover from) as
+                # stale — refuse instead, like any other
+                # acked-write-losing malformation
                 raise RuntimeError(
                     "tree snapshot %s exists but cannot be loaded "
                     "(%s); refusing to start — restore the member or "
@@ -1288,6 +1303,7 @@ class CoordServer:
         loop = asyncio.get_running_loop()
         waiters: list[tuple[_Conn, asyncio.Future]] = []
         acks = 0
+        attach_pending = set()
         for f in list(self._follower_conns):
             if f.attached_seq >= seq:
                 # its attach snapshot already carried this op, so
@@ -1297,6 +1313,18 @@ class CoordServer:
                 # received a byte of it.
                 if f.attach_acked:
                     acks += 1
+                    continue
+                # attach in flight: push nothing, but DO register a
+                # waiter — the cumulative sync_ack for the attach
+                # snapshot (seq >= attached_seq >= our seq) resolves
+                # it.  Skipping instead failed writes issued in the
+                # attach window with a spurious no-quorum (e.g. right
+                # after a blackout restart, both followers mid-attach)
+                # and silently dropped 2-member wait-for-all semantics.
+                attach_pending.add(f)
+                fut = loop.create_future()
+                f.ack_waiters.setdefault(seq, []).append(fut)
+                waiters.append((f, fut))
                 continue
             fut = loop.create_future()
             f.ack_waiters.setdefault(seq, []).append(fut)
@@ -1322,7 +1350,13 @@ class CoordServer:
             acks += sum(1 for d in done if not d.cancelled())
             if acks >= need_f:
                 break
-        laggards = [(f, fut) for f, fut in waiters if not fut.done()]
+        # attach-pending conns were never pushed this ship: a slow
+        # big-tree attach must not be severed as a laggard here (its
+        # own stream timeouts catch a dead attach); its waiter simply
+        # resolves on the eventual attach ack or is cancelled with the
+        # connection
+        laggards = [(f, fut) for f, fut in waiters
+                    if not fut.done() and f not in attach_pending]
         if laggards:
             # strong refs: the loop holds tasks weakly and a GC'd
             # reaper would leave hung followers connected forever

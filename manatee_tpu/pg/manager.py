@@ -36,7 +36,7 @@ from pathlib import Path
 from typing import Awaitable, Callable
 
 from manatee_tpu.health.telemetry import STATUS_EVERY
-from manatee_tpu.pg.engine import Engine, PgError
+from manatee_tpu.pg.engine import Engine, PgError, parse_pg_url
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
 
@@ -435,23 +435,52 @@ class PostgresMgr:
             self._repoint_task = asyncio.ensure_future(
                 self._repoint_watchdog(pgcfg))
 
+    async def _upstream_reachable(self, upstream: dict) -> bool:
+        """Cheap TCP probe of the upstream database port: separates
+        'reachable but refuses our stream' (divergence — restore is
+        the right escalation) from 'temporarily unreachable' (outage —
+        wait, like the walreceiver itself would)."""
+        try:
+            _scheme, host, port = parse_pg_url(upstream["pgUrl"])
+        except Exception:
+            return True        # unparseable: fail open (old behavior)
+        try:
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(host, port), 2.0)
+        except (OSError, asyncio.TimeoutError):
+            return False
+        w.close()
+        return True
+
     async def _repoint_watchdog(self, pgcfg: dict) -> None:
         """After a standby transition on a real-postgres engine, verify
         the walreceiver actually attaches to the NEW upstream: a
         refused stream (divergence) leaves the server running and
         retrying forever, looking healthy in recovery while the
         restore path never triggers (ADVICE r4).  No attachment AND no
-        recovery progress within replicationTimeout ⇒ force the full
-        restore path.  Progress (the replay position advancing — e.g.
-        a returning standby chewing through a local pg_wal backlog
-        before it ever connects) extends the deadline, exactly like
-        the catchup loop's no-PROGRESS semantics: a healthy replaying
-        standby must never be wiped for being slow."""
+        recovery progress within replicationTimeout — while the
+        upstream is REACHABLE — ⇒ force the full restore path.
+
+        Two things extend the deadline, exactly like the catchup
+        loop's no-PROGRESS semantics (a healthy standby must never be
+        wiped for waiting):
+
+        - the REPLAY position advancing — e.g. a returning standby
+          chewing through a local pg_wal backlog before its
+          walreceiver ever starts (during which receive_lsn is NULL:
+          progress must be read from replay, not receive);
+        - the upstream being unreachable — an outage is
+          indistinguishable from divergence at the walreceiver level
+          (pg_stat_wal_receiver is empty either way), and a real
+          walreceiver just keeps retrying an outage; wiping the local
+          dataset to restore from a peer that is down only
+          crash-loops.  Only reachable-but-never-attached counts
+          toward the divergence verdict."""
         upstream = pgcfg["upstream"]
         poll = max(0.2, float(self.cfg["replPollInterval"]))
         repl_timeout = float(self.cfg["replicationTimeout"])
         deadline = time.monotonic() + repl_timeout
-        last_xlog: str | None = None
+        last_replay: str | None = None
         while not self._closed and time.monotonic() < deadline:
             try:
                 if await self.engine.upstream_attached(
@@ -459,21 +488,31 @@ class PostgresMgr:
                     return
             except PgError:
                 pass
+            progressed = False
             try:
                 res = await self._local_query({"op": "status"}, 5.0)
-                xlog = res.get("xlog_location")
-                if xlog is not None and xlog != last_xlog:
-                    if last_xlog is not None:
+                replay = res.get("replay_location") \
+                    or res.get("xlog_location")
+                if replay is not None and replay != last_replay:
+                    if last_replay is not None:
+                        progressed = True
                         deadline = time.monotonic() + repl_timeout
-                    last_xlog = xlog
+                    last_replay = replay
             except PgError:
                 pass
+            # only probe when this iteration saw neither attachment nor
+            # replay progress — the only case where the unreachable
+            # extension matters (every probe forks a real backend on
+            # the upstream just to see EOF)
+            if not progressed \
+                    and not await self._upstream_reachable(upstream):
+                deadline = time.monotonic() + repl_timeout
             await asyncio.sleep(poll)
         if self._closed:
             return
-        log.warning("%s: standby never attached to %s (and made no "
-                    "recovery progress); forcing the restore path",
-                    self.peer_id, upstream.get("id"))
+        log.warning("%s: standby never attached to reachable upstream "
+                    "%s (and made no recovery progress); forcing the "
+                    "restore path", self.peer_id, upstream.get("id"))
         async with self._reconf_lock:
             # only if the topology has not moved on meanwhile
             if self._applied is not pgcfg or self._closed:

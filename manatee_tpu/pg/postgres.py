@@ -372,6 +372,14 @@ class PostgresEngine(Engine):
             xlog_sql = ("SELECT CASE WHEN pg_is_in_recovery() "
                         "THEN %s ELSE %s END;"
                         % (w["receive"], w["current"]))
+            # the REPLAY position, separately: receive_lsn is NULL for
+            # the whole local-pg_wal replay a restarting standby does
+            # before its walreceiver ever starts, so "is recovery
+            # making progress" (the re-point watchdog's question) must
+            # read replay, not receive
+            replay_sql = ("SELECT CASE WHEN pg_is_in_recovery() "
+                          "THEN %s ELSE %s END;"
+                          % (w["replay"], w["current"]))
             # a fully-caught-up standby reports 0 regardless of how
             # long the cluster has been idle: bare
             # now() - pg_last_xact_replay_timestamp() reads as
@@ -401,13 +409,15 @@ class PostgresEngine(Engine):
             ro_sql = "SHOW default_transaction_read_only;"
             sec = await self._psql_sections(
                 host, port,
-                [in_rec_sql, xlog_sql, lag_sql, repl_sql, ro_sql],
+                [in_rec_sql, xlog_sql, replay_sql, lag_sql, repl_sql,
+                 ro_sql],
                 timeout)
             in_rec = sec[0].strip() == "t"
             xlog = sec[1].strip()
-            lag = sec[2].strip()
+            replay = sec[2].strip()
+            lag = sec[3].strip()
             lag_s = float(lag) if in_rec and lag else None
-            rows = sec[3]
+            rows = sec[4]
             repl = []
             for line in rows.splitlines():
                 if not line.strip():
@@ -419,10 +429,11 @@ class PostgresEngine(Engine):
                     "flush_lsn": f[4], "replay_lsn": f[5],
                     "sync_state": f[6],
                 })
-            ro = sec[4].strip() == "on"
+            ro = sec[5].strip() == "on"
             return {"ok": True, "in_recovery": in_rec,
                     "read_only": in_rec or ro,
                     "xlog_location": xlog or "0/0000000",
+                    "replay_location": replay or "0/0000000",
                     "replication": repl, "replay_lag_seconds": lag_s,
                     "version": self.version}
         if kind == "insert":

@@ -420,6 +420,8 @@ class SimPgServer:
                 "in_recovery": self.in_recovery,
                 "read_only": self.read_only,
                 "xlog_location": lsn_str(self.wal.last_lsn),
+                # the sim applies WAL synchronously: replay == receive
+                "replay_location": lsn_str(self.wal.last_lsn),
                 "replication": repl,
                 # caught-up standbys report 0 however long the cluster
                 # has been idle; a severed upstream link reports time

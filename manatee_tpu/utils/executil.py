@@ -22,6 +22,32 @@ log = logging.getLogger("manatee.exec")
 MAX_OUTPUT_BYTES = 2 * 1024 * 1024
 
 _run_ids = itertools.count(1)
+# strong refs to shielded kill/reap cleanups: the loop holds tasks
+# weakly, and a GC'd cleanup would leak the very child it reaps
+_cleanup_tasks: set = set()
+
+
+async def _kill_and_reap(proc, tasks) -> None:
+    """Kill the child and reap it, guaranteed: a cancellation landing
+    during the cleanup awaits (e.g. reconfigure cancels the watchdog,
+    then close() cancels it again, or a timeout handler's caller is
+    cancelled) must not skip the kill/reap — that is exactly the
+    orphan these handlers exist to close.  The work runs in a
+    shielded, strongly-referenced task so the reap completes even if
+    the caller's await is cut (it then finishes detached and a
+    CancelledError propagates to the caller — correct in both the
+    cancel and the timeout branches)."""
+    for t in tasks:
+        t.cancel()
+
+    async def _cleanup() -> None:
+        await asyncio.gather(*tasks, return_exceptions=True)
+        await reap_killed(proc)
+
+    cleanup = asyncio.ensure_future(_cleanup())
+    _cleanup_tasks.add(cleanup)
+    cleanup.add_done_callback(_cleanup_tasks.discard)
+    await asyncio.shield(cleanup)
 
 
 @dataclass
@@ -151,17 +177,6 @@ async def run(
         asyncio.ensure_future(_pump_stdin(proc, stdin_data)),
     ]
 
-    async def _discard(stream: asyncio.StreamReader) -> None:
-        # Process.wait() only resolves once every pipe transport reaches
-        # EOF (asyncio wakes exit waiters from _call_connection_lost, gated
-        # on all pipes being disconnected) — so after killing the child we
-        # must still drain its pipes or wait() deadlocks.
-        try:
-            while await stream.read(65536):
-                pass
-        except Exception:
-            pass
-
     try:
         out, err, _ = await asyncio.wait_for(
             asyncio.gather(*tasks), timeout=timeout
@@ -171,23 +186,10 @@ async def run(
         # the CALLER was cancelled (a watchdog/reconfigure racing this
         # exec): the child must not be orphaned — kill and reap it,
         # then let the cancellation propagate
-        for t in tasks:
-            t.cancel()
-        await asyncio.gather(*tasks, return_exceptions=True)
-        try:
-            proc.kill()
-        except ProcessLookupError:
-            pass
-        await asyncio.gather(_discard(proc.stdout), _discard(proc.stderr))
-        await proc.wait()
+        await _kill_and_reap(proc, tasks)
         raise
     except (asyncio.TimeoutError, OutputLimitExceeded) as e:
-        for t in tasks:
-            t.cancel()
-        await asyncio.gather(*tasks, return_exceptions=True)
-        proc.kill()
-        await asyncio.gather(_discard(proc.stdout), _discard(proc.stderr))
-        await proc.wait()
+        await _kill_and_reap(proc, tasks)
         why = ("timeout after %ss" % timeout
                if isinstance(e, asyncio.TimeoutError)
                else "output exceeded %d bytes" % max_output)

@@ -517,14 +517,24 @@ def test_corrupt_snapshot_refuses_to_start(tmp_path):
         await crash(server)
 
         snap = tmp_path / "coordd-tree.json"
-        snap.write_text(snap.read_text()[:40])     # bitrot
-
+        good = snap.read_text()
         n_segments = len(list(tmp_path.glob("coordd-oplog-*.jsonl")))
-        with pytest.raises(RuntimeError, match="refusing to start"):
-            CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
-        # and it preserved the segments for the operator
-        assert len(list(tmp_path.glob("coordd-oplog-*.jsonl"))) \
-            == n_segments
+        # bad JSON, and VALID json of the wrong shape — from_snapshot
+        # is lenient and would silently yield an EMPTY tree for the
+        # latter (epoch 0 -> segments deleted as stale), so _load_tree
+        # must validate the shape itself (code-review r5 high)
+        for bad in (good[:40], "{}", "[]", '{"v": 2, "root": {}}',
+                    '{"v": 1}', "null",
+                    # v1+root but MISSING seq/epoch: loading would
+                    # default the epoch to 0 and delete the
+                    # real-epoch segments as stale
+                    '{"v": 1, "root": {}}'):
+            snap.write_text(bad)
+            with pytest.raises(RuntimeError, match="refusing to start"):
+                CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+            # and it preserved the segments for the operator
+            assert len(list(tmp_path.glob("coordd-oplog-*.jsonl"))) \
+                == n_segments
     run(go())
 
 

@@ -223,6 +223,7 @@ def test_cold_start_elects_highest_seq_not_lowest_id(tmp_path):
         for i, (tree, seq) in enumerate([(old, 3), (old, 3), (new, 5)]):
             snap = tree.to_snapshot()
             snap["seq"] = seq
+            snap["epoch"] = 0   # real writers always stamp both
             (dirs[i] / "coordd-tree.json").write_text(_json.dumps(snap))
         servers, members = await start_ensemble(
             data_dirs=[str(d) for d in dirs])
@@ -929,5 +930,89 @@ def test_concurrent_mixed_txn_and_op_share_stream_without_resync(tmp_path):
             await c2.close()
         finally:
             for s in servers:
+                await s.stop()
+    run(go())
+
+
+def test_write_committed_via_attach_window_follower(tmp_path):
+    """Regression (code-review r5 high): a follower whose attach
+    snapshot already covers a write (attached_seq >= seq) but has not
+    yet acked it was SKIPPED by _ship without registering a waiter —
+    a write issued in the attach window failed with a spurious
+    'no quorum' even though the attach snapshot carrying it was acked
+    milliseconds later.  Construct the window deterministically: park
+    a write between its seq bump and its ship (gated log fsync), have
+    a fresh follower attach during the park (its snapshot covers the
+    write; its attach persist gated too), sever the old follower, then
+    release both gates — the commit must ride the attach ack."""
+    import threading
+
+    async def go():
+        dirs = [str(tmp_path / ("m%d" % i)) for i in range(3)]
+        ports = free_ports(3)
+        members = [("127.0.0.1", p) for p in ports]
+
+        def mk(i):
+            return CoordServer("127.0.0.1", ports[i], tick=0.05,
+                               ensemble=members, ensemble_id=i,
+                               promote_grace=0.3, data_dir=dirs[i])
+
+        s0, s1, s2 = mk(0), mk(1), mk(2)
+        await s0.start()
+        await s1.start()
+        try:
+            assert await wait_leader_with_quorum(s0, 1)
+            c = NetCoord(connstr(members[:1]), session_timeout=5)
+            await c.connect()
+
+            # park the next mutation between seq bump and ship
+            gate = asyncio.Event()
+            orig_fsync = s0._log_fsync
+
+            async def gated_fsync(gen, target):
+                await gate.wait()
+                await orig_fsync(gen, target)
+
+            s0._log_fsync = gated_fsync
+
+            # gate the fresh follower's attach persist so it is
+            # attach-PENDING (registered, snapshot in flight, not yet
+            # acked) when the ship runs
+            f_release = threading.Event()
+            orig_write = s2._write_snapshot_tmp
+
+            def gated_write(snap):
+                f_release.wait(5)
+                return orig_write(snap)
+
+            s2._write_snapshot_tmp = gated_write
+
+            t_w = asyncio.ensure_future(c.create("/attach-window", b"w"))
+            await asyncio.sleep(0.2)       # parked at the gated fsync
+            assert not t_w.done()
+
+            # the old follower dies; the fresh one attaches NOW — its
+            # snapshot covers the parked write's seq
+            await s1.stop()
+            await s2.start()
+            assert await wait_for(
+                lambda: any(f.follower_id == 2 and not f.attach_acked
+                            for f in s0._follower_conns), 5)
+
+            s0._log_fsync = orig_fsync
+            gate.set()                     # ship runs: f2 attach-pending
+            await asyncio.sleep(0.1)
+            f_release.set()                # attach persist completes, acks
+
+            # the write commits on the attach ack — no spurious
+            # no-quorum, no laggard-sever of the attaching follower
+            await asyncio.wait_for(t_w, 10)
+            assert await wait_for(
+                lambda: s2.tree.exists("/attach-window") is not None, 5)
+            assert any(f.follower_id == 2 for f in s0._follower_conns), \
+                "attaching follower was severed as a laggard"
+            await c.close()
+        finally:
+            for s in (s0, s1, s2):
                 await s.stop()
     run(go())

@@ -240,6 +240,7 @@ def test_status_parsing_golden(tmp_path):
                 "in_recovery": False,
                 "read_only": False,
                 "xlog_location": "0/5000100",
+                "replay_location": "0/5000100",
                 "replication": [
                     {"application_name": "peerA", "state": "streaming",
                      "sent_lsn": "0/5000100", "write_lsn": "0/5000100",
@@ -716,6 +717,109 @@ def test_boot_path_watchdog_catches_lingering_diverged_standby(tmp_path):
                 raise AssertionError(
                     "boot-path watchdog never forced the restore "
                     "(events=%r)" % events)
+        finally:
+            await standby.close()
+            await prim_a.close()
+            await prim_b.close()
+    run(go())
+
+
+def test_repoint_watchdog_waits_out_unreachable_upstream(tmp_path):
+    """code-review r5 (high): pg_stat_wal_receiver is empty both when
+    the upstream REFUSES our stream (divergence — restore is right)
+    and when the upstream is simply DOWN (outage — a real walreceiver
+    just keeps retrying).  The watchdog must not wipe a healthy local
+    dataset to restore from a peer that is unreachable: only a
+    reachable-but-never-attached upstream counts toward the
+    divergence verdict."""
+    import shutil
+
+    async def go():
+        prim_a = make_mgr(tmp_path, "prima", version="13.0",
+                          singleton=True)
+        # constructed but NOT started: its port is allocated (so the
+        # topology can name it) yet nothing listens — an outage
+        prim_b = make_mgr(tmp_path, "primb", version="13.0",
+                          singleton=True)
+        standby = make_mgr(tmp_path, "stand", version="13.0",
+                           replicationTimeout=1.5)
+        events = []
+        standby.on("restoreStart", lambda up: events.append("start"))
+        standby.on("restoreDone", lambda up: events.append("done"))
+
+        async def restore(upstream):
+            src = prim_a if upstream["id"] == prim_a.peer_id else prim_b
+            d = Path(standby.datadir)
+            if d.exists():
+                shutil.rmtree(d)
+            shutil.copytree(src.datadir, d)
+            (d / "fake_linger_on_refusal").touch()
+        standby.restore_fn = restore
+
+        def up_of(mgr):
+            return {"id": mgr.peer_id,
+                    "pgUrl": "tcp://%s:%d" % (mgr.host, mgr.port),
+                    "backupUrl": "http://127.0.0.1:1"}
+
+        try:
+            await prim_a.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            # advance A so a standby of A is DIVERGED (ahead) relative
+            # to a freshly-initdb'd B — B must REFUSE its stream once
+            # the outage ends, or the escalation phase below would
+            # just attach
+            for i in range(3):
+                await prim_a._local_query(
+                    {"op": "insert", "value": "a%d" % i})
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_a),
+                                       "downstream": None})
+            await wait_online(standby)
+            assert events == ["start", "done"]
+            events.clear()
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if await attached_quietly(standby, up_of(prim_a)):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("standby never attached to A")
+
+            # live re-point to the DOWN primary B: the walreceiver
+            # retries, the watchdog arms — and must keep waiting
+            pid_before = standby._proc.pid
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_b),
+                                       "downstream": None})
+            assert standby._proc.pid == pid_before   # fast path taken
+            assert standby._repoint_task is not None
+
+            # well past replicationTimeout (1.5s): no restore, no wipe,
+            # database still alive in recovery
+            await asyncio.sleep(4.5)
+            assert events == [], \
+                "watchdog wiped a standby over an upstream OUTAGE"
+            assert standby.running
+            assert standby._proc.pid == pid_before
+
+            # the outage ends — B comes up as a fresh (empty) primary
+            # that REFUSES the diverged standby's stream: NOW the
+            # watchdog escalates to the restore path, from B
+            await prim_b.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline:
+                if events == ["start", "done"] and standby.running \
+                        and await attached_quietly(standby,
+                                                   up_of(prim_b)):
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise AssertionError(
+                    "watchdog never escalated once the upstream "
+                    "became reachable (events=%r)" % events)
         finally:
             await standby.close()
             await prim_a.close()

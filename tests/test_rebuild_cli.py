@@ -150,6 +150,8 @@ def test_rebuild_aborts_after_repeated_restore_failures(tmp_path):
             assert "4 attempts remaining" in err
             assert "1 attempt remaining" in err
             assert "restore failed 5 times" in err
+            # the final failure is the abort, not a "0 remaining" tease
+            assert "0 attempts remaining" not in err
             assert "timed out" not in err
         finally:
             await runner.cleanup()
