@@ -396,12 +396,11 @@ class AdmClient:
 
     # -- cluster details --
 
-    async def legacy_state(self, shard: str) -> dict:
-        """Topology under v1 semantics (lib/adm.js:226-337): derived
-        from the election-node order — first member primary, second
-        sync, the rest asyncs — instead of the persistent cluster
-        state.  The `status -l` view for diagnosing a cluster whose
-        state object is missing or disputed."""
+    async def _election_topology(self, shard: str) -> tuple:
+        """v1 semantics (lib/adm.js:226-337): the election-node order
+        IS the daisy chain — first member primary, second sync, the
+        rest asyncs.  Shared by `status -l` and `state-backfill` (the
+        latter applies the _rearrangeState shift on top)."""
         actives = await self.get_active(shard)
         if not actives:
             raise AdmError("no active peers in shard %s" % shard)
@@ -413,11 +412,20 @@ class AdmClient:
             d.setdefault("zoneId", a["id"])
             return d
 
+        return (info(actives[0]),
+                info(actives[1]) if len(actives) > 1 else None,
+                [info(a) for a in actives[2:]])
+
+    async def legacy_state(self, shard: str) -> dict:
+        """Topology under v1 semantics, instead of the persistent
+        cluster state.  The `status -l` view for diagnosing a cluster
+        whose state object is missing or disputed."""
+        primary, sync, asyncs = await self._election_topology(shard)
         return {
             "generation": None,
-            "primary": info(actives[0]),
-            "sync": info(actives[1]) if len(actives) > 1 else None,
-            "async": [info(a) for a in actives[2:]],
+            "primary": primary,
+            "sync": sync,
+            "async": asyncs,
             "deposed": [],
         }
 
@@ -639,19 +647,7 @@ class AdmClient:
         if precomputed is not None:
             new = precomputed
         else:
-            actives = await self.get_active(shard)
-            if not actives:
-                raise AdmError("no active peers in shard %s" % shard)
-            actives.sort(key=lambda a: a["seq"])
-
-            def info(a):
-                d = {"id": a["id"]}
-                d.update(a.get("data") or {})
-                d.setdefault("zoneId", a["id"])
-                return d
-
-            sync = info(actives[1]) if len(actives) > 1 else None
-            asyncs = [info(a) for a in actives[2:]]
+            primary, sync, asyncs = await self._election_topology(shard)
             # _rearrangeState parity (lib/adm.js:1251-1259): v1
             # election order named the daisy chain head-first, but the
             # backfilled v2 sync is the LAST async, with the old sync
@@ -664,7 +660,7 @@ class AdmClient:
             new = {
                 "generation": 0,
                 "initWal": "0/0000000",
-                "primary": info(actives[0]),
+                "primary": primary,
                 "sync": sync,
                 "async": asyncs,
                 "deposed": [],

@@ -62,18 +62,45 @@ def spawn_victim(marker: str) -> subprocess.Popen:
 def wait_cluster_up(proc: subprocess.Popen, marker: str,
                     timeout: float = 90.0) -> None:
     """Block until the victim printed its sentinel and a real cluster
-    (coordd + sitters + backupservers ≥ 5 marked processes) is live."""
+    (coordd + sitters + backupservers ≥ 5 marked processes) is live.
+
+    The sentinel is read through a pump thread: a bare readline() on
+    the pipe would re-check the deadline only BETWEEN lines, so a
+    victim that wedges silently (alive, no output) would hang the
+    whole suite instead of failing the assertion (code-review r5).
+    The pump also keeps draining afterwards, so a chatty victim can
+    never block on a full pipe."""
+    import queue
+    import threading
+
     deadline = time.monotonic() + timeout
-    line = ""
+    lines: queue.Queue = queue.Queue()
+
+    def pump():
+        for ln in proc.stdout:
+            lines.put(ln)
+        lines.put(None)                      # EOF
+
+    threading.Thread(target=pump, daemon=True).start()
+    seen: list[str] = []
     while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if "VICTIM_CLUSTER_UP" in line:
+        try:
+            ln = lines.get(timeout=min(
+                1.0, max(0.05, deadline - time.monotonic())))
+        except queue.Empty:
+            if proc.poll() is not None:
+                raise AssertionError("victim died early:\n"
+                                     + "".join(seen))
+            continue
+        if ln is None:
+            raise AssertionError("victim died early:\n" + "".join(seen))
+        seen.append(ln)
+        if "VICTIM_CLUSTER_UP" in ln:
             break
-        if proc.poll() is not None:
-            raise AssertionError("victim died early:\n"
-                                 + proc.stdout.read())
     else:
-        raise AssertionError("victim never reported cluster up")
+        raise AssertionError(
+            "victim never reported cluster up (wedged silent after:\n"
+            + "".join(seen[-20:]) + ")")
     while time.monotonic() < deadline:
         if len(reaper.living(marker)) >= 5:
             return
