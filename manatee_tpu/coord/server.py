@@ -205,6 +205,12 @@ class _Conn:
         self.alive = True
         self.is_follower = False
         self.follower_id: int | None = None
+        # True while a request from this connection is being
+        # dispatched: requests are served serially per connection, so
+        # a mutation awaiting its replication fault budget blocks the
+        # client's queued heartbeats — that silence is OURS, not the
+        # client's, and must not heartbeat-expire its session
+        self.in_dispatch = False
         # seq the follower's attach snapshot covered: ops at or below
         # it must not be re-shipped (the follower would see them as
         # gaps).  They count toward commit quorum only once the
@@ -921,10 +927,21 @@ class CoordServer:
 
     def _expire_due_sessions(self) -> None:
         for sid in self.tree.expired_sessions():
+            conn = self._session_conns.get(sid)
+            if conn is not None and conn.in_dispatch:
+                # the client is silent because WE are: its request is
+                # mid-dispatch (e.g. a mutation waiting out the
+                # replication fault budget) and its queued heartbeats
+                # sit unread behind it.  Expiring a live client here
+                # would delete its election ephemeral and trigger a
+                # spurious failover; refresh it instead — its queued
+                # pings take over as soon as the dispatch returns.
+                self.tree.touch_session(sid)
+                continue
             log.info("session %s expired", sid)
             self.tree.expire_session(sid)
             self.tree.sessions.pop(sid, None)
-            conn = self._session_conns.pop(sid, None)
+            self._session_conns.pop(sid, None)
             if conn is not None:
                 # hung-but-connected client: sever the socket so it
                 # observes expiry instead of lingering half-alive
@@ -956,7 +973,11 @@ class CoordServer:
                     conn.push({"ok": False, "error": "CoordError",
                                "msg": "bad json"})
                     continue
-                await self._dispatch(conn, req)
+                conn.in_dispatch = True
+                try:
+                    await self._dispatch(conn, req)
+                finally:
+                    conn.in_dispatch = False
                 try:
                     await writer.drain()
                 except (ConnectionError, RuntimeError):

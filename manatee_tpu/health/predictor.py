@@ -165,4 +165,21 @@ def synthetic_batch(key: jax.Array, batch: int
     carried = carried.swapaxes(0, 1)                  # [batch, W, 2]
     windows = windows.at[..., 2].set(carried[..., 0])
     windows = windows.at[..., 3].set(carried[..., 1])
+
+    # Restart masking: the deployed ring starts scoring at window//2
+    # ticks (telemetry.ready), zero-padding the OLD end
+    # (window_array) — so for the first half-window after every
+    # sitter/database restart the scorer sees leading all-zero rows.
+    # Train on that shape too (leading zeros on a random ~third of
+    # windows, pad length up to the ready() minimum) or those ticks
+    # are scored on a distribution the model never saw, exactly when
+    # spurious "degrading" notices are most misleading
+    # (code-review r5).
+    k7 = jax.random.fold_in(k1, 11)
+    k8 = jax.random.fold_in(k1, 13)
+    pad_on = jax.random.uniform(k7, (batch, 1)) < 0.35
+    pad_len = jax.random.randint(k8, (batch, 1), 1,
+                                 WINDOW - WINDOW // 2 + 1)
+    keep = pos >= jnp.where(pad_on, pad_len, 0)        # [batch, W]
+    windows = windows * keep[..., None]
     return windows, labels

@@ -22,7 +22,10 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-from manatee_tpu.coord.client import NetCoord           # noqa: E402
+from manatee_tpu.coord.client import (                  # noqa: E402
+    NetCoord,
+    sync_status,
+)
 from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
 from manatee_tpu.pg.postgres import PostgresEngine      # noqa: E402
 from manatee_tpu.storage import DirBackend              # noqa: E402
@@ -330,20 +333,10 @@ class ClusterHarness:
         raise AssertionError("no coordd leader emerged")
 
     async def _sync_status(self, port: int) -> dict | None:
-        try:
-            r, w = await asyncio.wait_for(
-                asyncio.open_connection("127.0.0.1", port), 0.5)
-        except (OSError, asyncio.TimeoutError):
-            return None
-        try:
-            w.write(b'{"op":"sync_status","xid":0}\n')
-            await w.drain()
-            line = await asyncio.wait_for(r.readline(), 0.5)
-            return json.loads(line).get("result")
-        except (OSError, ValueError, asyncio.TimeoutError):
-            return None
-        finally:
-            w.close()
+        # the PRODUCTION probe, not a reimplementation: the harness
+        # must test the same wire exchange the ensemble and
+        # `manatee-adm coord-status` use
+        return await sync_status("127.0.0.1", port, 0.5)
 
     async def start(self, *, peers: list[int] | None = None,
                     stagger: float = 0.3) -> None:

@@ -690,3 +690,45 @@ def test_repeated_flaps_never_expire_graced_session():
         finally:
             await server.stop()
     run(go())
+
+
+def test_session_survives_own_write_blocked_in_dispatch(tmp_path):
+    """code-review r5 (high, round-3 range): requests are served
+    serially per connection, so a mutation waiting out its durability/
+    replication awaits blocks the same client's queued heartbeats.
+    That silence is the SERVER's doing — heartbeat expiry must not
+    kill the live session mid-write (it would delete its election
+    ephemeral and trigger a spurious failover of a healthy peer)."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp_path))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=1.0)
+        await c.connect()
+        await c.create("/el", b"")
+        eph = await c.create("/el/e-", b"x", ephemeral=True,
+                             sequential=True)
+
+        # park the next mutation inside its dispatch (gated log fsync)
+        gate = asyncio.Event()
+        orig = server._log_fsync
+
+        async def gated(gen, target):
+            await gate.wait()
+            await orig(gen, target)
+
+        server._log_fsync = gated
+        t = asyncio.ensure_future(c.create("/w", b"v"))
+        # well past the 1s session timeout; expiry ticks run throughout
+        await asyncio.sleep(2.5)
+        assert server.tree.exists(eph) is not None, \
+            "session heartbeat-expired while its own write was " \
+            "mid-dispatch"
+        server._log_fsync = orig
+        gate.set()
+        await asyncio.wait_for(t, 5)
+        # the session (and its ephemeral) survived the whole episode
+        assert server.tree.exists(eph) is not None
+        assert await c.get("/w") == (b"v", 0)
+        await c.close()
+        await server.stop()
+    run(go())

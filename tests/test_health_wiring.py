@@ -273,3 +273,31 @@ def test_playbook_promote_away_from_degrading_sync(tmp_path):
         finally:
             await cluster.stop()
     run(go())
+
+
+def test_half_filled_ring_scores_healthy_peer_low():
+    """Restart calibration (code-review r5): the ring starts scoring at
+    window//2 ticks with the old end zero-padded — the model must be
+    trained on that shape too, or the first post-restart scores come
+    from a distribution it never saw, exactly when a spurious
+    'degrading' notice is most misleading.  A healthy half-filled ring
+    must score well below the 0.8 alert threshold."""
+    from manatee_tpu.health.telemetry import WINDOW
+
+    sc = NumpyScorer()           # packaged weights
+    if not sc.available:
+        import pytest
+        pytest.skip("packaged weights missing")
+    ring = TelemetryRing(window=WINDOW)
+    # exactly the ready() minimum of healthy ticks after a restart
+    lsn = 0x100
+    for _ in range(WINDOW // 2):
+        lsn += 0x40
+        ring.add(latency_ms=12.0, timed_out=False,
+                 lag_s=0.0, wal_lsn=lsn, in_recovery=True)
+    assert ring.ready()
+    score = sc.score(ring.window_array())
+    assert score is not None
+    assert score < 0.5, \
+        "half-filled healthy ring scored %.3f (uncalibrated restart " \
+        "window)" % score
