@@ -24,7 +24,8 @@ import json
 import os
 from dataclasses import dataclass
 
-from manatee_tpu.coord.api import BadVersionError, NoNodeError
+from manatee_tpu.coord.api import BadVersionError, NoNodeError, \
+    cluster_state_txn
 from manatee_tpu.coord.client import NetCoord
 from manatee_tpu.pg.engine import PgError, parse_pg_url
 from manatee_tpu.state.types import role_of
@@ -538,15 +539,9 @@ class AdmClient:
                 raise AdmError("no cluster state for shard %r" % shard)
             new = mutate(json.loads(json.dumps(state)))
             try:
-                data = json.dumps(new).encode()
-                from manatee_tpu.coord.api import Op
-                await self._client.multi([
-                    Op.create("%s/history/%d-" % (
-                        self._shard_path(shard),
-                        int(new["generation"])), data,
-                        sequential=True),
-                    Op.set(self._shard_path(shard) + "/state", data, ver),
-                ])
+                await self._client.multi(cluster_state_txn(
+                    self._shard_path(shard) + "/history",
+                    self._shard_path(shard) + "/state", new, ver))
                 return new
             except BadVersionError:
                 continue
@@ -669,14 +664,10 @@ class AdmClient:
             }
         if dry_run:
             return new
-        from manatee_tpu.coord.api import Op
-        data = json.dumps(new).encode()
         await self._client.mkdirp(self._shard_path(shard) + "/history")
-        await self._client.multi([
-            Op.create(self._shard_path(shard) + "/history/0-", data,
-                      sequential=True),
-            Op.create(self._shard_path(shard) + "/state", data),
-        ])
+        await self._client.multi(cluster_state_txn(
+            self._shard_path(shard) + "/history",
+            self._shard_path(shard) + "/state", new, None))
         return new
 
     # -- promote --

@@ -105,6 +105,33 @@ class Op:
         return cls("check", path, None, version)
 
 
+def cluster_state_txn(history_path: str, state_path: str, state: dict,
+                      version: int | None) -> list["Op"]:
+    """THE state-write transaction (putClusterState contract,
+    lib/zookeeperMgr.js:605-630): one persistent-sequential history
+    record under *history_path* named by generation, plus the state
+    write at *state_path* — a CAS set against *version*, or a fresh
+    create when *version* is None (no state yet: state-backfill, first
+    bootstrap).
+
+    The single builder shared by the sitter (ConsensusMgr) and the
+    operator library (adm): sitter writes and operator writes land in
+    the same coordination tree, so the transaction shape must never
+    drift between them.  Takes the two paths explicitly — callers keep
+    exactly one source of truth for where the shard's tree lives."""
+    import json
+
+    data = json.dumps(state).encode()
+    ops = [Op.create(
+        "%s/%d-" % (history_path, int(state["generation"])),
+        data, sequential=True)]
+    if version is None:
+        ops.append(Op.create(state_path, data))
+    else:
+        ops.append(Op.set(state_path, data, version))
+    return ops
+
+
 @dataclass
 class Stat:
     version: int

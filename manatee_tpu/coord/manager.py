@@ -34,8 +34,8 @@ from manatee_tpu.coord.api import (
     CoordClient,
     CoordError,
     NoNodeError,
-    Op,
     SessionExpiredError,
+    cluster_state_txn,
 )
 
 log = logging.getLogger("manatee.coord")
@@ -155,13 +155,66 @@ class ConsensusMgr:
     # ---- lifecycle ----
 
     async def start(self) -> None:
-        await self._setup_client()
+        # run the initial setup AS the tracked _setup_task: a session
+        # expiry mid-setup fires _schedule_resetup, which must see the
+        # live task and no-op — otherwise it spawns a SECOND concurrent
+        # setup loop racing this one for self._client, and the loser's
+        # stale-generation on_session closure silently ignores later
+        # expiries (the peer drops out of coordination until process
+        # restart)
+        self._setup_task = asyncio.ensure_future(self._setup_client())
+        try:
+            await self._setup_task
+        except asyncio.CancelledError:
+            if self._setup_task.cancelled():
+                # the SETUP was cancelled (a concurrent close() racing
+                # startup) while our own caller was not: re-raising
+                # CancelledError here would falsely signal cancellation
+                # of an uncancelled caller — surface a clean error
+                raise ConnectionLossError(
+                    "coordination manager closed during startup"
+                ) from None
+            # our caller was cancelled (e.g. a wait_for timeout treated
+            # as startup failure): the retry loop must not run on
+            # detached — it would eventually connect and join the
+            # election as a ghost peer.  Await the cancelled task so
+            # its own cleanup (closing a half-built client) completes
+            # before the caller moves on.
+            self._setup_task.cancel()
+            try:
+                await self._setup_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            if self._setup_task.done() \
+                    and not self._setup_task.cancelled() \
+                    and self._setup_task.exception() is None \
+                    and self._client is not None:
+                # the setup FINISHED in the same tick the caller was
+                # cancelled (cancel() was a no-op on the done task):
+                # nothing else will close the built client, and a
+                # caller retrying start() after its timeout would
+                # spawn a second client/ephemeral for the same ident
+                client, self._client = self._client, None
+                self._ready = False
+                try:
+                    await client.close()
+                except (CoordError, OSError):
+                    pass
+            raise
         if self._anti_entropy_interval > 0:
             self._anti_entropy_task = asyncio.ensure_future(
                 self._anti_entropy_loop())
 
     async def close(self) -> None:
         self._closed = True
+        if self._setup_task and not self._setup_task.done():
+            # a retry loop sleeping out RETRY_DELAY must not outlive
+            # close() and race the client teardown below
+            self._setup_task.cancel()
+            try:
+                await self._setup_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._anti_entropy_task:
             # finish any in-flight pass before tearing the client down,
             # so no callbacks fire after close() returns
@@ -224,6 +277,17 @@ class ConsensusMgr:
                 await self._setup_data(client)
                 self._ready = True
                 return
+            except asyncio.CancelledError:
+                # a cancelled setup (start() timeout/abandonment, or
+                # close()) must not strand a half-built CONNECTED
+                # client: its live session would keep a ghost
+                # ephemeral in the election until session timeout
+                if client is not None:
+                    try:
+                        await client.close()
+                    except (CoordError, OSError):
+                        pass
+                raise
             except (CoordError, OSError) as e:
                 # OSError: transient TCP failures (refused, reset, SYN
                 # drops under load) must retry, not kill the daemon.
@@ -282,6 +346,7 @@ class ConsensusMgr:
                 return
 
             async def rearm():
+                retry = False
                 async with self._lock:
                     if self._closed or client is not self._client:
                         return
@@ -292,8 +357,14 @@ class ConsensusMgr:
                     except CoordError as e:
                         log.warning("watch handler error on %s: %s; retrying",
                                     handler.__name__, e)
-                        await asyncio.sleep(RETRY_DELAY)
-                        fired(None)
+                        retry = True
+                if retry:
+                    # sleep OUTSIDE the lock: holding it for RETRY_DELAY
+                    # would stall every other watch handler (e.g. the
+                    # activeChange that kicks a takeover) behind one
+                    # failing re-read
+                    await asyncio.sleep(RETRY_DELAY)
+                    fired(None)
 
             asyncio.ensure_future(rearm())
 
@@ -396,15 +467,8 @@ class ConsensusMgr:
             raise CoordError("cluster state requires a generation")
         version = (expected_version if expected_version is not None
                    else self._cluster_state_version)
-        data = json.dumps(state).encode()
-        ops = [Op.create(
-            "%s/%d-" % (self._history_path, int(state["generation"])),
-            data, sequential=True)]
-        if version is not None:
-            ops.append(Op.set(self._state_path, data, version))
-        else:
-            ops.append(Op.create(self._state_path, data))
-        res = await self._client.multi(ops)
+        res = await self._client.multi(cluster_state_txn(
+            self._history_path, self._state_path, state, version))
         self._cluster_state = state
         # the set op reports the new version; a fresh create starts at 0
         self._cluster_state_version = res[1] if isinstance(res[1], int) else 0

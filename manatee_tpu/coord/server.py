@@ -1422,10 +1422,17 @@ class CoordServer:
                 # ahead of the stream while a mutation awaits its log
                 # fsync, and an unshipped seq would read as drift
                 f.push({"sync_ping": {"seq": self._shipped_seq}})
-            for idx, addr in enumerate(self.ensemble):
-                if idx == self.my_id:
-                    continue
-                st = await self._probe(addr)
+            # probe the other members CONCURRENTLY: sequential 0.5s
+            # probe timeouts against unreachable members would stretch
+            # the gap between sync_pings past the followers' idle
+            # timeout (max(2s, 6*tick)), making healthy followers
+            # resync-flap exactly when the ensemble is degraded
+            others = [(idx, addr)
+                      for idx, addr in enumerate(self.ensemble)
+                      if idx != self.my_id]
+            results = await asyncio.gather(
+                *(self._probe(addr) for _i, addr in others))
+            for (idx, _addr), st in zip(others, results):
                 if st and st.get("role") == "leader":
                     if (st.get("seq", 0) > self._seq
                             or (st.get("seq", 0) == self._seq

@@ -201,9 +201,21 @@ class PostgresMgr:
     async def reconfigure(self, pgcfg: dict) -> None:
         """{role, upstream, downstream} — the contract of
         lib/postgresMgr.js:758-845.  Cancelable; serialized."""
+        # cancel long-running background transitions BEFORE taking the
+        # lock: the re-point watchdog's forced restore runs UNDER
+        # _reconf_lock (potentially for hours), so cancelling only
+        # after acquisition would WAIT OUT the restore instead of
+        # interrupting it — a write outage for the restore's duration
+        # on every topology change (cancelable-transition parity,
+        # lib/postgresMgr.js:379-385)
+        self._cancel_repoint()
+        await self._cancel_catchup()
         async with self._reconf_lock:
             role = pgcfg.get("role")
             log.info("%s: reconfigure -> %s", self.peer_id, role)
+            # again under the lock: a reconfigure that was mid-flight
+            # when we pre-cancelled may have armed fresh tasks on its
+            # way out
             await self._cancel_catchup()
             self._cancel_repoint()
             if role == "primary":

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
 import os
 import signal
@@ -65,11 +66,24 @@ class Wal:
     def __init__(self, datadir: Path):
         self.path = datadir / WAL_NAME
         self.records: list[dict] = []
+        self._run = hashlib.sha1()
+        # prefix_digests[k] = digest of records[:k]; maintained
+        # incrementally so the replication handshake stays O(1) even
+        # against a storm-grown WAL (recomputing per reconnect would be
+        # quadratic over a kill/reconnect churn)
+        self.prefix_digests: list[str] = [self._run.hexdigest()]
         if self.path.exists():
             for line in self.path.read_text().splitlines():
                 if line.strip():
-                    self.records.append(json.loads(line))
+                    self._track(json.loads(line))
         self._fh = open(self.path, "a")
+
+    def _track(self, rec: dict) -> None:
+        self.records.append(rec)
+        self._run.update(json.dumps(
+            [rec["lsn"], rec["value"], rec["ts"]]).encode())
+        self._run.update(b"\x00")
+        self.prefix_digests.append(self._run.hexdigest())
 
     @property
     def last_lsn(self) -> int:
@@ -78,7 +92,7 @@ class Wal:
     def append(self, value, ts: float | None = None) -> int:
         rec = {"lsn": self.last_lsn + 1, "value": value,
                "ts": ts if ts is not None else time.time()}
-        self.records.append(rec)
+        self._track(rec)
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
@@ -86,6 +100,14 @@ class Wal:
 
     def get_from(self, lsn: int) -> list[dict]:
         return self.records[lsn:]
+
+    def digest_to(self, lsn: int) -> str:
+        """Digest of the WAL prefix up to *lsn* — the sim's analogue of
+        PostgreSQL's timeline-history check.  An equal-LENGTH but
+        divergent-CONTENT history (old primary and new primary both
+        wrote record N) is invisible to the from_lsn comparison alone;
+        the digest makes any content divergence refuse the stream."""
+        return self.prefix_digests[lsn]
 
 
 class SimPgServer:
@@ -217,6 +239,7 @@ class SimPgServer:
             # distinct id: the probe must never collide with the real
             # stream's registration on the upstream
             req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
+                   "prefix_digest": self.wal.digest_to(self.wal.last_lsn),
                    "standby_id": self.peer_id + ":probe"}
             writer.write((json.dumps(req) + "\n").encode())
             await writer.drain()
@@ -243,6 +266,8 @@ class SimPgServer:
                 reader, writer = await asyncio.open_connection(
                     conninfo["host"], int(conninfo["port"]))
                 req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
+                       "prefix_digest": self.wal.digest_to(
+                           self.wal.last_lsn),
                        "standby_id": self.peer_id}
                 writer.write((json.dumps(req) + "\n").encode())
                 await writer.drain()
@@ -323,6 +348,21 @@ class SimPgServer:
                  "error": "requested start %s beyond local wal %s "
                           "(diverged)" % (lsn_str(from_lsn),
                                           lsn_str(self.wal.last_lsn))}
+            ) + "\n").encode())
+            await writer.drain()
+            return
+        digest = req.get("prefix_digest")
+        if digest is not None and digest != self.wal.digest_to(from_lsn):
+            # same LENGTH is not same HISTORY: an old primary killed
+            # right after appending record N that the takeover sync
+            # never saw rejoins with from_lsn == our last_lsn but a
+            # conflicting record N — content divergence must refuse
+            # the stream exactly like the beyond-wal case (PostgreSQL's
+            # timeline check; docs/xlog-diverge.md)
+            writer.write((json.dumps(
+                {"ok": False,
+                 "error": "wal prefix at %s does not match ours "
+                          "(diverged)" % lsn_str(from_lsn)}
             ) + "\n").encode())
             await writer.drain()
             return

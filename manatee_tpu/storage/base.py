@@ -175,3 +175,114 @@ async def flush_transport(writer: asyncio.StreamWriter,
         if asyncio.get_running_loop().time() > deadline:
             raise StorageError("transport buffer never drained")
         await asyncio.sleep(0.005)
+
+
+async def pump_child_to_socket(
+    argv: list[str],
+    writer: asyncio.StreamWriter,
+    *,
+    on_progress: Callable[[int], None] | None = None,
+    stderr_task: Callable | None = None,
+    env: dict | None = None,
+    label: str = "native send",
+):
+    """MANATEE_NATIVE=1 shared core: spawn *argv* with stdout on a fresh
+    pipe and splice that pipe into *writer*'s socket with the native
+    pump (native/streampump.cpp) — the kernel-piped transfer of the
+    reference's `zfs send | socket` (lib/backupSender.js:172-180) —
+    leaving the event loop free.  The transport socket stays
+    non-blocking (asyncio refuses setblocking); the pump absorbs EAGAIN
+    with poll(2).
+
+    The fd-lifetime/cancellation protocol here is corruption-critical
+    and exists in exactly ONE place (both backends share it): the read
+    fd must stay open until the pump THREAD exits, or a reused fd
+    number would receive spliced bytes (silent corruption); on
+    cancellation the abort flag + child kill bound the thread's exit.
+
+    The child's stderr is ALWAYS consumed concurrently with the pump —
+    a child emitting more than the pipe buffer of stderr (tar's
+    'file changed as we read it' flood, zfs send -v progress) would
+    otherwise block on stderr, stall its stdout short of EOF, and hang
+    the pump forever.  *stderr_task* customizes the consumer: a
+    callable receiving the process and returning a coroutine (default:
+    read stderr to EOF, resolving to the bytes).  The helper owns the
+    consumer task's whole lifecycle, including the subtle abort
+    ordering: on the failure paths it is cancelled and AWAITED before
+    reap_killed reads the same StreamReader (a concurrent read would
+    silently skip the drain and proc.wait() could block forever).
+
+    Returns (child process, stderr-consumer task) after a successful
+    pump, the child unwaited — rc/stderr semantics stay with the
+    caller.  *on_progress* (optional) runs in the pump thread with the
+    byte total.
+    """
+    import os
+    import threading
+
+    from manatee_tpu import native
+    from manatee_tpu.utils.executil import drain_and_reap
+
+    # drain() only waits for the low-water mark: the raw-fd pump must
+    # not start while a JSON header is still buffered in the transport,
+    # or child bytes would precede it on the wire
+    await flush_transport(writer)
+    sock = writer.get_extra_info("socket")
+    rfd, wfd = os.pipe()
+    try:
+        kwargs: dict = {"stdout": wfd, "stderr": asyncio.subprocess.PIPE}
+        if env is not None:
+            kwargs["env"] = env
+        proc = await asyncio.create_subprocess_exec(*argv, **kwargs)
+    except Exception:
+        os.close(rfd)
+        os.close(wfd)
+        raise
+    os.close(wfd)   # pump sees EOF when the child exits
+    consumer = stderr_task or (lambda p: p.stderr.read())
+    err_task = asyncio.ensure_future(consumer(proc))
+
+    cancelled = threading.Event()
+
+    def pump_cb(total: int) -> bool:
+        if on_progress:
+            on_progress(total)
+        return cancelled.is_set()
+
+    loop = asyncio.get_running_loop()
+    fut = loop.run_in_executor(None, native.pump, rfd, sock.fileno(),
+                               pump_cb)
+    try:
+        await asyncio.shield(fut)
+    except asyncio.CancelledError:
+        cancelled.set()
+        await drain_and_reap(proc, err_task)
+        finished = True
+        try:
+            await asyncio.wait_for(fut, 10)
+        except asyncio.TimeoutError:
+            finished = False
+        except Exception:
+            pass
+        if finished:
+            os.close(rfd)
+        # else: the pump thread is wedged past the bound while still
+        # holding rfd — deliberately LEAK the fd: closing it under a
+        # live thread would let a reused fd number receive spliced
+        # bytes (the silent corruption this protocol exists to prevent)
+        raise
+    except OSError as e:
+        # the pump itself failed: the thread has exited, rfd is safe
+        await drain_and_reap(proc, err_task)
+        os.close(rfd)
+        raise StorageError("%s aborted: %s" % (label, e)) from e
+    except Exception:
+        # e.g. a raising progress callback surfacing through the pump
+        # thread (an expected abort mode): same cleanup, then let the
+        # caller's exception propagate — without this branch the child
+        # ran on as an orphan and rfd leaked per failed send
+        await drain_and_reap(proc, err_task)
+        os.close(rfd)
+        raise
+    os.close(rfd)
+    return proc, err_task

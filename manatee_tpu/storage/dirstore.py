@@ -21,7 +21,6 @@ unmounted data really is invisible at the mountpoint, as with zfs.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import json
 import os
 import shutil
@@ -33,9 +32,10 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
-    flush_transport,
+    pump_child_to_socket,
     snapshot_name_now,
 )
+from manatee_tpu.utils.executil import drain_and_reap
 
 _RESERVED = {"@data", "@snapshots", "@meta.json"}
 
@@ -314,6 +314,10 @@ class DirBackend(StorageBackend):
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
         )
+        # drain stderr CONCURRENTLY: a tar emitting more warnings than
+        # the pipe buffer would block on stderr and stall stdout short
+        # of EOF, deadlocking the copy loop below
+        t_err = asyncio.ensure_future(proc.stderr.read())
         done = 0
         try:
             while True:
@@ -325,14 +329,20 @@ class DirBackend(StorageBackend):
                 await writer.drain()
                 if progress_cb:
                     progress_cb(done, size)
+        except asyncio.CancelledError:
+            # our caller was cancelled (server shutdown, peer-handler
+            # teardown): same cleanup, then let the cancel propagate —
+            # `except Exception` alone would leak the drainer task and
+            # leave tar blocked on its full stdout pipe forever
+            await drain_and_reap(proc, t_err)
+            raise
         except Exception as e:
             # receiver went away mid-stream: kill tar first, or reading its
             # stderr to EOF below would block on the full stdout pipe
-            from manatee_tpu.utils.executil import reap_killed
-            await reap_killed(proc)
+            await drain_and_reap(proc, t_err)
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
-        err = await proc.stderr.read()
+        err = await t_err
         rc = await proc.wait()
         if rc != 0:
             raise StorageError("tar send failed (rc=%d): %s"
@@ -343,64 +353,18 @@ class DirBackend(StorageBackend):
                            writer: asyncio.StreamWriter,
                            progress_cb: ProgressCb | None) -> None:
         """MANATEE_NATIVE=1 bulk path: tar's stdout is spliced into the
-        peer socket by the native pump (native/streampump.cpp) — the
-        kernel-piped transfer of the reference's `zfs send | socket`
-        (lib/backupSender.js:172-180) — leaving the event loop free.
-        The transport socket stays non-blocking (asyncio refuses
-        setblocking); the pump absorbs EAGAIN with poll(2)."""
-        import os
-
-        from manatee_tpu import native
-        from manatee_tpu.utils.executil import reap_killed
-
-        # drain() only waits for the low-water mark: the raw-fd pump
-        # must not start while the JSON header is still buffered in the
-        # transport, or tar bytes would precede it on the wire
-        await flush_transport(writer)
-
-        sock = writer.get_extra_info("socket")
-        rfd, wfd = os.pipe()
-        try:
-            proc = await asyncio.create_subprocess_exec(
-                "tar", "-C", str(src), "-cf", "-", ".",
-                stdout=wfd, stderr=asyncio.subprocess.PIPE)
-        except Exception:
-            os.close(rfd)
-            os.close(wfd)
-            raise
-        os.close(wfd)   # pump sees EOF when tar exits
-
-        import threading
-        cancelled = threading.Event()
-
-        def progress(total: int) -> bool:
+        peer socket by the native pump — fd-lifetime/cancellation
+        protocol shared with ZfsBackend in
+        storage.base.pump_child_to_socket."""
+        def on_progress(total: int) -> None:
             if progress_cb:
                 progress_cb(total, size)
-            return cancelled.is_set()
 
-        loop = asyncio.get_running_loop()
-        fut = loop.run_in_executor(
-            None, native.pump, rfd, sock.fileno(), progress)
-        try:
-            await asyncio.shield(fut)
-        except asyncio.CancelledError:
-            # the fd must stay open until the pump THREAD exits, or a
-            # reused fd number would receive spliced bytes (silent
-            # corruption).  The abort flag + tar kill guarantee the
-            # thread returns promptly (bounded poll in wait_ready).
-            cancelled.set()
-            await reap_killed(proc)
-            with contextlib.suppress(Exception):
-                await asyncio.wait_for(fut, 10)
-            os.close(rfd)
-            raise
-        except OSError as e:
-            await reap_killed(proc)
-            os.close(rfd)
-            raise StorageError("native send of %s@%s aborted: %s"
-                               % (dataset, name, e)) from e
-        os.close(rfd)
-        err = await proc.stderr.read()
+        proc, t_err = await pump_child_to_socket(
+            ["tar", "-C", str(src), "-cf", "-", "."], writer,
+            on_progress=on_progress,
+            label="native send of %s@%s" % (dataset, name))
+        err = await t_err
         rc = await proc.wait()
         if rc != 0:
             raise StorageError("tar send failed (rc=%d): %s"
@@ -439,6 +403,11 @@ class DirBackend(StorageBackend):
             stdin=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
         )
+        # drain stderr CONCURRENTLY with the feed: a tar emitting more
+        # warnings than the pipe buffer ('implausibly old time stamp',
+        # unknown extended headers) would block on stderr, stop
+        # reading stdin, and wedge the drain() below forever
+        t_err = asyncio.ensure_future(proc.stderr.read())
         done = 0
         stream_error: Exception | None = None
         while True:
@@ -460,8 +429,7 @@ class DirBackend(StorageBackend):
             if progress_cb:
                 progress_cb(done, size)
         if stream_error is not None:
-            from manatee_tpu.utils.executil import reap_killed
-            await reap_killed(proc)
+            await drain_and_reap(proc, t_err)
             await self.destroy(dataset, recursive=True)
             raise StorageError("recv into %s aborted: %s"
                                % (dataset, stream_error)) from stream_error
@@ -469,7 +437,7 @@ class DirBackend(StorageBackend):
             proc.stdin.close()
         except OSError:
             pass
-        err = await proc.stderr.read()
+        err = await t_err
         rc = await proc.wait()
         if rc != 0:
             await self.destroy(dataset, recursive=True)

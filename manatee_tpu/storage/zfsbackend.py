@@ -21,7 +21,7 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
-    flush_transport,
+    pump_child_to_socket,
 )
 from manatee_tpu.utils import ExecError, run
 
@@ -236,63 +236,22 @@ class ZfsBackend(StorageBackend):
                            writer: asyncio.StreamWriter,
                            progress_cb: ProgressCb | None) -> None:
         """MANATEE_NATIVE=1: `zfs send` stdout is spliced to the peer
-        socket in the kernel (native/streampump.cpp) — the literal
-        kernel-piped transfer of lib/backupSender.js:172-180 — while the
-        -v/-P progress lines are still parsed from stderr on the loop."""
-        import contextlib
-        import os
-        import threading
-
-        from manatee_tpu import native
+        socket in the kernel — fd-lifetime/cancellation protocol shared
+        with DirBackend in storage.base.pump_child_to_socket — while
+        the -v/-P progress lines are still parsed from stderr on the
+        loop."""
         from manatee_tpu.utils.executil import reap_killed
 
-        await flush_transport(writer)   # no buffered bytes may remain
-        sock = writer.get_extra_info("socket")
-        rfd, wfd = os.pipe()
-        try:
-            proc = await asyncio.create_subprocess_exec(
-                self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name),
-                stdout=wfd, stderr=asyncio.subprocess.PIPE, env={})
-        except Exception:
-            os.close(rfd)
-            os.close(wfd)
-            raise
-        os.close(wfd)
         state = _SendState()
         err_chunks: list[bytes] = []
 
-        cancelled = threading.Event()
-
-        def pump_progress(_total: int) -> bool:
-            return cancelled.is_set()
-
-        # the transport socket stays non-blocking (asyncio refuses
-        # setblocking); the pump absorbs EAGAIN with poll(2)
-        loop = asyncio.get_running_loop()
-        t_err = asyncio.ensure_future(
-            _watch_send_stderr(proc, state, err_chunks, progress_cb))
-        fut = loop.run_in_executor(
-            None, native.pump, rfd, sock.fileno(), pump_progress)
-        try:
-            await asyncio.shield(fut)
-        except asyncio.CancelledError:
-            # keep rfd open until the pump THREAD exits, or a reused fd
-            # could receive spliced bytes (silent corruption); the abort
-            # flag + zfs kill bound the thread's exit
-            cancelled.set()
-            t_err.cancel()
-            await reap_killed(proc)
-            with contextlib.suppress(Exception):
-                await asyncio.wait_for(fut, 10)
-            os.close(rfd)
-            raise
-        except OSError as e:
-            t_err.cancel()
-            await reap_killed(proc)
-            os.close(rfd)
-            raise StorageError("native zfs send of %s@%s aborted: %s"
-                               % (dataset, name, e)) from e
-        os.close(rfd)
+        proc, t_err = await pump_child_to_socket(
+            [self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name)],
+            writer,
+            stderr_task=lambda p: _watch_send_stderr(
+                p, state, err_chunks, progress_cb),
+            env={},
+            label="native zfs send of %s@%s" % (dataset, name))
         try:
             await t_err
         except Exception as e:
@@ -319,6 +278,10 @@ class ZfsBackend(StorageBackend):
             stderr=asyncio.subprocess.PIPE,
             env={},
         )
+        # drain stderr CONCURRENTLY with the feed (same hazard as the
+        # send paths: a verbose recv blocking on a full stderr pipe
+        # stops reading stdin and wedges the drain() below)
+        t_err = asyncio.ensure_future(proc.stderr.read())
         done = 0
         stream_error: Exception | None = None
         while True:
@@ -338,15 +301,15 @@ class ZfsBackend(StorageBackend):
             if progress_cb:
                 progress_cb(done, None)
         if stream_error is not None:
-            from manatee_tpu.utils.executil import reap_killed
-            await reap_killed(proc)
+            from manatee_tpu.utils.executil import drain_and_reap
+            await drain_and_reap(proc, t_err)
             raise StorageError("zfs recv into %s aborted: %s"
                                % (dataset, stream_error)) from stream_error
         try:
             proc.stdin.close()
         except OSError:
             pass
-        err = await proc.stderr.read()
+        err = await t_err
         rc = await proc.wait()
         if rc != 0:
             raise StorageError("zfs recv failed (rc=%d): %s"

@@ -119,6 +119,22 @@ async def _pump_stdin(proc: asyncio.subprocess.Process,
     proc.stdin.close()
 
 
+async def drain_and_reap(proc: asyncio.subprocess.Process,
+                         err_task: "asyncio.Task") -> None:
+    """Abort-path cleanup for a child whose stderr is consumed by a
+    separate task: the consumer must FINISH (cancellation delivered,
+    task done) before reap_killed reads the same StreamReader — a
+    concurrent read raises RuntimeError, silently skips the stderr
+    drain, and proc.wait() can then block forever on the
+    undisconnected pipe."""
+    err_task.cancel()
+    try:
+        await err_task
+    except (asyncio.CancelledError, Exception):
+        pass
+    await reap_killed(proc)
+
+
 async def reap_killed(proc: asyncio.subprocess.Process) -> None:
     """Kill *proc* and wait without deadlocking: asyncio's Process.wait()
     only resolves once every pipe transport disconnects, so abandoned
@@ -177,11 +193,17 @@ async def run(
         asyncio.ensure_future(_pump_stdin(proc, stdin_data)),
     ]
 
-    try:
-        out, err, _ = await asyncio.wait_for(
-            asyncio.gather(*tasks), timeout=timeout
-        )
+    async def _collect():
+        # proc.wait() INSIDE the timeout: a child that closes its
+        # output pipes but never exits (stuck ioctl, daemonizing
+        # wrapper) must still be bounded — waiting outside would hang
+        # the caller forever despite the explicit timeout
+        out, err, _ = await asyncio.gather(*tasks)
         await proc.wait()
+        return out, err
+
+    try:
+        out, err = await asyncio.wait_for(_collect(), timeout=timeout)
     except asyncio.CancelledError:
         # the CALLER was cancelled (a watchdog/reconfigure racing this
         # exec): the child must not be orphaned — kill and reap it,
@@ -190,10 +212,23 @@ async def run(
         raise
     except (asyncio.TimeoutError, OutputLimitExceeded) as e:
         await _kill_and_reap(proc, tasks)
+
+        def partial(t) -> bytes:
+            # whatever the reader captured before the cut — on the
+            # wait()-phase timeout (pipes closed, child never exited)
+            # this is the COMPLETE output, the only clue to the wedge
+            if t.done() and not t.cancelled() and t.exception() is None:
+                return t.result() or b""
+            return b""
+
         why = ("timeout after %ss" % timeout
                if isinstance(e, asyncio.TimeoutError)
                else "output exceeded %d bytes" % max_output)
-        res = ExecResult(argv, -9, "", why,
+        err_b = partial(tasks[1])
+        res = ExecResult(argv, -9,
+                         partial(tasks[0]).decode("utf-8", "replace"),
+                         (err_b.decode("utf-8", "replace") + "\n" + why
+                          if err_b else why),
                          (time.monotonic() - t0) * 1000.0, run_id)
         _log_result(res)
         raise ExecError(res) from None

@@ -732,3 +732,47 @@ def test_session_survives_own_write_blocked_in_dispatch(tmp_path):
         await c.close()
         await server.stop()
     run(go())
+
+
+def test_resetup_during_initial_setup_is_single_flight():
+    """code-review r5 (high, rounds-1-2 range): start() must run the
+    initial setup AS the tracked _setup_task — a session expiry firing
+    _schedule_resetup mid-setup otherwise spawns a SECOND concurrent
+    setup loop racing the first for self._client; the loser's
+    stale-generation on_session closure then ignores later expiries
+    and the peer silently leaves coordination until process restart."""
+    async def go():
+        space = CoordSpace()
+        in_flight = {"now": 0, "max": 0, "calls": 0}
+        release = asyncio.Event()
+
+        async def factory():
+            in_flight["now"] += 1
+            in_flight["calls"] += 1
+            in_flight["max"] = max(in_flight["max"], in_flight["now"])
+            try:
+                if in_flight["calls"] == 1:
+                    await release.wait()
+                c = space.client(60.0)
+                await c.connect()
+                return c
+            finally:
+                in_flight["now"] -= 1
+
+        mgr = ConsensusMgr(
+            client_factory=factory, path="/shard",
+            ident="10.0.0.1:5432:12345",
+            data={"zoneId": "z", "ip": "10.0.0.1",
+                  "pgUrl": "tcp://x", "backupUrl": "http://x"})
+        t = asyncio.ensure_future(mgr.start())
+        await asyncio.sleep(0.05)      # first factory call parked
+        # a session-expiry notification lands mid-setup
+        mgr._schedule_resetup()
+        await asyncio.sleep(0.05)
+        release.set()
+        await asyncio.wait_for(t, 5)
+        assert in_flight["max"] == 1, \
+            "a second concurrent setup loop was spawned"
+        assert mgr._ready
+        await mgr.close()
+    run(go())

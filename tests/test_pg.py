@@ -526,3 +526,61 @@ def test_wedged_standby_promotion_takes_restart_path(tmp_path):
             await p.close()
             await s.close()
     run(go())
+
+
+def test_equal_length_divergent_wal_triggers_restore(tmp_path):
+    """code-review r5 (high, rounds-1-2 range): from_lsn comparison
+    alone misses equal-LENGTH but divergent-CONTENT histories — an old
+    primary SIGKILLed right after appending record N that the takeover
+    sync never received rejoins with from_lsn == the new primary's
+    last_lsn and a CONFLICTING record N.  The WAL prefix digest (the
+    sim's analogue of PostgreSQL's timeline check) must refuse the
+    stream and send the peer down the restore path, not silently keep
+    the conflicting record alive on one peer."""
+    async def go():
+        p = make_mgr(tmp_path, "prim", singleton=True)
+        await p.start_manager()
+        restores = []
+
+        async def restore_fn(upstream):
+            restores.append(upstream["id"])
+            src = Path(p.datadir)
+            dst = Path(s.datadir)
+            if dst.exists():
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+
+        s = make_mgr(tmp_path, "stand", singleton=True,
+                     restore_fn=restore_fn)
+        await s.start_manager()
+        try:
+            # both histories have the SAME length (3 records) but the
+            # last record differs — the old-primary-wrote-one-more-
+            # then-died-and-the-sync-wrote-its-own shape
+            await p.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            for v in ("a", "b", "p3"):
+                await p._local_query({"op": "insert", "value": v})
+            await s.reconfigure({"role": "primary", "upstream": None,
+                                 "downstream": None})
+            for v in ("a", "b", "s3"):
+                await s._local_query({"op": "insert", "value": v})
+            s.cfg["singleton"] = False
+
+            await s.reconfigure({"role": "sync", "upstream": info_for(p),
+                                 "downstream": None})
+            assert restores == [p.peer_id], \
+                "divergent-content history streamed without a restore"
+
+            # post-restore: the standby holds the PRIMARY's history
+            async def converged():
+                try:
+                    res = await s._local_query({"op": "select"})
+                    return res["rows"] == ["a", "b", "p3"]
+                except PgError:
+                    return False
+            await wait_until(converged, what="post-restore content")
+        finally:
+            await p.close()
+            await s.close()
+    run(go())

@@ -825,3 +825,86 @@ def test_repoint_watchdog_waits_out_unreachable_upstream(tmp_path):
             await prim_a.close()
             await prim_b.close()
     run(go())
+
+
+def test_reconfigure_cancels_watchdog_forced_restore(tmp_path):
+    """code-review r5 (high): the watchdog's forced restore runs UNDER
+    _reconf_lock — potentially for hours.  A topology change must
+    CANCEL it (cancelable-transition parity, lib/postgresMgr.js:
+    379-385), not queue behind it: reconfigure() used to acquire the
+    lock before cancelling the watchdog task, waiting out the whole
+    restore while the shard had a write outage."""
+    import shutil
+
+    async def go():
+        prim_a = make_mgr(tmp_path, "prima", version="13.0",
+                          singleton=True)
+        prim_b = make_mgr(tmp_path, "primb", version="13.0",
+                          singleton=True)
+        standby = make_mgr(tmp_path, "stand", version="13.0",
+                           replicationTimeout=1.0)
+        restore_block = asyncio.Event()
+        restore_blocked = asyncio.Event()
+        calls = {"n": 0}
+
+        async def restore(upstream):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                # the watchdog's forced restore: hold it mid-flight
+                restore_blocked.set()
+                await restore_block.wait()
+            src = prim_a if upstream["id"] == prim_a.peer_id else prim_b
+            d = Path(standby.datadir)
+            if d.exists():
+                shutil.rmtree(d)
+            shutil.copytree(src.datadir, d)
+            (d / "fake_linger_on_refusal").touch()
+        standby.restore_fn = restore
+
+        def up_of(mgr):
+            return {"id": mgr.peer_id,
+                    "pgUrl": "tcp://%s:%d" % (mgr.host, mgr.port),
+                    "backupUrl": "http://127.0.0.1:1"}
+
+        try:
+            await prim_a.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            await prim_b.reconfigure({"role": "primary",
+                                      "upstream": None,
+                                      "downstream": None})
+            # A ahead of B: a standby of A is diverged relative to B
+            for i in range(3):
+                await prim_a._local_query(
+                    {"op": "insert", "value": "a%d" % i})
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_a),
+                                       "downstream": None})
+            await wait_online(standby)
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if await attached_quietly(standby, up_of(prim_a)):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("standby never attached to A")
+
+            # live re-point to diverged B: stream refused+lingering,
+            # the watchdog escalates into the (blocked) forced restore
+            await standby.reconfigure({"role": "sync",
+                                       "upstream": up_of(prim_b),
+                                       "downstream": None})
+            await asyncio.wait_for(restore_blocked.wait(), 15)
+
+            # topology moves on: the reconfigure must interrupt the
+            # restore, not wait it out
+            await asyncio.wait_for(
+                standby.reconfigure({"role": "sync",
+                                     "upstream": up_of(prim_a),
+                                     "downstream": None}), 10)
+        finally:
+            restore_block.set()
+            await standby.close()
+            await prim_a.close()
+            await prim_b.close()
+    run(go())
