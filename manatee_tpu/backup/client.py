@@ -116,18 +116,53 @@ class RestoreClient:
             if total is not None:
                 job["size"] = total
 
-        async def handle(reader: asyncio.StreamReader,
-                         writer: asyncio.StreamWriter) -> None:
+        # the recv runs in a server-spawned handler task that NOTHING
+        # cancels by default: an abort of _receive (the watchdog's
+        # forced restore being cancelled by a topology change, a
+        # sender-failed poll) must cancel it explicitly — on
+        # Python >= 3.12 server.wait_closed() waits for handler tasks,
+        # so leaving it running would block the teardown (and any lock
+        # the caller holds) for the remainder of a multi-hour transfer
+        handler_tasks: set[asyncio.Task] = set()
+
+        async def _handle(reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
             try:
                 await self.storage.recv(self.dataset, reader,
                                         progress_cb=progress)
                 if not recv_done.done():
                     recv_done.set_result(None)
+            except asyncio.CancelledError:
+                if not recv_done.done():
+                    recv_done.cancel()
+                raise
             except Exception as e:
                 if not recv_done.done():
                     recv_done.set_exception(e)
             finally:
                 writer.close()
+
+        def handle(reader: asyncio.StreamReader,
+                   writer: asyncio.StreamWriter) -> None:
+            # PLAIN callback: the task is created and registered
+            # synchronously at accept time, so the teardown's cancel
+            # sweep can never miss a handler whose coroutine body has
+            # not run its first line yet
+            t = asyncio.ensure_future(_handle(reader, writer))
+            handler_tasks.add(t)
+
+            def _done(task, w=writer):
+                handler_tasks.discard(task)
+                # a task cancelled before its FIRST step never runs
+                # _handle's finally: close the accepted socket here
+                # (idempotent) or it leaks and the sender stays
+                # blocked writing into it
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+            t.add_done_callback(_done)
 
         server = await asyncio.start_server(handle, self.listen_host,
                                             self.listen_port)
@@ -174,6 +209,12 @@ class RestoreClient:
                                        % poll_error)
                 await recv_done
             job["done"] = True
+        except asyncio.CancelledError:
+            job["done"] = "failed"
+            job["error"] = "cancelled"
+            if not recv_done.done():
+                recv_done.cancel()
+            raise
         except Exception as e:
             job["done"] = "failed"
             job["error"] = str(e)
@@ -182,4 +223,14 @@ class RestoreClient:
             raise
         finally:
             server.close()
+            # stop a still-running transfer before wait_closed: the
+            # handler's own CancelledError path reaps its child and
+            # cleans up the partial dataset (storage.recv)
+            while handler_tasks:
+                tasks = [t for t in handler_tasks if not t.done()]
+                if not tasks:
+                    break   # done-callbacks just haven't swept the set
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
             await server.wait_closed()

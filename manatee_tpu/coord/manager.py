@@ -283,6 +283,10 @@ class ConsensusMgr:
                 # client: its live session would keep a ghost
                 # ephemeral in the election until session timeout
                 if client is not None:
+                    if self._client is client:
+                        # don't leave status/consumers pointing at the
+                        # closed instance
+                        self._client = None
                     try:
                         await client.close()
                     except (CoordError, OSError):
@@ -294,6 +298,10 @@ class ConsensusMgr:
                 # Close the half-built client or its still-live session
                 # leaves a ghost ephemeral in the election.
                 if client is not None:
+                    if self._client is client:
+                        # status/consumers must not hold the closed
+                        # instance for the whole retry window
+                        self._client = None
                     try:
                         await client.close()
                     except (CoordError, OSError):

@@ -1069,6 +1069,14 @@ class CoordServer:
                                                result)
                         acks = await self._replicate_op(seq, req,
                                                         result)
+                    elif not self.data_dir \
+                            and not self._follower_conns:
+                        # memory-only standalone: nothing to persist,
+                        # nobody to ship to — skip the O(tree)
+                        # snapshot walk entirely (a follower attaching
+                        # right after this check gets the mutation via
+                        # its attach snapshot)
+                        acks = 0
                     else:
                         pair = await self._persist_snapshot_async()
                         if pair is None:

@@ -252,37 +252,114 @@ async def pump_child_to_socket(
     loop = asyncio.get_running_loop()
     fut = loop.run_in_executor(None, native.pump, rfd, sock.fileno(),
                                pump_cb)
+    # the finallys below keep the fd bookkeeping intact even when
+    # drain_and_reap re-raises a FRESH cancellation delivered during
+    # its own awaits
     try:
         await asyncio.shield(fut)
     except asyncio.CancelledError:
         cancelled.set()
-        await drain_and_reap(proc, err_task)
-        finished = True
         try:
-            await asyncio.wait_for(fut, 10)
-        except asyncio.TimeoutError:
-            finished = False
-        except Exception:
-            pass
-        if finished:
-            os.close(rfd)
-        # else: the pump thread is wedged past the bound while still
-        # holding rfd — deliberately LEAK the fd: closing it under a
-        # live thread would let a reused fd number receive spliced
-        # bytes (the silent corruption this protocol exists to prevent)
+            await drain_and_reap(proc, err_task)
+        finally:
+            finished = True
+            try:
+                await asyncio.wait_for(fut, 10)
+            except asyncio.TimeoutError:
+                finished = False
+            except BaseException:
+                # incl. a FRESH cancel delivered at this await: the
+                # original CancelledError is re-raised below either
+                # way; only close the fd if the thread truly finished
+                finished = fut.done()
+            if finished:
+                os.close(rfd)
+            # else: the pump thread is wedged past the bound while
+            # still holding rfd — deliberately LEAK the fd: closing
+            # it under a live thread would let a reused fd number
+            # receive spliced bytes (the silent corruption this
+            # protocol exists to prevent)
         raise
     except OSError as e:
         # the pump itself failed: the thread has exited, rfd is safe
-        await drain_and_reap(proc, err_task)
-        os.close(rfd)
+        try:
+            await drain_and_reap(proc, err_task)
+        finally:
+            os.close(rfd)
         raise StorageError("%s aborted: %s" % (label, e)) from e
     except Exception:
         # e.g. a raising progress callback surfacing through the pump
         # thread (an expected abort mode): same cleanup, then let the
         # caller's exception propagate — without this branch the child
         # ran on as an orphan and rfd leaked per failed send
-        await drain_and_reap(proc, err_task)
-        os.close(rfd)
+        try:
+            await drain_and_reap(proc, err_task)
+        finally:
+            os.close(rfd)
         raise
     os.close(rfd)
     return proc, err_task
+
+
+async def pump_socket_to_child(
+    proc,
+    reader: asyncio.StreamReader,
+    err_task: "asyncio.Task",
+    on_progress: Callable[[int], None] | None = None,
+    label: str = "recv",
+) -> tuple[bytes, int]:
+    """The recv-side twin of :func:`pump_child_to_socket`, shared by
+    both backends: feed *reader* into the child's stdin, with the
+    child's stderr consumed concurrently by *err_task* (a child
+    emitting more than a pipe buffer of warnings would otherwise block
+    on stderr, stop reading stdin, and wedge the drain forever).
+
+    Returns (stderr bytes, return code) once the stream reaches EOF
+    and the child exits.  A died network stream raises StorageError; a
+    cancellation anywhere — mid-feed or on the tail awaits — reaps the
+    child first (drain_and_reap) and propagates.  Backend-specific
+    aftermath (destroying a partial dataset, rc interpretation) stays
+    with the caller.
+    """
+    from manatee_tpu.utils.executil import drain_and_reap
+
+    done = 0
+    stream_error: Exception | None = None
+    try:
+        while True:
+            try:
+                chunk = await reader.read(1 << 16)
+            except Exception as e:
+                # the network stream died — a clean child exit would be
+                # meaningless (truncated-but-aligned archives extract
+                # "ok")
+                stream_error = e
+                break
+            if not chunk:
+                break
+            done += len(chunk)
+            try:
+                proc.stdin.write(chunk)
+                await proc.stdin.drain()
+            except (BrokenPipeError, ConnectionResetError):
+                break  # child died early; rc/stderr tell the story
+            if on_progress:
+                on_progress(done)
+        if stream_error is not None:
+            await drain_and_reap(proc, err_task)
+            raise StorageError("%s aborted: %s" % (label, stream_error)) \
+                from stream_error
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        err = await err_task
+        rc = await proc.wait()
+        return err, rc
+    except BaseException:
+        # aborted anywhere — a cancel, a dead stream (the StorageError
+        # above already reaped; the re-reap is idempotent), or a
+        # raising progress callback: the child must not run on as an
+        # orphan blocked on its stdin
+        await drain_and_reap(proc, err_task)
+        raise

@@ -33,6 +33,7 @@ from manatee_tpu.storage.base import (
     StorageBackend,
     StorageError,
     pump_child_to_socket,
+    pump_socket_to_child,
     snapshot_name_now,
 )
 from manatee_tpu.utils.executil import drain_and_reap
@@ -342,8 +343,14 @@ class DirBackend(StorageBackend):
             await drain_and_reap(proc, t_err)
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
-        err = await t_err
-        rc = await proc.wait()
+        try:
+            err = await t_err
+            rc = await proc.wait()
+        except asyncio.CancelledError:
+            # cancellation landing on the post-stream awaits must
+            # still reap the child
+            await drain_and_reap(proc, t_err)
+            raise
         if rc != 0:
             raise StorageError("tar send failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))
@@ -364,8 +371,19 @@ class DirBackend(StorageBackend):
             ["tar", "-C", str(src), "-cf", "-", "."], writer,
             on_progress=on_progress,
             label="native send of %s@%s" % (dataset, name))
-        err = await t_err
-        rc = await proc.wait()
+        try:
+            err = await t_err
+            rc = await proc.wait()
+        except asyncio.CancelledError:
+            # the pump finished but a cancel cut the tail awaits: the
+            # child must still be reaped (zfs sibling reaps in exactly
+            # this window)
+            await drain_and_reap(proc, t_err)
+            raise
+        except Exception as e:
+            await drain_and_reap(proc, t_err)
+            raise StorageError("native send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
         if rc != 0:
             raise StorageError("tar send failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))
@@ -408,45 +426,37 @@ class DirBackend(StorageBackend):
         # unknown extended headers) would block on stderr, stop
         # reading stdin, and wedge the drain() below forever
         t_err = asyncio.ensure_future(proc.stderr.read())
-        done = 0
-        stream_error: Exception | None = None
-        while True:
-            try:
-                chunk = await reader.read(1 << 16)
-            except Exception as e:
-                # the network stream died — a clean tar exit would be
-                # meaningless (truncated-but-aligned archives extract "ok")
-                stream_error = e
-                break
-            if not chunk:
-                break
-            done += len(chunk)
-            try:
-                proc.stdin.write(chunk)
-                await proc.stdin.drain()
-            except (BrokenPipeError, ConnectionResetError):
-                break  # tar died early; its rc/stderr tell the story below
-            if progress_cb:
-                progress_cb(done, size)
-        if stream_error is not None:
-            await drain_and_reap(proc, t_err)
-            await self.destroy(dataset, recursive=True)
-            raise StorageError("recv into %s aborted: %s"
-                               % (dataset, stream_error)) from stream_error
         try:
-            proc.stdin.close()
-        except OSError:
-            pass
-        err = await t_err
-        rc = await proc.wait()
+            err, rc = await pump_socket_to_child(
+                proc, reader, t_err,
+                on_progress=(lambda d: progress_cb(d, size))
+                if progress_cb else None,
+                label="recv into %s" % dataset)
+        except BaseException:
+            # restore aborted (cancel, dead stream, anything): the
+            # helper already reaped the child; remove the partial
+            # dataset — leaving it would fail the NEXT restore attempt
+            # with 'recv target exists' until an operator intervenes
+            await self.destroy(dataset, recursive=True)
+            raise
         if rc != 0:
             await self.destroy(dataset, recursive=True)
             raise StorageError("tar recv failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))
-        # preserve the received snapshot on the receiver, like zfs recv
-        snapdir = self._dspath(dataset) / "@snapshots" / snapname
-        await asyncio.to_thread(shutil.copytree, data, snapdir, symlinks=True)
-        meta = self._load_meta(dataset)
-        meta["snaps"][snapname] = time.time()
-        meta["mounted"] = False  # zfs recv -u: received unmounted
-        self._save_meta(dataset, meta)
+        try:
+            # preserve the received snapshot on the receiver, like
+            # zfs recv
+            snapdir = self._dspath(dataset) / "@snapshots" / snapname
+            await asyncio.to_thread(shutil.copytree, data, snapdir,
+                                    symlinks=True)
+            meta = self._load_meta(dataset)
+            meta["snaps"][snapname] = time.time()
+            meta["mounted"] = False  # zfs recv -u: received unmounted
+            self._save_meta(dataset, meta)
+        except BaseException:
+            # ANY failure past this point — cancel, ENOSPC, perms —
+            # strands a half-recorded dataset that blocks every later
+            # restore with 'recv target exists': remove it like any
+            # other aborted restore
+            await self.destroy(dataset, recursive=True)
+            raise

@@ -22,6 +22,7 @@ from manatee_tpu.storage.base import (
     StorageBackend,
     StorageError,
     pump_child_to_socket,
+    pump_socket_to_child,
 )
 from manatee_tpu.utils import ExecError, run
 
@@ -217,14 +218,22 @@ class ZfsBackend(StorageBackend):
         t_err = asyncio.ensure_future(
             _watch_send_stderr(proc, state, err_chunks, progress_cb))
         t_out = asyncio.ensure_future(pump_stdout())
+        async def abort() -> None:
+            # shielded + strongly-referenced: a SECOND cancel during
+            # the abort must not skip the reap
+            from manatee_tpu.utils.executil import kill_and_reap
+            await kill_and_reap(proc, (t_err, t_out))
+
         try:
             await asyncio.gather(t_err, t_out)
+        except asyncio.CancelledError:
+            # caller cancelled (server shutdown, handler teardown):
+            # zfs send must not run on as an orphan blocked on its
+            # full stdout pipe
+            await abort()
+            raise
         except Exception as e:
-            for t in (t_err, t_out):
-                t.cancel()
-            await asyncio.gather(t_err, t_out, return_exceptions=True)
-            from manatee_tpu.utils.executil import reap_killed
-            await reap_killed(proc)
+            await abort()
             raise StorageError("zfs send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
         rc = await proc.wait()
@@ -254,13 +263,19 @@ class ZfsBackend(StorageBackend):
             label="native zfs send of %s@%s" % (dataset, name))
         try:
             await t_err
+            rc = await proc.wait()
+        except asyncio.CancelledError:
+            # cancellation on the tail awaits: the child must still be
+            # reaped
+            from manatee_tpu.utils.executil import drain_and_reap
+            await drain_and_reap(proc, t_err)
+            raise
         except Exception as e:
             # a failing progress callback aborts the send, exactly as on
             # the non-native path
             await reap_killed(proc)
             raise StorageError("zfs send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
-        rc = await proc.wait()
         if rc != 0:
             raise StorageError(
                 "zfs send failed (rc=%d): %s"
@@ -282,35 +297,14 @@ class ZfsBackend(StorageBackend):
         # send paths: a verbose recv blocking on a full stderr pipe
         # stops reading stdin and wedges the drain() below)
         t_err = asyncio.ensure_future(proc.stderr.read())
-        done = 0
-        stream_error: Exception | None = None
-        while True:
-            try:
-                chunk = await reader.read(1 << 16)
-            except Exception as e:
-                stream_error = e
-                break
-            if not chunk:
-                break
-            done += len(chunk)
-            try:
-                proc.stdin.write(chunk)
-                await proc.stdin.drain()
-            except (BrokenPipeError, ConnectionResetError):
-                break  # zfs recv died early; rc/stderr below explain
-            if progress_cb:
-                progress_cb(done, None)
-        if stream_error is not None:
-            from manatee_tpu.utils.executil import drain_and_reap
-            await drain_and_reap(proc, t_err)
-            raise StorageError("zfs recv into %s aborted: %s"
-                               % (dataset, stream_error)) from stream_error
-        try:
-            proc.stdin.close()
-        except OSError:
-            pass
-        err = await t_err
-        rc = await proc.wait()
+        # a killed zfs recv discards the incomplete stream itself, so
+        # unlike DirBackend there is no partial dataset to remove on
+        # abort — the helper's reap is the whole cleanup
+        err, rc = await pump_socket_to_child(
+            proc, reader, t_err,
+            on_progress=(lambda d: progress_cb(d, None))
+            if progress_cb else None,
+            label="zfs recv into %s" % dataset)
         if rc != 0:
             raise StorageError("zfs recv failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))

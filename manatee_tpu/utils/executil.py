@@ -27,7 +27,7 @@ _run_ids = itertools.count(1)
 _cleanup_tasks: set = set()
 
 
-async def _kill_and_reap(proc, tasks) -> None:
+async def kill_and_reap(proc, tasks) -> None:
     """Kill the child and reap it, guaranteed: a cancellation landing
     during the cleanup awaits (e.g. reconfigure cancels the watchdog,
     then close() cancels it again, or a timeout handler's caller is
@@ -126,13 +126,33 @@ async def drain_and_reap(proc: asyncio.subprocess.Process,
     task done) before reap_killed reads the same StreamReader — a
     concurrent read raises RuntimeError, silently skips the stderr
     drain, and proc.wait() can then block forever on the
-    undisconnected pipe."""
+    undisconnected pipe.
+
+    A cancellation aimed at the CALLING task while we await here is
+    indistinguishable at the except site from err_task's own
+    cancellation; finish the cleanup, then re-raise it (tracked via
+    Task.cancelling) so callers on except-Exception paths don't
+    convert a cancel into a StorageError/swallow it."""
+    cur = asyncio.current_task()
+    base = cur.cancelling() if cur is not None else 0
     err_task.cancel()
     try:
         await err_task
     except (asyncio.CancelledError, Exception):
         pass
-    await reap_killed(proc)
+    # the reap itself is shielded (like kill_and_reap): a cancel
+    # delivered during ITS awaits must not leave the child killed but
+    # never waited — the cleanup finishes detached and the cancel is
+    # re-raised below
+    cleanup = asyncio.ensure_future(reap_killed(proc))
+    _cleanup_tasks.add(cleanup)
+    cleanup.add_done_callback(_cleanup_tasks.discard)
+    try:
+        await asyncio.shield(cleanup)
+    except asyncio.CancelledError:
+        pass
+    if cur is not None and cur.cancelling() > base:
+        raise asyncio.CancelledError()
 
 
 async def reap_killed(proc: asyncio.subprocess.Process) -> None:
@@ -208,10 +228,10 @@ async def run(
         # the CALLER was cancelled (a watchdog/reconfigure racing this
         # exec): the child must not be orphaned — kill and reap it,
         # then let the cancellation propagate
-        await _kill_and_reap(proc, tasks)
+        await kill_and_reap(proc, tasks)
         raise
     except (asyncio.TimeoutError, OutputLimitExceeded) as e:
-        await _kill_and_reap(proc, tasks)
+        await kill_and_reap(proc, tasks)
 
         def partial(t) -> bytes:
             # whatever the reader captured before the cut — on the
