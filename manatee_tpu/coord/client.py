@@ -85,7 +85,13 @@ async def sync_status(host: str, port: int,
         writer.write(b'{"op":"sync_status","xid":0}\n')
         await writer.drain()
         line = await asyncio.wait_for(reader.readline(), timeout)
-        return json.loads(line).get("result")
+        res = json.loads(line)
+        # a malformed reply (e.g. literal null, a bare list) is 'does
+        # not answer properly', not an exception for the caller
+        if not isinstance(res, dict):
+            return None
+        result = res.get("result")
+        return result if isinstance(result, dict) else None
     except (OSError, ValueError, asyncio.TimeoutError):
         return None
     finally:

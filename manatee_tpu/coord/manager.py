@@ -167,10 +167,18 @@ class ConsensusMgr:
             await self._setup_task
         except asyncio.CancelledError:
             if self._setup_task.cancelled():
-                # the SETUP was cancelled (a concurrent close() racing
-                # startup) while our own caller was not: re-raising
-                # CancelledError here would falsely signal cancellation
-                # of an uncancelled caller — surface a clean error
+                cur = asyncio.current_task()
+                if cur is not None and cur.cancelling():
+                    # BOTH happened: close() cancelled the setup AND
+                    # our own caller was cancelled — the caller's
+                    # cancel must win, or wait_for's uncancel
+                    # bookkeeping is violated and a cancelled task
+                    # keeps running down an error path
+                    raise
+                # only the SETUP was cancelled (a concurrent close()
+                # racing startup): re-raising CancelledError would
+                # falsely signal cancellation of an uncancelled
+                # caller — surface a clean error
                 raise ConnectionLossError(
                     "coordination manager closed during startup"
                 ) from None
@@ -226,7 +234,9 @@ class ConsensusMgr:
         if self._client:
             try:
                 await self._client.close()
-            except CoordError:
+            except (CoordError, OSError):
+                # a TCP reset mid-close must not turn a clean daemon
+                # shutdown into a crash
                 pass
 
     async def _anti_entropy_loop(self) -> None:

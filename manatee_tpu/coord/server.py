@@ -1439,8 +1439,16 @@ class CoordServer:
                       for idx, addr in enumerate(self.ensemble)
                       if idx != self.my_id]
             results = await asyncio.gather(
-                *(self._probe(addr) for _i, addr in others))
+                *(self._probe(addr) for _i, addr in others),
+                return_exceptions=True)
             for (idx, _addr), st in zip(others, results):
+                if isinstance(st, BaseException):
+                    # a malformed/hostile reply must not kill the
+                    # heartbeat loop (followers would idle-timeout and
+                    # resync-flap forever) — but it IS a bug signal:
+                    # sync_status swallows all anticipated failures
+                    log.warning("probe of member %d raised %r", idx, st)
+                    continue
                 if st and st.get("role") == "leader":
                     if (st.get("seq", 0) > self._seq
                             or (st.get("seq", 0) == self._seq

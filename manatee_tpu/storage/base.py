@@ -345,21 +345,21 @@ async def pump_socket_to_child(
                 break  # child died early; rc/stderr tell the story
             if on_progress:
                 on_progress(done)
-        if stream_error is not None:
-            await drain_and_reap(proc, err_task)
-            raise StorageError("%s aborted: %s" % (label, stream_error)) \
-                from stream_error
-        try:
-            proc.stdin.close()
-        except OSError:
-            pass
-        err = await err_task
-        rc = await proc.wait()
-        return err, rc
+        if stream_error is None:
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            err = await err_task
+            rc = await proc.wait()
     except BaseException:
-        # aborted anywhere — a cancel, a dead stream (the StorageError
-        # above already reaped; the re-reap is idempotent), or a
-        # raising progress callback: the child must not run on as an
-        # orphan blocked on its stdin
+        # aborted anywhere — a cancel, or a raising progress callback:
+        # the child must not run on as an orphan blocked on its stdin
         await drain_and_reap(proc, err_task)
         raise
+    if stream_error is not None:
+        # raised OUTSIDE the try above so the reap runs exactly once
+        await drain_and_reap(proc, err_task)
+        raise StorageError("%s aborted: %s" % (label, stream_error)) \
+            from stream_error
+    return err, rc
