@@ -533,15 +533,22 @@ class AdmClient:
                             retries: int = 3) -> dict:
         """Read-modify-CAS loop for operator writes.  *mutate(state)*
         returns the new state dict (or raises AdmError)."""
+        from manatee_tpu.obs import bind_trace, new_trace_id
         for _ in range(retries):
             state, ver = await self.get_state(shard)
             if state is None:
                 raise AdmError("no cluster state for shard %r" % shard)
             new = mutate(json.loads(json.dumps(state)))
+            # operator transitions mint trace ids like the state
+            # machine's do, so freeze/promote/reap actions correlate
+            # with every peer's reaction in `manatee-adm events`
+            tid = new_trace_id()
+            new["trace"] = tid
             try:
-                await self._client.multi(cluster_state_txn(
-                    self._shard_path(shard) + "/history",
-                    self._shard_path(shard) + "/state", new, ver))
+                with bind_trace(tid):
+                    await self._client.multi(cluster_state_txn(
+                        self._shard_path(shard) + "/history",
+                        self._shard_path(shard) + "/state", new, ver))
                 return new
             except BadVersionError:
                 continue
@@ -664,10 +671,14 @@ class AdmClient:
             }
         if dry_run:
             return new
+        from manatee_tpu.obs import bind_trace, new_trace_id
+        new = dict(new)
+        new["trace"] = new_trace_id()
         await self._client.mkdirp(self._shard_path(shard) + "/history")
-        await self._client.multi(cluster_state_txn(
-            self._shard_path(shard) + "/history",
-            self._shard_path(shard) + "/state", new, None))
+        with bind_trace(new["trace"]):
+            await self._client.multi(cluster_state_txn(
+                self._shard_path(shard) + "/history",
+                self._shard_path(shard) + "/state", new, None))
         return new
 
     # -- promote --
@@ -690,7 +701,7 @@ class AdmClient:
                 raise AdmError("cluster has warnings; use -y to "
                                "override: %s"
                                % "; ".join(details.warnings))
-            if any(l > lag_to_ignore for l in lags):
+            if any(lag > lag_to_ignore for lag in lags):
                 raise AdmError("replication lag exceeds %ss; use -y to "
                                "override" % lag_to_ignore)
 
@@ -760,3 +771,75 @@ class AdmClient:
         """True if the lock node EXISTS (lib/adm.js:2049-2086)."""
         stat = await self._client.exists(path)
         return stat is not None
+
+    # -- shard-wide event timeline --
+
+    async def shard_events(self, shard: str, *,
+                           limit: int | None = None,
+                           timeout: float = 5.0) -> dict:
+        """Fan out ``GET /events`` to every peer's status server (the
+        topology's peers plus any election member not yet adopted),
+        merge the rings by wall-clock timestamp (peer/seq as the
+        tiebreak), and return::
+
+            {"events": [...merged, oldest first...],
+             "errors": {peer_id: "why the fetch failed", ...}}
+
+        The merged list is what one grep of per-peer bunyan logs could
+        never give the reference's operators: a single trace-correlated
+        takeover timeline."""
+        import aiohttp
+
+        state, _v = await self.get_state(shard)
+        peers: dict[str, dict] = {}
+        if state is not None:
+            for p in ([state.get("primary"), state.get("sync")]
+                      + list(state.get("async") or [])
+                      + list(state.get("deposed") or [])):
+                if p and p.get("id"):
+                    peers[p["id"]] = p
+        for a in await self.get_active(shard):
+            ent = {"id": a["id"]}
+            ent.update(a.get("data") or {})
+            peers.setdefault(a["id"], ent)
+
+        events: list[dict] = []
+        errors: dict[str, str] = {}
+
+        async def fetch(peer: dict, http) -> None:
+            try:
+                _s, host, pg_port = parse_pg_url(peer.get("pgUrl") or "")
+            except PgError:
+                errors[peer["id"]] = ("unsupported pgUrl %r"
+                                      % peer.get("pgUrl"))
+                return
+            url = "http://%s:%d/events" % (host, pg_port + 1)
+            if limit is not None:
+                url += "?limit=%d" % limit
+            try:
+                async with http.get(url) as resp:
+                    if resp.status != 200:
+                        errors[peer["id"]] = "HTTP %d" % resp.status
+                        return
+                    body = await resp.json()
+            except Exception as e:
+                errors[peer["id"]] = str(e) or type(e).__name__
+                return
+            for ev in body.get("events") or []:
+                if not isinstance(ev, dict):
+                    continue
+                # an old sitter (or a journal predating set_peer) may
+                # report peer missing/None; the fan-out knows who it
+                # asked
+                if ev.get("peer") is None:
+                    ev["peer"] = peer["id"]
+                events.append(ev)
+
+        http_timeout = aiohttp.ClientTimeout(total=timeout)
+        async with aiohttp.ClientSession(timeout=http_timeout) as http:
+            await asyncio.gather(*[fetch(p, http)
+                                   for p in peers.values()])
+        events.sort(key=lambda e: (e.get("ts") or 0.0,
+                                   str(e.get("peer")),
+                                   e.get("seq") or 0))
+        return {"events": events, "errors": errors}

@@ -26,6 +26,7 @@ import logging
 
 import aiohttp
 
+from manatee_tpu.obs import get_journal
 from manatee_tpu.storage.base import StorageBackend
 
 log = logging.getLogger("manatee.backup.client")
@@ -79,13 +80,21 @@ class RestoreClient:
         """Full restore from *backup_url* (the upstream PeerInfo's
         backupUrl)."""
         isolated = await self.isolate(isolate_prefix)
+        journal = get_journal()
+        journal.record("restore.receive.start", url=backup_url,
+                       dataset=self.dataset)
         try:
             await self._receive(backup_url)
-        except Exception:
+        except Exception as e:
             # the failed partial was cleaned by storage.recv; the
             # isolated dataset is left for operator recovery, as the
             # reference does
+            journal.record("restore.receive.failed", url=backup_url,
+                           error=str(e))
             raise
+        journal.record(
+            "restore.receive.done", url=backup_url,
+            bytes=(self.current_job or {}).get("completed"))
         await self.storage.set_mountpoint(self.dataset, self.mountpoint)
         await self.storage.mount(self.dataset)
         await self.storage.snapshot(self.dataset)   # initial snapshot

@@ -528,6 +528,59 @@ def cmd_history(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_events(args) -> int:
+    """Merged shard-wide event timeline (beyond-parity observability):
+    fans out GET /events across every peer's status server, merges by
+    timestamp, and prints one trace-correlated sequence — a takeover is
+    reconstructed end-to-end with a single command instead of grepping
+    per-peer bunyan logs."""
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            out = await adm.shard_events(_shard(args),
+                                         limit=args.limit)
+        events = out["events"]
+        if args.trace:
+            events = [e for e in events
+                      if e.get("trace") == args.trace]
+        if args.event:
+            events = [e for e in events
+                      if args.event in str(e.get("event"))]
+        if args.json:
+            for e in events:
+                print(json.dumps(e))
+        else:
+            cols = [
+                {"name": "time", "label": "TIME", "width": 24},
+                {"name": "peer", "label": "PEER", "width": 21},
+                {"name": "trace", "label": "TRACE", "width": 16},
+                {"name": "event", "label": "EVENT", "width": 24},
+                {"name": "detail", "label": "DETAIL", "width": 30},
+            ]
+            core = {"seq", "ts", "time", "peer", "event", "trace"}
+            rows = []
+            for e in events:
+                detail = " ".join(
+                    "%s=%s" % (k, e[k]) for k in sorted(e)
+                    if k not in core and e[k] is not None)
+                rows.append({
+                    "time": e.get("time", "?"),
+                    "peer": e.get("peer", "?"),
+                    "trace": e.get("trace") or "-",
+                    "event": e.get("event", "?"),
+                    "detail": detail or "-",
+                })
+            emit_table(cols, rows, omit_header=args.omit_header)
+        for peer_id, err in sorted(out["errors"].items()):
+            sys.stderr.write("warning: no events from %s: %s\n"
+                             % (peer_id, err))
+        # exit nonzero only when NO peer answered (a dead peer's ring
+        # died with it; partial timelines are still the tool's job) —
+        # judged on the UNFILTERED fetch, so a -t/-e filter matching
+        # nothing is not an error
+        return 0 if out["events"] or not out["errors"] else 1
+    return asyncio.run(go())
+
+
 def cmd_rebuild(args) -> int:
     """Guarded rebuild flow (lib/adm.js:1319-1684): refuse on the
     primary; deposed peers get their dataset destroyed and their deposed
@@ -747,6 +800,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("check-lock", cmd_check_lock,
              "exit 1 if a lock node exists", shard=False)
     sp.add_argument("-p", "--path", required=True)
+
+    sp = add("events", cmd_events,
+             "merged shard-wide event timeline (trace-correlated)")
+    sp.add_argument("-j", "--json", action="store_true",
+                    help="one JSON object per event")
+    sp.add_argument("-t", "--trace", default=None,
+                    help="only events carrying this trace id")
+    sp.add_argument("-e", "--event", default=None,
+                    help="only events whose name contains this string")
+    sp.add_argument("-n", "--limit", type=int, default=None,
+                    help="newest N events per peer")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
 
     sp = add("history", cmd_history, "annotated cluster state history")
     sp.add_argument("-j", "--json", action="store_true")

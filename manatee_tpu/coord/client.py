@@ -39,8 +39,18 @@ from manatee_tpu.coord.api import (
     WatchCb,
     WatchEvent,
 )
+from manatee_tpu.obs import current_trace, get_journal, get_registry
 
 log = logging.getLogger("manatee.coord.client")
+
+_REG = get_registry()
+_RPC_DUR = _REG.histogram(
+    "coord_rpc_duration_seconds",
+    "coordination RPC round-trip latency", ("op",))
+_SESSION_EVENTS = _REG.counter(
+    "coord_session_events_total",
+    "coordination session lifecycle events "
+    "(connected/disconnected/expired)", ("event",))
 
 _ERRS = {
     "NoNodeError": NoNodeError,
@@ -274,6 +284,10 @@ class NetCoord(CoordClient):
         self._session_cbs.append(cb)
 
     def _notify(self, event: str) -> None:
+        _SESSION_EVENTS.inc(event=event)
+        get_journal().record("coord.session." + event,
+                             session=self._session_id,
+                             addr="%s:%d" % (self.host, self.port))
         for cb in list(self._session_cbs):
             try:
                 cb(event)
@@ -394,8 +408,14 @@ class NetCoord(CoordClient):
             raise ConnectionLossError("not connected")
         xid = next(self._xids)
         req["xid"] = xid
+        # trace propagation: the server binds this id for its own
+        # logging, so one grep follows a transition into coordd
+        tid = current_trace()
+        if tid is not None and "trace" not in req:
+            req["trace"] = tid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
+        t0 = time.monotonic()
         try:
             self._writer.write((json.dumps(req) + "\n").encode())
             await self._writer.drain()
@@ -403,6 +423,8 @@ class NetCoord(CoordClient):
             self._pending.pop(xid, None)
             raise ConnectionLossError(str(e)) from None
         msg = await fut
+        _RPC_DUR.observe(time.monotonic() - t0,
+                         op=str(req.get("op", "?")))
         if msg.get("ok"):
             return msg.get("result")
         raise _ERRS.get(msg.get("error"), CoordError)(msg.get("msg", ""))

@@ -37,6 +37,7 @@ from manatee_tpu.coord.api import (
     SessionExpiredError,
     cluster_state_txn,
 )
+from manatee_tpu.obs import get_journal
 
 log = logging.getLogger("manatee.coord")
 
@@ -339,6 +340,8 @@ class ConsensusMgr:
         await self._read_active_and_watch(client)
         if not self._inited:
             self._inited = True
+            get_journal().record(
+                "coord.init", members=[a["id"] for a in self.active])
             self._emit("init", {
                 "active": self.active,
                 "clusterState": self._cluster_state,
@@ -347,6 +350,9 @@ class ConsensusMgr:
             # a post-init rebuild (session expiry): membership knowledge
             # was reconstructed from scratch — consumers that reason
             # about "how long has X been absent" must re-arm
+            get_journal().record(
+                "coord.session.rebuilt",
+                members=[a["id"] for a in self.active])
             self._emit("sessionRebuilt", {
                 "active": self.active,
                 "clusterState": self._cluster_state,
@@ -417,6 +423,15 @@ class ConsensusMgr:
         self._cluster_state = state
         self._cluster_state_version = version
         if self._inited and changed:
+            # the observed transition carries its initiator's trace id
+            # (state/machine.py embeds it at write time): journal under
+            # it so every peer's reaction lines up in the shard timeline
+            get_journal().record(
+                "clusterstate.change",
+                trace_id=state.get("trace") if isinstance(state, dict)
+                else None,
+                generation=(state or {}).get("generation"),
+                version=version)
             self._emit("clusterStateChange", state)
 
     # ---- active watch ----
@@ -452,6 +467,9 @@ class ConsensusMgr:
         should_debounce = _id_lists_equal(self._active, active)
         self._active = active
         if self._inited and not should_debounce:
+            get_journal().record(
+                "membership.change",
+                members=[a["id"] for a in active])
             self._emit("activeChange", self.active)
 
     async def refresh_cluster_state(self, client: CoordClient | None = None

@@ -102,9 +102,25 @@ from manatee_tpu.coord.api import (
     NotLeaderError,
     Op,
 )
+from manatee_tpu.obs import bind_trace
+from manatee_tpu.obs.metrics import Histogram
 from manatee_tpu.utils.logutil import setup_logging
 
 log = logging.getLogger("manatee.coordd")
+
+# server-side RPC handling latency (includes replication/fsync waits for
+# mutations).  A standalone instrument, NOT the process registry: coordd
+# renders its own builder under the "coordd" prefix.
+_RPC_HANDLE = Histogram(
+    "rpc_handle_duration_seconds",
+    "server-side request handling latency (fsync+replication included "
+    "for mutations)", ("op",))
+# ops a client can legitimately name; anything else folds into "other"
+# so a hostile/buggy client cannot explode label cardinality
+_KNOWN_OPS = frozenset({
+    "hello", "goodbye", "ping", "create", "get", "set", "delete",
+    "exists", "children", "multi", "sync_status", "sync_hello",
+    "sync_ack"})
 
 _ERR_NAMES = {
     NoNodeError: "NoNodeError",
@@ -923,6 +939,8 @@ class CoordServer:
                  count_nodes(self.tree._root))
         b.metric("watches", "gauge", "registered one-shot watches",
                  sum(len(v) for v in self.tree._watches.values()))
+        b.histogram(_RPC_HANDLE.name, _RPC_HANDLE.help,
+                    _RPC_HANDLE.buckets, _RPC_HANDLE.series())
         return b.render()
 
     def _expire_due_sessions(self) -> None:
@@ -974,10 +992,22 @@ class CoordServer:
                                "msg": "bad json"})
                     continue
                 conn.in_dispatch = True
+                tid = req.get("trace")
+                t0 = time.monotonic()
                 try:
-                    await self._dispatch(conn, req)
+                    # bind the client's trace id so every log line this
+                    # request produces correlates with the transition
+                    # that caused it (the sitter's state write)
+                    with bind_trace(tid if isinstance(tid, str)
+                                    else None):
+                        await self._dispatch(conn, req)
                 finally:
                     conn.in_dispatch = False
+                    op = req.get("op")
+                    _RPC_HANDLE.observe(
+                        time.monotonic() - t0,
+                        op=(op if isinstance(op, str)
+                            and op in _KNOWN_OPS else "other"))
                 try:
                     await writer.drain()
                 except (ConnectionError, RuntimeError):

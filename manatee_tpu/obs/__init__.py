@@ -1,0 +1,57 @@
+"""Shard-wide observability: metrics registry, trace ids, event journal.
+
+The reference manatee has none of this — its operators reconstruct a
+failover by grepping per-peer bunyan logs (PAPER.md §0).  This package
+gives every component in the peer three shared primitives:
+
+- a process-wide metrics **registry** (`get_registry()`): counters,
+  gauges, and monotonic-clock latency histograms with fixed buckets,
+  rendered through the shared Prometheus text builder by the status
+  server's ``GET /metrics`` (and coordd's);
+- **trace ids** (`new_trace_id()` / `bind_trace()`): every
+  state-machine transition mints one; it rides the coord RPC frames,
+  the cluster-state object itself (so *other* peers' reactions to the
+  transition carry the initiator's id), every bunyan log record, and
+  the pg/backup operations the transition causes;
+- an in-memory ring-buffer event **journal** (`get_journal()`):
+  transition begun/committed, role changes, coord session events,
+  probe state flips, restore start/finish — exposed as ``GET /events``
+  per peer and merged shard-wide by ``manatee-adm events``.
+
+Everything here is stdlib-only and allocation-light: observability must
+never be able to hurt HA.
+"""
+
+from manatee_tpu.obs.journal import EventJournal, get_journal, set_peer
+from manatee_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from manatee_tpu.obs.trace import (
+    TraceLogFilter,
+    bind_trace,
+    current_trace,
+    ensure_trace,
+    new_trace_id,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "EventJournal",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "TraceLogFilter",
+    "bind_trace",
+    "current_trace",
+    "ensure_trace",
+    "get_journal",
+    "get_registry",
+    "new_trace_id",
+    "set_peer",
+]

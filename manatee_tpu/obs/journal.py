@@ -1,0 +1,94 @@
+"""In-memory ring-buffer event journal (one per process).
+
+Every notable control-plane moment — transition begun/committed, leader
+acquired/lost, coord session events, probe state flips, restore
+start/finish — is recorded as one small dict.  The ring is fixed-size
+(observability must never grow without bound inside an HA daemon) and
+exposed verbatim by the status server's ``GET /events``;
+``manatee-adm events`` fans out across peers and merges the rings into
+the shard timeline.
+
+Event shape::
+
+    {"seq":   int,     # per-process, monotonically increasing
+     "ts":    float,   # epoch seconds (wall clock, for cross-peer merge)
+     "time":  str,     # ISO-8601 ms UTC of ts
+     "peer":  str,     # this peer's id (set_peer at daemon startup)
+     "event": str,     # dotted name, e.g. "transition.committed"
+     "trace": str|None,# trace id (bound or explicit)
+     ...}              # free-form detail fields
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from manatee_tpu.obs.trace import current_trace
+
+DEFAULT_CAPACITY = 2048
+
+_RESERVED = frozenset(("seq", "ts", "time", "peer", "event", "trace"))
+
+
+def _iso_ms(ts: float) -> str:
+    ms = int(round((ts % 1.0) * 1000))
+    sec = int(ts)
+    if ms >= 1000:                  # carry: .9995+ rounds into the next second
+        sec += 1
+        ms -= 1000
+    base = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(sec))
+    return "%s.%03dZ" % (base, ms)
+
+
+class EventJournal:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._buf: deque[dict] = deque(maxlen=capacity)
+        self._seq = 0
+        self.peer: str | None = None
+
+    def record(self, event: str, *, trace_id: str | None = None,
+               **fields) -> dict:
+        """Append one event.  *trace_id* defaults to the trace bound in
+        the current context; detail *fields* may not shadow the core
+        keys."""
+        self._seq += 1
+        ts = round(time.time(), 3)   # one value for ts AND time
+        ent = {
+            "seq": self._seq,
+            "ts": ts,
+            "time": _iso_ms(ts),
+            "peer": self.peer,
+            "event": event,
+            "trace": trace_id if trace_id is not None else current_trace(),
+        }
+        for k, v in fields.items():
+            if k not in _RESERVED:
+                ent[k] = v
+        self._buf.append(ent)
+        return ent
+
+    def events(self, *, since: int = 0, limit: int | None = None
+               ) -> list[dict]:
+        """Events with seq > *since*, oldest first, newest *limit*."""
+        out = [e for e in self._buf if e["seq"] > since]
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+_JOURNAL = EventJournal()
+
+
+def get_journal() -> EventJournal:
+    """The process-wide journal every component records into."""
+    return _JOURNAL
+
+
+def set_peer(peer_id: str) -> None:
+    """Stamp this process's peer identity onto subsequent events (called
+    once at daemon wiring time)."""
+    _JOURNAL.peer = peer_id

@@ -1,0 +1,223 @@
+"""Process-wide metrics registry.
+
+Components get-or-create named instruments at import/wiring time and
+update them on hot paths with plain dict writes — no locks, no I/O, no
+allocation beyond the first touch of a label set (asyncio runs them on
+one thread).  The status server renders the whole registry through the
+shared Prometheus text builder on scrape.
+
+Naming is enforced at registration, not left to review: counters must
+end in ``_total`` and histograms observing durations must be base-unit
+``_seconds`` (the Prometheus conventions the satellite audit fixed in
+``utils/prom.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+# Latency buckets for control-plane operations: sub-ms RPCs up through
+# multi-minute restores.  One fixed scale everywhere so histograms from
+# different peers are mergeable.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+)
+
+_INF = float("inf")
+
+
+def _labels_key(label_names: tuple[str, ...], labels: dict) -> tuple:
+    if set(labels) != set(label_names):
+        raise ValueError("expected labels %r, got %r"
+                         % (label_names, sorted(labels)))
+    return tuple(str(labels[n]) for n in label_names)
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = ()):
+        if not name.endswith("_total"):
+            raise ValueError("counter %r must end in _total" % name)
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _labels_key(self.label_names, labels)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_labels_key(self.label_names, labels), 0.0)
+
+    def samples(self) -> list[tuple[dict, float]]:
+        if not self.label_names and not self._values:
+            return [({}, 0.0)]   # an untouched plain counter still exports
+        return [(dict(zip(self.label_names, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self._values[_labels_key(self.label_names, labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _labels_key(self.label_names, labels)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_labels_key(self.label_names, labels), 0.0)
+
+    def samples(self) -> list[tuple[dict, float]]:
+        return [(dict(zip(self.label_names, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class Histogram(_Instrument):
+    """Cumulative fixed-bucket histogram; durations observed in seconds
+    measured on the monotonic clock (use :meth:`time`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        if "duration" in name and not name.endswith("_seconds"):
+            raise ValueError(
+                "duration histogram %r must end in _seconds" % name)
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket")
+        self._series: dict[tuple, dict] = {}
+
+    def _series_for(self, labels: dict) -> dict:
+        key = _labels_key(self.label_names, labels)
+        s = self._series.get(key)
+        if s is None:
+            s = {"counts": [0] * len(self.buckets), "sum": 0.0,
+                 "count": 0}
+            self._series[key] = s
+        return s
+
+    def observe(self, value: float, **labels) -> None:
+        s = self._series_for(labels)
+        s["sum"] += value
+        s["count"] += 1
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                s["counts"][i] += 1
+
+    @contextlib.contextmanager
+    def time(self, **labels):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(time.monotonic() - t0, **labels)
+
+    def snapshot(self, **labels) -> dict:
+        """{'count', 'sum', 'counts'} for one label set (zeros if never
+        observed) — for tests and acceptance probes."""
+        key = _labels_key(self.label_names, labels)
+        s = self._series.get(key)
+        if s is None:
+            return {"counts": [0] * len(self.buckets), "sum": 0.0,
+                    "count": 0}
+        return {"counts": list(s["counts"]), "sum": s["sum"],
+                "count": s["count"]}
+
+    def series(self) -> list[tuple[dict, dict]]:
+        return [(dict(zip(self.label_names, k)), s)
+                for k, s in sorted(self._series.items())]
+
+
+class Registry:
+    """Get-or-create instrument registry.  Re-registering the same name
+    with the same kind returns the existing instrument (components wire
+    independently and must converge on one series); a kind clash is a
+    programming error and raises."""
+
+    def __init__(self):
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name: str, help_: str,
+                       label_names: tuple[str, ...], **kw):
+        inst = self._instruments.get(name)
+        if inst is not None:
+            if not isinstance(inst, cls):
+                raise ValueError("metric %r already registered as %s"
+                                 % (name, inst.kind))
+            return inst
+        inst = cls(name, help_, label_names, **kw)
+        self._instruments[name] = inst
+        return inst
+
+    def counter(self, name: str, help_: str,
+                label_names: tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_, label_names)
+
+    def gauge(self, name: str, help_: str,
+              label_names: tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, label_names)
+
+    def histogram(self, name: str, help_: str,
+                  label_names: tuple[str, ...] = (),
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help_, label_names,
+                                   buckets=buckets)
+
+    def instruments(self) -> list[_Instrument]:
+        return [self._instruments[k]
+                for k in sorted(self._instruments)]
+
+    def render_into(self, builder) -> None:
+        """Append every instrument to a ``utils.prom.MetricsBuilder``."""
+        from manatee_tpu.utils.prom import label_str
+
+        for inst in self.instruments():
+            if inst.kind in ("counter", "gauge"):
+                samples = [(label_str(**labels), _fmt(v))
+                           for labels, v in inst.samples()]
+                builder.metric(inst.name, inst.kind, inst.help, samples)
+            else:
+                series = [(labels, s) for labels, s in inst.series()]
+                builder.histogram(inst.name, inst.help, inst.buckets,
+                                  series)
+
+
+def _fmt(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-wide registry every component registers into."""
+    return _REGISTRY

@@ -1,0 +1,68 @@
+"""Trace ids: correlate one topology transition across peers and layers.
+
+A trace id is minted where a transition originates (the state machine's
+durable write, an operator action in ``manatee-adm``) and then:
+
+- bound to the current task via a :mod:`contextvars` context var, so
+  everything the transition causes in-process (pg reconfigure, restore,
+  journal events) inherits it without plumbing;
+- attached to every coord RPC frame the client sends (``trace`` field),
+  so coordd's logs carry it;
+- embedded in the written cluster state (``trace`` key), so *other*
+  peers reacting to the watch fire bind the same id — that is what
+  makes the shard-wide ``manatee-adm events`` timeline line up;
+- stamped on every bunyan log record by :class:`TraceLogFilter`
+  (installed by ``logutil.setup_logging``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import uuid
+
+_current: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "manatee_trace_id", default=None)
+
+
+def new_trace_id() -> str:
+    """16 hex chars — short enough to read in a log line, unique enough
+    for a shard's lifetime of transitions."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace() -> str | None:
+    return _current.get()
+
+
+def ensure_trace() -> str:
+    """The bound trace id, or a freshly minted one (NOT bound)."""
+    return _current.get() or new_trace_id()
+
+
+@contextlib.contextmanager
+def bind_trace(trace_id: str | None):
+    """Bind *trace_id* for the duration of the block (None = leave the
+    current binding untouched, so callers can pass through an optional
+    id without branching)."""
+    if trace_id is None:
+        yield _current.get()
+        return
+    token = _current.set(trace_id)
+    try:
+        yield trace_id
+    finally:
+        _current.reset(token)
+
+
+class TraceLogFilter(logging.Filter):
+    """Stamps the bound trace id onto every record that does not already
+    carry one — the bunyan formatter's generic extra passthrough then
+    emits it as ``trace_id``."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tid = _current.get()
+        if tid is not None and not hasattr(record, "trace_id"):
+            record.trace_id = tid
+        return True

@@ -36,11 +36,24 @@ from pathlib import Path
 from typing import Awaitable, Callable
 
 from manatee_tpu.health.telemetry import STATUS_EVERY
+from manatee_tpu.obs import get_journal, get_registry
 from manatee_tpu.pg.engine import Engine, PgError, parse_pg_url
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
 
 log = logging.getLogger("manatee.pg")
+
+_REG = get_registry()
+_RECONF_DUR = _REG.histogram(
+    "pg_reconfigure_duration_seconds",
+    "role reconfiguration latency (restore time included)", ("role",))
+_PROBE_DUR = _REG.histogram(
+    "pg_probe_duration_seconds", "health-probe round-trip latency")
+_PROBE_FLIPS = _REG.counter(
+    "pg_probe_flips_total", "health verdict flips", ("to",))
+_RESTORES = _REG.counter(
+    "pg_restores_total", "full restores from an upstream backup server",
+    ("result",))
 
 
 class NeedsRestoreError(PgError):
@@ -213,23 +226,39 @@ class PostgresMgr:
         async with self._reconf_lock:
             role = pgcfg.get("role")
             log.info("%s: reconfigure -> %s", self.peer_id, role)
+            journal = get_journal()
+            journal.record("pg.reconfigure.begin", role=role,
+                           peer_id=self.peer_id)
             # again under the lock: a reconfigure that was mid-flight
             # when we pre-cancelled may have armed fresh tasks on its
             # way out
             await self._cancel_catchup()
             self._cancel_repoint()
-            if role == "primary":
-                if self._applied and self._applied.get("role") == \
-                        "primary" and self.running:
-                    await self._update_standby(pgcfg)
+            t0 = time.monotonic()
+            try:
+                if role == "primary":
+                    if self._applied and self._applied.get("role") == \
+                            "primary" and self.running:
+                        await self._update_standby(pgcfg)
+                    else:
+                        await self._primary(pgcfg)
+                elif role in ("sync", "async"):
+                    await self._standby(pgcfg)
+                elif role == "none":
+                    await self._stop()
                 else:
-                    await self._primary(pgcfg)
-            elif role in ("sync", "async"):
-                await self._standby(pgcfg)
-            elif role == "none":
-                await self._stop()
-            else:
-                raise PgError("bad role: %r" % role)
+                    raise PgError("bad role: %r" % role)
+            except asyncio.CancelledError:
+                journal.record("pg.reconfigure.cancelled", role=role)
+                raise
+            except Exception as e:
+                _RECONF_DUR.observe(time.monotonic() - t0,
+                                    role=str(role))
+                journal.record("pg.reconfigure.failed", role=role,
+                               error=str(e))
+                raise
+            _RECONF_DUR.observe(time.monotonic() - t0, role=str(role))
+            journal.record("pg.reconfigure.done", role=role)
             self._applied = pgcfg
 
     def _cancel_repoint(self) -> None:
@@ -431,7 +460,23 @@ class PostgresMgr:
                         "%s", self.peer_id, e, upstream.get("backupUrl"))
             await self._stop()
             self._emit("restoreStart", upstream)
-            await self.restore_fn(upstream)
+            get_journal().record("restore.start",
+                                 upstream=upstream.get("id"),
+                                 url=upstream.get("backupUrl"),
+                                 reason=str(e))
+            try:
+                await self.restore_fn(upstream)
+            except asyncio.CancelledError:
+                raise
+            except Exception as re_err:
+                _RESTORES.inc(result="failed")
+                get_journal().record("restore.failed",
+                                     upstream=upstream.get("id"),
+                                     error=str(re_err))
+                raise
+            _RESTORES.inc(result="ok")
+            get_journal().record("restore.done",
+                                 upstream=upstream.get("id"))
             self._emit("restoreDone", upstream)
             await self._ensure_dataset_mounted(create=False)
             self.engine.write_config(
@@ -695,6 +740,7 @@ class PostgresMgr:
             if not self.running:
                 if self._online:
                     self._online = False
+                    self._probe_flip("offline", "not running")
                     self._emit("unhealthy", "not running")
                 continue
             # LIVENESS keeps the reference's contract verbatim: one
@@ -703,6 +749,7 @@ class PostgresMgr:
             t0 = time.monotonic()
             ok = await self.engine.health(self.host, self.port, timeout)
             latency_ms = (time.monotonic() - t0) * 1000.0
+            _PROBE_DUR.observe(latency_ms / 1000.0)
             # TELEMETRY piggybacks on a subset of ticks (the status op
             # may be several queries on a real engine); its failure
             # never flips liveness — missing lag/wal is just unknown
@@ -717,10 +764,17 @@ class PostgresMgr:
             self._record_telemetry(ok, latency_ms, st)
             if ok and not self._online:
                 self._online = True
+                self._probe_flip("online", None)
                 self._emit("healthy", None)
             elif not ok and self._online:
                 self._online = False
+                self._probe_flip("offline", "health check failed")
                 self._emit("unhealthy", "health check failed")
+
+    def _probe_flip(self, to: str, why: str | None) -> None:
+        _PROBE_FLIPS.inc(to=to)
+        get_journal().record("probe.flip", to=to, why=why,
+                             peer_id=self.peer_id)
 
     def _record_telemetry(self, ok: bool, latency_ms: float,
                           st: dict | None) -> None:

@@ -48,11 +48,18 @@ from __future__ import annotations
 import asyncio
 import datetime
 import logging
+import time
 from typing import Callable
 
 from manatee_tpu.coord.api import (
     BadVersionError,
     NodeExistsError,
+)
+from manatee_tpu.obs import (
+    bind_trace,
+    get_journal,
+    get_registry,
+    new_trace_id,
 )
 from manatee_tpu.state.types import (
     INITIAL_WAL,
@@ -66,6 +73,24 @@ from manatee_tpu.state.types import (
 log = logging.getLogger("manatee.state")
 
 RETRY_DELAY = 1.0
+
+_REG = get_registry()
+# durable state writes by this peer (was the status server's ad-hoc
+# listener counter; same exported name, now registry-owned)
+_TRANSITIONS = _REG.counter(
+    "state_transitions_total", "durable state writes made by this peer")
+_TRANSITION_DUR = _REG.histogram(
+    "transition_write_duration_seconds",
+    "latency of the durable cluster-state CAS write")
+# THE headline SLI: primary-loss-detection -> new-primary-writable,
+# observed by the taking-over sync (detection stamped in _sync_duties,
+# completion on the PG manager's 'writable' event)
+_FAILOVER_DUR = _REG.histogram(
+    "failover_duration_seconds",
+    "primary loss detected by the sync until the new primary re-enabled "
+    "writes",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0))
 
 
 from manatee_tpu.utils import iso_ms as _now_iso  # noqa: E402
@@ -122,11 +147,23 @@ class PeerStateMachine:
         self._pg_target: dict | None = None
         self._pg_applied: dict | None = None
         self._listeners: dict[str, list[Callable]] = {}
+        # failover SLI bookkeeping: monotonic stamp of the moment this
+        # peer (as sync) detected the primary's loss, and the trace id
+        # of the takeover, cleared when the new primary is writable
+        self._failover_t0: float | None = None
+        self._failover_trace: str | None = None
 
         zk.on("init", self._on_zk_init)
         zk.on("activeChange", self._on_active_change)
         zk.on("clusterStateChange", self._on_cluster_state)
         zk.on("sessionRebuilt", self._on_session_rebuilt)
+        # 'writable' fires when the PG manager re-enables writes after
+        # the downstream catches up — the end of the failover SLI.
+        # getattr-guarded: unit-test fakes implement only the pg calls
+        # the decision procedure needs.
+        pg_on = getattr(pg, "on", None)
+        if callable(pg_on):
+            pg_on("writable", self._on_pg_writable)
 
     # ---- events out (role changes, shutdown requests) ----
 
@@ -167,6 +204,10 @@ class PeerStateMachine:
         self._boot_time = asyncio.get_event_loop().time()
         self._witnessed.clear()
         self._witness((payload or {}).get("active"))
+        # the failover clock rests on witnessed-death evidence, which a
+        # rebuilt session voids along with the sightings themselves
+        self._failover_t0 = None
+        self._failover_trace = None
         self.kick()
 
     def _on_active_change(self, actives: list[dict]) -> None:
@@ -257,29 +298,36 @@ class PeerStateMachine:
             return
 
         my_role = role_of(st, self.self_id)
-        self._notify_role(my_role, st)
+        # react under the trace of the transition that produced this
+        # state: the pg reconfigure (and its logs/journal events) on
+        # EVERY peer then correlates with the initiating write — new
+        # transitions we decide below mint their own fresh ids in
+        # _write_state
+        with bind_trace(st.get("trace")):
+            self._notify_role(my_role, st)
 
-        if st.get("oneNodeWriteMode") and my_role != "primary":
-            # ONWM: foreign peers shut down (docs/user-guide.md:369-372)
-            log.warning("cluster is in one-node-write mode and we are not "
-                        "the primary; shutting down")
-            await self._apply_pg({"role": "none"})
-            return
+            if st.get("oneNodeWriteMode") and my_role != "primary":
+                # ONWM: foreign peers shut down
+                # (docs/user-guide.md:369-372)
+                log.warning("cluster is in one-node-write mode and we "
+                            "are not the primary; shutting down")
+                await self._apply_pg({"role": "none"})
+                return
 
-        if my_role == "primary":
-            await self._apply_pg(self._pg_config_for(st, "primary"))
-            await self._primary_duties(st, ver, actives)
-        elif my_role == "sync":
-            acted = await self._sync_duties(st, ver, actives)
-            if not acted:
-                await self._apply_pg(self._pg_config_for(st, "sync"))
-        elif my_role == "async":
-            await self._apply_pg(self._pg_config_for(st, "async"))
-        elif my_role == "deposed":
-            await self._apply_pg({"role": "none", "deposed": True})
-        else:
-            # unassigned: wait for the primary to adopt us
-            await self._apply_pg({"role": "none"})
+            if my_role == "primary":
+                await self._apply_pg(self._pg_config_for(st, "primary"))
+                await self._primary_duties(st, ver, actives)
+            elif my_role == "sync":
+                acted = await self._sync_duties(st, ver, actives)
+                if not acted:
+                    await self._apply_pg(self._pg_config_for(st, "sync"))
+            elif my_role == "async":
+                await self._apply_pg(self._pg_config_for(st, "async"))
+            elif my_role == "deposed":
+                await self._apply_pg({"role": "none", "deposed": True})
+            else:
+                # unassigned: wait for the primary to adopt us
+                await self._apply_pg({"role": "none"})
 
     def _notify_role(self, my_role: str | None, st: ClusterState) -> None:
         """Emit role-transition events ONCE per transition."""
@@ -289,6 +337,19 @@ class PeerStateMachine:
         if key == self._notified_role:
             return
         self._notified_role = key
+        if key not in ("sync", "primary") and \
+                self._failover_t0 is not None:
+            # demoted (async/deposed/none) while a failover clock was
+            # running: this peer can no longer complete the takeover it
+            # detected, and a 'writable' event in some far-future
+            # primary life must not observe a bogus duration
+            get_journal().record("failover.aborted",
+                                 trace_id=self._failover_trace,
+                                 why="role became %s" % (key or "none"))
+            self._failover_t0 = None
+            self._failover_trace = None
+        get_journal().record("role.change", role=key or "none",
+                             generation=st.get("generation"))
         if key == "deposed":
             log.warning("we are deposed; stopping postgres and waiting "
                         "for operator rebuild")
@@ -445,7 +506,27 @@ class PeerStateMachine:
             datetime.datetime.now(datetime.timezone.utc).timestamp())
 
         if primary_alive and not promote_me:
+            if self._failover_t0 is not None:
+                # the primary flapped back before we took over: the
+                # detection was not a failover after all
+                get_journal().record("failover.aborted",
+                                     trace_id=self._failover_trace,
+                                     primary=st["primary"]["id"])
+                self._failover_t0 = None
+                self._failover_trace = None
             return False
+
+        if not primary_alive and self._failover_t0 is None \
+                and st["primary"]["id"] in self._witnessed:
+            # SLI clock starts: we watched this primary die (witnessed
+            # membership expiry), and it stops when the new primary
+            # re-enables writes (_on_pg_writable)
+            self._failover_t0 = time.monotonic()
+            self._failover_trace = new_trace_id()
+            get_journal().record("failover.detected",
+                                 trace_id=self._failover_trace,
+                                 primary=st["primary"]["id"],
+                                 generation=st.get("generation"))
 
         if not primary_alive and not promote_me and self._boot_time \
                 and st["primary"]["id"] not in self._witnessed:
@@ -490,40 +571,83 @@ class PeerStateMachine:
             "deposed": (st.get("deposed") or []) + [st["primary"]],
         }
         why = ("promote request" if promote_me else "primary death")
-        if not await self._write_state(new, "takeover (%s)" % why, ver):
-            # lost the race (e.g. an operator freeze landed first): do NOT
-            # promote local postgres; re-evaluate against the winner
-            return False
-        # the takeover is durable; we are the primary now
-        await self._apply_pg(self._pg_config_for(new, "primary"))
+        # the takeover rides the trace minted at loss detection, so the
+        # detection, the durable write, and the pg promotion all carry
+        # one id across the journal and the logs
+        tid = self._failover_trace or new_trace_id()
+        with bind_trace(tid):
+            get_journal().record("takeover.begin", why=why,
+                                 old_primary=st["primary"]["id"],
+                                 new_generation=new["generation"])
+            if not await self._write_state(new, "takeover (%s)" % why,
+                                           ver, trace_id=tid):
+                # lost the race (e.g. an operator freeze landed first):
+                # do NOT promote local postgres; re-evaluate against
+                # the winner
+                return False
+            # the takeover is durable; we are the primary now
+            await self._apply_pg(self._pg_config_for(new, "primary"))
         return True
 
     # -- shared helpers --
 
     async def _write_state(self, state: ClusterState, why: str,
-                           expected_version: int | None) -> bool:
-        """CAS-write; returns False when the write lost a race."""
-        log.info("writing cluster state gen=%s (%s)",
-                 state.get("generation"), why)
-        try:
-            await self.zk.put_cluster_state(
-                state, expected_version=expected_version)
-        except (BadVersionError, NodeExistsError):
-            log.info("state write lost a race (%s); deferring", why)
-            # refresh the cached state explicitly: if our watch was
-            # lost, waiting for it would spin on the same stale snapshot
-            refresh = getattr(self.zk, "refresh_cluster_state", None)
-            if refresh is not None:
-                try:
-                    await refresh()
-                except Exception:
-                    pass
-            await _sleep(0.05)
-            self.kick()
-            return False
-        self._emit("stateWritten", state)
+                           expected_version: int | None, *,
+                           trace_id: str | None = None) -> bool:
+        """CAS-write; returns False when the write lost a race.
+
+        Every durable transition mints a trace id (or rides the one the
+        caller minted, e.g. at failover detection) and embeds it in the
+        state object, so peers reacting to the watch — and the coordd
+        that stored it — all log and journal under the same id."""
+        tid = trace_id or new_trace_id()
+        state = dict(state)
+        state["trace"] = tid
+        journal = get_journal()
+        with bind_trace(tid):
+            log.info("writing cluster state gen=%s (%s)",
+                     state.get("generation"), why)
+            journal.record("transition.begin", why=why,
+                           generation=state.get("generation"))
+            try:
+                with _TRANSITION_DUR.time():
+                    await self.zk.put_cluster_state(
+                        state, expected_version=expected_version)
+            except (BadVersionError, NodeExistsError):
+                log.info("state write lost a race (%s); deferring", why)
+                journal.record("transition.conflict", why=why)
+                # refresh the cached state explicitly: if our watch was
+                # lost, waiting for it would spin on the same stale
+                # snapshot
+                refresh = getattr(self.zk, "refresh_cluster_state", None)
+                if refresh is not None:
+                    try:
+                        await refresh()
+                    except Exception:
+                        pass
+                await _sleep(0.05)
+                self.kick()
+                return False
+            _TRANSITIONS.inc()
+            journal.record("transition.committed", why=why,
+                           generation=state.get("generation"))
+            self._emit("stateWritten", state)
         self.kick()
         return True
+
+    def _on_pg_writable(self, _standby_id) -> None:
+        """PG manager re-enabled writes.  If a failover clock is
+        running, this peer just completed a takeover end-to-end: observe
+        the headline SLI."""
+        if self._failover_t0 is None:
+            return
+        dur = time.monotonic() - self._failover_t0
+        _FAILOVER_DUR.observe(dur)
+        get_journal().record("failover.complete",
+                             trace_id=self._failover_trace,
+                             duration_s=round(dur, 3))
+        self._failover_t0 = None
+        self._failover_trace = None
 
     def _pg_config_for(self, st: ClusterState, role: str) -> dict:
         """The reconfigure contract {role, upstream, downstream}

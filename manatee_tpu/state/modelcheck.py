@@ -642,6 +642,17 @@ class World:
 
     # -- canonical hash --
 
+    @staticmethod
+    def _sem(state):
+        """Semantic projection of a cluster state for hashing: the
+        per-transition trace id (obs metadata, unique on every durable
+        write) is quotiented out — hashing it would make every
+        logically-identical state look fresh and defeat memoization
+        (an exponential blowup of the sweep)."""
+        if not isinstance(state, dict) or "trace" not in state:
+            return state
+        return {k: v for k, v in state.items() if k != "trace"}
+
     def digest(self) -> str:
         peers = {}
         for name in sorted(self.peers):
@@ -660,14 +671,14 @@ class World:
                                     == [a["id"] for a in
                                         self.store.actives]),
                 "evaled_current": p.eval_epoch >= p.view_epoch,
-                "view": p.zk.cluster_state,
+                "view": self._sem(p.zk.cluster_state),
                 "view_actives": [a["id"] for a in p.zk.active],
                 "target": p.sm._pg_target,
                 "applied": p.sm._pg_applied,
                 "role_note": p.sm._notified_role,
             }
         blob = json.dumps({
-            "state": self.store.state,
+            "state": self._sem(self.store.state),
             "actives": [a["id"] for a in self.store.actives],
             "kills": self.kills,
             "rejoins": self.rejoins,

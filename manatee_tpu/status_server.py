@@ -8,16 +8,25 @@ Reference parity: lib/statusServer.js — restify server on
 - ``GET /state``   the state machine's debugState() (:100-109)
 - ``GET /restore`` the restore client's current job (:111-121)
 
-Beyond parity: ``GET /metrics`` exports the same facts in Prometheus
-text format (the reference predates that convention; its operators
-scrape bunyan logs).
+Beyond parity (the reference predates both conventions; its operators
+scrape bunyan logs):
+
+- ``GET /metrics`` Prometheus text format: the state-derived gauges
+  below plus the whole process-wide obs registry (transition counters,
+  failover/reconfigure/RPC latency histograms, probe flips, ...);
+- ``GET /events``  this peer's ring-buffer event journal
+  (``?since=SEQ&limit=N``) — the per-peer feed `manatee-adm events`
+  merges into the shard timeline.
 """
 
 from __future__ import annotations
 
 import logging
+import time
 
 from aiohttp import web
+
+from manatee_tpu.obs import get_journal, get_registry
 
 log = logging.getLogger("manatee.status")
 
@@ -37,13 +46,8 @@ class StatusServer:
         app.router.add_get("/state", self._state)
         app.router.add_get("/restore", self._restore)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/events", self._events)
         self._app = app
-        # transition counter for /metrics: one increment per durable
-        # state write this peer made
-        self._transitions = 0
-        if state_machine is not None:
-            state_machine.on("stateWritten",
-                             lambda _st: self._count_transition())
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self._app)
@@ -60,7 +64,7 @@ class StatusServer:
 
     async def _routes(self, _req: web.Request) -> web.Response:
         return web.json_response(["/ping", "/state", "/restore",
-                                  "/metrics"])
+                                  "/metrics", "/events"])
 
     async def _ping(self, _req: web.Request) -> web.Response:
         healthy = bool(self.pg_mgr and self.pg_mgr.online)
@@ -87,11 +91,27 @@ class StatusServer:
             return web.json_response({"restore": None})
         return web.json_response({"restore": job})
 
-    def _count_transition(self) -> None:
-        self._transitions += 1
+    async def _events(self, req: web.Request) -> web.Response:
+        """The peer's event journal, oldest first.  ?since=SEQ returns
+        only events after that per-process sequence number (incremental
+        tailing); ?limit=N caps the reply to the newest N."""
+        journal = get_journal()
+        try:
+            since = int(req.query.get("since", 0))
+            limit = (int(req.query["limit"])
+                     if "limit" in req.query else None)
+        except ValueError:
+            return web.json_response({"error": "since/limit must be "
+                                               "integers"}, status=400)
+        return web.json_response({
+            "peer": journal.peer,
+            "now": round(time.time(), 3),
+            "events": journal.events(since=since, limit=limit),
+        })
 
     async def _metrics(self, _req: web.Request) -> web.Response:
-        """Prometheus text exposition of the peer's state."""
+        """Prometheus text exposition: state-derived gauges + the whole
+        process-wide obs registry."""
         from manatee_tpu.utils.prom import MetricsBuilder
 
         b = MetricsBuilder("manatee")
@@ -138,9 +158,6 @@ class StatusServer:
                    + (1 if st.get("sync") else 0)
                    + len(st.get("async") or [])
                    + len(st.get("deposed") or []))
-            metric("state_transitions_total", "counter",
-                   "durable state writes made by this peer",
-                   self._transitions)
         job = (self.restore_client.current_job
                if self.restore_client else None)
         if job is not None:
@@ -150,5 +167,12 @@ class StatusServer:
             metric("restore_done_bytes", "gauge",
                    "bytes received by the in-flight restore",
                    int(job.get("completed") or 0))
+        metric("journal_events", "gauge",
+               "events buffered in the in-memory journal ring",
+               len(get_journal()))
+        # the process-wide registry: state_transitions_total, the
+        # failover/reconfigure/probe/RPC histograms, restore counters —
+        # everything components registered via manatee_tpu.obs
+        get_registry().render_into(b)
         return web.Response(text=b.render(),
                             content_type="text/plain")

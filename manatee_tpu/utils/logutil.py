@@ -24,6 +24,15 @@ _BUNYAN_LEVELS = {
     logging.CRITICAL: 60,
 }
 
+# logging internals that must never leak into records as "extras":
+# every attribute a bare LogRecord carries, plus the ones Formatter and
+# asyncio stamp on later.  Anything NOT in this set was passed by the
+# caller via extra= (or a filter, e.g. the trace-id filter) and belongs
+# in the bunyan record.
+_RECORD_INTERNALS = frozenset(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime", "taskName"}
+
 
 class BunyanFormatter(logging.Formatter):
     def __init__(self, name: str):
@@ -44,9 +53,18 @@ class BunyanFormatter(logging.Formatter):
                                   time.gmtime(record.created))
                     + ".%03dZ" % (record.msecs,),
         }
-        for attr in ("run_id", "argv", "rc", "duration_ms"):
-            if hasattr(record, attr):
-                rec[attr] = getattr(record, attr)
+        # generic extra-field passthrough: any caller-supplied extra
+        # (run_id, rc, duration_ms, trace_id, peer, span, ...) lands in
+        # the record without this formatter needing to know its name —
+        # but never shadowing the bunyan core fields above
+        for attr, value in record.__dict__.items():
+            if attr in _RECORD_INTERNALS or attr in rec:
+                continue
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                value = repr(value)
+            rec[attr] = value
         if record.exc_info:
             rec["err"] = self.formatException(record.exc_info)
         return json.dumps(rec)
@@ -60,6 +78,10 @@ def setup_logging(name: str, verbose: int = 0,
     level, but an explicit -v always wins."""
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(BunyanFormatter(name))
+    # stamp the bound trace id on every record (obs/trace.py); the
+    # generic extra passthrough above emits it as "trace_id"
+    from manatee_tpu.obs.trace import TraceLogFilter
+    handler.addFilter(TraceLogFilter())
     root = logging.getLogger()
     root.handlers[:] = [handler]
     env_level = os.environ.get("LOG_LEVEL", "").upper()

@@ -1,5 +1,12 @@
 """Minimal Prometheus text-exposition builder shared by the peer
-status server and coordd (one copy so format fixes land everywhere)."""
+status server and coordd (one copy so format fixes land everywhere).
+
+Naming conventions are enforced HERE, not left to each producer:
+counters are exported under a ``_total``-suffixed name (a producer that
+registers a bare name gets the suffix appended, with the old name kept
+as a one-release deprecated alias so existing dashboards keep working),
+and duration metrics must be base-unit ``_seconds`` (never ``_ms``).
+"""
 
 from __future__ import annotations
 
@@ -26,22 +33,72 @@ def _escape_help(text: str) -> str:
     return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def format_le(ub: float) -> str:
+    """Bucket upper bound as Prometheus renders it ('0.5', '1', '+Inf')."""
+    if ub == float("inf"):
+        return "+Inf"
+    if float(ub).is_integer():
+        return str(int(ub))
+    return repr(float(ub))
+
+
 class MetricsBuilder:
     def __init__(self, prefix: str):
         self.prefix = prefix
         self.lines: list[str] = []
 
+    def _family(self, full: str, mtype: str, help_: str) -> None:
+        self.lines.append("# HELP %s %s" % (full, _escape_help(help_)))
+        self.lines.append("# TYPE %s %s" % (full, mtype))
+
     def metric(self, name: str, mtype: str, help_: str, samples) -> None:
         """*samples*: a scalar value, or [(label_string, value), ...]
         where label_string is e.g. '{role="leader"}' — build dynamic
-        ones with label_str() so the values are escaped."""
-        full = "%s_%s" % (self.prefix, name)
-        self.lines.append("# HELP %s %s" % (full, _escape_help(help_)))
-        self.lines.append("# TYPE %s %s" % (full, mtype))
+        ones with label_str() so the values are escaped.
+
+        A counter whose *name* lacks the conventional ``_total`` suffix
+        is exported as ``<name>_total`` AND under the old bare name (a
+        deprecated one-release alias), so the convention fix cannot
+        silently break an existing scrape."""
         if not isinstance(samples, list):
             samples = [("", samples)]
+        if mtype == "counter" and not name.endswith("_total"):
+            self._emit(name + "_total", mtype, help_, samples)
+            self._emit(name, mtype,
+                       "DEPRECATED alias of %s_%s_total; removed next "
+                       "release" % (self.prefix, name), samples)
+            return
+        self._emit(name, mtype, help_, samples)
+
+    def _emit(self, name: str, mtype: str, help_: str,
+              samples: list) -> None:
+        full = "%s_%s" % (self.prefix, name)
+        self._family(full, mtype, help_)
         for labels, value in samples:
             self.lines.append("%s%s %s" % (full, labels, value))
+
+    def histogram(self, name: str, help_: str, buckets, series) -> None:
+        """Render one histogram family.  *buckets* is the ascending
+        upper-bound list (an implicit +Inf bucket is appended);
+        *series* is [(labels_dict, {'counts', 'sum', 'count'}), ...]
+        with 'counts' cumulative per explicit bucket."""
+        full = "%s_%s" % (self.prefix, name)
+        self._family(full, "histogram", help_)
+        for labels, s in series:
+            for ub, c in zip(buckets, s["counts"]):
+                lab = dict(labels)
+                lab["le"] = format_le(ub)
+                self.lines.append("%s_bucket%s %d"
+                                  % (full, label_str(**lab), c))
+            lab = dict(labels)
+            lab["le"] = "+Inf"
+            self.lines.append("%s_bucket%s %d"
+                              % (full, label_str(**lab), s["count"]))
+            self.lines.append("%s_sum%s %s"
+                              % (full, label_str(**labels),
+                                 repr(float(s["sum"]))))
+            self.lines.append("%s_count%s %d"
+                              % (full, label_str(**labels), s["count"]))
 
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"

@@ -251,6 +251,64 @@ def test_chaos(tmp_path):
                    cp.stderr)
             await chaos.verify_durability()
 
+            # observability invariants over the whole recorded storm:
+            # every durable transition minted a trace id, and the
+            # /events rings from all peers merge (via the real
+            # `manatee-adm events` fan-out) into one timeline whose
+            # takeover sequences are internally consistent
+            c = await cluster.coord_client()
+            try:
+                names = await c.get_children(
+                    cluster.shard_path + "/history")
+                assert names, "chaos run recorded no history"
+                traced = 0
+                for n in names:
+                    import json as _json
+                    data, _v = await c.get(
+                        cluster.shard_path + "/history/" + n)
+                    st = _json.loads(data.decode())
+                    assert st.get("trace"), \
+                        "transition %s carries no trace id" % n
+                    traced += 1
+                print("chaos: %d transitions, all traced" % traced,
+                      flush=True)
+            finally:
+                await c.close()
+            cp = run_cli(cluster, "events", "-j", timeout=60)
+            assert cp.returncode == 0, cp.stderr
+            import json as _json
+            merged = [_json.loads(ln) for ln in
+                      cp.stdout.splitlines() if ln.strip()]
+            assert merged, "no events from any peer after the storm"
+            # the fan-out sorts by (ts, peer, seq): per-peer order must
+            # be preserved in the merge (seq strictly increasing)
+            last_seq: dict = {}
+            for e in merged:
+                if e["peer"] in last_seq:
+                    assert e["seq"] > last_seq[e["peer"]], \
+                        "merge scrambled %s's events" % e["peer"]
+                last_seq[e["peer"]] = e["seq"]
+            assert len(last_seq) >= 2, "timeline covers one peer only"
+            # every takeover visible in the merge is trace-correlated
+            # across at least two peers (the taker's commit + another
+            # peer's observed clusterstate.change)
+            takeovers = {e["trace"] for e in merged
+                         if e["event"] == "takeover.begin"
+                         and e.get("trace")}
+            correlated = 0
+            for tid in takeovers:
+                peers_seen = {e["peer"] for e in merged
+                              if e.get("trace") == tid}
+                if len(peers_seen) >= 2:
+                    correlated += 1
+            if takeovers:
+                assert correlated, \
+                    "no takeover trace crossed peer boundaries"
+            print("chaos: merged %d events from %d peers; %d/%d "
+                  "takeover traces cross-peer correlated"
+                  % (len(merged), len(last_seq), correlated,
+                     len(takeovers)), flush=True)
+
             # the snapshotter trio survived the storm: snapshots kept
             # flowing, GC held the bound, no spurious stuck alarm
             from manatee_tpu.storage import DirBackend

@@ -93,11 +93,11 @@ def test_mutation_missing_generation_bump_is_caught():
     discipline (lib/adm.js:2296-2416) must flag the write."""
     orig = machine.PeerStateMachine._write_state
 
-    async def bad_write(self, state, why, ver):
+    async def bad_write(self, state, why, ver, **kw):
         if "takeover" in why and state.get("generation", 0) > 0:
             state = dict(state)
             state["generation"] -= 1
-        return await orig(self, state, why, ver)
+        return await orig(self, state, why, ver, **kw)
 
     machine.PeerStateMachine._write_state = bad_write
     try:

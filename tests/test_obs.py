@@ -1,0 +1,190 @@
+"""Observability subsystem: registry/journal/trace units plus a
+full-stack check that a real failover is reconstructable — every
+transition carries a trace id, `GET /events` timelines from all peers
+merge into one consistent takeover sequence, and the
+failover_duration_seconds histogram is populated on the new primary."""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+from tests.harness import ClusterHarness, cli_env
+from tests.test_integration import converged
+from tests.test_utils import parse_exposition
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- units ----
+
+def test_journal_ring_capacity_and_since():
+    from manatee_tpu.obs import EventJournal
+
+    j = EventJournal(capacity=4)
+    j.peer = "p1"
+    for i in range(10):
+        j.record("tick", n=i)
+    evs = j.events()
+    assert len(evs) == 4                      # ring dropped the oldest
+    assert [e["n"] for e in evs] == [6, 7, 8, 9]
+    assert all(e["peer"] == "p1" for e in evs)
+    assert [e["n"] for e in j.events(since=evs[1]["seq"])] == [8, 9]
+    assert [e["n"] for e in j.events(limit=2)] == [8, 9]
+    # core keys cannot be shadowed by detail fields
+    j.record("evil", peer="spoofed", seq=-1, ts="spoofed")
+    assert j.events()[-1]["event"] == "evil"
+    assert j.events()[-1]["peer"] == "p1"
+    assert j.events()[-1]["seq"] != -1
+
+
+def test_trace_binding_nests_and_propagates_to_tasks():
+    from manatee_tpu.obs import bind_trace, current_trace, new_trace_id
+
+    assert current_trace() is None
+    t1, t2 = new_trace_id(), new_trace_id()
+    assert t1 != t2 and len(t1) == 16
+
+    async def go():
+        with bind_trace(t1):
+            assert current_trace() == t1
+            with bind_trace(None):            # None = passthrough
+                assert current_trace() == t1
+            with bind_trace(t2):
+                assert current_trace() == t2
+                # tasks snapshot the context at creation
+                task = asyncio.ensure_future(_read_trace())
+            with bind_trace(t1):
+                pass
+            assert await task == t2
+        assert current_trace() is None
+
+    async def _read_trace():
+        from manatee_tpu.obs import current_trace as cur
+        return cur()
+
+    asyncio.run(go())
+
+
+def test_journal_records_bound_trace():
+    from manatee_tpu.obs import EventJournal, bind_trace
+
+    j = EventJournal()
+    with bind_trace("aaaabbbbccccdddd"):
+        j.record("implicit")
+    j.record("explicit", trace_id="1111222233334444")
+    j.record("none")
+    evs = j.events()
+    assert evs[0]["trace"] == "aaaabbbbccccdddd"
+    assert evs[1]["trace"] == "1111222233334444"
+    assert evs[2]["trace"] is None
+
+
+def test_histogram_timer_and_snapshot():
+    from manatee_tpu.obs.metrics import Histogram
+
+    h = Histogram("x_duration_seconds", "t", buckets=(0.5, 5.0))
+    with h.time():
+        pass
+    s = h.snapshot()
+    assert s["count"] == 1
+    assert s["counts"] == [1, 1]              # fast path under 0.5s
+    assert 0.0 <= s["sum"] < 0.5
+
+
+# ---- full stack: one command reconstructs a failover ----
+
+def test_failover_is_trace_reconstructable(tmp_path):
+    async def go():
+        import aiohttp
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            primary.kill()
+            await cluster.wait_topology(primary=sync, asyncs=[],
+                                        sync=asyncs[0], timeout=60)
+            await cluster.wait_writable(sync, "post-failover")
+
+            # 1. every durable transition carries a trace id
+            c = await cluster.coord_client()
+            try:
+                data, _v = await c.get(cluster.shard_path + "/state")
+                st = json.loads(data.decode())
+                assert st.get("trace"), "state written without trace"
+                takeover_trace = st["trace"]
+                names = await c.get_children(
+                    cluster.shard_path + "/history")
+                names.sort(key=lambda n: int(n.rsplit("-", 1)[1]))
+                for n in names:
+                    hdata, _ = await c.get(
+                        cluster.shard_path + "/history/" + n)
+                    hst = json.loads(hdata.decode())
+                    assert hst.get("trace"), \
+                        "history transition %s lacks a trace" % n
+            finally:
+                await c.close()
+
+            # 2. /events from every live peer merges into one
+            #    trace-correlated takeover sequence
+            merged = []
+            async with aiohttp.ClientSession() as http:
+                for peer in (sync, asyncs[0]):
+                    url = ("http://127.0.0.1:%d/events"
+                           % peer.status_port)
+                    async with http.get(url) as r:
+                        assert r.status == 200
+                        body = await r.json()
+                    assert body["peer"] == peer.ident
+                    merged.extend(body["events"])
+            merged.sort(key=lambda e: (e["ts"], str(e["peer"]),
+                                       e["seq"]))
+            by_trace = [e for e in merged
+                        if e.get("trace") == takeover_trace]
+            kinds = [e["event"] for e in by_trace]
+            peers_involved = {e["peer"] for e in by_trace}
+            assert "transition.committed" in kinds
+            assert "clusterstate.change" in kinds
+            assert len(peers_involved) >= 2, \
+                "takeover trace did not cross peers: %r" % by_trace
+            # the new primary saw the whole arc
+            new_primary_kinds = [e["event"] for e in merged
+                                 if e["peer"] == sync.ident]
+            assert "failover.detected" in new_primary_kinds
+            assert "takeover.begin" in new_primary_kinds
+            assert "failover.complete" in new_primary_kinds
+
+            # 3. the headline SLI histogram is populated (and the whole
+            #    exposition still satisfies the strict parser)
+            async with aiohttp.ClientSession() as http:
+                async with http.get("http://127.0.0.1:%d/metrics"
+                                    % sync.status_port) as r:
+                    text = await r.text()
+            fams = parse_exposition(text)
+            fam = fams["manatee_failover_duration_seconds"]
+            count = [float(v) for name, labels, v in fam["samples"]
+                     if name.endswith("_count")]
+            assert count and count[0] >= 1, \
+                "failover histogram never observed"
+            assert fams["manatee_state_transitions_total"]
+
+            # 4. `manatee-adm events` prints the merged timeline
+            cp = subprocess.run(
+                [sys.executable, "-m", "manatee_tpu.cli", "events",
+                 "-j"],
+                capture_output=True, text=True, timeout=60,
+                env=cli_env(cluster.coord_connstr))
+            assert cp.returncode == 0, cp.stderr
+            lines = [json.loads(ln) for ln in
+                     cp.stdout.splitlines() if ln.strip()]
+            assert any(e.get("trace") == takeover_trace
+                       for e in lines), \
+                "adm events lost the takeover trace"
+            assert {e["peer"] for e in lines} >= {sync.ident,
+                                                  asyncs[0].ident}
+        finally:
+            await cluster.stop()
+    run(go())

@@ -139,6 +139,226 @@ def test_run_async_stdin():
     asyncio.run(go())
 
 
+# ---- Prometheus exposition round-trip (utils/prom.py) ----
+#
+# A strict text-format parser: every non-comment line must be
+# `name{labels} value`, every sample must be preceded by HELP+TYPE for
+# its family, label values must unescape cleanly, and histogram
+# families must carry consistent _bucket/_sum/_count triplets.
+
+import re
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> dict:
+    """{family: {"type", "help", "samples": [(name, labels, value)]}};
+    raises AssertionError on any strictness violation."""
+    families: dict = {}
+    pending_help: dict = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            pending_help[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            assert mtype in ("counter", "gauge", "histogram",
+                             "summary", "untyped"), mtype
+            assert name in pending_help, "TYPE before HELP for %s" % name
+            assert name not in families, "duplicate TYPE for %s" % name
+            families[name] = {"type": mtype,
+                              "help": pending_help[name], "samples": []}
+            continue
+        assert not line.startswith("#"), "unknown comment: %r" % line
+        m = _SAMPLE_RE.match(line)
+        assert m, "malformed sample line: %r" % line
+        name, _, labelstr, value = m.groups()
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in families:
+                base = name[:-len(suffix)]
+        assert base in families, "sample %r without TYPE/HELP" % name
+        labels = {}
+        if labelstr:
+            pairs = _LABEL_RE.findall(labelstr)
+            rebuilt = ",".join('%s="%s"' % (k, v) for k, v in pairs)
+            assert rebuilt == labelstr, \
+                "unparseable labels: %r" % labelstr
+            for k, v in pairs:
+                labels[k] = (v.replace("\\n", "\n")
+                             .replace('\\"', '"').replace("\\\\", "\\"))
+        float(value)    # must be numeric
+        families[base]["samples"].append((name, labels, value))
+    # histogram triplet consistency
+    for fam, d in families.items():
+        if d["type"] != "histogram":
+            continue
+        by_series: dict = {}
+        for name, labels, value in d["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = by_series.setdefault(key, {"buckets": [], "sum": None,
+                                           "count": None})
+            if name.endswith("_bucket"):
+                s["buckets"].append((labels["le"], float(value)))
+            elif name.endswith("_sum"):
+                s["sum"] = float(value)
+            elif name.endswith("_count"):
+                s["count"] = float(value)
+        for key, s in by_series.items():
+            assert s["sum"] is not None and s["count"] is not None, \
+                "%s%r missing _sum/_count" % (fam, key)
+            assert s["buckets"], "%s%r has no buckets" % (fam, key)
+            assert s["buckets"][-1][0] == "+Inf", \
+                "%s%r lacks a +Inf bucket" % (fam, key)
+            counts = [c for _le, c in s["buckets"]]
+            assert counts == sorted(counts), \
+                "%s%r bucket counts not cumulative" % (fam, key)
+            assert counts[-1] == s["count"], \
+                "%s%r +Inf bucket != _count" % (fam, key)
+    return families
+
+
+def test_exposition_roundtrip_counters_gauges_and_escaping():
+    from manatee_tpu.utils.prom import MetricsBuilder, label_str
+
+    b = MetricsBuilder("m")
+    b.metric("role", "gauge", "current role",
+             [(label_str(role='we"ird\\peer\nname'), 1)])
+    b.metric("writes_total", "counter", "durable writes", 7)
+    fams = parse_exposition(b.render())
+    assert fams["m_writes_total"]["type"] == "counter"
+    (_n, labels, value), = fams["m_role"]["samples"]
+    # escaping round-trips: the parser recovers the raw value
+    assert labels["role"] == 'we"ird\\peer\nname'
+    assert value == "1"
+
+
+def test_exposition_counter_naming_fix_emits_alias():
+    # the naming-convention fix: a counter registered WITHOUT _total is
+    # exported under the conventional name AND the old name (deprecated
+    # one-release alias), so existing scrapes keep working
+    from manatee_tpu.utils.prom import MetricsBuilder
+
+    b = MetricsBuilder("m")
+    b.metric("mutations", "counter", "tree mutations", 3)
+    fams = parse_exposition(b.render())
+    assert fams["m_mutations_total"]["samples"][0][2] == "3"
+    assert fams["m_mutations"]["samples"][0][2] == "3"
+    assert "DEPRECATED" in fams["m_mutations"]["help"]
+
+
+def test_exposition_histogram_triplets():
+    from manatee_tpu.obs.metrics import Histogram
+    from manatee_tpu.utils.prom import MetricsBuilder
+
+    h = Histogram("op_duration_seconds", "op latency", ("op",),
+                  buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05, op="get")
+    h.observe(0.5, op="get")
+    h.observe(99.0, op="get")
+    h.observe(0.2, op="set")
+    b = MetricsBuilder("m")
+    b.histogram(h.name, h.help, h.buckets, h.series())
+    fams = parse_exposition(b.render())
+    fam = fams["m_op_duration_seconds"]
+    assert fam["type"] == "histogram"
+    get_buckets = {labels["le"]: value for name, labels, value
+                   in fam["samples"]
+                   if name.endswith("_bucket")
+                   and labels.get("op") == "get"}
+    assert get_buckets == {"0.1": "1", "1": "2", "10": "2",
+                           "+Inf": "3"}
+    sums = [float(v) for name, labels, v in fam["samples"]
+            if name.endswith("_sum") and labels.get("op") == "get"]
+    assert sums == [pytest.approx(99.55)]
+
+
+def test_exposition_registry_render_is_strict():
+    # whatever the process registry accumulates must always satisfy the
+    # strict parser — this is the guard every new instrument runs under
+    from manatee_tpu.obs import get_registry
+    from manatee_tpu.utils.prom import MetricsBuilder
+
+    reg = get_registry()
+    reg.counter("roundtrip_test_total", "test counter",
+                ("kind",)).inc(kind='tricky"value\\x')
+    reg.histogram("roundtrip_test_duration_seconds",
+                  "test histogram").observe(0.2)
+    b = MetricsBuilder("manatee")
+    reg.render_into(b)
+    fams = parse_exposition(b.render())
+    assert "manatee_roundtrip_test_total" in fams
+    assert fams["manatee_roundtrip_test_duration_seconds"]["type"] == \
+        "histogram"
+
+
+def test_registry_naming_enforcement():
+    from manatee_tpu.obs.metrics import Counter, Histogram, Registry
+
+    with pytest.raises(ValueError):
+        Counter("bad_counter", "no _total suffix")
+    with pytest.raises(ValueError):
+        Histogram("op_duration_ms", "durations must be _seconds")
+    reg = Registry()
+    c1 = reg.counter("x_total", "x")
+    assert reg.counter("x_total", "x") is c1    # get-or-create
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "kind clash")
+
+
+# ---- bunyan extra-field passthrough (utils/logutil.py) ----
+
+def test_bunyan_generic_extra_passthrough():
+    import json as _json
+    import logging
+
+    from manatee_tpu.utils.logutil import BunyanFormatter
+
+    fmt = BunyanFormatter("test")
+    logger = logging.getLogger("manatee.test.extra")
+    rec = logger.makeRecord(
+        "manatee.test.extra", logging.INFO, __file__, 1, "hello %s",
+        ("world",), None,
+        extra={"trace_id": "abcd1234", "peer": "p1", "span": "write",
+               "rc": 0, "unjsonable": object()})
+    out = _json.loads(fmt.format(rec))
+    assert out["msg"] == "hello world"
+    assert out["trace_id"] == "abcd1234"
+    assert out["peer"] == "p1"
+    assert out["span"] == "write"
+    assert out["rc"] == 0
+    assert isinstance(out["unjsonable"], str)   # repr()'d, not dropped
+    # logging internals must NOT leak
+    for internal in ("args", "levelno", "msecs", "exc_info"):
+        assert internal not in out
+
+
+def test_trace_filter_stamps_bound_trace():
+    import json as _json
+    import logging
+
+    from manatee_tpu.obs import bind_trace
+    from manatee_tpu.obs.trace import TraceLogFilter
+    from manatee_tpu.utils.logutil import BunyanFormatter
+
+    fmt = BunyanFormatter("test")
+    filt = TraceLogFilter()
+    logger = logging.getLogger("manatee.test.trace")
+    rec = logger.makeRecord("manatee.test.trace", logging.INFO,
+                            __file__, 1, "traced", (), None)
+    with bind_trace("feedbeef12345678"):
+        filt.filter(rec)
+    out = _json.loads(fmt.format(rec))
+    assert out["trace_id"] == "feedbeef12345678"
+
+
 # ---- config validation ----
 
 def test_validate_config():
