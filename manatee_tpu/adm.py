@@ -505,6 +505,8 @@ class AdmClient:
                 body = await resp.json()
             score = body.get("healthScore")
             return float(score) if score is not None else None
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return None
 
@@ -822,6 +824,8 @@ class AdmClient:
                         errors[peer["id"]] = "HTTP %d" % resp.status
                         return
                     body = await resp.json()
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
                 errors[peer["id"]] = str(e) or type(e).__name__
                 return

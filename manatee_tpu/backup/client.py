@@ -157,7 +157,7 @@ class RestoreClient:
             # synchronously at accept time, so the teardown's cancel
             # sweep can never miss a handler whose coroutine body has
             # not run its first line yet
-            t = asyncio.ensure_future(_handle(reader, writer))
+            t = asyncio.create_task(_handle(reader, writer))
             handler_tasks.add(t)
 
             def _done(task, w=writer):

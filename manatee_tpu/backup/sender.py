@@ -18,6 +18,8 @@ from manatee_tpu.storage.base import StorageBackend, StorageError
 
 log = logging.getLogger("manatee.backup.sender")
 
+CONNECT_TIMEOUT = 30.0   # dial-back to the requester's receive listener
+
 
 class BackupSender:
     def __init__(self, queue: BackupQueue, storage: StorageBackend,
@@ -29,15 +31,17 @@ class BackupSender:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = asyncio.create_task(self._loop())
 
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass       # the cancel we just requested
+            except Exception:
+                log.exception("backup sender loop died uncleanly")
 
     async def _loop(self) -> None:
         while True:
@@ -58,7 +62,10 @@ class BackupSender:
                                % self.dataset)
         log.info("sending %s to %s:%d for job %s", snap.full, job.host,
                  job.port, job.uuid)
-        reader, writer = await asyncio.open_connection(job.host, job.port)
+        # bounded connect: a requester that vanished between the POST
+        # and our dial must fail the job, not wedge the send loop
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(job.host, job.port), CONNECT_TIMEOUT)
 
         def progress(done: int, total: int | None) -> None:
             job.completed = done

@@ -44,6 +44,8 @@ class BackupRestServer:
     async def _post_backup(self, req: web.Request) -> web.Response:
         try:
             params = await req.json()
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return web.json_response(
                 {"error": "invalid json"}, status=400)

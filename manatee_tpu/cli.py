@@ -255,6 +255,8 @@ def cmd_verify(args) -> int:
     async def go():
         try:
             details = await _load_details(args)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             print("error: failed to fetch cluster state")
             return 1

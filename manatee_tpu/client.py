@@ -71,12 +71,20 @@ class ManateeClient:
     # -- lifecycle --
 
     async def start(self) -> None:
-        self._task = asyncio.ensure_future(self._run())
+        self._task = asyncio.create_task(self._run())
 
     async def close(self) -> None:
         self._closed = True
         if self._task:
             self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass       # the cancel we just requested
+            except Exception:
+                # a watcher that already died of an unexpected error:
+                # its stale exception must not abort the teardown
+                log.exception("client watcher died uncleanly")
         if self._client:
             await self._client.close()
 
@@ -138,8 +146,8 @@ class ManateeClient:
 
     @staticmethod
     async def _wait_either(a: asyncio.Event, b: asyncio.Event) -> None:
-        ta = asyncio.ensure_future(a.wait())
-        tb = asyncio.ensure_future(b.wait())
+        ta = asyncio.create_task(a.wait())
+        tb = asyncio.create_task(b.wait())
         try:
             await asyncio.wait([ta, tb],
                                return_when=asyncio.FIRST_COMPLETED)

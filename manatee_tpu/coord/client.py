@@ -195,8 +195,15 @@ class NetCoord(CoordClient):
     async def _open_conn(self, resume: bool) -> None:
         host, port = self._addrs[self._addr_idx]
         try:
-            reader, writer = await asyncio.open_connection(
-                host, port, limit=MAX_LINE)
+            # bounded: a SYN into a blackholed route would otherwise pin
+            # the connect for kernel-retry minutes
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port, limit=MAX_LINE),
+                HANDSHAKE_TIMEOUT)
+        except asyncio.TimeoutError:
+            self._rotate()
+            raise ConnectionLossError(
+                "connect to %s:%d timed out" % (host, port)) from None
         except OSError:
             self._rotate()
             raise
@@ -240,7 +247,7 @@ class NetCoord(CoordClient):
             raise _ERRS.get(msg.get("error"), CoordError)(msg.get("msg", ""))
         res = msg.get("result") or {}
         self._reader, self._writer = reader, writer
-        self._read_task = asyncio.ensure_future(self._read_loop(reader))
+        self._read_task = asyncio.create_task(self._read_loop(reader))
         self._session_id = res["session_id"]
         # adopt the server's (possibly floored) values so our reconnect
         # give-up deadline — and anything reasoning about the effective
@@ -250,7 +257,7 @@ class NetCoord(CoordClient):
             self._disconnect_grace = float(res["disconnect_grace"])
         self._connected.set()
         if self._ping_task is None or self._ping_task.done():
-            self._ping_task = asyncio.ensure_future(self._ping_loop())
+            self._ping_task = asyncio.create_task(self._ping_loop())
         self._notify("connected")
 
     async def close(self) -> None:
@@ -258,6 +265,13 @@ class NetCoord(CoordClient):
         for t in (self._read_task, self._ping_task, self._reconnect_task):
             if t:
                 t.cancel()
+        # reap before touching the writer: the read loop's finally runs
+        # to completion here, so no disconnect handling can interleave
+        # with (or outlive) the teardown below
+        await asyncio.gather(
+            *(t for t in (self._read_task, self._ping_task,
+                          self._reconnect_task) if t),
+            return_exceptions=True)
         if self._writer:
             if not self._expired and self._connected.is_set():
                 # best-effort explicit session end, so our ephemerals
@@ -315,7 +329,9 @@ class NetCoord(CoordClient):
                 fut = self._pending.pop(msg.get("xid"), None)
                 if fut and not fut.done():
                     fut.set_result(msg)
-        except (ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            pass        # close() cancels us; disconnect handling below
+        except ConnectionError:
             pass
         finally:
             if not self._closed:
@@ -328,7 +344,7 @@ class NetCoord(CoordClient):
             return
         self._notify("disconnected")
         if self._reconnect_task is None or self._reconnect_task.done():
-            self._reconnect_task = asyncio.ensure_future(self._reconnect())
+            self._reconnect_task = asyncio.create_task(self._reconnect())
 
     async def _reconnect(self) -> None:
         deadline = time.monotonic() + self._timeout

@@ -38,6 +38,7 @@ from manatee_tpu.coord.api import (
     cluster_state_txn,
 )
 from manatee_tpu.obs import get_journal
+from manatee_tpu.utils.aio import cancel_requests
 
 log = logging.getLogger("manatee.coord")
 
@@ -107,6 +108,9 @@ class ConsensusMgr:
         self._generation_of_setup = 0
         self._anti_entropy_interval = anti_entropy_interval
         self._anti_entropy_task: asyncio.Task | None = None
+        # live watch-rearm tasks (fire-and-forget otherwise): held so
+        # their exceptions are observable and close() can reap them
+        self._rearm_tasks: set[asyncio.Task] = set()
 
     # ---- events ----
 
@@ -163,13 +167,12 @@ class ConsensusMgr:
         # stale-generation on_session closure silently ignores later
         # expiries (the peer drops out of coordination until process
         # restart)
-        self._setup_task = asyncio.ensure_future(self._setup_client())
+        self._setup_task = asyncio.create_task(self._setup_client())
         try:
             await self._setup_task
         except asyncio.CancelledError:
             if self._setup_task.cancelled():
-                cur = asyncio.current_task()
-                if cur is not None and cur.cancelling():
+                if cancel_requests(asyncio.current_task()):
                     # BOTH happened: close() cancelled the setup AND
                     # our own caller was cancelled — the caller's
                     # cancel must win, or wait_for's uncancel
@@ -192,8 +195,10 @@ class ConsensusMgr:
             self._setup_task.cancel()
             try:
                 await self._setup_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass           # the cancel we just requested
+            except Exception:
+                pass           # teardown is best-effort here
             if self._setup_task.done() \
                     and not self._setup_task.cancelled() \
                     and self._setup_task.exception() is None \
@@ -211,7 +216,7 @@ class ConsensusMgr:
                     pass
             raise
         if self._anti_entropy_interval > 0:
-            self._anti_entropy_task = asyncio.ensure_future(
+            self._anti_entropy_task = asyncio.create_task(
                 self._anti_entropy_loop())
 
     async def close(self) -> None:
@@ -222,16 +227,27 @@ class ConsensusMgr:
             self._setup_task.cancel()
             try:
                 await self._setup_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass           # the cancel we just requested
+            except Exception:
+                pass           # retry loop died on its own: moot now
         if self._anti_entropy_task:
             # finish any in-flight pass before tearing the client down,
             # so no callbacks fire after close() returns
             self._anti_entropy_task.cancel()
             try:
                 await self._anti_entropy_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                pass
+        if self._rearm_tasks:
+            # sleeping retry-rearms must not outlive close() and fire
+            # a watch handler against the torn-down client
+            rearms = list(self._rearm_tasks)
+            for t in rearms:
+                t.cancel()
+            await asyncio.gather(*rearms, return_exceptions=True)
         if self._client:
             try:
                 await self._client.close()
@@ -325,7 +341,7 @@ class ConsensusMgr:
         if self._setup_task and not self._setup_task.done():
             return
         self._ready = False
-        self._setup_task = asyncio.ensure_future(self._setup_client())
+        self._setup_task = asyncio.create_task(self._setup_client())
 
     async def _setup_data(self, client: CoordClient) -> None:
         """mkdirp directories, watch state, join election, watch election
@@ -390,7 +406,9 @@ class ConsensusMgr:
                     await asyncio.sleep(RETRY_DELAY)
                     fired(None)
 
-            asyncio.ensure_future(rearm())
+            t = asyncio.create_task(rearm())
+            self._rearm_tasks.add(t)
+            t.add_done_callback(self._rearm_tasks.discard)
 
         return fired
 

@@ -594,8 +594,17 @@ class CoordServer:
             try:
                 if self._oplog_fh is None:
                     path = self._segment_path(seq)
-                    self._oplog_fh = open(path, "ab")
-                    self._oplog_bytes = path.stat().st_size
+
+                    # worker thread (under _log_lock, so append order
+                    # is preserved): segment open + size probe are
+                    # rotation-rare and must not stall the loop on a
+                    # slow disk
+                    def _open_segment(p=path):
+                        fh = open(p, "ab")
+                        return fh, os.fstat(fh.fileno()).st_size
+
+                    self._oplog_fh, self._oplog_bytes = \
+                        await asyncio.to_thread(_open_segment)
                     self._log_count = 0
                     self._synced_upto = self._oplog_bytes
                     self._fsync_data_dir()
@@ -635,7 +644,7 @@ class CoordServer:
         while self._log_gen == gen and self._synced_upto < target:
             t = self._fsync_task
             if t is None or t.done():
-                self._fsync_task = t = asyncio.ensure_future(
+                self._fsync_task = t = asyncio.create_task(
                     self._fsync_once())
             try:
                 await t
@@ -685,7 +694,7 @@ class CoordServer:
         # only ever called from _log_append (a coroutine), so a
         # running loop is guaranteed
         if self._compact_task is None or self._compact_task.done():
-            self._compact_task = asyncio.ensure_future(self._compact())
+            self._compact_task = asyncio.create_task(self._compact())
 
     async def _compact(self) -> None:
         """Write a snapshot covering everything logged so far, then drop
@@ -840,9 +849,9 @@ class CoordServer:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, limit=MAX_LINE)
         self.port = self._server.sockets[0].getsockname()[1]
-        self._expiry_task = asyncio.ensure_future(self._expiry_loop())
+        self._expiry_task = asyncio.create_task(self._expiry_loop())
         if self.ensemble:
-            self._follow_task = asyncio.ensure_future(self._follow_loop())
+            self._follow_task = asyncio.create_task(self._follow_loop())
         if self.metrics_port is not None:
             await self._start_metrics()
         log.info("coordd listening on %s:%d%s%s", self.host, self.port,
@@ -856,15 +865,20 @@ class CoordServer:
         if self._metrics_runner is not None:
             await self._metrics_runner.cleanup()
             self._metrics_runner = None
-        for t in (self._follow_task, self._probe_task):
+        for t in (self._follow_task, self._probe_task,
+                  self._expiry_task, self._compact_task):
             if t:
                 t.cancel()
         for t in list(self._reap_tasks):
             t.cancel()
-        if self._expiry_task:
-            self._expiry_task.cancel()
-        if self._compact_task and not self._compact_task.done():
-            self._compact_task.cancel()
+        # reap before the final synchronous compaction: a half-dead
+        # compact task must not race _persist_snapshot_now for the
+        # segment files, and loop tasks must be done unwinding before
+        # connections are severed under them
+        await asyncio.gather(
+            *(t for t in (self._follow_task, self._probe_task,
+                          self._expiry_task, self._compact_task) if t),
+            *list(self._reap_tasks), return_exceptions=True)
         self._persist_snapshot_now()   # final compaction (rotates too)
         # close live connections BEFORE wait_closed(): since 3.12 it waits
         # for every connection handler to finish
@@ -1117,6 +1131,8 @@ class CoordServer:
                         acks = await self._replicate_snapshot(*pair)
                     self._check_commit_quorum(acks)
             conn.push({"xid": xid, "ok": True, "result": result})
+        except asyncio.CancelledError:
+            raise           # server teardown mid-op: unwind, no reply
         except NotLeaderError as e:
             reply = {"xid": xid, "ok": False, "error": "NotLeaderError",
                      "msg": str(e)}
@@ -1419,7 +1435,7 @@ class CoordServer:
         if laggards:
             # strong refs: the loop holds tasks weakly and a GC'd
             # reaper would leave hung followers connected forever
-            t = asyncio.ensure_future(
+            t = asyncio.create_task(
                 self._reap_laggards(seq, laggards, deadline))
             self._reap_tasks.add(t)
             t.add_done_callback(self._reap_tasks.discard)
@@ -1494,7 +1510,7 @@ class CoordServer:
         self._shipped_seq = self._seq
         self.leader_addr = self.ensemble[self.my_id]
         if self._probe_task is None or self._probe_task.done():
-            self._probe_task = asyncio.ensure_future(
+            self._probe_task = asyncio.create_task(
                 self._leader_probe_loop())
 
     def _step_down(self, why: str) -> None:
@@ -1511,7 +1527,7 @@ class CoordServer:
         for conn in list(self._conns):
             conn.sever()
         if self._follow_task is None or self._follow_task.done():
-            self._follow_task = asyncio.ensure_future(self._follow_loop())
+            self._follow_task = asyncio.create_task(self._follow_loop())
 
     # ---- ensemble: follower side ----
 

@@ -1,0 +1,38 @@
+"""mnt-lint — the repo's pluggable stdlib static analyzer.
+
+The engine (rule registry, suppression handling, output formats) lives
+in :mod:`manatee_tpu.lint.engine`; the rules themselves in
+:mod:`manatee_tpu.lint.rules_style` (the original six checks) and
+:mod:`manatee_tpu.lint.rules_async` (async-concurrency discipline:
+orphaned tasks, blocking calls, swallowed cancellation, unreaped
+cancels, lock hygiene, unbounded network waits).
+
+``tools/lint`` is a thin shim over :func:`main`; ``python -m
+manatee_tpu.lint`` works too.  See docs/lint.md for the rule catalog.
+"""
+
+from manatee_tpu.lint.engine import (
+    RULES,
+    Config,
+    Finding,
+    LintResult,
+    check_paths,
+    check_source,
+    main,
+)
+
+# importing the rule modules populates the registry
+from manatee_tpu.lint import rules_style  # noqa: F401  (registration)
+from manatee_tpu.lint import rules_async  # noqa: F401  (registration)
+
+__all__ = [
+    "RULES",
+    "Config",
+    "Finding",
+    "LintResult",
+    "check_paths",
+    "check_source",
+    "main",
+    "rules_style",
+    "rules_async",
+]

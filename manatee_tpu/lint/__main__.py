@@ -1,0 +1,8 @@
+"""`python -m manatee_tpu.lint` — same CLI as tools/lint."""
+
+import sys
+
+from manatee_tpu.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,453 @@
+"""mnt-lint engine: rule registry, per-line suppressions, output.
+
+A *rule* is a generator function ``fn(ctx) -> Iterator[Finding]``
+registered under a kebab-case name with the :func:`rule` decorator.
+Each file is parsed once into a :class:`FileContext` (source text, AST,
+lazily-built parent/owner maps) and every enabled rule runs over it.
+
+Suppressions are per line::
+
+    risky_line()   # mnt-lint: disable=<rule>
+    other()        # mnt-lint: disable=<rule>,<rule2>
+    anything()     # mnt-lint: disable=<all>
+
+A suppression matches findings whose reported line is the line the
+comment sits on (for multi-line statements that is the first line).
+Suppressed findings are kept separately in :class:`LintResult` so the
+JSON output — and the test suite — can account for them.
+
+Configuration comes from defaults < a JSON config file
+(``--config``, or ``.mnt-lint.json`` in the working directory when
+present) < CLI flags.  See docs/lint.md for the keys.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import fnmatch
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Callable, Iterator
+
+DEFAULT_PATHS = ["manatee_tpu", "tests", "tools", "bench.py",
+                 "__graft_entry__.py"]
+# directory-walk exclusions (explicit file arguments are always linted:
+# the fixture suite under tests/data/lint depends on that)
+DEFAULT_EXCLUDE = ["tests/data"]
+
+_SUPPRESS_RE = re.compile(r"#\s*mnt-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def render(self) -> str:
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
+                                   self.msg)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Config:
+    max_line: int = 100
+    disable: frozenset = frozenset()
+    exclude: tuple = tuple(DEFAULT_EXCLUDE)
+    # unbounded-wait: dotted call names / method names whose direct
+    # await must be bounded by wait_for or an enclosing timeout block
+    unbounded_primitives: frozenset = frozenset(
+        {"asyncio.open_connection"})
+    unbounded_methods: frozenset = frozenset({"readexactly", "readuntil"})
+    # "<path-glob>::<function-glob>" entries where an unbounded await is
+    # deliberate (e.g. an idle read loop)
+    unbounded_allow: frozenset = frozenset()
+    # extra dotted call names for blocking-call-in-async
+    blocking_extra: frozenset = frozenset()
+    # per-path rule scoping: (("<path-glob>", frozenset({rule, ...})),
+    # ...) — those rules are off for matching files.  This is how the
+    # repo keeps the strict profile on production packages while test/
+    # bench code drops e.g. the sync-file-I/O rule (tiny fixture writes
+    # in a test do not need a worker thread).
+    path_disable: tuple = ()
+
+    _KEYS = {
+        "max-line": "max_line",
+        "disable": "disable",
+        "exclude": "exclude",
+        "unbounded-primitives": "unbounded_primitives",
+        "unbounded-methods": "unbounded_methods",
+        "unbounded-allow": "unbounded_allow",
+        "blocking-extra": "blocking_extra",
+        "path-disable": "path_disable",
+    }
+
+    @classmethod
+    def from_dict(cls, data: dict, base: "Config | None" = None
+                  ) -> "Config":
+        cfg = base or cls()
+        kw = {}
+        for key, val in data.items():
+            field = cls._KEYS.get(key)
+            if field is None:
+                raise ValueError("unknown mnt-lint config key: %r" % key)
+            if field == "max_line":
+                kw[field] = int(val)
+            elif field == "exclude":
+                kw[field] = tuple(val)
+            elif field == "path_disable":
+                kw[field] = tuple(sorted(
+                    (glob, frozenset(rules))
+                    for glob, rules in dict(val).items()))
+            else:
+                kw[field] = frozenset(val)
+        return dataclasses.replace(cfg, **kw)
+
+    def disabled_for(self, path: str) -> frozenset:
+        """Rules off for *path*: the global disable set plus any
+        path-disable entries whose glob matches."""
+        out = set(self.disable)
+        for glob, rules in self.path_disable:
+            if fnmatch.fnmatch(path, glob) \
+                    or fnmatch.fnmatch(path, "*/" + glob):
+                out.update(rules)
+        return frozenset(out)
+
+    @classmethod
+    def from_file(cls, path: str | Path,
+                  base: "Config | None" = None) -> "Config":
+        with open(path) as fh:
+            data = json.load(fh)
+        if not isinstance(data, dict):
+            raise ValueError("%s: config must be a JSON object" % path)
+        return cls.from_dict(data, base)
+
+
+@dataclasses.dataclass
+class LintResult:
+    path: str
+    findings: list
+    suppressed: list
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    fn: Callable
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(name: str, summary: str):
+    """Register a rule function under *name* (kebab-case)."""
+    def deco(fn):
+        if name in RULES:
+            raise ValueError("duplicate rule %r" % name)
+        RULES[name] = Rule(name, summary, fn)
+        return fn
+    return deco
+
+
+# 'syntax' is engine-level (a file that does not parse runs no rules)
+# but registered so --list-rules and the disable machinery see it
+@rule("syntax", "file must parse (ast.parse)")
+def _syntax_rule(ctx):
+    return iter(())
+
+
+# ---- AST helpers shared by rules ----
+
+def dotted(node) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def walk_no_defs(node) -> Iterator[ast.AST]:
+    """Walk *node*'s subtree without descending into nested function
+    definitions or lambdas (their bodies run in a different execution
+    context, so e.g. an ``await`` there is not an await *here*)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            stack.append(child)
+
+
+def has_await(stmts) -> bool:
+    """True when the statement list contains an await point (await /
+    async for / async with) in the current execution context."""
+    for stmt in stmts:
+        for node in walk_no_defs(stmt):
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                return True
+    return False
+
+
+class FileContext:
+    def __init__(self, path: str, text: str, tree: ast.AST,
+                 config: Config):
+        self.path = path
+        self.text = text
+        self.tree = tree
+        self.config = config
+        self.lines = text.splitlines()
+        self._parents: dict | None = None
+        self._owners: dict | None = None
+
+    def finding(self, line: int, rule_name: str, msg: str) -> Finding:
+        return Finding(self.path, line, rule_name, msg)
+
+    @property
+    def parents(self) -> dict:
+        """node -> immediate parent node."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    @property
+    def owners(self) -> dict:
+        """node -> nearest enclosing function def (or None at module
+        scope).  Lambdas count as a scope boundary but are never
+        reported as the owner."""
+        if self._owners is None:
+            owners: dict = {}
+
+            def rec(node, owner):
+                for child in ast.iter_child_nodes(node):
+                    owners[child] = owner
+                    rec(child,
+                        child if isinstance(child, _SCOPE_NODES) else owner)
+
+            rec(self.tree, None)
+            self._owners = owners
+        return self._owners
+
+    def async_owner(self, node):
+        """The enclosing async def of *node*, or None (lambda and sync
+        def boundaries block ownership)."""
+        owner = self.owners.get(node)
+        return owner if isinstance(owner, ast.AsyncFunctionDef) else None
+
+
+# ---- suppression handling ----
+
+def parse_suppressions(text: str) -> dict:
+    """line number -> set of rule names (or {'all'})."""
+    out: dict[int, set] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            if names:
+                out[i] = names
+    return out
+
+
+# ---- core per-file run ----
+
+def check_source(text: str, path: str = "<string>",
+                 config: Config | None = None) -> LintResult:
+    config = config or Config()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        f = Finding(path, e.lineno or 0, "syntax",
+                    "syntax error: %s" % e.msg)
+        return LintResult(path, [f], [])
+    except ValueError as e:        # e.g. source with null bytes
+        return LintResult(path, [Finding(path, 0, "syntax", str(e))], [])
+    ctx = FileContext(path, text, tree, config)
+    disabled = config.disabled_for(path)
+    findings: list[Finding] = []
+    for r in RULES.values():
+        if r.name in disabled:
+            continue
+        findings.extend(r.fn(ctx))
+    supp = parse_suppressions(text)
+    kept, suppressed = [], []
+    for f in sorted(findings):
+        names = supp.get(f.line, ())
+        if "all" in names or f.rule in names:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return LintResult(path, kept, suppressed)
+
+
+def check_file(path: Path, config: Config | None = None) -> LintResult:
+    try:
+        text = path.read_text()
+    except UnicodeDecodeError:
+        return LintResult(str(path),
+                          [Finding(str(path), 0, "syntax", "not utf-8")],
+                          [])
+    except OSError as e:
+        return LintResult(str(path),
+                          [Finding(str(path), 0, "syntax",
+                                   "unreadable: %s" % e)], [])
+    return check_source(text, str(path), config)
+
+
+# ---- file iteration ----
+
+def _is_python_script(p: Path) -> bool:
+    try:
+        head = p.open("rb").readline()
+    except OSError:
+        return False
+    return head.startswith(b"#!") and b"python" in head
+
+
+def _excluded(p: Path, config: Config) -> bool:
+    s = str(p)
+    return any(part in s for part in config.exclude)
+
+
+def iter_files(paths, config: Config) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            found = sorted(p.rglob("*.py"))
+            # shebang scripts without .py (tools/lint itself, tools/
+            # mkdevcluster, tests/fakepg/postgres, ...) are gated too
+            found += sorted(
+                f for f in p.rglob("*")
+                if f.is_file() and f.suffix == "" and _is_python_script(f))
+            for f in found:
+                if not _excluded(f, config):
+                    yield f
+        elif p.is_file() and (p.suffix == ".py" or _is_python_script(p)):
+            # explicit file arguments bypass the exclude list
+            yield p
+
+
+def check_paths(paths, config: Config | None = None
+                ) -> tuple[int, list, list]:
+    """(files checked, findings, suppressed findings) over *paths*."""
+    config = config or Config()
+    n = 0
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in iter_files(paths, config):
+        n += 1
+        res = check_file(f, config)
+        findings.extend(res.findings)
+        suppressed.extend(res.suppressed)
+    return n, findings, suppressed
+
+
+# ---- allowlist matching (used by unbounded-wait) ----
+
+def allow_matches(entries, path: str, funcname: str) -> bool:
+    """True when any "<path-glob>::<func-glob>" entry matches.  The path
+    part matches against the end of the reported path so entries stay
+    stable regardless of how the tool was invoked."""
+    for entry in entries:
+        pat_path, sep, pat_fn = entry.partition("::")
+        if not sep:
+            pat_path, pat_fn = entry, "*"
+        if not fnmatch.fnmatch(funcname or "", pat_fn):
+            continue
+        if fnmatch.fnmatch(path, pat_path) \
+                or fnmatch.fnmatch(path, "*" + pat_path.lstrip("*")):
+            return True
+    return False
+
+
+# ---- CLI ----
+
+def _build_config(args) -> Config:
+    cfg = Config()
+    cfg_path = args.config
+    if cfg_path is None and Path(".mnt-lint.json").is_file():
+        cfg_path = ".mnt-lint.json"
+    if cfg_path:
+        cfg = Config.from_file(cfg_path, cfg)
+    overrides = {}
+    if args.max_line is not None:
+        overrides["max_line"] = args.max_line
+    if args.disable:
+        names = set(cfg.disable)
+        for chunk in args.disable:
+            names.update(n.strip() for n in chunk.split(",") if n.strip())
+        unknown = names - set(RULES)
+        if unknown:
+            raise SystemExit("mnt-lint: unknown rule(s): %s"
+                             % ", ".join(sorted(unknown)))
+        overrides["disable"] = frozenset(names)
+    if args.unbounded_allow:
+        overrides["unbounded_allow"] = (cfg.unbounded_allow
+                                        | frozenset(args.unbounded_allow))
+    return dataclasses.replace(cfg, **overrides)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mnt-lint",
+        description="stdlib static checks incl. async-concurrency rules "
+                    "(docs/lint.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to check (default: the repo tree)")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--disable", action="append", default=[],
+                    metavar="RULE[,RULE...]",
+                    help="disable rules by name")
+    ap.add_argument("--config", metavar="FILE",
+                    help="JSON config (default: ./.mnt-lint.json if "
+                         "present)")
+    ap.add_argument("--max-line", type=int, default=None)
+    ap.add_argument("--unbounded-allow", action="append", default=[],
+                    metavar="PATH::FUNC",
+                    help="allowlist entry for the unbounded-wait rule")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name in sorted(RULES):
+            print("%-*s  %s" % (width, name, RULES[name].summary))
+        return 0
+
+    config = _build_config(args)
+    n, findings, suppressed = check_paths(args.paths or DEFAULT_PATHS,
+                                          config)
+    if args.format == "json":
+        print(json.dumps({
+            "files": n,
+            "problems": len(findings),
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": [f.as_dict() for f in suppressed],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        print("mnt-lint: %d files, %d problems (%d suppressed)"
+              % (n, len(findings), len(suppressed)), file=sys.stderr)
+    return 1 if findings else 0

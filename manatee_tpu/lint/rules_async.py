@@ -1,0 +1,517 @@
+"""Async-concurrency rules: the discipline the control plane's safety
+rests on, checked mechanically.
+
+The cluster state machine survives adversarial interleavings only if
+every spawned task has an owner, cancellation propagates, and no
+coroutine wedges the loop or waits forever on a peer that will never
+answer.  Each rule below encodes one of those invariants; docs/lint.md
+has the bad/good example pairs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from manatee_tpu.lint.engine import (
+    FileContext,
+    allow_matches,
+    dotted,
+    has_await,
+    rule,
+    walk_no_defs,
+)
+
+# ---------------------------------------------------------------- spawn
+
+_LOOP_FACTORIES = ("get_event_loop", "get_running_loop", "new_event_loop")
+
+
+def _spawn_kind(call: ast.Call) -> str | None:
+    """'ensure' / 'create' when *call* spawns a free-running task.
+
+    ``TaskGroup.create_task`` results are owned by the group, so only
+    ``asyncio.create_task``, a bare ``create_task``, and
+    ``<...loop>.create_task`` count as ownerless spawns.
+    """
+    func = call.func
+    name = dotted(func)
+    if name is not None:
+        last = name.rsplit(".", 1)[-1]
+        if last == "ensure_future":
+            return "ensure"
+        if last == "create_task":
+            if name in ("create_task", "asyncio.create_task"):
+                return "create"
+            recv = name.rsplit(".", 1)[0].rsplit(".", 1)[-1]
+            if recv.endswith("loop"):
+                return "create"
+        return None
+    # asyncio.get_event_loop().create_task(...)
+    if isinstance(func, ast.Attribute) and func.attr == "create_task" \
+            and isinstance(func.value, ast.Call):
+        inner = dotted(func.value.func)
+        if inner and inner.rsplit(".", 1)[-1] in _LOOP_FACTORIES:
+            return "create"
+    return None
+
+
+@rule("orphan-task", "spawned task with no handle (exception lost)")
+def orphan_task(ctx: FileContext):
+    """A ``create_task`` result that is never bound loses its exception
+    forever (and, pre-3.8-semantics aside, the task itself can be
+    garbage-collected mid-flight).  ``asyncio.ensure_future`` is flagged
+    outright: every call site in this tree spawns a coroutine, and
+    ``asyncio.create_task`` is the Python >= 3.7 idiom for that."""
+    parents = ctx.parents
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _spawn_kind(node)
+        if kind == "ensure":
+            yield ctx.finding(
+                node.lineno, "orphan-task",
+                "asyncio.ensure_future() is retired here: spawn with "
+                "asyncio.create_task() and keep the handle")
+        elif kind == "create" and isinstance(parents.get(node), ast.Expr):
+            yield ctx.finding(
+                node.lineno, "orphan-task",
+                "task is spawned and discarded: bind the handle (and "
+                "cancel/await it on teardown) or its exception is lost")
+
+
+# ------------------------------------------------- blocking-call-in-async
+
+_BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "os.system", "os.popen", "os.wait", "os.waitpid",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "subprocess.getstatusoutput", "subprocess.Popen",
+    "socket.create_connection", "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+})
+# sync file I/O: the open() builtin plus pathlib-style method names
+_BLOCKING_IO_CALLS = frozenset({"open"})
+_BLOCKING_IO_METHODS = frozenset({
+    "read_text", "write_text", "read_bytes", "write_bytes",
+})
+
+
+def _sync_calls_in_async(ctx: FileContext):
+    """Calls inside an async def's own execution context that are not
+    themselves awaited (an awaited call is an async API)."""
+    owners = ctx.owners
+    parents = ctx.parents
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(owners.get(node), ast.AsyncFunctionDef) \
+                and not isinstance(parents.get(node), ast.Await):
+            yield node
+
+
+@rule("blocking-call-in-async", "sync sleep/subprocess/DNS in async def")
+def blocking_call_in_async(ctx: FileContext):
+    """A synchronous sleep, subprocess wait, or DNS/TCP setup inside
+    ``async def`` stalls the whole event loop for its full duration —
+    on a sitter that means every health check, watch handler, and RPC
+    on the peer.  Use the asyncio equivalent, or push the call into a
+    worker thread (``loop.run_in_executor`` / ``asyncio.to_thread``)."""
+    blocking = _BLOCKING_CALLS | ctx.config.blocking_extra
+    for node in _sync_calls_in_async(ctx):
+        name = dotted(node.func)
+        if name in blocking:
+            yield ctx.finding(
+                node.lineno, "blocking-call-in-async",
+                "%s() blocks the event loop; use the asyncio "
+                "equivalent or run_in_executor/to_thread" % name)
+
+
+@rule("blocking-io-in-async", "sync file I/O in async def")
+def blocking_io_in_async(ctx: FileContext):
+    """Sync file I/O (``open``, ``Path.read_text`` & friends) inside
+    ``async def`` rides on disk latency: instant on a healthy local
+    disk, a multi-second loop stall on a degraded one — exactly when
+    the control plane most needs to stay responsive.  Production code
+    pushes these into a worker thread; test/bench code disables the
+    rule via the ``path-disable`` config (tiny fixture writes do not
+    need a thread hop)."""
+    for node in _sync_calls_in_async(ctx):
+        name = dotted(node.func)
+        if name in _BLOCKING_IO_CALLS:
+            yield ctx.finding(
+                node.lineno, "blocking-io-in-async",
+                "%s() is synchronous file I/O; run it in a worker "
+                "thread (run_in_executor/to_thread)" % name)
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_IO_METHODS:
+            yield ctx.finding(
+                node.lineno, "blocking-io-in-async",
+                ".%s() is synchronous file I/O; run it in a worker "
+                "thread (run_in_executor/to_thread)" % node.func.attr)
+
+
+# ------------------------------------------------- swallowed-cancellation
+
+_GENERIC = {"Exception", "BaseException"}
+
+
+def _handler_names(h: ast.ExceptHandler) -> set:
+    """Last components of the exception types a handler catches
+    (empty set for a bare ``except:``)."""
+    if h.type is None:
+        return set()
+    nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    out = set()
+    for n in nodes:
+        name = dotted(n)
+        if name:
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for s in h.body
+               for n in walk_no_defs(s))
+
+
+@rule("swallowed-cancellation",
+      "generic except in async def eats CancelledError")
+def swallowed_cancellation(ctx: FileContext):
+    """Cancellation surfaces at await points as ``CancelledError``; a
+    generic handler that neither re-raises nor follows an explicit
+    ``except asyncio.CancelledError`` arm turns a cancel into a silent
+    wedge (the task keeps running, its canceller hangs).  Catching
+    CancelledError *mixed into a tuple* with other types is flagged too:
+    give cancellation its own arm so the reader can see the decision."""
+    owners = ctx.owners
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not isinstance(owners.get(node), ast.AsyncFunctionDef):
+            continue
+        if not has_await(node.body):
+            continue           # no await point: cancellation cannot land
+        cancel_armed = False
+        for h in node.handlers:
+            names = _handler_names(h)
+            if names and names <= {"CancelledError"}:
+                cancel_armed = True      # explicit, deliberate arm
+                continue
+            if "CancelledError" in names:
+                yield ctx.finding(
+                    h.lineno, "swallowed-cancellation",
+                    "CancelledError is caught in a tuple with %s: give "
+                    "cancellation its own except arm"
+                    % ", ".join(sorted(names - {"CancelledError"})))
+                cancel_armed = True      # it IS handled, however badly
+                continue
+            generic = h.type is None or (names & _GENERIC)
+            if not generic or cancel_armed or _reraises(h):
+                continue
+            caught = ", ".join(sorted(names & _GENERIC)) or "everything"
+            yield ctx.finding(
+                h.lineno, "swallowed-cancellation",
+                "except %s around awaits can swallow task cancellation: "
+                "add 'except asyncio.CancelledError: raise' before it"
+                % caught)
+
+
+# --------------------------------------------------- cancel-without-await
+
+_WAIT_FUNCS = {"gather", "wait", "wait_for", "shield", "as_completed"}
+
+
+def _attr_names_in(node) -> set:
+    return {n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)}
+
+
+def _is_wait_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in _WAIT_FUNCS
+
+
+def _function_nodes(tree):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _local_scan(fn, ctx: FileContext):
+    """Per-scope maps: name->attr aliases, loop-var->attrs, plus
+    spawned/awaited/cancelled local names and awaited/cancelled attrs."""
+    alias: dict[str, str] = {}
+    loopvars: dict[str, set] = {}
+    spawned_locals: set = set()
+    awaited_names: set = set()
+    cancelled: list = []       # (local name | None, attr | None, lineno)
+    owners = ctx.owners
+    scope = fn if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        else None
+    for node in ast.walk(fn):
+        if owners.get(node) is not scope and node is not fn:
+            continue
+        if isinstance(node, ast.Assign):
+            targets, values = node.targets, [node.value]
+            if len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(node.targets[0].elts) == len(node.value.elts):
+                targets = node.targets[0].elts
+                values = node.value.elts
+            for t, v in zip(targets, values):
+                if not isinstance(t, ast.Name):
+                    continue
+                if isinstance(v, ast.Attribute):
+                    alias[t.id] = v.attr
+                elif any(_spawn_kind(c) for c in ast.walk(v)
+                         if isinstance(c, ast.Call)):
+                    spawned_locals.add(t.id)
+        elif isinstance(node, ast.For) and isinstance(node.target,
+                                                      ast.Name):
+            loopvars.setdefault(node.target.id,
+                                set()).update(_attr_names_in(node.iter))
+        elif isinstance(node, ast.Await):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    awaited_names.add(sub.id)
+        elif _is_wait_call(node):
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        awaited_names.add(sub.id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "cancel" and not node.args:
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                cancelled.append((recv.id, None, node.lineno))
+            elif isinstance(recv, ast.Attribute):
+                cancelled.append((None, recv.attr, node.lineno))
+    return alias, loopvars, spawned_locals, awaited_names, cancelled
+
+
+@rule("cancel-without-await",
+      ".cancel() on a spawned task that is never reaped")
+def cancel_without_await(ctx: FileContext):
+    """``task.cancel()`` only *requests* cancellation; until the task is
+    awaited (or gathered) its finally blocks may still be running and
+    its outcome is never observed.  Flagged when a task this file spawns
+    is cancelled but never awaited anywhere in the file (attributes) or
+    in the same function (locals)."""
+    # pass 1 (file scope): which attributes hold spawned tasks, which
+    # attributes are ever awaited/gathered
+    spawned_attrs: set = set()
+    awaited_attrs: set = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            if any(_spawn_kind(c) for c in ast.walk(node.value)
+                   if isinstance(c, ast.Call)):
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Attribute):
+                            spawned_attrs.add(sub.attr)
+        if isinstance(node, ast.Call) and _spawn_kind(node):
+            # spawn(coro(self._old_task)): the handle is passed into a
+            # fresh coroutine — ownership transferred, it reaps it
+            for arg in node.args:
+                awaited_attrs.update(_attr_names_in(arg))
+        if isinstance(node, ast.Await):
+            awaited_attrs.update(_attr_names_in(node))
+        elif _is_wait_call(node):
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                awaited_attrs.update(_attr_names_in(arg))
+        elif isinstance(node, ast.For):
+            # reap loop:  for t in (self._a, self._b): await t
+            if isinstance(node.target, ast.Name) and any(
+                    isinstance(sub, ast.Await)
+                    and node.target.id in {n.id for n in ast.walk(sub)
+                                           if isinstance(n, ast.Name)}
+                    for stmt in node.body for sub in walk_no_defs(stmt)):
+                awaited_attrs.update(_attr_names_in(node.iter))
+
+    # pass 2 (per scope): aliases, loop vars, locals, cancels
+    for fn in _function_nodes(ctx.tree):
+        alias, loopvars, spawned_locals, awaited_names, cancelled = \
+            _local_scan(fn, ctx)
+        for local, attr, lineno in cancelled:
+            attrs: set = set()
+            if attr is not None:
+                attrs = {attr}
+            elif local is not None:
+                if local in alias:
+                    attrs = {alias[local]}
+                elif local in loopvars:
+                    attrs = loopvars[local]
+                elif local in spawned_locals:
+                    if local not in awaited_names:
+                        yield ctx.finding(
+                            lineno, "cancel-without-await",
+                            "task %r is cancelled but never awaited in "
+                            "this function: await it (or gather it) so "
+                            "its teardown completes and its outcome is "
+                            "observed" % local)
+                    continue
+            hits = attrs & spawned_attrs
+            for a in sorted(hits):
+                if a not in awaited_attrs \
+                        and (local is None or local not in awaited_names):
+                    yield ctx.finding(
+                        lineno, "cancel-without-await",
+                        "task attribute %r is cancelled but never "
+                        "awaited anywhere in this file: reap it "
+                        "(await / gather(..., return_exceptions=True)) "
+                        "after cancelling" % a)
+
+
+# ------------------------------------------------------- lock-discipline
+
+def _release_targets(stmts) -> set:
+    out = set()
+    for stmt in stmts:
+        for node in walk_no_defs(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                recv = dotted(node.func.value)
+                if recv:
+                    out.add(recv)
+    return out
+
+
+def _enclosing_stmt(ctx: FileContext, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _next_sibling(ctx: FileContext, stmt):
+    parent = ctx.parents.get(stmt)
+    if parent is None:
+        return None
+    for field in ("body", "orelse", "finalbody", "handlers"):
+        seq = getattr(parent, field, None)
+        if isinstance(seq, list) and stmt in seq:
+            i = seq.index(stmt)
+            return seq[i + 1] if i + 1 < len(seq) else None
+    return None
+
+
+@rule("lock-discipline", ".acquire() without async with / try-finally")
+def lock_discipline(ctx: FileContext):
+    """An explicit ``.acquire()`` whose release is not structurally
+    guaranteed deadlocks the peer on the first exception between acquire
+    and release.  Use ``async with lock:`` (or ``with lock:``); when
+    staged acquisition is genuinely needed, the acquire must be the
+    statement immediately before (or the first statement of) a ``try``
+    whose ``finally`` releases the same lock."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"):
+            continue
+        target = dotted(node.func.value)
+        if target is None:
+            continue
+        stmt = _enclosing_stmt(ctx, node)
+        if stmt is None:
+            continue
+        # inside a try whose finally releases the target?
+        protected = False
+        cur = stmt
+        while cur is not None:
+            parent = ctx.parents.get(cur)
+            if isinstance(parent, ast.Try) and cur in parent.body \
+                    and target in _release_targets(parent.finalbody):
+                protected = True
+                break
+            cur = parent
+        if not protected:
+            nxt = _next_sibling(ctx, stmt)
+            if isinstance(nxt, ast.Try) \
+                    and target in _release_targets(nxt.finalbody):
+                protected = True
+        if not protected:
+            yield ctx.finding(
+                node.lineno, "lock-discipline",
+                "%s.acquire() without a structural release: use "
+                "'async with %s:' or pair it with try/finally %s"
+                ".release()" % (target, target, target))
+
+
+# -------------------------------------------------------- unbounded-wait
+
+_TIMEOUT_CTXS = {"timeout", "timeout_at"}
+
+
+def _qualfunc(ctx: FileContext, node) -> str:
+    owner = ctx.owners.get(node)
+    return owner.name if owner is not None else "<module>"
+
+
+@rule("unbounded-wait", "network primitive awaited without a timeout")
+def unbounded_wait(ctx: FileContext):
+    """A TCP connect (or a length-prefixed read) against a wedged peer
+    — SIGSTOP, a blackholed route — hangs forever unless bounded.
+    Awaits of the configured primitives must run under
+    ``asyncio.wait_for`` or an enclosing ``asyncio.timeout`` block.
+    Deliberately-unbounded call sites (idle read loops) go on the
+    allowlist: config key ``unbounded-allow``, entries
+    ``"<path-glob>::<function-glob>"``."""
+    cfg = ctx.config
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        hit = None
+        if name in cfg.unbounded_primitives:
+            hit = name
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in cfg.unbounded_methods:
+            hit = "." + node.func.attr
+        if hit is None:
+            continue
+        if not isinstance(ctx.parents.get(node), ast.Await):
+            # wrapped (wait_for(...) arg, ensure_future, ...) or a
+            # handle stored for later: only the direct await is the
+            # unbounded wait
+            continue
+        protected = False
+        cur = node
+        while cur is not None:
+            parent = ctx.parents.get(cur)
+            if isinstance(parent, ast.Call):
+                pname = dotted(parent.func)
+                if pname and pname.rsplit(".", 1)[-1] == "wait_for":
+                    protected = True
+                    break
+            if isinstance(parent, (ast.AsyncWith, ast.With)):
+                for item in parent.items:
+                    cexpr = item.context_expr
+                    if isinstance(cexpr, ast.Call):
+                        cname = dotted(cexpr.func)
+                        if cname and cname.rsplit(".", 1)[-1] \
+                                in _TIMEOUT_CTXS:
+                            protected = True
+                            break
+                if protected:
+                    break
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                break
+            cur = parent
+        if protected:
+            continue
+        if allow_matches(cfg.unbounded_allow, ctx.path,
+                         _qualfunc(ctx, node)):
+            continue
+        yield ctx.finding(
+            node.lineno, "unbounded-wait",
+            "await %s(...) with no timeout can hang on a wedged peer: "
+            "wrap in asyncio.wait_for(...) or add the call site to the "
+            "unbounded-allow list" % hit)

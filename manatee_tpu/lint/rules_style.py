@@ -1,0 +1,111 @@
+"""The original mnt-lint checks, carried over as engine rules.
+
+These are the style/correctness checks the seed ``tools/lint`` shipped
+with (no third-party linters ship in the dev image; the reference gates
+on jsl + jsstyle, Makefile:60-66).  Syntax is engine-level: a file that
+does not parse yields a single ``syntax`` finding and no rule runs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from manatee_tpu.lint.engine import FileContext, rule
+
+
+class _ImportVisitor(ast.NodeVisitor):
+    """Collect imported names and all referenced names per module."""
+
+    def __init__(self):
+        self.imports: dict[str, ast.stmt] = {}
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports[name] = node
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+@rule("unused-import", "module-level import never referenced")
+def unused_import(ctx: FileContext):
+    """Module scope only: function-level imports are often deliberate
+    lazy loads here.  Names listed in __all__ count as used (re-export
+    modules); other string literals do NOT — a docstring mentioning a
+    module name must not disable the check for it."""
+    iv = _ImportVisitor()
+    iv.visit(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    iv.used.add(c.value)
+    for name, node in iv.imports.items():
+        if name not in iv.used and not name.startswith("_"):
+            yield ctx.finding(node.lineno, "unused-import",
+                              "unused import %r" % name)
+
+
+@rule("shadowed-def", "duplicate def/class in the same scope")
+def shadowed_def(ctx: FileContext):
+    """A shadowed def is almost always a copy-paste bug."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.ClassDef, ast.Module)):
+            continue
+        names: dict[str, int] = {}
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                key = child.name
+                if key in names and not key.startswith("_dup_ok"):
+                    yield ctx.finding(
+                        child.lineno, "shadowed-def",
+                        "%r shadows definition at line %d"
+                        % (key, names[key]))
+                names[key] = child.lineno
+
+
+@rule("bare-except", "except: with no exception type")
+def bare_except(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield ctx.finding(node.lineno, "bare-except", "bare except")
+
+
+@rule("mutable-default", "mutable default argument")
+def mutable_default(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield ctx.finding(
+                        node.lineno, "mutable-default",
+                        "mutable default argument in %s()" % node.name)
+
+
+@rule("style", "tabs, trailing whitespace, long lines")
+def style(ctx: FileContext):
+    max_line = ctx.config.max_line
+    for i, line in enumerate(ctx.lines, 1):
+        if "\t" in line:
+            yield ctx.finding(i, "style", "tab character")
+        if line != line.rstrip():
+            yield ctx.finding(i, "style", "trailing whitespace")
+        if len(line) > max_line:
+            yield ctx.finding(i, "style", "line too long (%d > %d)"
+                              % (len(line), max_line))

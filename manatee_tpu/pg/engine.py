@@ -152,12 +152,16 @@ class SimPgEngine(Engine):
         d.mkdir(parents=True, exist_ok=True)
         if self.is_initialized(datadir):
             raise PgError("already initialized: %s" % datadir)
-        (d / VERSION_FILE).write_text(VERSION + "\n")
-        (d / CONF_NAME).write_text(json.dumps({
-            "port": 0, "read_only": True,
-            "synchronous_standby_names": [],
-            "primary_conninfo": None,
-        }))
+
+        def _write() -> None:        # worker thread: off the loop
+            (d / VERSION_FILE).write_text(VERSION + "\n")
+            (d / CONF_NAME).write_text(json.dumps({
+                "port": 0, "read_only": True,
+                "synchronous_standby_names": [],
+                "primary_conninfo": None,
+            }))
+
+        await asyncio.to_thread(_write)
 
     def start_argv(self, datadir: str) -> list[str]:
         return [sys.executable, "-m", "manatee_tpu.pg.simpg",

@@ -40,6 +40,7 @@ from manatee_tpu.obs import get_journal, get_registry
 from manatee_tpu.pg.engine import Engine, PgError, parse_pg_url
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
+from manatee_tpu.utils.aio import cancel_requests
 
 log = logging.getLogger("manatee.pg")
 
@@ -113,6 +114,7 @@ class PostgresMgr:
         self._health_task: asyncio.Task | None = None
         self._catchup_task: asyncio.Task | None = None
         self._repoint_task: asyncio.Task | None = None
+        self._exit_watch: asyncio.Task | None = None
         self._reconf_lock = asyncio.Lock()
         self._query_lock = asyncio.Lock()   # serialized local queries
         self._last_xlog = INITIAL_WAL
@@ -154,22 +156,32 @@ class PostgresMgr:
         """Initial probe + health loop; emits 'init' {setup, online}
         (lib/postgresMgr.js:401-421)."""
         setup = self.engine.is_initialized(self.datadir)
-        self._health_task = asyncio.ensure_future(self._health_loop())
+        self._health_task = asyncio.create_task(self._health_loop())
         self._emit("init", {"setup": setup, "online": False})
 
     async def close(self) -> None:
         """Crash-only shutdown: the child is shot in the head, never a
         clean postgres shutdown (lib/shard.js:78-93)."""
         self._closed = True
-        for t in (self._health_task, self._catchup_task,
-                  self._repoint_task):
-            if t:
-                t.cancel()
-        await self._kill_proc()
-        if self._log_fh:
-            self._log_fh.close()
-        if self._dump_fh:
-            self._dump_fh.close()
+        try:
+            await self._cancel_catchup()
+            await self._cancel_repoint()
+            for t in (self._health_task, self._exit_watch):
+                if t:
+                    t.cancel()
+            # reap: their finallys complete before the process goes away
+            await asyncio.gather(
+                *(t for t in (self._health_task, self._exit_watch) if t),
+                return_exceptions=True)
+        finally:
+            # crash-only contract: the child is shot even if close()
+            # itself is cancelled mid-reap (the kill() in _kill_proc is
+            # synchronous, so it lands before any further await)
+            await self._kill_proc()
+            if self._log_fh:
+                self._log_fh.close()
+            if self._dump_fh:
+                self._dump_fh.close()
 
     @property
     def online(self) -> bool:
@@ -221,7 +233,7 @@ class PostgresMgr:
         # interrupting it — a write outage for the restore's duration
         # on every topology change (cancelable-transition parity,
         # lib/postgresMgr.js:379-385)
-        self._cancel_repoint()
+        await self._cancel_repoint()
         await self._cancel_catchup()
         async with self._reconf_lock:
             role = pgcfg.get("role")
@@ -233,7 +245,7 @@ class PostgresMgr:
             # when we pre-cancelled may have armed fresh tasks on its
             # way out
             await self._cancel_catchup()
-            self._cancel_repoint()
+            await self._cancel_repoint()
             t0 = time.monotonic()
             try:
                 if role == "primary":
@@ -261,10 +273,19 @@ class PostgresMgr:
             journal.record("pg.reconfigure.done", role=role)
             self._applied = pgcfg
 
-    def _cancel_repoint(self) -> None:
+    async def _cancel_repoint(self) -> None:
         t, self._repoint_task = self._repoint_task, None
         if t and not t.done():
             t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                # as in _cancel_catchup: if WE are being cancelled,
+                # propagate rather than resume a cancelled reconfigure
+                if cancel_requests(asyncio.current_task()):
+                    raise
+            except Exception:
+                pass
 
     async def _cancel_catchup(self) -> None:
         t, self._catchup_task = self._catchup_task, None
@@ -276,8 +297,7 @@ class PostgresMgr:
                 # if WE are being cancelled (topology changed again while
                 # awaiting the child's teardown), propagate — otherwise
                 # the supposedly-cancelled reconfigure would continue
-                cur = asyncio.current_task()
-                if cur is not None and cur.cancelling():
+                if cancel_requests(asyncio.current_task()):
                     raise
             except Exception:
                 pass
@@ -340,7 +360,7 @@ class PostgresMgr:
             await self._start()
         await self._snapshot_safe()
         if downstream:
-            self._catchup_task = asyncio.ensure_future(
+            self._catchup_task = asyncio.create_task(
                 self._wait_for_standby(downstream["id"], sync_ids))
 
     async def _update_standby(self, pgcfg: dict) -> None:
@@ -356,7 +376,7 @@ class PostgresMgr:
             sync_standby_ids=sync_ids, upstream=None)
         self._reload()
         if downstream:
-            self._catchup_task = asyncio.ensure_future(
+            self._catchup_task = asyncio.create_task(
                 self._wait_for_standby(downstream["id"], sync_ids))
 
     async def _wait_for_standby(self, standby_id: str,
@@ -433,7 +453,7 @@ class PostgresMgr:
                 sync_standby_ids=[], upstream=upstream)
             self._reload()
             if self.engine.lingering_repoint_failure:
-                self._repoint_task = asyncio.ensure_future(
+                self._repoint_task = asyncio.create_task(
                     self._repoint_watchdog(pgcfg))
             return
         try:
@@ -489,7 +509,7 @@ class PostgresMgr:
         # standby transition arms the attachment watchdog, not just
         # the reload fast path (code-review r5)
         if self.engine.lingering_repoint_failure:
-            self._repoint_task = asyncio.ensure_future(
+            self._repoint_task = asyncio.create_task(
                 self._repoint_watchdog(pgcfg))
 
     async def _upstream_reachable(self, upstream: dict) -> bool:
@@ -507,6 +527,10 @@ class PostgresMgr:
         except (OSError, asyncio.TimeoutError):
             return False
         w.close()
+        # bounded drain of the half-closed transport: each watchdog
+        # poll otherwise leaks it until GC (ADVICE r5)
+        with contextlib.suppress(Exception):
+            await asyncio.wait_for(w.wait_closed(), 2.0)
         return True
 
     async def _repoint_watchdog(self, pgcfg: dict) -> None:
@@ -635,7 +659,9 @@ class PostgresMgr:
             logpath = self.cfg.get(
                 "pgLogFile", str(Path(self.datadir).parent
                                  / ("pg-%d.log" % self.port)))
-            self._log_fh = open(logpath, "ab")
+            # worker thread: a degraded disk must not stall the loop
+            # on the failover path
+            self._log_fh = await asyncio.to_thread(open, logpath, "ab")
         self._proc = await asyncio.create_subprocess_exec(
             *argv, stdout=self._log_fh, stderr=self._log_fh,
             env=self.engine.child_env())
@@ -655,7 +681,8 @@ class PostgresMgr:
                 # boot complete: only NOW is an exit "unexpected" —
                 # exits during boot are handled by this loop (and may
                 # legitimately mean "needs restore")
-                asyncio.ensure_future(self._watch_exit(self._proc))
+                self._exit_watch = asyncio.create_task(
+                    self._watch_exit(self._proc))
                 return
             # fine-grained early, coarser later: boot completes in tens
             # of ms for the sim engine and this poll is squarely on the

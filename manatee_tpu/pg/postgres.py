@@ -189,10 +189,13 @@ class PostgresEngine(Engine):
                 raise PgError("installing pg_hba.conf failed: %s"
                               % e) from None
             return
-        try:
+        def _copy() -> None:        # worker thread: off the loop
             tmp = dst.with_name(dst.name + ".tmp")
             tmp.write_text(Path(self.hba_file).read_text())
             tmp.replace(dst)
+
+        try:
+            await asyncio.to_thread(_copy)
         except OSError as e:
             raise PgError("installing pg_hba.conf failed: %s" % e) from None
 

@@ -15,7 +15,6 @@ import json
 import sys
 
 from manatee_tpu.daemons.common import parse_daemon_args
-from manatee_tpu.pg.engine import PgError
 from manatee_tpu.shard import Shard
 from manatee_tpu.utils.logutil import setup_logging
 from manatee_tpu.utils.validation import load_json_config
@@ -83,7 +82,9 @@ async def repl(cfg: dict) -> None:
                 break
             else:
                 print("unknown command %r; 'help' for help" % cmd)
-        except (PgError, Exception) as e:
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
             print("error: %s" % e)
     await pg.close()
 

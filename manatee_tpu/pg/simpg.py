@@ -178,7 +178,7 @@ class SimPgServer:
                 if self._upstream_task:
                     self._upstream_task.cancel()
                 self._upstream_ok = False
-                self._upstream_task = asyncio.ensure_future(
+                self._upstream_task = asyncio.create_task(
                     self._stream_from_upstream())
             elif self.in_recovery and not new_upstream:
                 # pg_promote() parity (PostgreSQL 12+): exit recovery
@@ -217,12 +217,18 @@ class SimPgServer:
         sys.stderr.flush()
 
         if self.in_recovery:
-            self._upstream_task = asyncio.ensure_future(
+            self._upstream_task = asyncio.create_task(
                 self._stream_from_upstream())
 
         await stop.wait()
         if self._upstream_task:
             self._upstream_task.cancel()
+            try:
+                await self._upstream_task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                pass       # a dying streamer's last error is moot
         self._server.close()
 
     # ---- upstream replication (we are a standby) ----
@@ -263,8 +269,9 @@ class SimPgServer:
         conninfo = self.conf["primary_conninfo"]
         while not self._stopping:
             try:
-                reader, writer = await asyncio.open_connection(
-                    conninfo["host"], int(conninfo["port"]))
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        conninfo["host"], int(conninfo["port"])), 5.0)
                 req = {"op": "replicate", "from_lsn": self.wal.last_lsn,
                        "prefix_digest": self.wal.digest_to(
                            self.wal.last_lsn),
@@ -295,7 +302,8 @@ class SimPgServer:
                     ack = {"flush": self.wal.last_lsn}
                     writer.write((json.dumps(ack) + "\n").encode())
                     await writer.drain()
-            except (OSError, ValueError, json.JSONDecodeError):
+            except (OSError, ValueError, json.JSONDecodeError,
+                    asyncio.TimeoutError):
                 pass
             finally:
                 # a cancelled ex-streamer (live upstream re-point) must
@@ -329,7 +337,9 @@ class SimPgServer:
                 if not line:
                     break
                 req = json.loads(line)
-        except (ConnectionError, json.JSONDecodeError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            pass       # engine teardown cancels handler tasks
+        except (ConnectionError, json.JSONDecodeError):
             pass
         finally:
             try:
@@ -385,7 +395,7 @@ class SimPgServer:
                 st["replay"] = st["flush"]
                 self._wake_repl_waiters()
 
-        ack_task = asyncio.ensure_future(read_acks())
+        ack_task = asyncio.create_task(read_acks())
         try:
             cursor = from_lsn
             while True:
@@ -412,6 +422,12 @@ class SimPgServer:
             pass
         finally:
             ack_task.cancel()
+            try:
+                await ack_task
+            except asyncio.CancelledError:
+                pass       # the cancel we just requested
+            except Exception:
+                pass       # ack reader died with the connection
             # a newer connection for the same standby may have replaced
             # our entry; never pop someone else's registration
             if self.downstreams.get(standby_id) is st:
@@ -433,7 +449,8 @@ class SimPgServer:
             # latency-climb signature the health predictor fires on,
             # which the operator playbook's scripted test drives
             try:
-                await asyncio.sleep(float(slow.read_text().strip()))
+                delay = await asyncio.to_thread(slow.read_text)
+                await asyncio.sleep(float(delay.strip()))
             except (ValueError, OSError):
                 pass
         if op == "health":

@@ -52,8 +52,8 @@ class SnapShotter:
 
     def start(self) -> None:
         self._tasks = [
-            asyncio.ensure_future(self._create_loop()),
-            asyncio.ensure_future(self._cleanup_loop()),
+            asyncio.create_task(self._create_loop()),
+            asyncio.create_task(self._cleanup_loop()),
         ]
 
     async def stop(self) -> None:
@@ -62,8 +62,10 @@ class SnapShotter:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass       # the cancel we just requested
+            except Exception:
+                log.exception("snapshot loop died uncleanly")
 
     # -- creation --
 

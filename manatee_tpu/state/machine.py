@@ -235,7 +235,7 @@ class PeerStateMachine:
 
     def start(self) -> None:
         if self._worker_task is None:
-            self._worker_task = asyncio.ensure_future(self._worker())
+            self._worker_task = asyncio.create_task(self._worker())
 
     async def close(self) -> None:
         self._closed = True
@@ -245,8 +245,10 @@ class PeerStateMachine:
                 t.cancel()
                 try:
                     await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+                except asyncio.CancelledError:
+                    pass       # the cancel we just requested
+                except Exception:
+                    pass       # a dying worker's last error is moot
 
     def kick(self) -> None:
         self._kick.set()
@@ -623,6 +625,8 @@ class PeerStateMachine:
                 if refresh is not None:
                     try:
                         await refresh()
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         pass
                 await _sleep(0.05)
@@ -675,7 +679,7 @@ class PeerStateMachine:
             # and must not wedge the next topology change,
             # lib/postgresMgr.js:1263-1275)
             self._pg_task.cancel()
-        self._pg_task = asyncio.ensure_future(self._run_pg(cfg))
+        self._pg_task = asyncio.create_task(self._run_pg(cfg))
 
     async def _run_pg(self, cfg: dict) -> None:
         try:

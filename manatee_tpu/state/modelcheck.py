@@ -481,6 +481,8 @@ class World:
         epoch = p.view_epoch
         try:
             await p.sm._evaluate()
+        except asyncio.CancelledError:
+            raise
         except ConnectionLossError:
             pass                          # partitioned: expected
         except Exception as exc:          # noqa: BLE001 - report, don't die

@@ -240,7 +240,7 @@ async def pump_child_to_socket(
         raise
     os.close(wfd)   # pump sees EOF when the child exits
     consumer = stderr_task or (lambda p: p.stderr.read())
-    err_task = asyncio.ensure_future(consumer(proc))
+    err_task = asyncio.create_task(consumer(proc))
 
     cancelled = threading.Event()
 
@@ -267,10 +267,12 @@ async def pump_child_to_socket(
                 await asyncio.wait_for(fut, 10)
             except asyncio.TimeoutError:
                 finished = False
+            except asyncio.CancelledError:
+                # a FRESH cancel delivered at this await: the original
+                # CancelledError is re-raised below either way; only
+                # close the fd if the thread truly finished
+                finished = fut.done()
             except BaseException:
-                # incl. a FRESH cancel delivered at this await: the
-                # original CancelledError is re-raised below either
-                # way; only close the fd if the thread truly finished
                 finished = fut.done()
             if finished:
                 os.close(rfd)
@@ -329,6 +331,8 @@ async def pump_socket_to_child(
         while True:
             try:
                 chunk = await reader.read(1 << 16)
+            except asyncio.CancelledError:
+                raise      # reaped + propagated by the outer handler
             except Exception as e:
                 # the network stream died — a clean child exit would be
                 # meaningless (truncated-but-aligned archives extract

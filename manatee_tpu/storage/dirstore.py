@@ -318,7 +318,7 @@ class DirBackend(StorageBackend):
         # drain stderr CONCURRENTLY: a tar emitting more warnings than
         # the pipe buffer would block on stderr and stall stdout short
         # of EOF, deadlocking the copy loop below
-        t_err = asyncio.ensure_future(proc.stderr.read())
+        t_err = asyncio.create_task(proc.stderr.read())
         done = 0
         try:
             while True:
@@ -425,7 +425,7 @@ class DirBackend(StorageBackend):
         # warnings than the pipe buffer ('implausibly old time stamp',
         # unknown extended headers) would block on stderr, stop
         # reading stdin, and wedge the drain() below forever
-        t_err = asyncio.ensure_future(proc.stderr.read())
+        t_err = asyncio.create_task(proc.stderr.read())
         try:
             err, rc = await pump_socket_to_child(
                 proc, reader, t_err,

@@ -215,9 +215,9 @@ class ZfsBackend(StorageBackend):
                 if progress_cb:
                     progress_cb(done, state.size)
 
-        t_err = asyncio.ensure_future(
+        t_err = asyncio.create_task(
             _watch_send_stderr(proc, state, err_chunks, progress_cb))
-        t_out = asyncio.ensure_future(pump_stdout())
+        t_out = asyncio.create_task(pump_stdout())
         async def abort() -> None:
             # shielded + strongly-referenced: a SECOND cancel during
             # the abort must not skip the reap
@@ -296,7 +296,7 @@ class ZfsBackend(StorageBackend):
         # drain stderr CONCURRENTLY with the feed (same hazard as the
         # send paths: a verbose recv blocking on a full stderr pipe
         # stops reading stdin and wedges the drain() below)
-        t_err = asyncio.ensure_future(proc.stderr.read())
+        t_err = asyncio.create_task(proc.stderr.read())
         # a killed zfs recv discards the incomplete stream itself, so
         # unlike DirBackend there is no partial dataset to remove on
         # abort — the helper's reap is the whole cleanup

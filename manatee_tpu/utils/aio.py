@@ -1,0 +1,18 @@
+"""Small asyncio compatibility helpers shared across the tree."""
+
+from __future__ import annotations
+
+
+def cancel_requests(task) -> int:
+    """``task.cancelling()`` (Python >= 3.11), else 0.
+
+    The teardown helpers use the cancel-request count to tell "the task
+    I am reaping was cancelled" apart from "I myself am being
+    cancelled".  On 3.10 the counter does not exist and the distinction
+    cannot be observed; returning 0 degrades to the swallow-and-finish
+    behavior instead of crashing with AttributeError mid-teardown.
+    """
+    if task is None:
+        return 0
+    cancelling = getattr(task, "cancelling", None)
+    return cancelling() if cancelling is not None else 0

@@ -16,6 +16,8 @@ import shlex
 import time
 from dataclasses import dataclass
 
+from manatee_tpu.utils.aio import cancel_requests
+
 log = logging.getLogger("manatee.exec")
 
 # lib/common.js:151 uses a 2 MB maxBuffer for zfs output.
@@ -44,7 +46,7 @@ async def kill_and_reap(proc, tasks) -> None:
         await asyncio.gather(*tasks, return_exceptions=True)
         await reap_killed(proc)
 
-    cleanup = asyncio.ensure_future(_cleanup())
+    cleanup = asyncio.create_task(_cleanup())
     _cleanup_tasks.add(cleanup)
     cleanup.add_done_callback(_cleanup_tasks.discard)
     await asyncio.shield(cleanup)
@@ -134,24 +136,28 @@ async def drain_and_reap(proc: asyncio.subprocess.Process,
     Task.cancelling) so callers on except-Exception paths don't
     convert a cancel into a StorageError/swallow it."""
     cur = asyncio.current_task()
-    base = cur.cancelling() if cur is not None else 0
+    base = cancel_requests(cur)
     err_task.cancel()
     try:
         await err_task
-    except (asyncio.CancelledError, Exception):
+    except asyncio.CancelledError:
+        # ours or err_task's own — if it was aimed at us, the
+        # cancelling() bookkeeping below re-raises it
+        pass
+    except Exception:
         pass
     # the reap itself is shielded (like kill_and_reap): a cancel
     # delivered during ITS awaits must not leave the child killed but
     # never waited — the cleanup finishes detached and the cancel is
     # re-raised below
-    cleanup = asyncio.ensure_future(reap_killed(proc))
+    cleanup = asyncio.create_task(reap_killed(proc))
     _cleanup_tasks.add(cleanup)
     cleanup.add_done_callback(_cleanup_tasks.discard)
     try:
         await asyncio.shield(cleanup)
     except asyncio.CancelledError:
         pass
-    if cur is not None and cur.cancelling() > base:
+    if cancel_requests(cur) > base:
         raise asyncio.CancelledError()
 
 
@@ -208,9 +214,9 @@ async def run(
         cwd=cwd,
     )
     tasks = [
-        asyncio.ensure_future(_read_capped(proc.stdout, max_output)),
-        asyncio.ensure_future(_read_capped(proc.stderr, max_output)),
-        asyncio.ensure_future(_pump_stdin(proc, stdin_data)),
+        asyncio.create_task(_read_capped(proc.stdout, max_output)),
+        asyncio.create_task(_read_capped(proc.stderr, max_output)),
+        asyncio.create_task(_pump_stdin(proc, stdin_data)),
     ]
 
     async def _collect():

@@ -1,0 +1,54 @@
+# mnt-lint fixture: one violation per rule, NO suppressions.  The
+# engine walk excludes tests/data, so this file is only ever linted by
+# tests/test_lint.py passing it explicitly.
+import asyncio
+import os                                  # unused-import
+import time
+
+
+async def orphan():
+    asyncio.create_task(work())            # orphan-task (discarded)
+    t = asyncio.ensure_future(work())      # orphan-task (retired API)
+    return t
+
+
+async def blocking():
+    time.sleep(1)                          # blocking-call-in-async
+    open("/tmp/x")                         # blocking-io-in-async
+
+
+async def swallows():
+    try:
+        await work()
+    except Exception:                      # swallowed-cancellation
+        pass
+
+
+async def unreaped():
+    t = asyncio.create_task(work())
+    t.cancel()                             # cancel-without-await
+
+
+async def undisciplined(lock):
+    await lock.acquire()                   # lock-discipline
+    lock.release()
+
+
+async def unbounded():
+    await asyncio.open_connection("h", 1)  # unbounded-wait
+
+
+def shadowed():
+    return 1
+
+
+def shadowed():                            # shadowed-def
+    try:
+        return 2
+    except:                                # bare-except
+        pass
+
+
+def mutable(arg=[]):                       # mutable-default
+    return arg
+# the line above ends with a tab + this one is deliberately longer than the 100 column style limit ----

@@ -1,0 +1,38 @@
+# mnt-lint fixture: the same violation classes as positives.py, each
+# silenced with a per-line suppression — the engine must report zero
+# findings and account for every suppression.
+import asyncio
+import os                                 # mnt-lint: disable=unused-import
+import time
+
+
+async def orphan():
+    asyncio.create_task(work())           # mnt-lint: disable=orphan-task
+    t = asyncio.ensure_future(work())     # mnt-lint: disable=orphan-task
+    return t
+
+
+async def blocking():
+    time.sleep(1)     # mnt-lint: disable=blocking-call-in-async
+    open("/tmp/x")    # mnt-lint: disable=blocking-io-in-async
+
+
+async def swallows():
+    try:
+        await work()
+    except Exception:  # mnt-lint: disable=swallowed-cancellation
+        pass
+
+
+async def unreaped():
+    t = asyncio.create_task(work())
+    t.cancel()                  # mnt-lint: disable=cancel-without-await
+
+
+async def undisciplined(lock):
+    await lock.acquire()        # mnt-lint: disable=lock-discipline
+    lock.release()
+
+
+async def unbounded():
+    await asyncio.open_connection("h", 1)  # mnt-lint: disable=all

@@ -360,10 +360,11 @@ class ClusterHarness:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             try:
-                r, w = await asyncio.open_connection("127.0.0.1", port)
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", port), 1.0)
                 w.close()
                 return
-            except OSError:
+            except (OSError, asyncio.TimeoutError):
                 await asyncio.sleep(0.05)
         raise RuntimeError("port %d never came up" % port)
 
@@ -387,6 +388,8 @@ class ClusterHarness:
         try:
             data, _v = await c.get(self.shard_path + "/state")
             return json.loads(data.decode())
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return None
         finally:
@@ -450,6 +453,8 @@ class ClusterHarness:
                                            "timeout": 3.0}, 5.0)
                 if res.get("ok"):
                     return
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
                 last_err = e
             await asyncio.sleep(0.05)

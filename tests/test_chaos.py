@@ -68,6 +68,8 @@ class Chaos:
     async def state(self):
         try:
             return await self.cluster.cluster_state()
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return None
 
@@ -93,6 +95,8 @@ class Chaos:
         try:
             res = await peer.pg_query(
                 {"op": "insert", "value": value, "timeout": 2.0}, 4.0)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return
         if res.get("ok"):
@@ -111,6 +115,8 @@ class Chaos:
             return
         try:
             res = await peer.pg_query({"op": "select"}, 5.0)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return                      # primary mid-transition; later
         if res.get("rows") is None:

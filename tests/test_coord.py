@@ -717,7 +717,7 @@ def test_session_survives_own_write_blocked_in_dispatch(tmp_path):
             await orig(gen, target)
 
         server._log_fsync = gated
-        t = asyncio.ensure_future(c.create("/w", b"v"))
+        t = asyncio.create_task(c.create("/w", b"v"))
         # well past the 1s session timeout; expiry ticks run throughout
         await asyncio.sleep(2.5)
         assert server.tree.exists(eph) is not None, \
@@ -764,7 +764,7 @@ def test_resetup_during_initial_setup_is_single_flight():
             ident="10.0.0.1:5432:12345",
             data={"zoneId": "z", "ip": "10.0.0.1",
                   "pgUrl": "tcp://x", "backupUrl": "http://x"})
-        t = asyncio.ensure_future(mgr.start())
+        t = asyncio.create_task(mgr.start())
         await asyncio.sleep(0.05)      # first factory call parked
         # a session-expiry notification lands mid-setup
         mgr._schedule_resetup()

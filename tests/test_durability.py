@@ -109,15 +109,17 @@ def test_put_cluster_state_survives_sigkill_after_ack(tmp_path):
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             try:
-                r, w = await asyncio.open_connection("127.0.0.1", port)
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", port), 1.0)
                 w.close()
                 return
-            except OSError:
+            except (OSError, asyncio.TimeoutError):
                 await asyncio.sleep(0.05)
         raise RuntimeError("coordd never came up")
 
     async def go():
-        proc = subprocess.Popen(argv, stdout=logf, stderr=logf, env=env,
+        proc = await asyncio.to_thread(
+            subprocess.Popen, argv, stdout=logf, stderr=logf, env=env,
                                 start_new_session=True)
         try:
             await wait_port()
@@ -143,8 +145,9 @@ def test_put_cluster_state_survives_sigkill_after_ack(tmp_path):
                 os.killpg(proc.pid, signal.SIGKILL)
                 proc.wait(timeout=5)
 
-        proc = subprocess.Popen(argv, stdout=logf, stderr=logf, env=env,
-                                start_new_session=True)
+        proc = await asyncio.to_thread(
+            subprocess.Popen, argv, stdout=logf, stderr=logf, env=env,
+            start_new_session=True)
         try:
             await wait_port()
             c = NetCoord("127.0.0.1:%d" % port, session_timeout=5)
@@ -286,9 +289,13 @@ def test_full_ensemble_sigkill_storm_keeps_acked_state(tmp_path):
             try:
                 await asyncio.wait_for(c.connect(), 2.0)
                 return c
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 try:
                     await c.close()
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     pass
                 await asyncio.sleep(0.2)
@@ -311,6 +318,8 @@ def test_full_ensemble_sigkill_storm_keeps_acked_state(tmp_path):
                             _, ver = await c.get("/state")
                             await c.set("/state", payload, ver)
                         break
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         # ambiguous commit (applied locally, quorum
                         # refused): a retry may see the write already
@@ -319,6 +328,8 @@ def test_full_ensemble_sigkill_storm_keeps_acked_state(tmp_path):
                             data, _ = await c.get("/state")
                             if data == payload:
                                 break
+                        except asyncio.CancelledError:
+                            raise
                         except Exception:
                             pass
                         if time.monotonic() > deadline:

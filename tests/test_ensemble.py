@@ -122,7 +122,8 @@ def test_follower_redirects_client_to_leader():
             await c.create("/via-redirect", b"x")
             await c.close()
             # direct hello at a follower is refused with the hint
-            r, w = await asyncio.open_connection(*members[1])
+            r, w = await asyncio.wait_for(
+                asyncio.open_connection(*members[1]), 5.0)
             w.write(b'{"op":"hello","xid":1,"session_timeout":5}\n')
             await w.drain()
             import json
@@ -365,7 +366,7 @@ def test_ensemble_soak_random_member_churn(tmp_path):
                     except CoordError:
                         pass
 
-            wtask = asyncio.ensure_future(writer_loop())
+            wtask = asyncio.create_task(writer_loop())
             # churn: stop a random member, wait, bring it back with its
             # persisted tree; 8 rounds
             for _ in range(8):
@@ -894,7 +895,7 @@ def test_concurrent_mixed_txn_and_op_share_stream_without_resync(tmp_path):
 
             # the mixed transaction (deletes an ephemeral -> snapshot
             # replication) blocks inside the gated snapshot write...
-            t_txn = asyncio.ensure_future(c.multi([
+            t_txn = asyncio.create_task(c.multi([
                 Op.set("/state", b"s1", 0),
                 Op.delete(eph),
             ]))
@@ -902,7 +903,7 @@ def test_concurrent_mixed_txn_and_op_share_stream_without_resync(tmp_path):
             assert await loop.run_in_executor(None, entered.wait, 5)
             # ...while a plain persistent op applies and bumps the seq,
             # then queues on the log lock the persist holds
-            t_set = asyncio.ensure_future(c2.set("/other", b"o1", 0))
+            t_set = asyncio.create_task(c2.set("/other", b"o1", 0))
             await asyncio.sleep(0.2)
             leader._write_snapshot_tmp = orig_write
             release.set()
@@ -987,7 +988,7 @@ def test_write_committed_via_attach_window_follower(tmp_path):
 
             s2._write_snapshot_tmp = gated_write
 
-            t_w = asyncio.ensure_future(c.create("/attach-window", b"w"))
+            t_w = asyncio.create_task(c.create("/attach-window", b"w"))
             await asyncio.sleep(0.2)       # parked at the gated fsync
             assert not t_w.done()
 

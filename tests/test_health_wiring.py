@@ -84,7 +84,7 @@ def test_degrading_peer_scores_above_threshold_before_hard_timeout(tmp_path):
             orig(ok, 20.0 * deg.tick, st)
         mgr._record_telemetry = record
 
-        task = asyncio.ensure_future(mgr._health_loop())
+        task = asyncio.create_task(mgr._health_loop())
         try:
             for _ in range(400):
                 await asyncio.sleep(0.02)
@@ -100,6 +100,7 @@ def test_degrading_peer_scores_above_threshold_before_hard_timeout(tmp_path):
             assert mgr.status()["healthScore"] == mgr.health_score
         finally:
             task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
             mgr._proc = None
     run(go())
 
@@ -121,13 +122,14 @@ def test_healthy_peer_scores_low(tmp_path):
                     "replay_lag_seconds": 0.02, "replication": []}
         mgr.engine.health = health
         mgr.engine.status = status
-        task = asyncio.ensure_future(mgr._health_loop())
+        task = asyncio.create_task(mgr._health_loop())
         try:
             await asyncio.sleep(0.02 * 20)
             assert mgr.health_score is not None
             assert mgr.health_score < 0.5
         finally:
             task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
             mgr._proc = None
     run(go())
 
@@ -216,7 +218,7 @@ def test_playbook_promote_away_from_degrading_sync(tmp_path):
                     slow.write_text(str(min(0.85, 0.08 * v)))
                     lag.write_text(str(0.5 * v))
                     await asyncio.sleep(1.0)
-            ramp_task = asyncio.ensure_future(ramp())
+            ramp_task = asyncio.create_task(ramp())
 
             # playbook step 1: poll the operator surface until PRED on
             # the sync crosses the warning threshold
@@ -254,6 +256,7 @@ def test_playbook_promote_away_from_degrading_sync(tmp_path):
                     await asyncio.sleep(1.0)
             finally:
                 ramp_task.cancel()
+                await asyncio.gather(ramp_task, return_exceptions=True)
 
             # playbook step 3: planned promote of the healthy async
             # into the sync slot (-y: the advisory must not block the

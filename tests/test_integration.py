@@ -213,6 +213,8 @@ def test_deep_chain_eight_peers(tmp_path):
                 try:
                     res = await tail.pg_query({"op": "select"}, 3.0)
                     return "deep-chain" in (res.get("rows") or [])
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     return False
             deadline = asyncio.get_event_loop().time() + 30

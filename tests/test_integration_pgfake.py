@@ -130,7 +130,8 @@ def test_pgfake_rebuild_deposed(tmp_path):
 
             primary.start()
             await asyncio.sleep(1.0)
-            cp = subprocess.run(
+            cp = await asyncio.to_thread(
+                subprocess.run,
                 [sys.executable, "-m", "manatee_tpu.cli", "rebuild",
                  "-y", "-c", str(primary.root / "sitter.json"),
                  "--timeout", "60"],
@@ -172,6 +173,8 @@ def test_pgfake_standby_boot_failure_triggers_restore(tmp_path):
                     res = await victim.pg_query({"op": "select"}, 3.0)
                     if "before-breakage" in (res.get("rows") or []):
                         break
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     pass
                 assert asyncio.get_event_loop().time() < deadline, \
@@ -198,6 +201,8 @@ def test_pgfake_standby_boot_failure_triggers_restore(tmp_path):
                     res = await victim.pg_query({"op": "select"}, 3.0)
                     if "before-breakage" in (res.get("rows") or []):
                         break
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     pass
                 assert asyncio.get_event_loop().time() < deadline, \

@@ -293,6 +293,8 @@ def test_storm_restart_reverse_order(tmp_path):
                         if res.get("ok"):
                             new_primary = cand
                             break
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         pass
                 await asyncio.sleep(0.25)
@@ -337,6 +339,8 @@ def test_storm_primary_flap(tmp_path):
                         if res.get("ok"):
                             new_primary = cand
                             break
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         pass
                 await asyncio.sleep(0.25)

@@ -1,0 +1,564 @@
+"""mnt-lint v2: engine + per-rule fixture suite.
+
+Every rule has at least one positive (the rule fires) and one negative
+(a near-miss that must stay quiet) snippet — deleting a rule from the
+registry fails its positive here.  The engine tests cover per-line
+suppressions end to end (including the accounting the JSON output
+reports), the JSON format itself, per-path rule scoping, and the
+config file loader.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.lint import RULES, Config, check_source, main
+from manatee_tpu.lint.engine import check_paths, parse_suppressions
+
+DATA = Path(__file__).parent / "data" / "lint"
+
+NEW_RULES = {
+    "orphan-task", "blocking-call-in-async", "blocking-io-in-async",
+    "swallowed-cancellation", "cancel-without-await", "lock-discipline",
+    "unbounded-wait",
+}
+PORTED_RULES = {
+    "syntax", "unused-import", "shadowed-def", "bare-except",
+    "mutable-default", "style",
+}
+
+
+def lint(src: str, config: Config | None = None):
+    return check_source(textwrap.dedent(src), "snippet.py", config)
+
+
+def rules_of(src: str, config: Config | None = None) -> set:
+    return {f.rule for f in lint(src, config).findings}
+
+
+def test_registry_complete():
+    assert NEW_RULES | PORTED_RULES <= set(RULES)
+
+
+# ---- ported rules ----
+
+def test_syntax():
+    assert rules_of("def f(:\n") == {"syntax"}
+    assert rules_of("x = 1\n") == set()
+
+
+def test_unused_import():
+    assert "unused-import" in rules_of("import os\n")
+    assert "unused-import" not in rules_of("import os\nprint(os)\n")
+    # __all__ re-exports count as used; docstrings do not
+    assert "unused-import" not in rules_of(
+        "from a import b\n__all__ = ['b']\n")
+    assert "unused-import" in rules_of('"""mentions b"""\nfrom a import b\n')
+
+
+def test_shadowed_def():
+    assert "shadowed-def" in rules_of(
+        "def f():\n    pass\ndef f():\n    pass\n")
+    assert "shadowed-def" not in rules_of(
+        "def f():\n    pass\ndef g():\n    pass\n")
+
+
+def test_bare_except():
+    assert "bare-except" in rules_of(
+        "try:\n    x()\nexcept:\n    pass\n")
+    assert "bare-except" not in rules_of(
+        "try:\n    x()\nexcept ValueError:\n    pass\n")
+
+
+def test_mutable_default():
+    assert "mutable-default" in rules_of("def f(a=[]):\n    pass\n")
+    assert "mutable-default" not in rules_of("def f(a=()):\n    pass\n")
+
+
+def test_style():
+    assert "style" in rules_of("x = 1 \n")          # trailing space
+    assert "style" in rules_of("x = 'a\tb'\n")      # tab
+    assert "style" in rules_of("x = '%s'\n" % ("y" * 120))
+    assert "style" not in rules_of("x = 1\n")
+    # max-line is configurable
+    assert "style" not in rules_of("x = '%s'\n" % ("y" * 120),
+                                   Config(max_line=200))
+
+
+# ---- orphan-task ----
+
+def test_orphan_task_discarded_spawn():
+    assert "orphan-task" in rules_of("""\
+        async def f():
+            asyncio.create_task(g())
+    """)
+
+
+def test_orphan_task_ensure_future_flagged_outright():
+    # even a BOUND ensure_future is flagged: the API itself is retired
+    assert "orphan-task" in rules_of("t = asyncio.ensure_future(g())\n")
+
+
+def test_orphan_task_negative():
+    assert "orphan-task" not in rules_of("""\
+        async def f():
+            t = asyncio.create_task(g())
+            await t
+    """)
+    # TaskGroup owns its tasks: not an orphan
+    assert "orphan-task" not in rules_of("""\
+        async def f():
+            async with asyncio.TaskGroup() as tg:
+                tg.create_task(g())
+    """)
+
+
+def test_orphan_task_loop_spawns_flagged():
+    assert "orphan-task" in rules_of("""\
+        async def f():
+            loop.create_task(g())
+    """)
+    assert "orphan-task" in rules_of("""\
+        async def f():
+            asyncio.get_event_loop().create_task(g())
+    """)
+
+
+# ---- blocking-call-in-async / blocking-io-in-async ----
+
+def test_blocking_call_positive():
+    assert "blocking-call-in-async" in rules_of("""\
+        async def f():
+            time.sleep(1)
+    """)
+    assert "blocking-call-in-async" in rules_of("""\
+        async def f():
+            subprocess.run(["ls"])
+    """)
+
+
+def test_blocking_call_negative():
+    # sync function: fine
+    assert "blocking-call-in-async" not in rules_of(
+        "def f():\n    time.sleep(1)\n")
+    # asyncio.sleep awaited: fine
+    assert "blocking-call-in-async" not in rules_of(
+        "async def f():\n    await asyncio.sleep(1)\n")
+    # pushed to a worker thread (callable passed, not called): fine
+    assert "blocking-call-in-async" not in rules_of("""\
+        async def f():
+            await asyncio.to_thread(subprocess.run, ["ls"])
+    """)
+    # a nested sync def runs elsewhere (e.g. inside to_thread)
+    assert "blocking-call-in-async" not in rules_of("""\
+        async def f():
+            def work():
+                time.sleep(1)
+            await asyncio.to_thread(work)
+    """)
+
+
+def test_blocking_io_positive():
+    assert "blocking-io-in-async" in rules_of(
+        "async def f():\n    open('/x')\n")
+    assert "blocking-io-in-async" in rules_of(
+        "async def f(p):\n    p.read_text()\n")
+
+
+def test_blocking_io_negative():
+    assert "blocking-io-in-async" not in rules_of(
+        "def f():\n    open('/x')\n")
+    # an awaited .read_text is some async API, not pathlib
+    assert "blocking-io-in-async" not in rules_of(
+        "async def f(p):\n    await p.read_text()\n")
+
+
+# ---- swallowed-cancellation ----
+
+def test_swallowed_cancellation_positive():
+    assert "swallowed-cancellation" in rules_of("""\
+        async def f():
+            try:
+                await g()
+            except Exception:
+                pass
+    """)
+    assert "swallowed-cancellation" in rules_of("""\
+        async def f():
+            try:
+                await g()
+            except BaseException:
+                pass
+    """)
+
+
+def test_swallowed_cancellation_tuple_mix():
+    # CancelledError hidden inside a tuple: flagged (split the arms)
+    assert "swallowed-cancellation" in rules_of("""\
+        async def f():
+            try:
+                await g()
+            except (asyncio.CancelledError, Exception):
+                pass
+    """)
+
+
+def test_swallowed_cancellation_negative():
+    # explicit cancel arm before the generic handler
+    assert "swallowed-cancellation" not in rules_of("""\
+        async def f():
+            try:
+                await g()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+    """)
+    # handler re-raises
+    assert "swallowed-cancellation" not in rules_of("""\
+        async def f():
+            try:
+                await g()
+            except Exception as e:
+                log(e)
+                raise
+    """)
+    # no await point in the try body: cancellation cannot land there
+    assert "swallowed-cancellation" not in rules_of("""\
+        async def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    # sync function: out of scope
+    assert "swallowed-cancellation" not in rules_of("""\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+
+
+# ---- cancel-without-await ----
+
+def test_cancel_without_await_local():
+    assert "cancel-without-await" in rules_of("""\
+        async def f():
+            t = asyncio.create_task(g())
+            t.cancel()
+    """)
+    assert "cancel-without-await" not in rules_of("""\
+        async def f():
+            t = asyncio.create_task(g())
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+    """)
+    assert "cancel-without-await" not in rules_of("""\
+        async def f():
+            t = asyncio.create_task(g())
+            t.cancel()
+            await asyncio.gather(t, return_exceptions=True)
+    """)
+
+
+def test_cancel_without_await_attribute():
+    src_unreaped = """\
+        class C:
+            def start(self):
+                self._t = asyncio.create_task(g())
+            async def stop(self):
+                self._t.cancel()
+    """
+    assert "cancel-without-await" in rules_of(src_unreaped)
+    assert "cancel-without-await" not in rules_of(src_unreaped + """\
+            async def reap(self):
+                await self._t
+    """)
+
+
+def test_cancel_without_await_reap_loop():
+    # the snapshots.py shape: cancel loop + await loop over the same attr
+    assert "cancel-without-await" not in rules_of("""\
+        class C:
+            def start(self):
+                self._tasks = [asyncio.create_task(g())]
+            async def stop(self):
+                for t in self._tasks:
+                    t.cancel()
+                for t in self._tasks:
+                    try:
+                        await t
+                    except asyncio.CancelledError:
+                        pass
+    """)
+
+
+def test_cancel_without_await_tuple_swap_alias():
+    # the pg/manager shape: swap-then-cancel-then-await via a local
+    assert "cancel-without-await" not in rules_of("""\
+        class C:
+            def arm(self):
+                self._t = asyncio.create_task(g())
+            async def stop(self):
+                t, self._t = self._t, None
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+    """)
+
+
+def test_cancel_without_await_ownership_transfer():
+    # handing the old task into the replacement coroutine counts
+    assert "cancel-without-await" not in rules_of("""\
+        class C:
+            def repoint(self):
+                self._t.cancel()
+                self._t = asyncio.create_task(restart_after(self._t))
+            def arm(self):
+                self._t = asyncio.create_task(g())
+    """)
+
+
+def test_cancel_without_await_non_task_ignored():
+    # futures (create_future) are not spawns; cancelling them is fine
+    assert "cancel-without-await" not in rules_of("""\
+        async def f(loop):
+            fut = loop.create_future()
+            fut.cancel()
+    """)
+
+
+# ---- lock-discipline ----
+
+def test_lock_discipline_positive():
+    assert "lock-discipline" in rules_of("""\
+        async def f(lock):
+            await lock.acquire()
+            work()
+            lock.release()
+    """)
+
+
+def test_lock_discipline_try_finally():
+    assert "lock-discipline" not in rules_of("""\
+        async def f(lock):
+            await lock.acquire()
+            try:
+                work()
+            finally:
+                lock.release()
+    """)
+    assert "lock-discipline" not in rules_of("""\
+        async def f(lock):
+            try:
+                await lock.acquire()
+                work()
+            finally:
+                lock.release()
+    """)
+    # async with never calls .acquire() syntactically: trivially clean
+    assert "lock-discipline" not in rules_of("""\
+        async def f(lock):
+            async with lock:
+                work()
+    """)
+
+
+def test_lock_discipline_wrong_lock_released():
+    assert "lock-discipline" in rules_of("""\
+        async def f(a, b):
+            await a.acquire()
+            try:
+                work()
+            finally:
+                b.release()
+    """)
+
+
+# ---- unbounded-wait ----
+
+def test_unbounded_wait_positive():
+    assert "unbounded-wait" in rules_of("""\
+        async def f():
+            r, w = await asyncio.open_connection("h", 1)
+    """)
+    assert "unbounded-wait" in rules_of("""\
+        async def f(reader):
+            data = await reader.readexactly(16)
+    """)
+
+
+def test_unbounded_wait_wrapped():
+    assert "unbounded-wait" not in rules_of("""\
+        async def f():
+            r, w = await asyncio.wait_for(
+                asyncio.open_connection("h", 1), 5.0)
+    """)
+    assert "unbounded-wait" not in rules_of("""\
+        async def f():
+            async with asyncio.timeout(5):
+                r, w = await asyncio.open_connection("h", 1)
+    """)
+
+
+def test_unbounded_wait_allowlist():
+    cfg = Config(unbounded_allow=frozenset({"*::read_loop"}))
+    src = """\
+        async def read_loop(reader):
+            data = await reader.readexactly(16)
+    """
+    assert "unbounded-wait" in rules_of(src)
+    assert "unbounded-wait" not in rules_of(src, cfg)
+    # the allowlist is per function, not per file
+    other = """\
+        async def other(reader):
+            data = await reader.readexactly(16)
+    """
+    assert "unbounded-wait" in rules_of(other, cfg)
+
+
+def test_unbounded_wait_configurable_primitives():
+    cfg = Config(unbounded_methods=frozenset({"drain"}))
+    assert "unbounded-wait" in rules_of(
+        "async def f(w):\n    await w.drain()\n", cfg)
+
+
+# ---- suppressions ----
+
+MARK = "# mnt-lint: " + "disable="     # split so this file contains no
+                                       # live suppression comments
+
+
+def test_suppression_parse():
+    sup = parse_suppressions(
+        "a()  %sorphan-task,style\n"
+        "b()\n"
+        "c()  %sall\n" % (MARK, MARK))
+    assert sup == {1: {"orphan-task", "style"}, 3: {"all"}}
+
+
+def test_suppression_roundtrip():
+    src = "async def f():\n    asyncio.create_task(g())\n"
+    res = lint(src)
+    assert [f.rule for f in res.findings] == ["orphan-task"]
+    line = res.findings[0].line
+    lines = textwrap.dedent(src).splitlines()
+    lines[line - 1] += "  %sorphan-task" % MARK
+    res2 = check_source("\n".join(lines) + "\n", "snippet.py")
+    assert res2.findings == []
+    assert [f.rule for f in res2.suppressed] == ["orphan-task"]
+    # a suppression for a DIFFERENT rule must not silence it
+    lines[line - 1] = lines[line - 1].replace("orphan-task", "style")
+    res3 = check_source("\n".join(lines) + "\n", "snippet.py")
+    assert [f.rule for f in res3.findings] == ["orphan-task"]
+
+
+# ---- fixture files + outputs ----
+
+def test_positive_fixture_covers_every_rule():
+    n, findings, suppressed = check_paths([DATA / "positives.py"])
+    assert n == 1
+    got = {f.rule for f in findings}
+    assert got >= (NEW_RULES | PORTED_RULES) - {"syntax"}
+    assert suppressed == []
+
+
+def test_suppressed_fixture_is_clean():
+    n, findings, suppressed = check_paths([DATA / "suppressed.py"])
+    assert n == 1
+    assert findings == []
+    assert {f.rule for f in suppressed} >= {
+        "unused-import", "orphan-task", "blocking-call-in-async",
+        "blocking-io-in-async", "swallowed-cancellation",
+        "cancel-without-await", "lock-discipline", "unbounded-wait"}
+
+
+def test_fixture_dir_excluded_from_tree_walk():
+    # walking tests/ must skip tests/data (fixtures would otherwise
+    # fail the repo gate); explicit file args bypass the exclusion
+    import manatee_tpu.lint.engine as eng
+    files = list(eng.iter_files([str(DATA.parent.parent)], Config()))
+    assert not [f for f in files if "data" in f.parts]
+
+
+def test_json_output_roundtrip(capsys):
+    rc = main(["--format", "json", str(DATA / "positives.py"),
+               str(DATA / "suppressed.py")])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["files"] == 2
+    assert out["problems"] == len(out["findings"]) > 0
+    assert len(out["suppressed"]) >= 8
+    for f in out["findings"]:
+        assert set(f) == {"path", "line", "rule", "msg"}
+        assert f["rule"] in RULES
+    # human format agrees on the finding count
+    rc2 = main([str(DATA / "positives.py"), str(DATA / "suppressed.py")])
+    assert rc2 == 1
+    human = capsys.readouterr().out.strip().splitlines()
+    assert len(human) == out["problems"]
+
+
+def test_disable_flag_and_unknown_rule(capsys):
+    rc = main(["--disable", ",".join(set(RULES) - {"syntax"}),
+               str(DATA / "positives.py")])
+    assert rc == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        main(["--disable", "no-such-rule", str(DATA / "positives.py")])
+
+
+def test_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in RULES:
+        assert name in out
+
+
+# ---- config ----
+
+def test_config_from_dict_path_disable():
+    cfg = Config.from_dict({
+        "path-disable": {"tests/*": ["blocking-io-in-async"]},
+        "max-line": 120,
+    })
+    assert cfg.max_line == 120
+    assert "blocking-io-in-async" in cfg.disabled_for("tests/test_x.py")
+    assert "blocking-io-in-async" not in cfg.disabled_for(
+        "manatee_tpu/x.py")
+    src = "async def f():\n    open('/x')\n"
+    assert "blocking-io-in-async" in {
+        f.rule for f in check_source(src, "manatee_tpu/x.py", cfg).findings}
+    assert "blocking-io-in-async" not in {
+        f.rule for f in check_source(src, "tests/test_x.py", cfg).findings}
+
+
+def test_config_unknown_key_rejected():
+    with pytest.raises(ValueError):
+        Config.from_dict({"no-such-key": 1})
+
+
+def test_config_file_loader(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"disable": ["style"], "max-line": 72}))
+    cfg = Config.from_file(p)
+    assert cfg.disable == frozenset({"style"})
+    assert cfg.max_line == 72
+
+
+def test_repo_config_parses():
+    # the checked-in repo config must always load
+    repo = Path(__file__).parent.parent
+    cfg = Config.from_file(repo / ".mnt-lint.json")
+    assert "blocking-io-in-async" in cfg.disabled_for("tests/test_x.py")

@@ -55,7 +55,7 @@ def test_trace_binding_nests_and_propagates_to_tasks():
             with bind_trace(t2):
                 assert current_trace() == t2
                 # tasks snapshot the context at creation
-                task = asyncio.ensure_future(_read_trace())
+                task = asyncio.create_task(_read_trace())
             with bind_trace(t1):
                 pass
             assert await task == t2
@@ -172,7 +172,8 @@ def test_failover_is_trace_reconstructable(tmp_path):
             assert fams["manatee_state_transitions_total"]
 
             # 4. `manatee-adm events` prints the merged timeline
-            cp = subprocess.run(
+            cp = await asyncio.to_thread(
+                subprocess.run,
                 [sys.executable, "-m", "manatee_tpu.cli", "events",
                  "-j"],
                 capture_output=True, text=True, timeout=60,

@@ -319,7 +319,7 @@ def test_reconfigure_cancelable(tmp_path):
         try:
             await p.reconfigure({"role": "primary", "upstream": None,
                                  "downstream": None})
-            t = asyncio.ensure_future(s.reconfigure(
+            t = asyncio.create_task(s.reconfigure(
                 {"role": "sync", "upstream": info_for(p),
                  "downstream": None}))
             await hang.wait()

@@ -111,6 +111,8 @@ async def wait_for(pred, timeout=60.0, interval=0.25):
         try:
             if await pred():
                 return True
+        except asyncio.CancelledError:
+            raise
         except Exception:
             pass
         await asyncio.sleep(interval)

@@ -40,7 +40,8 @@ def test_rebuild_deposed_peer(tmp_path):
                        COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
                        SHARD="1")
             env.pop("MANATEE_ADM_TEST_STATE", None)
-            cp = subprocess.run(
+            cp = await asyncio.to_thread(
+                subprocess.run,
                 [sys.executable, "-m", "manatee_tpu.cli", "rebuild",
                  "-y", "-c", str(primary.root / "sitter.json"),
                  "--timeout", "60"],

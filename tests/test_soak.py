@@ -64,6 +64,8 @@ def test_random_interleavings(seed):
                 st = json.loads(data.decode())
                 mutate(st)
                 await c.set("/shard/state", json.dumps(st).encode(), v)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 pass
             await c.close()

@@ -153,7 +153,8 @@ def test_send_recv_roundtrip_over_tcp(be, tmp_path):
         server = await asyncio.start_server(handle, "127.0.0.1", 0)
         port = server.sockets[0].getsockname()[1]
 
-        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 10)
         await be.send("pg", snap.name, writer)
         writer.close()
         await writer.wait_closed()
@@ -239,7 +240,8 @@ def test_send_receiver_disconnect_raises_storage_error(be, tmp_path):
 
         server = await asyncio.start_server(handler, "127.0.0.1", 0)
         port = server.sockets[0].getsockname()[1]
-        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 10)
         with pytest.raises(StorageError):
             # generous bound: subprocess spawn latency spikes when the
             # whole suite's process churn is high

@@ -207,7 +207,8 @@ def test_send_recv_roundtrip_with_progress(tmp_path, monkeypatch,
 
         server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
         port = server.sockets[0].getsockname()[1]
-        _r, writer = await asyncio.open_connection("127.0.0.1", port)
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 10)
         ticks = []
         await be.send("src", "1700000000111", writer,
                       progress_cb=lambda done, total: ticks.append(
@@ -236,7 +237,8 @@ def test_send_missing_snapshot_fails(tmp_path):
         be = ZfsBackend(zfs_cmd=cmd)
         await be.create("src")
         server, port = await _sink_server()
-        _r, writer = await asyncio.open_connection("127.0.0.1", port)
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 10)
         try:
             with pytest.raises(StorageError):
                 await be.send("src", "9999999999999", writer)
