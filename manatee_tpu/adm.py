@@ -305,6 +305,22 @@ def history_annotation(state: dict, last: dict | None) -> str:
 # ---------------------------------------------------------------------------
 
 
+def merge_events(events: list[dict]) -> list[dict]:
+    """Merge per-peer journal/span rings into one shard timeline:
+    wall-clock timestamp first, then (peer, seq) as the tiebreak.
+
+    The tiebreak matters: two peers' clocks quantize to the same
+    millisecond constantly during a failover (the reacting peers all
+    journal within the same watch-delivery tick), and without a total
+    order the interleaving would depend on fan-out completion order —
+    two runs of `manatee-adm events` over the same rings would render
+    different timelines.  Within one peer, seq preserves the ring's
+    own causality regardless of any clock step between its records."""
+    return sorted(events, key=lambda e: (e.get("ts") or 0.0,
+                                         str(e.get("peer")),
+                                         e.get("seq") or 0))
+
+
 class AdmClient:
     """Operator-side client: talks to the coordination service and each
     peer's database directly (lib/adm.js:81-209, 2166-2227)."""
@@ -543,9 +559,14 @@ class AdmClient:
             new = mutate(json.loads(json.dumps(state)))
             # operator transitions mint trace ids like the state
             # machine's do, so freeze/promote/reap actions correlate
-            # with every peer's reaction in `manatee-adm events`
+            # with every peer's reaction in `manatee-adm events`.
+            # The copied-through SPAN id must go: it names the PREVIOUS
+            # transition's write, and peers would wrongly parent their
+            # reaction spans under it (this CLI process's own spans die
+            # with it, so there is no id worth embedding instead)
             tid = new_trace_id()
             new["trace"] = tid
+            new.pop("span", None)
             try:
                 with bind_trace(tid):
                     await self._client.multi(cluster_state_txn(
@@ -774,24 +795,12 @@ class AdmClient:
         stat = await self._client.exists(path)
         return stat is not None
 
-    # -- shard-wide event timeline --
+    # -- shard-wide event timeline / span tree --
 
-    async def shard_events(self, shard: str, *,
-                           limit: int | None = None,
-                           timeout: float = 5.0) -> dict:
-        """Fan out ``GET /events`` to every peer's status server (the
-        topology's peers plus any election member not yet adopted),
-        merge the rings by wall-clock timestamp (peer/seq as the
-        tiebreak), and return::
-
-            {"events": [...merged, oldest first...],
-             "errors": {peer_id: "why the fetch failed", ...}}
-
-        The merged list is what one grep of per-peer bunyan logs could
-        never give the reference's operators: a single trace-correlated
-        takeover timeline."""
-        import aiohttp
-
+    async def _shard_peers(self, shard: str) -> dict[str, dict]:
+        """PeerInfo by id: the durable topology's peers plus any
+        election member not yet adopted — the fan-out set for /events
+        and /spans."""
         state, _v = await self.get_state(shard)
         peers: dict[str, dict] = {}
         if state is not None:
@@ -804,46 +813,139 @@ class AdmClient:
             ent = {"id": a["id"]}
             ent.update(a.get("data") or {})
             peers.setdefault(a["id"], ent)
+        return peers
 
-        events: list[dict] = []
+    async def _fan_out(self, peers: dict[str, dict], path: str,
+                       keys: tuple[str, ...], *, timeout: float,
+                       query: str = "",
+                       include_backup: bool = False
+                       ) -> tuple[dict[str, list], dict[str, str]]:
+        """GET *path* from every peer's status server (and, when
+        *include_backup*, its backup server too), collecting the dicts
+        under each of *keys*; per-peer failures land in the errors
+        map."""
+        import aiohttp
+
+        out: dict[str, list] = {k: [] for k in keys}
         errors: dict[str, str] = {}
 
-        async def fetch(peer: dict, http) -> None:
-            try:
-                _s, host, pg_port = parse_pg_url(peer.get("pgUrl") or "")
-            except PgError:
-                errors[peer["id"]] = ("unsupported pgUrl %r"
-                                      % peer.get("pgUrl"))
-                return
-            url = "http://%s:%d/events" % (host, pg_port + 1)
-            if limit is not None:
-                url += "?limit=%d" % limit
+        async def fetch(peer: dict, url: str, err_key: str,
+                        http) -> None:
             try:
                 async with http.get(url) as resp:
                     if resp.status != 200:
-                        errors[peer["id"]] = "HTTP %d" % resp.status
+                        errors[err_key] = "HTTP %d" % resp.status
                         return
                     body = await resp.json()
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                errors[peer["id"]] = str(e) or type(e).__name__
+                errors[err_key] = str(e) or type(e).__name__
                 return
-            for ev in body.get("events") or []:
-                if not isinstance(ev, dict):
-                    continue
-                # an old sitter (or a journal predating set_peer) may
-                # report peer missing/None; the fan-out knows who it
-                # asked
-                if ev.get("peer") is None:
-                    ev["peer"] = peer["id"]
-                events.append(ev)
+            for key in keys:
+                for ent in body.get(key) or []:
+                    if not isinstance(ent, dict):
+                        continue
+                    # an old daemon (or a ring predating set_peer) may
+                    # report peer missing/None; the fan-out knows who
+                    # it asked
+                    if ent.get("peer") is None:
+                        ent["peer"] = peer["id"]
+                    out[key].append(ent)
 
+        jobs = []
         http_timeout = aiohttp.ClientTimeout(total=timeout)
         async with aiohttp.ClientSession(timeout=http_timeout) as http:
-            await asyncio.gather(*[fetch(p, http)
-                                   for p in peers.values()])
-        events.sort(key=lambda e: (e.get("ts") or 0.0,
-                                   str(e.get("peer")),
-                                   e.get("seq") or 0))
-        return {"events": events, "errors": errors}
+            for peer in peers.values():
+                try:
+                    _s, host, pg_port = parse_pg_url(
+                        peer.get("pgUrl") or "")
+                except PgError:
+                    errors[peer["id"]] = ("unsupported pgUrl %r"
+                                          % peer.get("pgUrl"))
+                    continue
+                jobs.append(fetch(
+                    peer,
+                    "http://%s:%d%s%s" % (host, pg_port + 1, path,
+                                          query),
+                    peer["id"], http))
+                if include_backup and peer.get("backupUrl"):
+                    # the backup sender's spans live in the
+                    # backupserver daemon, a separate process
+                    jobs.append(fetch(
+                        peer,
+                        peer["backupUrl"].rstrip("/") + path + query,
+                        peer["id"] + "/backup", http))
+            await asyncio.gather(*jobs)
+        return out, errors
+
+    async def shard_events(self, shard: str, *,
+                           limit: int | None = None,
+                           timeout: float = 5.0) -> dict:
+        """Fan out ``GET /events`` to every peer's status server, merge
+        the rings by wall-clock timestamp (peer/seq as the tiebreak),
+        and return::
+
+            {"events": [...merged, oldest first...],
+             "errors": {peer_id: "why the fetch failed", ...}}
+
+        The merged list is what one grep of per-peer bunyan logs could
+        never give the reference's operators: a single trace-correlated
+        takeover timeline."""
+        peers = await self._shard_peers(shard)
+        got, errors = await self._fan_out(
+            peers, "/events", ("events",), timeout=timeout,
+            query=("?limit=%d" % limit) if limit is not None else "")
+        return {"events": merge_events(got["events"]), "errors": errors}
+
+    async def shard_spans(self, shard: str, *,
+                          trace: str | None = None,
+                          limit: int | None = None,
+                          timeout: float = 5.0) -> dict:
+        """Fan out ``GET /spans`` to every peer's status server AND
+        backup server, returning ``{"spans": [...merged...],
+        "open": [...], "errors": {...}}``.  *trace* filters server-side
+        so a busy shard's rings are not shipped whole."""
+        peers = await self._shard_peers(shard)
+        q = []
+        if trace is not None:
+            q.append("trace=%s" % trace)
+        if limit is not None:
+            q.append("limit=%d" % limit)
+        got, errors = await self._fan_out(
+            peers, "/spans", ("spans", "open"), timeout=timeout,
+            query=("?" + "&".join(q)) if q else "",
+            include_backup=True)
+        opens = got["open"]
+        if trace is not None:
+            # the trace query filters completed spans server-side;
+            # open spans come back whole (they are the leak signal)
+            opens = [o for o in opens if o.get("trace") == trace]
+        return {"spans": merge_events(got["spans"]), "open": opens,
+                "errors": errors}
+
+    async def last_failover_trace(self, shard: str, *,
+                                  timeout: float = 5.0) -> str:
+        """The trace id of the most recent failover visible in the
+        shard's journals (completed if one exists, else the freshest
+        detection) — what `manatee-adm trace --last-failover`
+        resolves."""
+        out = await self.shard_events(shard, timeout=timeout)
+        best: tuple | None = None
+        for ev in out["events"]:
+            name = str(ev.get("event") or "")
+            if name not in ("failover.complete", "failover.detected"):
+                continue
+            tid = ev.get("trace")
+            if not tid:
+                continue
+            rank = (1 if name == "failover.complete" else 0,
+                    ev.get("ts") or 0.0)
+            if best is None or rank > best[0]:
+                best = (rank, tid)
+        if best is None:
+            raise AdmError(
+                "no failover found in any peer's journal window "
+                "(rings are in-memory; a restarted peer's history "
+                "died with it)")
+        return best[1]

@@ -26,7 +26,12 @@ import logging
 
 import aiohttp
 
-from manatee_tpu.obs import get_journal
+from manatee_tpu.obs import (
+    current_span_id,
+    current_trace,
+    get_journal,
+    span,
+)
 from manatee_tpu.storage.base import StorageBackend
 
 log = logging.getLogger("manatee.backup.client")
@@ -84,7 +89,11 @@ class RestoreClient:
         journal.record("restore.receive.start", url=backup_url,
                        dataset=self.dataset)
         try:
-            await self._receive(backup_url)
+            # one span for the whole snapshot transfer; its id rides
+            # the POST so the sender's backup.send parents under it
+            with span("restore.receive", url=backup_url,
+                      dataset=self.dataset):
+                await self._receive(backup_url)
         except Exception as e:
             # the failed partial was cleaned by storage.recv; the
             # isolated dataset is left for operator recovery, as the
@@ -181,7 +190,11 @@ class RestoreClient:
                 async with http.post(
                         backup_url.rstrip("/") + "/backup",
                         json={"host": self.listen_host, "port": port,
-                              "dataset": self.dataset},
+                              "dataset": self.dataset,
+                              # observability identity: the sender's
+                              # span parents under our receive span
+                              "trace": current_trace(),
+                              "span": current_span_id()},
                         timeout=aiohttp.ClientTimeout(total=30)) as resp:
                     if resp.status != 201:
                         raise RestoreError(

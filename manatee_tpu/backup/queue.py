@@ -24,6 +24,11 @@ class BackupJob:
     error: str | None = None
     size: int | None = None
     completed: int = 0
+    # observability identity carried from the requester's POST: the
+    # sender's backup.send span binds both, so the stream shows up in
+    # the requester's restore tree despite living in another process
+    trace: str | None = None
+    span: str | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -35,6 +40,7 @@ class BackupJob:
             "error": self.error,
             "size": self.size,
             "completed": self.completed,
+            "trace": self.trace,
         }
 
 

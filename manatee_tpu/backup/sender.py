@@ -14,6 +14,7 @@ import asyncio
 import logging
 
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
+from manatee_tpu.obs import bind_parent, bind_trace, span
 from manatee_tpu.storage.base import StorageBackend, StorageError
 
 log = logging.getLogger("manatee.backup.sender")
@@ -56,33 +57,40 @@ class BackupSender:
                 job.error = str(e)
 
     async def _send(self, job: BackupJob) -> None:
-        snap = await self.storage.latest_backup_snapshot(self.dataset)
-        if snap is None:
-            raise StorageError("no snapshots of %s eligible for backup"
-                               % self.dataset)
-        log.info("sending %s to %s:%d for job %s", snap.full, job.host,
-                 job.port, job.uuid)
-        # bounded connect: a requester that vanished between the POST
-        # and our dial must fail the job, not wedge the send loop
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(job.host, job.port), CONNECT_TIMEOUT)
+        # the job carries the requester's trace/span ids (POST /backup):
+        # this process's send span parents into the requester's restore
+        # tree even though it lives in the backupserver daemon
+        with bind_trace(job.trace), bind_parent(job.span), \
+                span("backup.send", job=job.uuid, dataset=self.dataset):
+            snap = await self.storage.latest_backup_snapshot(self.dataset)
+            if snap is None:
+                raise StorageError("no snapshots of %s eligible for "
+                                   "backup" % self.dataset)
+            log.info("sending %s to %s:%d for job %s", snap.full,
+                     job.host, job.port, job.uuid)
+            # bounded connect: a requester that vanished between the
+            # POST and our dial must fail the job, not wedge the send
+            # loop
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(job.host, job.port),
+                CONNECT_TIMEOUT)
 
-        def progress(done: int, total: int | None) -> None:
-            job.completed = done
-            if total is not None:
-                job.size = total
+            def progress(done: int, total: int | None) -> None:
+                job.completed = done
+                if total is not None:
+                    job.size = total
 
-        try:
-            await self.storage.send(self.dataset, snap.name, writer,
-                                    progress_cb=progress)
-            writer.close()
             try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
-        except StorageError:
-            writer.close()
-            raise
-        job.done = True
-        log.info("completed backup job %s (%d bytes)", job.uuid,
-                 job.completed)
+                await self.storage.send(self.dataset, snap.name, writer,
+                                        progress_cb=progress)
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+            except StorageError:
+                writer.close()
+                raise
+            job.done = True
+            log.info("completed backup job %s (%d bytes)", job.uuid,
+                     job.completed)

@@ -3,15 +3,23 @@
 Reference parity: lib/backupServer.js — ``POST /backup`` with
 {host, port, dataset} enqueues a job and returns 201 with the job path
 (:132-155); ``GET /backup/:uuid`` reports status/progress (:108-130).
+
+Beyond parity: the POST may carry the requester's ``trace``/``span``
+ids, which ride the job into the sender so the snapshot stream's span
+parents into the requester's restore tree; ``GET /spans`` serves this
+process's span ring for the `manatee-adm trace` fan-out.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from aiohttp import web
 
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
+from manatee_tpu.obs import get_span_store
+from manatee_tpu.obs.spans import spans_http_reply
 
 log = logging.getLogger("manatee.backup.server")
 
@@ -26,6 +34,7 @@ class BackupRestServer:
         app = web.Application()
         app.router.add_post("/backup", self._post_backup)
         app.router.add_get("/backup/{uuid}", self._get_backup)
+        app.router.add_get("/spans", self._spans)
         self._app = app
 
     async def start(self) -> None:
@@ -53,9 +62,14 @@ class BackupRestServer:
             return web.json_response(
                 {"error": "host, dataset, and port parameters required"},
                 status=409)
+        trace = params.get("trace")
+        span_id = params.get("span")
         job = BackupJob(host=str(params["host"]),
                         port=int(params["port"]),
-                        dataset=str(params["dataset"]))
+                        dataset=str(params["dataset"]),
+                        trace=trace if isinstance(trace, str) else None,
+                        span=span_id if isinstance(span_id, str)
+                        else None)
         self.queue.push(job)
         log.info("enqueued backup job %s -> %s:%d", job.uuid, job.host,
                  job.port)
@@ -68,3 +82,11 @@ class BackupRestServer:
         if job is None:
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(job.to_dict())
+
+    async def _spans(self, req: web.Request) -> web.Response:
+        """This process's completed spans (the backup sender's
+        ``backup.send`` lives here, not in the sitter) — same contract
+        as the status server's ``GET /spans``."""
+        body, status = spans_http_reply(get_span_store(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")

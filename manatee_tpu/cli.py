@@ -583,6 +583,90 @@ def cmd_events(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_trace(args) -> int:
+    """Cross-peer span tree for one trace id (the failover
+    post-mortem tool): fans out GET /spans across every peer's status
+    AND backup servers, reassembles the tree, renders an ASCII
+    waterfall, and computes the critical path — the chain of spans
+    that actually bounds wall-clock time, with per-stage self times
+    and percentages.  --last-failover resolves the most recent
+    failover's trace id from the merged journals first."""
+    from manatee_tpu.obs.spans import (
+        assemble_tree,
+        critical_path,
+        render_waterfall,
+    )
+
+    if bool(args.trace_id) == bool(args.last_failover):
+        die("provide a trace id or --last-failover (not both)")
+
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            if args.last_failover:
+                tid = await adm.last_failover_trace(_shard(args))
+            else:
+                tid = args.trace_id
+            out = await adm.shard_spans(_shard(args), trace=tid,
+                                        limit=args.limit)
+        spans = out["spans"]
+        roots, children, orphans = assemble_tree(spans)
+        # the critical path is computed over the tree's MAIN root: the
+        # longest-running GENUINE root (parent None — for a failover
+        # trace that is the `failover` span whose window IS the SLI
+        # sample).  Orphans are roots only for rendering; a long
+        # orphaned restore from a peer whose ring died must not
+        # displace the failover root.  All-orphan forests (the whole
+        # initiating peer's ring was lost) fall back to the longest.
+        orphan_ids = {o["span"] for o in orphans}
+        genuine = [r for r in roots if r["span"] not in orphan_ids]
+        pool = genuine or roots
+        main = max(pool, key=lambda r: float(r.get("dur") or 0.0)) \
+            if pool else None
+        cp = critical_path(main, children) if main else None
+
+        if args.json:
+            print(json.dumps({
+                "trace": tid,
+                "spans": spans,
+                "roots": [r["span"] for r in roots],
+                "orphans": [o["span"] for o in orphans],
+                "open": out["open"],
+                "critical_path": cp,
+            }, indent=2))
+        else:
+            peers = {s.get("peer") for s in spans}
+            print("TRACE %s: %d spans across %d peer%s"
+                  % (tid, len(spans), len(peers),
+                     "" if len(peers) == 1 else "s"))
+            if spans:
+                print("")
+                for line in render_waterfall(roots, children):
+                    print(line)
+            if cp and cp["stages"]:
+                print("")
+                print("critical path (%.3fs total):" % cp["total_s"])
+                print("%9s %9s %6s  %-24s %s"
+                      % ("START", "SELF", "PCT", "SPAN", "PEER"))
+                for st in cp["stages"]:
+                    print("%+8.3fs %8.3fs %5.1f%%  %-24s %s"
+                          % (st["start_s"], st["self_s"], st["pct"],
+                             st["name"], st.get("peer") or "-"))
+        for key, err in sorted(out["errors"].items()):
+            sys.stderr.write("warning: no spans from %s: %s\n"
+                             % (key, err))
+        for o in orphans:
+            sys.stderr.write("warning: span %s (%s) has an unresolved "
+                             "parent %s (its recorder's ring may have "
+                             "died); shown as a root\n"
+                             % (o["span"], o["name"], o.get("parent")))
+        for o in out["open"]:
+            sys.stderr.write("warning: span %s (%s@%s) is still open\n"
+                             % (o.get("span"), o.get("name"),
+                                o.get("peer")))
+        return 0 if spans else 1
+    return asyncio.run(go())
+
+
 def cmd_rebuild(args) -> int:
     """Guarded rebuild flow (lib/adm.js:1319-1684): refuse on the
     primary; deposed peers get their dataset destroyed and their deposed
@@ -815,6 +899,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="newest N events per peer")
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")
+
+    sp = add("trace", cmd_trace,
+             "cross-peer span tree + critical path for one trace")
+    sp.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (16 hex chars) to reconstruct")
+    sp.add_argument("--last-failover", action="store_true",
+                    dest="last_failover",
+                    help="resolve the most recent failover's trace id "
+                         "from the merged journals")
+    sp.add_argument("-j", "--json", action="store_true",
+                    help="machine-readable spans + critical path")
+    sp.add_argument("-n", "--limit", type=int, default=None,
+                    help="newest N spans per peer")
 
     sp = add("history", cmd_history, "annotated cluster state history")
     sp.add_argument("-j", "--json", action="store_true")

@@ -39,7 +39,13 @@ from manatee_tpu.coord.api import (
     WatchCb,
     WatchEvent,
 )
-from manatee_tpu.obs import current_trace, get_journal, get_registry
+from manatee_tpu.obs import (
+    current_span_id,
+    current_trace,
+    get_journal,
+    get_registry,
+    get_span_store,
+)
 
 log = logging.getLogger("manatee.coord.client")
 
@@ -424,23 +430,46 @@ class NetCoord(CoordClient):
             raise ConnectionLossError("not connected")
         xid = next(self._xids)
         req["xid"] = xid
-        # trace propagation: the server binds this id for its own
-        # logging, so one grep follows a transition into coordd
+        op = str(req.get("op", "?"))
+        # trace/span propagation: the server binds both for its own
+        # logging and spans, so one trace follows a transition into
+        # coordd and the server-side handling nests under our span
         tid = current_trace()
         if tid is not None and "trace" not in req:
             req["trace"] = tid
+        sid = current_span_id()
+        if sid is not None and "span" not in req:
+            req["span"] = sid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
         t0 = time.monotonic()
+        t0_wall = time.time()
         try:
-            self._writer.write((json.dumps(req) + "\n").encode())
-            await self._writer.drain()
-        except (ConnectionError, RuntimeError) as e:
-            self._pending.pop(xid, None)
-            raise ConnectionLossError(str(e)) from None
-        msg = await fut
-        _RPC_DUR.observe(time.monotonic() - t0,
-                         op=str(req.get("op", "?")))
+            try:
+                self._writer.write((json.dumps(req) + "\n").encode())
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError) as e:
+                self._pending.pop(xid, None)
+                raise ConnectionLossError(str(e)) from None
+            msg = await fut
+        except BaseException as e:
+            if op != "ping":
+                get_span_store().record(
+                    "coord.rpc", ts=t0_wall,
+                    dur=time.monotonic() - t0,
+                    status=("cancelled"
+                            if isinstance(e, asyncio.CancelledError)
+                            else "error"),
+                    op=op, error=type(e).__name__)
+            raise
+        dur = time.monotonic() - t0
+        _RPC_DUR.observe(dur, op=op)
+        # pings are heartbeat noise; everything else is a stage worth
+        # attributing in the cross-peer waterfall
+        if op != "ping":
+            get_span_store().record(
+                "coord.rpc", ts=t0_wall, dur=dur,
+                status="ok" if msg.get("ok") else "error", op=op)
         if msg.get("ok"):
             return msg.get("result")
         raise _ERRS.get(msg.get("error"), CoordError)(msg.get("msg", ""))

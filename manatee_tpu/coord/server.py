@@ -102,8 +102,9 @@ from manatee_tpu.coord.api import (
     NotLeaderError,
     Op,
 )
-from manatee_tpu.obs import bind_trace
+from manatee_tpu.obs import bind_parent, bind_trace, get_span_store
 from manatee_tpu.obs.metrics import Histogram
+from manatee_tpu.obs.spans import spans_http_reply
 from manatee_tpu.utils.logutil import setup_logging
 
 log = logging.getLogger("manatee.coordd")
@@ -849,6 +850,11 @@ class CoordServer:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, limit=MAX_LINE)
         self.port = self._server.sockets[0].getsockname()[1]
+        store = get_span_store()
+        if store.peer is None:
+            # identify this member's dispatch spans in a fetched tree;
+            # never clobber an identity set by an embedding process
+            store.peer = "coordd:%s:%d" % (self.host, self.port)
         self._expiry_task = asyncio.create_task(self._expiry_loop())
         if self.ensemble:
             self._follow_task = asyncio.create_task(self._follow_loop())
@@ -898,8 +904,15 @@ class CoordServer:
             return web.Response(text=self._render_metrics(),
                                 content_type="text/plain")
 
+        async def spans(req):
+            body, status = spans_http_reply(get_span_store(),
+                                            req.query)
+            return web.json_response(body, status=status,
+                                     content_type="application/json")
+
         app = web.Application()
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/spans", spans)
         self._metrics_runner = web.AppRunner(app)
         await self._metrics_runner.setup()
         site = web.TCPSite(self._metrics_runner, self.host,
@@ -1007,21 +1020,40 @@ class CoordServer:
                     continue
                 conn.in_dispatch = True
                 tid = req.get("trace")
+                sid = req.get("span")
                 t0 = time.monotonic()
+                t0_wall = time.time()
                 try:
-                    # bind the client's trace id so every log line this
-                    # request produces correlates with the transition
-                    # that caused it (the sitter's state write)
+                    # bind the client's trace AND span ids so every log
+                    # line this request produces correlates with the
+                    # transition that caused it (the sitter's state
+                    # write), and the server-side handling span parents
+                    # under the CALLER's span (a sibling of the
+                    # client-side coord.rpc record, whose id is minted
+                    # post-hoc and never on the wire)
                     with bind_trace(tid if isinstance(tid, str)
-                                    else None):
+                                    else None), \
+                            bind_parent(sid if isinstance(sid, str)
+                                        else None):
                         await self._dispatch(conn, req)
                 finally:
                     conn.in_dispatch = False
                     op = req.get("op")
-                    _RPC_HANDLE.observe(
-                        time.monotonic() - t0,
-                        op=(op if isinstance(op, str)
-                            and op in _KNOWN_OPS else "other"))
+                    known = (op if isinstance(op, str)
+                             and op in _KNOWN_OPS else "other")
+                    dur = time.monotonic() - t0
+                    _RPC_HANDLE.observe(dur, op=known)
+                    if known not in ("ping", "other") \
+                            and isinstance(sid, str):
+                        # only traced, span-carrying requests (the
+                        # sitters' state writes and reads): heartbeats
+                        # and anonymous probes are waterfall noise
+                        get_span_store().record(
+                            "coordd.handle", ts=t0_wall, dur=dur,
+                            op=known,
+                            trace_id=tid if isinstance(tid, str)
+                            else None,
+                            parent_id=sid)
                 try:
                     await writer.drain()
                 except (ConnectionError, RuntimeError):

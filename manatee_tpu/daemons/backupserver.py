@@ -10,15 +10,20 @@ import logging
 
 from manatee_tpu.backup import BackupQueue, BackupRestServer, BackupSender
 from manatee_tpu.daemons.common import daemon_main
-from manatee_tpu.shard import build_storage
+from manatee_tpu.obs import set_peer
+from manatee_tpu.shard import build_ident, build_storage
 
 log = logging.getLogger("manatee.backupserver")
 
 SCHEMA = {
     "type": "object",
-    "required": ["ip", "backupPort", "dataset"],
+    # postgresPort is part of the peer's identity (ip:pgPort:backupPort
+    # — build_ident), which this daemon stamps on its spans; configgen
+    # has always copied it into backupserver.json from the sitter's
+    "required": ["ip", "postgresPort", "backupPort", "dataset"],
     "properties": {
         "ip": {"type": "string"},
+        "postgresPort": {"type": "integer"},
         "backupPort": {"type": "integer"},
         "dataset": {"type": "string"},
     },
@@ -26,6 +31,10 @@ SCHEMA = {
 
 
 async def start_backupserver(cfg: dict):
+    # the sitter's EXACT id (ip:pgPort:backupPort via the same
+    # build_ident), so this process's backup.send spans merge under
+    # the peer's identity in the `manatee-adm trace` fan-out
+    set_peer(build_ident(cfg)["id"])
     storage = build_storage(cfg)
     queue = BackupQueue()
     server = BackupRestServer(queue,

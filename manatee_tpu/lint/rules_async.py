@@ -515,3 +515,39 @@ def unbounded_wait(ctx: FileContext):
             "await %s(...) with no timeout can hang on a wedged peer: "
             "wrap in asyncio.wait_for(...) or add the call site to the "
             "unbounded-allow list" % hit)
+
+
+# --------------------------------------------------------- span-not-closed
+
+@rule("span-not-closed", "obs span() entered without with/async with")
+def span_not_closed(ctx: FileContext):
+    """``obs.span(...)`` is a context manager: calling it without
+    entering it via ``with`` records nothing (the span never starts),
+    and binding the generator for a manual ``__enter__`` leaks an
+    open span — the ring never sees it and every child misparents.
+    Callback-split lifecycles (the failover clock) must use the
+    explicit ``SpanStore.start()``/``Span.end()`` API instead, which
+    this rule deliberately ignores."""
+    parents = ctx.parents
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None or name.rsplit(".", 1)[-1] != "span":
+            continue
+        # only the obs API: a bare `span` name, or a dotted path whose
+        # receiver is the obs/spans module (`obs.span`, `spans.span`,
+        # `manatee_tpu.obs.span`) — `tracer.span()` from some other
+        # library is not ours to police
+        if "." in name:
+            recv = name.rsplit(".", 2)[-2]
+            if recv not in ("obs", "spans"):
+                continue
+        if isinstance(parents.get(node), ast.withitem):
+            continue
+        yield ctx.finding(
+            node.lineno, "span-not-closed",
+            "span(...) must be entered with `with`/`async with`: a "
+            "span that is never closed records nothing and misparents "
+            "its children (use SpanStore.start()/Span.end() for "
+            "callback-split lifecycles)")

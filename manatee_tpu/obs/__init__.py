@@ -1,8 +1,9 @@
-"""Shard-wide observability: metrics registry, trace ids, event journal.
+"""Shard-wide observability: metrics registry, trace ids, event
+journal, spans.
 
 The reference manatee has none of this — its operators reconstruct a
 failover by grepping per-peer bunyan logs (PAPER.md §0).  This package
-gives every component in the peer three shared primitives:
+gives every component in the peer four shared primitives:
 
 - a process-wide metrics **registry** (`get_registry()`): counters,
   gauges, and monotonic-clock latency histograms with fixed buckets,
@@ -16,13 +17,18 @@ gives every component in the peer three shared primitives:
 - an in-memory ring-buffer event **journal** (`get_journal()`):
   transition begun/committed, role changes, coord session events,
   probe state flips, restore start/finish — exposed as ``GET /events``
-  per peer and merged shard-wide by ``manatee-adm events``.
+  per peer and merged shard-wide by ``manatee-adm events``;
+- structured **spans** (`span()` / `get_span_store()`): per-stage
+  timing with parent links that cross RPC frames and the cluster-state
+  object, served at ``GET /spans`` and reassembled into one cross-peer
+  tree (waterfall + critical path) by ``manatee-adm trace``.
 
 Everything here is stdlib-only and allocation-light: observability must
 never be able to hurt HA.
 """
 
-from manatee_tpu.obs.journal import EventJournal, get_journal, set_peer
+from manatee_tpu.obs.journal import EventJournal, get_journal
+from manatee_tpu.obs.journal import set_peer as _set_journal_peer
 from manatee_tpu.obs.metrics import (
     DEFAULT_BUCKETS,
     Counter,
@@ -30,6 +36,18 @@ from manatee_tpu.obs.metrics import (
     Histogram,
     Registry,
     get_registry,
+)
+from manatee_tpu.obs.spans import (
+    Span,
+    SpanStore,
+    bind_parent,
+    current_span_id,
+    get_span_store,
+    new_span_id,
+    record_span,
+    set_span_peer,
+    span,
+    traced,
 )
 from manatee_tpu.obs.trace import (
     TraceLogFilter,
@@ -39,6 +57,14 @@ from manatee_tpu.obs.trace import (
     new_trace_id,
 )
 
+
+def set_peer(peer_id: str) -> None:
+    """Stamp this process's peer identity onto subsequent journal
+    events AND spans (called once at daemon wiring time)."""
+    _set_journal_peer(peer_id)
+    set_span_peer(peer_id)
+
+
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
@@ -46,12 +72,22 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Registry",
+    "Span",
+    "SpanStore",
     "TraceLogFilter",
+    "bind_parent",
     "bind_trace",
+    "current_span_id",
     "current_trace",
     "ensure_trace",
     "get_journal",
     "get_registry",
+    "get_span_store",
+    "new_span_id",
     "new_trace_id",
+    "record_span",
     "set_peer",
+    "set_span_peer",
+    "span",
+    "traced",
 ]

@@ -73,7 +73,9 @@ class EventJournal:
         """Events with seq > *since*, oldest first, newest *limit*."""
         out = [e for e in self._buf if e["seq"] > since]
         if limit is not None and limit >= 0:
-            out = out[-limit:]
+            # NOT out[-limit:]: -0 slices the whole list, so limit=0
+            # would return everything instead of nothing
+            out = out[-limit:] if limit else []
         return out
 
     def __len__(self) -> int:

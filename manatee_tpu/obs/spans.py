@@ -1,0 +1,487 @@
+"""Structured spans: per-stage timing attribution across peers.
+
+PR 1's flat trace ids answer *which* log lines belong to a failover;
+spans answer the operator's real question — *where did the time go*.
+A span is one timed stage (name, trace id, span id, parent id, peer,
+wall-clock start, monotonic duration, free-form attrs, status) and the
+parent links compose into a tree that crosses process and peer
+boundaries:
+
+- in-process, the current span id lives in a :mod:`contextvars` var, so
+  a span opened inside another nests under it without plumbing — and
+  ``asyncio.create_task`` snapshots the context, so background work
+  (a pg reconfigure task, the catchup watcher) parents correctly;
+- across the coord wire, RPC frames carry ``span`` next to ``trace``
+  and coordd binds it while dispatching, so the server-side handling
+  nests under the client's span;
+- across peers, the written cluster-state object carries the
+  transition span's id (``span`` key, next to ``trace``): every peer
+  reacting to the watch binds it as the foreign parent, so the
+  reconfigure/restore spans a takeover causes on *other* peers hang
+  off the initiator's transition span — that is what makes
+  ``manatee-adm trace`` a single rooted cross-peer tree.
+
+Completed spans land in a per-process ring (:class:`SpanStore`,
+``GET /spans``); spans still running are tracked separately so a leak
+is observable (``open`` in the endpoint payload, and the chaos suite
+asserts a finished failover leaves none behind).
+
+The analysis half of this module (:func:`assemble_tree`,
+:func:`critical_path`, :func:`render_waterfall`) is pure functions over
+fetched span records, shared by ``manatee-adm trace`` and the tests:
+the critical path walks backward from the root's end, descending into
+the child whose completion bounds each moment, and partitions the
+root's wall-clock window into per-stage self-time segments — the
+chain that actually bounds failover time, with percentages.
+
+Everything here is stdlib-only and allocation-light: observability must
+never be able to hurt HA.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import contextvars
+import functools
+import time
+import uuid
+from collections import deque
+
+from manatee_tpu.obs.journal import _iso_ms
+from manatee_tpu.obs.trace import bind_trace, current_trace
+
+DEFAULT_CAPACITY = 4096
+
+# span record keys detail attrs may not shadow
+_RESERVED = frozenset(("seq", "span", "parent", "trace", "name", "peer",
+                       "ts", "time", "dur", "status"))
+
+_current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "manatee_span_id", default=None)
+
+
+def new_span_id() -> str:
+    """16 hex chars, same shape as trace ids."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_span_id() -> str | None:
+    return _current_span.get()
+
+
+@contextlib.contextmanager
+def bind_parent(span_id: str | None):
+    """Adopt *span_id* — typically a FOREIGN id read off an RPC frame or
+    the cluster-state object — as the current parent for the block, so
+    locally-opened spans nest under work that started on another peer.
+    None = leave the current binding untouched (optional passthrough,
+    like :func:`bind_trace`)."""
+    if span_id is None:
+        yield _current_span.get()
+        return
+    token = _current_span.set(span_id)
+    try:
+        yield span_id
+    finally:
+        _current_span.reset(token)
+
+
+class Span:
+    """One in-flight span.  Created by :meth:`SpanStore.start`; call
+    :meth:`end` exactly once (the :func:`span` context manager does
+    both, and is the API everything but callback-split lifecycles —
+    the failover clock — should use)."""
+
+    __slots__ = ("name", "trace", "span_id", "parent_id", "ts", "_t0",
+                 "attrs", "_store", "_done")
+
+    def __init__(self, store: "SpanStore", name: str, *,
+                 trace_id: str | None, parent_id: str | None,
+                 attrs: dict):
+        self._store = store
+        self.name = name
+        self.trace = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.ts = round(time.time(), 3)
+        self._t0 = time.monotonic()
+        self.attrs = attrs
+        self._done = False
+
+    def end(self, status: str = "ok", **attrs) -> dict | None:
+        """Finish the span (idempotent) and commit it to the store."""
+        return self._store.finish(self, status=status, **attrs)
+
+
+class SpanStore:
+    """Fixed-size ring of COMPLETED spans plus an open-span registry
+    (observability must never grow without bound inside an HA daemon;
+    an unfinished span is a bug the registry makes visible)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._buf: deque[dict] = deque(maxlen=capacity)
+        self._seq = 0
+        self.peer: str | None = None
+        self._open: dict[str, Span] = {}
+
+    def start(self, name: str, *, trace_id: str | None = None,
+              parent_id: str | None = None, root: bool = False,
+              **attrs) -> Span:
+        """Open a span.  *trace_id* defaults to the bound trace,
+        *parent_id* to the bound (possibly foreign) span; *root* forces
+        parent None — the top of a new tree (the failover clock)."""
+        if parent_id is None and not root:
+            parent_id = _current_span.get()
+        sp = Span(self, name,
+                  trace_id=(trace_id if trace_id is not None
+                            else current_trace()),
+                  parent_id=None if root else parent_id,
+                  attrs=attrs)
+        self._open[sp.span_id] = sp
+        return sp
+
+    def finish(self, sp: Span, *, status: str = "ok",
+               **attrs) -> dict | None:
+        if sp._done:
+            return None
+        sp._done = True
+        self._open.pop(sp.span_id, None)
+        dur = time.monotonic() - sp._t0
+        merged = dict(sp.attrs)
+        merged.update(attrs)
+        return self._commit(sp.name, trace=sp.trace, span_id=sp.span_id,
+                            parent_id=sp.parent_id, ts=sp.ts, dur=dur,
+                            status=status, attrs=merged)
+
+    def record(self, name: str, *, ts: float, dur: float,
+               status: str = "ok", trace_id: str | None = None,
+               parent_id: str | None = None, **attrs) -> dict:
+        """Commit an already-measured span post-hoc (no open-span
+        bookkeeping).  The hot probe loop uses this so a span is only
+        materialized for the ticks worth keeping (failures and verdict
+        flips), not every healthy heartbeat."""
+        return self._commit(
+            name,
+            trace=trace_id if trace_id is not None else current_trace(),
+            span_id=new_span_id(),
+            parent_id=(parent_id if parent_id is not None
+                       else _current_span.get()),
+            ts=round(ts, 3), dur=dur, status=status, attrs=attrs)
+
+    def _commit(self, name: str, *, trace, span_id, parent_id, ts, dur,
+                status, attrs) -> dict:
+        self._seq += 1
+        rec = {
+            "seq": self._seq,
+            "span": span_id,
+            "parent": parent_id,
+            "trace": trace,
+            "name": name,
+            "peer": self.peer,
+            "ts": ts,
+            "time": _iso_ms(ts),
+            "dur": round(dur, 6),
+            "status": status,
+        }
+        for k, v in attrs.items():
+            if k not in _RESERVED:
+                rec[k] = v
+        self._buf.append(rec)
+        return rec
+
+    def spans(self, *, since: int = 0, limit: int | None = None,
+              trace: str | None = None) -> list[dict]:
+        """Completed spans with seq > *since*, oldest first, newest
+        *limit* — the same pagination contract as the event journal."""
+        out = [s for s in self._buf if s["seq"] > since
+               and (trace is None or s["trace"] == trace)]
+        if limit is not None and limit >= 0:
+            # NOT out[-limit:]: -0 slices the whole list, so limit=0
+            # would return everything instead of nothing
+            out = out[-limit:] if limit else []
+        return out
+
+    def open_spans(self) -> list[dict]:
+        """The spans currently in flight (leak visibility; served in
+        the ``GET /spans`` payload and asserted empty-for-a-trace by
+        the chaos suite)."""
+        return [{"span": sp.span_id, "name": sp.name, "trace": sp.trace,
+                 "parent": sp.parent_id, "ts": sp.ts}
+                for sp in self._open.values()]
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+_STORE = SpanStore()
+
+
+def get_span_store() -> SpanStore:
+    """The process-wide span store every component records into."""
+    return _STORE
+
+
+def set_span_peer(peer_id: str) -> None:
+    _STORE.peer = peer_id
+
+
+@contextlib.contextmanager
+def span(name: str, *, trace_id: str | None = None, root: bool = False,
+         **attrs):
+    """THE span API: times the block, nests under the current (possibly
+    foreign) span, and binds itself as the parent for anything opened —
+    or spawned via ``create_task`` — inside.  *trace_id* additionally
+    binds the trace for the block (None = inherit).  Status is derived
+    from how the block exits: ok / cancelled / error."""
+    store = _STORE
+    with bind_trace(trace_id):
+        sp = store.start(name, root=root, **attrs)
+        token = _current_span.set(sp.span_id)
+        try:
+            yield sp
+        except asyncio.CancelledError:
+            sp.end(status="cancelled")
+            raise
+        except BaseException as e:
+            sp.end(status="error", error=type(e).__name__)
+            raise
+        finally:
+            _current_span.reset(token)
+            sp.end()        # idempotent: no-op on the error paths above
+
+
+def record_span(name: str, *, ts: float, dur: float, status: str = "ok",
+                **attrs) -> dict:
+    """Module-level convenience for :meth:`SpanStore.record`."""
+    return _STORE.record(name, ts=ts, dur=dur, status=status, **attrs)
+
+
+def traced(name: str | None = None, **attrs):
+    """Decorator form of :func:`span` for whole functions (sync or
+    async)."""
+    def deco(fn):
+        label = name or fn.__qualname__
+        if _is_coroutine_fn(fn):
+            @functools.wraps(fn)
+            async def aw(*a, **kw):
+                with span(label, **attrs):
+                    return await fn(*a, **kw)
+            return aw
+
+        @functools.wraps(fn)
+        def w(*a, **kw):
+            with span(label, **attrs):
+                return fn(*a, **kw)
+        return w
+    return deco
+
+
+def _is_coroutine_fn(fn) -> bool:
+    import inspect
+    return inspect.iscoroutinefunction(fn)
+
+
+def parse_page_query(query) -> tuple[int, int | None]:
+    """The shared ``?since=SEQ&limit=N`` parse for every /events and
+    /spans endpoint (*query* is any mapping, e.g. an aiohttp request's
+    ``.query``).  Raises ValueError on non-integer values — each server
+    maps that to its 400 reply.  One definition so the endpoints'
+    pagination contract cannot drift across the three servers that
+    expose it."""
+    since = int(query.get("since", 0))
+    limit = int(query["limit"]) if "limit" in query else None
+    return since, limit
+
+
+def spans_payload(store: SpanStore, *, since: int = 0,
+                  limit: int | None = None,
+                  trace: str | None = None) -> dict:
+    """The ``GET /spans`` body — shared by the status server, the
+    backup REST server, and coordd so the endpoints cannot drift."""
+    return {
+        "peer": store.peer,
+        "now": round(time.time(), 3),
+        "open": store.open_spans(),
+        "spans": store.spans(since=since, limit=limit, trace=trace),
+    }
+
+
+def spans_http_reply(store: SpanStore, query) -> tuple[dict, int]:
+    """The WHOLE ``GET /spans`` endpoint minus the web framework:
+    (json body, HTTP status) for a request's query mapping.  The three
+    servers that expose the endpoint (status, backup REST, coordd
+    metrics) each wrap this in one json_response call, so the contract
+    lives in exactly one place."""
+    try:
+        since, limit = parse_page_query(query)
+    except ValueError:
+        return {"error": "since/limit must be integers"}, 400
+    return spans_payload(store, since=since, limit=limit,
+                         trace=query.get("trace")), 200
+
+
+# ---------------------------------------------------------------------------
+# analysis: tree assembly, critical path, waterfall — pure functions over
+# fetched span records, shared by `manatee-adm trace` and the tests
+# ---------------------------------------------------------------------------
+
+_EPS = 1e-9
+
+
+def _end(rec: dict) -> float:
+    return rec["ts"] + float(rec.get("dur") or 0.0)
+
+
+def assemble_tree(spans: list[dict]
+                  ) -> tuple[list[dict], dict[str, list[dict]],
+                             list[dict]]:
+    """(roots, children-by-span-id, orphans) for a fan-out's merged
+    span records.  Duplicates (a peer fetched twice) are dropped by
+    span id.  An *orphan* — parent id set but not present in the fetch
+    (e.g. a span recorded by a process whose ring died with it) — is
+    surfaced separately AND treated as a root so the waterfall still
+    renders everything."""
+    by_id: dict[str, dict] = {}
+    for s in spans:
+        sid = s.get("span")
+        if sid and sid not in by_id:
+            by_id[sid] = s
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    orphans: list[dict] = []
+    for s in by_id.values():
+        parent = s.get("parent")
+        if parent is None:
+            roots.append(s)
+        elif parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            orphans.append(s)
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda k: (k["ts"], str(k.get("peer")),
+                                 k.get("seq") or 0))
+    roots.sort(key=lambda k: (k["ts"], str(k.get("peer"))))
+    return roots, children, orphans
+
+
+def critical_path(root: dict, children: dict[str, list[dict]]) -> dict:
+    """The chain of spans that bounds the root's wall-clock window.
+
+    Walks backward from the window's end: at every moment, descend into
+    the child whose SUBTREE completes latest (that completion is what
+    the parent was waiting on — a grandchild that outlives its parent,
+    like the catchup watcher outliving the reconfigure that spawned it,
+    still bounds the takeover), attribute the uncovered remainder to
+    the parent itself, and recurse.  The resulting segments PARTITION
+    the root's window, so the per-stage self times sum exactly to the
+    total — percentages are honest.
+
+    Returns ``{"total_s", "root_dur_s", "stages": [{"name", "peer",
+    "span", "start_s", "self_s", "pct"}, ...]}`` with stages in
+    chronological order of first contribution."""
+    eff: dict[str, float] = {}
+
+    def eff_end(rec: dict) -> float:
+        """Latest completion in *rec*'s subtree."""
+        sid = rec["span"]
+        if sid not in eff:
+            eff[sid] = _end(rec)           # pre-seed: cycle-proof
+            eff[sid] = max([_end(rec)]
+                           + [eff_end(c)
+                              for c in children.get(sid, ())
+                              if c.get("dur") is not None])
+        return eff[sid]
+
+    segs: list[tuple[dict, float, float]] = []
+
+    def walk(rec: dict, t: float) -> None:
+        start = rec["ts"]
+        while t > start + _EPS:
+            kids = [c for c in children.get(rec["span"], ())
+                    if c.get("dur") is not None and c["ts"] < t - _EPS]
+            if not kids:
+                break
+            # what bounded the frontier is a COMPLETION: prefer the
+            # child whose subtree finished latest within the window.  A
+            # child still running at t (a restore outliving the root)
+            # completed nothing by then — it only explains waiting when
+            # no child finishes in the remaining window at all.
+            done = [c for c in kids if eff_end(c) <= t + _EPS]
+            c = max(done, key=eff_end) if done \
+                else max(kids, key=lambda k: min(eff_end(k), t))
+            ce = min(eff_end(c), t)
+            if ce <= c["ts"] + _EPS or ce <= start + _EPS:
+                break
+            if t - ce > _EPS:
+                segs.append((rec, ce, t))     # waiting after the child
+            walk(c, ce)
+            t = max(c["ts"], start)
+        if t > start + _EPS:
+            segs.append((rec, start, t))
+
+    # the walk is CLAMPED to the root's own end: a descendant that
+    # outlives the root (an async peer still restoring after the
+    # failover completed) is that peer's catch-up work, not part of
+    # the window being explained — without the clamp it would inflate
+    # the total past the SLI sample and evict the real bounding stage.
+    # Below the root, eff ends still apply (ce may exceed a CHILD's own
+    # end so the walk can descend into the grandchild that bounded it).
+    walk(root, _end(root))
+    agg: dict[str, dict] = {}
+    for rec, s, e in segs:
+        st = agg.setdefault(rec["span"], {
+            "name": rec["name"], "peer": rec.get("peer"),
+            "span": rec["span"], "start_s": s, "self_s": 0.0})
+        st["self_s"] += e - s
+        st["start_s"] = min(st["start_s"], s)
+    total = sum(st["self_s"] for st in agg.values())
+    stages = sorted(agg.values(), key=lambda st: st["start_s"])
+    t0 = root["ts"]
+    for st in stages:
+        st["start_s"] = round(st["start_s"] - t0, 6)
+        st["self_s"] = round(st["self_s"], 6)
+        st["pct"] = round(100.0 * st["self_s"] / total, 1) if total \
+            else 0.0
+    return {"total_s": round(total, 6),
+            "root_dur_s": round(float(root.get("dur") or 0.0), 6),
+            "stages": stages}
+
+
+def render_waterfall(roots: list[dict], children: dict[str, list[dict]],
+                     *, width: int = 32) -> list[str]:
+    """ASCII waterfall of the whole forest: one line per span, indented
+    by depth, with start offset, duration, and a proportional bar over
+    the forest's wall-clock window."""
+    flat: list[tuple[int, dict]] = []
+
+    def walk(rec: dict, depth: int) -> None:
+        flat.append((depth, rec))
+        for c in children.get(rec["span"], ()):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    if not flat:
+        return ["(no spans)"]
+    t0 = min(rec["ts"] for _d, rec in flat)
+    t1 = max(_end(rec) for _d, rec in flat)
+    window = max(t1 - t0, _EPS)
+    scale = width / window
+    lines = ["%-38s %-22s %9s %9s  %s"
+             % ("SPAN", "PEER", "START", "DUR",
+                "0s%*s" % (width - 2, "+%.3fs" % window))]
+    for depth, rec in flat:
+        label = ("  " * depth + rec["name"])[:38]
+        off = int((rec["ts"] - t0) * scale)
+        bar_w = max(1, int(round(float(rec.get("dur") or 0.0) * scale)))
+        bar_w = min(bar_w, width - min(off, width - 1))
+        bar = " " * min(off, width - 1) + "=" * bar_w
+        status = rec.get("status", "ok")
+        lines.append("%-38s %-22s %+8.3fs %8.3fs  |%-*s|%s"
+                     % (label, str(rec.get("peer") or "-")[:22],
+                        rec["ts"] - t0, float(rec.get("dur") or 0.0),
+                        width, bar,
+                        "" if status == "ok" else " " + status))
+    return lines

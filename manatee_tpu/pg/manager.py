@@ -36,7 +36,7 @@ from pathlib import Path
 from typing import Awaitable, Callable
 
 from manatee_tpu.health.telemetry import STATUS_EVERY
-from manatee_tpu.obs import get_journal, get_registry
+from manatee_tpu.obs import get_journal, get_registry, record_span, span
 from manatee_tpu.pg.engine import Engine, PgError, parse_pg_url
 from manatee_tpu.state.types import INITIAL_WAL
 from manatee_tpu.storage.base import StorageBackend, StorageError
@@ -248,18 +248,20 @@ class PostgresMgr:
             await self._cancel_repoint()
             t0 = time.monotonic()
             try:
-                if role == "primary":
-                    if self._applied and self._applied.get("role") == \
-                            "primary" and self.running:
-                        await self._update_standby(pgcfg)
+                with span("pg.reconfigure", role=str(role),
+                          peer_id=self.peer_id):
+                    if role == "primary":
+                        if self._applied and self._applied.get("role") \
+                                == "primary" and self.running:
+                            await self._update_standby(pgcfg)
+                        else:
+                            await self._primary(pgcfg)
+                    elif role in ("sync", "async"):
+                        await self._standby(pgcfg)
+                    elif role == "none":
+                        await self._stop()
                     else:
-                        await self._primary(pgcfg)
-                elif role in ("sync", "async"):
-                    await self._standby(pgcfg)
-                elif role == "none":
-                    await self._stop()
-                else:
-                    raise PgError("bad role: %r" % role)
+                        raise PgError("bad role: %r" % role)
             except asyncio.CancelledError:
                 journal.record("pg.reconfigure.cancelled", role=role)
                 raise
@@ -320,44 +322,47 @@ class PostgresMgr:
         # database would absorb the SIGHUP without acting on it, and
         # only the restart path's kill escalation recovers it
         promoted = False
-        if (self.running and self._online
-                and self.engine.promotable_in_place
-                and self._applied
-                and self._applied.get("role") in ("sync", "async")):
-            log.info("%s: promoting in place (no restart)",
-                     self.peer_id)
-            self.engine.write_config(
-                self.datadir, host=self.host, port=self.port,
-                peer_id=self.peer_id,
-                read_only=not singleton,
-                sync_standby_ids=sync_ids, upstream=None)
-            self._reload()
-            try:
-                # a healthy server promotes in well under a second; a
-                # short bound means a JUST-wedged one (health raced the
-                # gate) costs seconds before the restart fallback, not
-                # a full opsTimeout stall in the takeover path
-                await self.engine.promote_in_place(
-                    self.host, self.port,
-                    timeout=float(self.cfg["promoteWait"]))
-                promoted = True
-            except (PgError, asyncio.TimeoutError) as e:
-                # fall back to the restart path, which recovers any
-                # server state the in-place attempt left behind
-                log.warning("%s: in-place promotion failed (%s); "
-                            "restarting instead", self.peer_id, e)
-        if not promoted:
-            await self._stop()
-            await self._prepare_database()
-            # read-only until the sync catches up — taking writes
-            # before the sync is established risks data loss on the
-            # next failover
-            self.engine.write_config(
-                self.datadir, host=self.host, port=self.port,
-                peer_id=self.peer_id,
-                read_only=not singleton,
-                sync_standby_ids=sync_ids, upstream=None)
-            await self._start()
+        with span("pg.promote") as psp:
+            if (self.running and self._online
+                    and self.engine.promotable_in_place
+                    and self._applied
+                    and self._applied.get("role") in ("sync", "async")):
+                log.info("%s: promoting in place (no restart)",
+                         self.peer_id)
+                self.engine.write_config(
+                    self.datadir, host=self.host, port=self.port,
+                    peer_id=self.peer_id,
+                    read_only=not singleton,
+                    sync_standby_ids=sync_ids, upstream=None)
+                self._reload()
+                try:
+                    # a healthy server promotes in well under a second;
+                    # a short bound means a JUST-wedged one (health
+                    # raced the gate) costs seconds before the restart
+                    # fallback, not a full opsTimeout stall in the
+                    # takeover path
+                    await self.engine.promote_in_place(
+                        self.host, self.port,
+                        timeout=float(self.cfg["promoteWait"]))
+                    promoted = True
+                except (PgError, asyncio.TimeoutError) as e:
+                    # fall back to the restart path, which recovers any
+                    # server state the in-place attempt left behind
+                    log.warning("%s: in-place promotion failed (%s); "
+                                "restarting instead", self.peer_id, e)
+            psp.attrs["mode"] = "reload" if promoted else "restart"
+            if not promoted:
+                await self._stop()
+                await self._prepare_database()
+                # read-only until the sync catches up — taking writes
+                # before the sync is established risks data loss on the
+                # next failover
+                self.engine.write_config(
+                    self.datadir, host=self.host, port=self.port,
+                    peer_id=self.peer_id,
+                    read_only=not singleton,
+                    sync_standby_ids=sync_ids, upstream=None)
+                await self._start()
         await self._snapshot_safe()
         if downstream:
             self._catchup_task = asyncio.create_task(
@@ -386,38 +391,42 @@ class PostgresMgr:
         then enable writes (lib/postgresMgr.js:1037-1105, 2390-2555)."""
         last_flush: str | None = None
         deadline = time.monotonic() + float(self.cfg["replicationTimeout"])
-        while not self._closed:
-            try:
-                res = await self._local_query({"op": "status"}, 5.0)
-                row = next((r for r in res.get("replication", [])
-                            if r["application_name"] == standby_id), None)
-                if row and row.get("state") == "streaming":
-                    if row["flush_lsn"] != last_flush:
-                        last_flush = row["flush_lsn"]
+        with span("pg.catchup", standby=standby_id):
+            while not self._closed:
+                try:
+                    res = await self._local_query({"op": "status"}, 5.0)
+                    row = next((r for r in res.get("replication", [])
+                                if r["application_name"] == standby_id),
+                               None)
+                    if row and row.get("state") == "streaming":
+                        if row["flush_lsn"] != last_flush:
+                            last_flush = row["flush_lsn"]
+                            deadline = time.monotonic() + \
+                                float(self.cfg["replicationTimeout"])
+                        if row["sent_lsn"] == row["flush_lsn"]:
+                            log.info("%s: standby %s caught up at %s; "
+                                     "enabling writes", self.peer_id,
+                                     standby_id, row["flush_lsn"])
+                            self.engine.write_config(
+                                self.datadir, host=self.host,
+                                port=self.port,
+                                peer_id=self.peer_id, read_only=False,
+                                sync_standby_ids=sync_ids,
+                                upstream=None)
+                            self._reload()
+                            self._emit("writable", standby_id)
+                            return
+                    if time.monotonic() > deadline:
+                        log.error("%s: standby %s made no replication "
+                                  "progress in %ss; still waiting",
+                                  self.peer_id, standby_id,
+                                  self.cfg["replicationTimeout"])
+                        self._emit("replicationTimeout", standby_id)
                         deadline = time.monotonic() + \
                             float(self.cfg["replicationTimeout"])
-                    if row["sent_lsn"] == row["flush_lsn"]:
-                        log.info("%s: standby %s caught up at %s; "
-                                 "enabling writes", self.peer_id,
-                                 standby_id, row["flush_lsn"])
-                        self.engine.write_config(
-                            self.datadir, host=self.host, port=self.port,
-                            peer_id=self.peer_id, read_only=False,
-                            sync_standby_ids=sync_ids, upstream=None)
-                        self._reload()
-                        self._emit("writable", standby_id)
-                        return
-                if time.monotonic() > deadline:
-                    log.error("%s: standby %s made no replication "
-                              "progress in %ss; still waiting",
-                              self.peer_id, standby_id,
-                              self.cfg["replicationTimeout"])
-                    self._emit("replicationTimeout", standby_id)
-                    deadline = time.monotonic() + \
-                        float(self.cfg["replicationTimeout"])
-            except PgError as e:
-                log.debug("catchup poll error: %s", e)
-            await asyncio.sleep(float(self.cfg["replPollInterval"]))
+                except PgError as e:
+                    log.debug("catchup poll error: %s", e)
+                await asyncio.sleep(float(self.cfg["replPollInterval"]))
 
     # -- standby --
 
@@ -447,11 +456,12 @@ class PostgresMgr:
                 and self._applied.get("role") in ("sync", "async")):
             log.info("%s: re-pointing standby upstream to %s (reload, "
                      "no restart)", self.peer_id, upstream.get("id"))
-            self.engine.write_config(
-                self.datadir, host=self.host, port=self.port,
-                peer_id=self.peer_id, read_only=True,
-                sync_standby_ids=[], upstream=upstream)
-            self._reload()
+            with span("pg.repoint", upstream=upstream.get("id")):
+                self.engine.write_config(
+                    self.datadir, host=self.host, port=self.port,
+                    peer_id=self.peer_id, read_only=True,
+                    sync_standby_ids=[], upstream=upstream)
+                self._reload()
             if self.engine.lingering_repoint_failure:
                 self._repoint_task = asyncio.create_task(
                     self._repoint_watchdog(pgcfg))
@@ -484,26 +494,31 @@ class PostgresMgr:
                                  upstream=upstream.get("id"),
                                  url=upstream.get("backupUrl"),
                                  reason=str(e))
-            try:
-                await self.restore_fn(upstream)
-            except asyncio.CancelledError:
-                raise
-            except Exception as re_err:
-                _RESTORES.inc(result="failed")
-                get_journal().record("restore.failed",
-                                     upstream=upstream.get("id"),
-                                     error=str(re_err))
-                raise
-            _RESTORES.inc(result="ok")
-            get_journal().record("restore.done",
-                                 upstream=upstream.get("id"))
-            self._emit("restoreDone", upstream)
-            await self._ensure_dataset_mounted(create=False)
-            self.engine.write_config(
-                self.datadir, host=self.host, port=self.port,
-                peer_id=self.peer_id, read_only=True,
-                sync_standby_ids=[], upstream=upstream)
-            await self._start()
+            with span("pg.restore", upstream=upstream.get("id")):
+                try:
+                    await self.restore_fn(upstream)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as re_err:
+                    _RESTORES.inc(result="failed")
+                    get_journal().record("restore.failed",
+                                         upstream=upstream.get("id"),
+                                         error=str(re_err))
+                    raise
+                _RESTORES.inc(result="ok")
+                get_journal().record("restore.done",
+                                     upstream=upstream.get("id"))
+                self._emit("restoreDone", upstream)
+                await self._ensure_dataset_mounted(create=False)
+                self.engine.write_config(
+                    self.datadir, host=self.host, port=self.port,
+                    peer_id=self.peer_id, read_only=True,
+                    sync_standby_ids=[], upstream=upstream)
+                # replay: boot the restored dataset and chew through
+                # its WAL until the server answers health probes — the
+                # second half of a restore's wall-clock cost
+                with span("pg.replay"):
+                    await self._start()
         # real-postgres engines linger on a refused stream at BOOT too
         # (allow_restore_exit only catches an exiting child): every
         # standby transition arms the attachment watchdog, not just
@@ -774,6 +789,7 @@ class PostgresMgr:
             # cheap probe per tick, healthChkTimeout bounding it
             # (lib/postgresMgr.js:1550-1646)
             t0 = time.monotonic()
+            t0_wall = time.time()
             ok = await self.engine.health(self.host, self.port, timeout)
             latency_ms = (time.monotonic() - t0) * 1000.0
             _PROBE_DUR.observe(latency_ms / 1000.0)
@@ -789,14 +805,32 @@ class PostgresMgr:
                 except (PgError, asyncio.TimeoutError):
                     st = None
             self._record_telemetry(ok, latency_ms, st)
+            flipped = None
             if ok and not self._online:
                 self._online = True
+                flipped = "online"
                 self._probe_flip("online", None)
                 self._emit("healthy", None)
             elif not ok and self._online:
                 self._online = False
+                flipped = "offline"
                 self._probe_flip("offline", "health check failed")
                 self._emit("unhealthy", "health check failed")
+            if flipped or not ok:
+                # the probe→verdict→act hop, as a span — but only for
+                # ticks that carry signal (failures and verdict flips):
+                # a healthy heartbeat every interval would just evict
+                # other spans from the ring.  Deliberately AMBIENT
+                # (trace/parent None): probes precede any transition
+                # they might trigger, so there is no trace to join —
+                # they are read from the raw GET /spans feed, not from
+                # `manatee-adm trace` trees.
+                record_span("sitter.probe", ts=t0_wall,
+                            dur=latency_ms / 1000.0,
+                            status="ok" if ok else "error",
+                            trace_id=None, parent_id=None,
+                            peer_id=self.peer_id,
+                            **({"verdict": flipped} if flipped else {}))
 
     def _probe_flip(self, to: str, why: str | None) -> None:
         _PROBE_FLIPS.inc(to=to)

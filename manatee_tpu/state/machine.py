@@ -56,10 +56,13 @@ from manatee_tpu.coord.api import (
     NodeExistsError,
 )
 from manatee_tpu.obs import (
+    bind_parent,
     bind_trace,
     get_journal,
     get_registry,
+    get_span_store,
     new_trace_id,
+    span,
 )
 from manatee_tpu.state.types import (
     INITIAL_WAL,
@@ -152,6 +155,15 @@ class PeerStateMachine:
         # of the takeover, cleared when the new primary is writable
         self._failover_t0: float | None = None
         self._failover_trace: str | None = None
+        # the ROOT span of the failover tree: opened at loss detection,
+        # closed when writes re-enable (the same window the SLI
+        # histogram observes) — `manatee-adm trace` hangs the whole
+        # cross-peer takeover under it
+        self._failover_span = None
+        # last foreign transition span we reacted to, so exactly one
+        # state.evaluate span is recorded per observed transition (not
+        # one per worker kick)
+        self._reacted_span: str | None = None
 
         zk.on("init", self._on_zk_init)
         zk.on("activeChange", self._on_active_change)
@@ -206,6 +218,7 @@ class PeerStateMachine:
         self._witness((payload or {}).get("active"))
         # the failover clock rests on witnessed-death evidence, which a
         # rebuilt session voids along with the sightings themselves
+        self._abort_failover_span("session rebuilt")
         self._failover_t0 = None
         self._failover_trace = None
         self.kick()
@@ -239,6 +252,7 @@ class PeerStateMachine:
 
     async def close(self) -> None:
         self._closed = True
+        self._abort_failover_span("shutdown")
         self._kick.set()
         for t in (self._worker_task, self._pg_task):
             if t:
@@ -300,36 +314,52 @@ class PeerStateMachine:
             return
 
         my_role = role_of(st, self.self_id)
-        # react under the trace of the transition that produced this
-        # state: the pg reconfigure (and its logs/journal events) on
-        # EVERY peer then correlates with the initiating write — new
-        # transitions we decide below mint their own fresh ids in
-        # _write_state
-        with bind_trace(st.get("trace")):
-            self._notify_role(my_role, st)
-
-            if st.get("oneNodeWriteMode") and my_role != "primary":
-                # ONWM: foreign peers shut down
-                # (docs/user-guide.md:369-372)
-                log.warning("cluster is in one-node-write mode and we "
-                            "are not the primary; shutting down")
-                await self._apply_pg({"role": "none"})
-                return
-
-            if my_role == "primary":
-                await self._apply_pg(self._pg_config_for(st, "primary"))
-                await self._primary_duties(st, ver, actives)
-            elif my_role == "sync":
-                acted = await self._sync_duties(st, ver, actives)
-                if not acted:
-                    await self._apply_pg(self._pg_config_for(st, "sync"))
-            elif my_role == "async":
-                await self._apply_pg(self._pg_config_for(st, "async"))
-            elif my_role == "deposed":
-                await self._apply_pg({"role": "none", "deposed": True})
+        # react under the trace AND parent span of the transition that
+        # produced this state: the pg reconfigure (and its logs/journal
+        # events/spans) on EVERY peer then correlates with — and nests
+        # under — the initiating write.  New transitions we decide
+        # below mint their own fresh ids in _write_state.
+        with bind_trace(st.get("trace")), bind_parent(st.get("span")):
+            fresh = (st.get("span") is not None
+                     and st.get("span") != self._reacted_span)
+            if fresh:
+                # exactly one evaluate span per observed transition per
+                # peer (the worker re-kicks far more often than the
+                # state changes); everything the reaction spawns —
+                # the pg reconfigure task included — parents under it
+                self._reacted_span = st.get("span")
+                with span("state.evaluate", role=my_role or "none",
+                          generation=st.get("generation")):
+                    await self._react(st, ver, actives, my_role)
             else:
-                # unassigned: wait for the primary to adopt us
-                await self._apply_pg({"role": "none"})
+                await self._react(st, ver, actives, my_role)
+
+    async def _react(self, st: ClusterState, ver: int | None,
+                     actives: list[dict], my_role: str | None) -> None:
+        self._notify_role(my_role, st)
+
+        if st.get("oneNodeWriteMode") and my_role != "primary":
+            # ONWM: foreign peers shut down
+            # (docs/user-guide.md:369-372)
+            log.warning("cluster is in one-node-write mode and we "
+                        "are not the primary; shutting down")
+            await self._apply_pg({"role": "none"})
+            return
+
+        if my_role == "primary":
+            await self._apply_pg(self._pg_config_for(st, "primary"))
+            await self._primary_duties(st, ver, actives)
+        elif my_role == "sync":
+            acted = await self._sync_duties(st, ver, actives)
+            if not acted:
+                await self._apply_pg(self._pg_config_for(st, "sync"))
+        elif my_role == "async":
+            await self._apply_pg(self._pg_config_for(st, "async"))
+        elif my_role == "deposed":
+            await self._apply_pg({"role": "none", "deposed": True})
+        else:
+            # unassigned: wait for the primary to adopt us
+            await self._apply_pg({"role": "none"})
 
     def _notify_role(self, my_role: str | None, st: ClusterState) -> None:
         """Emit role-transition events ONCE per transition."""
@@ -348,6 +378,7 @@ class PeerStateMachine:
             get_journal().record("failover.aborted",
                                  trace_id=self._failover_trace,
                                  why="role became %s" % (key or "none"))
+            self._abort_failover_span("role became %s" % (key or "none"))
             self._failover_t0 = None
             self._failover_trace = None
         get_journal().record("role.change", role=key or "none",
@@ -514,6 +545,7 @@ class PeerStateMachine:
                 get_journal().record("failover.aborted",
                                      trace_id=self._failover_trace,
                                      primary=st["primary"]["id"])
+                self._abort_failover_span("primary flapped back")
                 self._failover_t0 = None
                 self._failover_trace = None
             return False
@@ -525,6 +557,14 @@ class PeerStateMachine:
             # re-enables writes (_on_pg_writable)
             self._failover_t0 = time.monotonic()
             self._failover_trace = new_trace_id()
+            # the ROOT of the cross-peer failover tree: everything the
+            # takeover causes — the durable write, every peer's
+            # reconfigure, the catchup wait — nests under this span,
+            # and its duration IS the SLI window
+            self._failover_span = get_span_store().start(
+                "failover", trace_id=self._failover_trace, root=True,
+                old_primary=st["primary"]["id"],
+                generation=st.get("generation"))
             get_journal().record("failover.detected",
                                  trace_id=self._failover_trace,
                                  primary=st["primary"]["id"],
@@ -575,14 +615,22 @@ class PeerStateMachine:
         why = ("promote request" if promote_me else "primary death")
         # the takeover rides the trace minted at loss detection, so the
         # detection, the durable write, and the pg promotion all carry
-        # one id across the journal and the logs
+        # one id across the journal and the logs — and parent under the
+        # failover root span, so `manatee-adm trace` shows one tree.
+        # No failover root (promote request; unwitnessed death): the
+        # transition must root its own trace, or the ambient evaluate
+        # span — which belongs to the PREVIOUS transition's trace —
+        # leaks in as a cross-trace parent and the tree looks orphaned.
         tid = self._failover_trace or new_trace_id()
-        with bind_trace(tid):
+        parent = (self._failover_span.span_id
+                  if self._failover_span is not None else None)
+        with bind_trace(tid), bind_parent(parent):
             get_journal().record("takeover.begin", why=why,
                                  old_primary=st["primary"]["id"],
                                  new_generation=new["generation"])
             if not await self._write_state(new, "takeover (%s)" % why,
-                                           ver, trace_id=tid):
+                                           ver, trace_id=tid,
+                                           root=parent is None):
                 # lost the race (e.g. an operator freeze landed first):
                 # do NOT promote local postgres; re-evaluate against
                 # the winner
@@ -595,13 +643,16 @@ class PeerStateMachine:
 
     async def _write_state(self, state: ClusterState, why: str,
                            expected_version: int | None, *,
-                           trace_id: str | None = None) -> bool:
+                           trace_id: str | None = None,
+                           root: bool | None = None) -> bool:
         """CAS-write; returns False when the write lost a race.
 
         Every durable transition mints a trace id (or rides the one the
         caller minted, e.g. at failover detection) and embeds it in the
-        state object, so peers reacting to the watch — and the coordd
-        that stored it — all log and journal under the same id."""
+        state object — along with the transition SPAN's id — so peers
+        reacting to the watch (and the coordd that stored it) log,
+        journal, and span under the same identity, parented to this
+        write."""
         tid = trace_id or new_trace_id()
         state = dict(state)
         state["trace"] = tid
@@ -609,40 +660,60 @@ class PeerStateMachine:
         with bind_trace(tid):
             log.info("writing cluster state gen=%s (%s)",
                      state.get("generation"), why)
-            journal.record("transition.begin", why=why,
-                           generation=state.get("generation"))
-            try:
-                with _TRANSITION_DUR.time():
-                    await self.zk.put_cluster_state(
-                        state, expected_version=expected_version)
-            except (BadVersionError, NodeExistsError):
-                log.info("state write lost a race (%s); deferring", why)
-                journal.record("transition.conflict", why=why)
-                # refresh the cached state explicitly: if our watch was
-                # lost, waiting for it would spin on the same stale
-                # snapshot
-                refresh = getattr(self.zk, "refresh_cluster_state", None)
-                if refresh is not None:
-                    try:
-                        await refresh()
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception:
-                        pass
-                await _sleep(0.05)
-                self.kick()
-                return False
-            _TRANSITIONS.inc()
-            journal.record("transition.committed", why=why,
-                           generation=state.get("generation"))
-            self._emit("stateWritten", state)
+            # root when WE minted the trace (callers with a same-trace
+            # parent — the takeover under its failover root — pass
+            # root=False explicitly): the ambient span here is the
+            # evaluate span reacting to the PREVIOUS state, and a
+            # cross-trace parent link would make this trace's own tree
+            # look orphaned.
+            with span("state.transition",
+                      root=(trace_id is None if root is None
+                            else root),
+                      why=why,
+                      generation=state.get("generation")) as tsp:
+                # the embedded span id is what makes a transition's
+                # effects on OTHER peers children of this write
+                state["span"] = tsp.span_id
+                journal.record("transition.begin", why=why,
+                               generation=state.get("generation"))
+                try:
+                    with span("state.cas_write"), \
+                            _TRANSITION_DUR.time():
+                        await self.zk.put_cluster_state(
+                            state, expected_version=expected_version)
+                except (BadVersionError, NodeExistsError):
+                    log.info("state write lost a race (%s); deferring",
+                             why)
+                    journal.record("transition.conflict", why=why)
+                    tsp.end(status="conflict")
+                    # refresh the cached state explicitly: if our watch
+                    # was lost, waiting for it would spin on the same
+                    # stale snapshot
+                    refresh = getattr(self.zk, "refresh_cluster_state",
+                                      None)
+                    if refresh is not None:
+                        try:
+                            await refresh()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            pass
+                    await _sleep(0.05)
+                    self.kick()
+                    return False
+                _TRANSITIONS.inc()
+                journal.record("transition.committed", why=why,
+                               generation=state.get("generation"))
+                self._emit("stateWritten", state)
         self.kick()
         return True
 
     def _on_pg_writable(self, _standby_id) -> None:
         """PG manager re-enabled writes.  If a failover clock is
         running, this peer just completed a takeover end-to-end: observe
-        the headline SLI."""
+        the headline SLI and close the root span — both cover the same
+        detection→writable window, so `manatee-adm trace`'s critical
+        path total and the histogram sample agree."""
         if self._failover_t0 is None:
             return
         dur = time.monotonic() - self._failover_t0
@@ -650,8 +721,18 @@ class PeerStateMachine:
         get_journal().record("failover.complete",
                              trace_id=self._failover_trace,
                              duration_s=round(dur, 3))
+        if self._failover_span is not None:
+            self._failover_span.end(duration_s=round(dur, 3))
+            self._failover_span = None
         self._failover_t0 = None
         self._failover_trace = None
+
+    def _abort_failover_span(self, why: str) -> None:
+        """A failover clock that will never complete must not leave its
+        root span open (the leak the chaos suite asserts against)."""
+        if self._failover_span is not None:
+            self._failover_span.end(status="aborted", why=why)
+            self._failover_span = None
 
     def _pg_config_for(self, st: ClusterState, role: str) -> dict:
         """The reconfigure contract {role, upstream, downstream}

@@ -644,16 +644,20 @@ class World:
 
     # -- canonical hash --
 
+    _OBS_KEYS = frozenset(("trace", "span"))
+
     @staticmethod
     def _sem(state):
         """Semantic projection of a cluster state for hashing: the
-        per-transition trace id (obs metadata, unique on every durable
-        write) is quotiented out — hashing it would make every
-        logically-identical state look fresh and defeat memoization
-        (an exponential blowup of the sweep)."""
-        if not isinstance(state, dict) or "trace" not in state:
+        per-transition trace AND span ids (obs metadata, unique on
+        every durable write) are quotiented out — hashing either would
+        make every logically-identical state look fresh and defeat
+        memoization (an exponential blowup of the sweep)."""
+        if not isinstance(state, dict) \
+                or not (World._OBS_KEYS & state.keys()):
             return state
-        return {k: v for k, v in state.items() if k != "trace"}
+        return {k: v for k, v in state.items()
+                if k not in World._OBS_KEYS}
 
     def digest(self) -> str:
         peers = {}

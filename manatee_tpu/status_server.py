@@ -16,7 +16,10 @@ scrape bunyan logs):
   failover/reconfigure/RPC latency histograms, probe flips, ...);
 - ``GET /events``  this peer's ring-buffer event journal
   (``?since=SEQ&limit=N``) — the per-peer feed `manatee-adm events`
-  merges into the shard timeline.
+  merges into the shard timeline;
+- ``GET /spans``   this peer's completed-span ring
+  (``?since=SEQ&limit=N&trace=ID``) plus its open spans — the per-peer
+  feed `manatee-adm trace` reassembles into the cross-peer tree.
 """
 
 from __future__ import annotations
@@ -26,7 +29,8 @@ import time
 
 from aiohttp import web
 
-from manatee_tpu.obs import get_journal, get_registry
+from manatee_tpu.obs import get_journal, get_registry, get_span_store
+from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
 
 log = logging.getLogger("manatee.status")
 
@@ -47,6 +51,7 @@ class StatusServer:
         app.router.add_get("/restore", self._restore)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/events", self._events)
+        app.router.add_get("/spans", self._spans)
         self._app = app
 
     async def start(self) -> None:
@@ -64,7 +69,7 @@ class StatusServer:
 
     async def _routes(self, _req: web.Request) -> web.Response:
         return web.json_response(["/ping", "/state", "/restore",
-                                  "/metrics", "/events"])
+                                  "/metrics", "/events", "/spans"])
 
     async def _ping(self, _req: web.Request) -> web.Response:
         healthy = bool(self.pg_mgr and self.pg_mgr.online)
@@ -94,20 +99,26 @@ class StatusServer:
     async def _events(self, req: web.Request) -> web.Response:
         """The peer's event journal, oldest first.  ?since=SEQ returns
         only events after that per-process sequence number (incremental
-        tailing); ?limit=N caps the reply to the newest N."""
+        tailing); ?limit=N keeps the newest N of what remains."""
         journal = get_journal()
         try:
-            since = int(req.query.get("since", 0))
-            limit = (int(req.query["limit"])
-                     if "limit" in req.query else None)
+            since, limit = parse_page_query(req.query)
         except ValueError:
-            return web.json_response({"error": "since/limit must be "
-                                               "integers"}, status=400)
+            return web.json_response(
+                {"error": "since/limit must be integers"}, status=400,
+                content_type="application/json")
         return web.json_response({
             "peer": journal.peer,
             "now": round(time.time(), 3),
             "events": journal.events(since=since, limit=limit),
-        })
+        }, content_type="application/json")
+
+    async def _spans(self, req: web.Request) -> web.Response:
+        """The peer's completed spans, oldest first, plus its open
+        spans; ?trace=ID filters to one trace's records."""
+        body, status = spans_http_reply(get_span_store(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
 
     async def _metrics(self, _req: web.Request) -> web.Response:
         """Prometheus text exposition: state-derived gauges + the whole

@@ -38,6 +38,10 @@ async def unbounded():
     await asyncio.open_connection("h", 1)  # unbounded-wait
 
 
+def leaky(obs):
+    obs.span("stage")                      # span-not-closed
+
+
 def shadowed():
     return 1
 

@@ -352,9 +352,44 @@ class ClusterHarness:
             await asyncio.sleep(stagger)  # join order = peer order
 
     async def stop(self) -> None:
+        # dump only on FAILING teardowns: stop() runs in the tests'
+        # finally blocks, so an in-flight exception here means the test
+        # is going red — green teardowns must not pay three CLI
+        # subprocesses each on a suite already near its time budget
+        if os.environ.get("MANATEE_OBS_DUMP") \
+                and sys.exc_info()[0] is not None:
+            await self._dump_obs()
         for p in self.peers:
             p.kill()
         self.kill_coordd()
+
+    async def _dump_obs(self) -> None:
+        """Best-effort observability dump into the cluster root BEFORE
+        the peers are killed (their journal/span rings are in-memory).
+        CI sets MANATEE_OBS_DUMP=1 and uploads these files as
+        artifacts on failure, so a red run's failover is debuggable
+        from `manatee-adm events`/`trace` output without a rerun."""
+        if not any(p and p.poll() is None for p in self.coord_procs):
+            return        # no coordination service left to fan out from
+        for args, fname in (
+                (["events", "-j"], "shard-events.jsonl"),
+                (["trace", "--last-failover"], "failover-trace.txt"),
+                (["trace", "--last-failover", "-j"],
+                 "failover-trace.json")):
+            try:
+                cp = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, "-m", "manatee_tpu.cli", *args],
+                    capture_output=True, text=True, timeout=15,
+                    env=cli_env(self.coord_connstr,
+                                self.shard_path.rsplit("/", 1)[-1]))
+                (self.root / fname).write_text(
+                    cp.stdout + ("\n--- stderr ---\n" + cp.stderr
+                                 if cp.stderr else ""))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass       # a dump must never turn teardown red
 
     async def _wait_port(self, port: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout

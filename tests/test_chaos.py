@@ -315,6 +315,43 @@ def test_chaos(tmp_path):
                   % (len(merged), len(last_seq), correlated,
                      len(takeovers)), flush=True)
 
+            # span invariants over the storm's LAST failover: the
+            # reassembled tree must be internally consistent — every
+            # fetched span complete (no open spans under the trace)
+            # and rooted.  Orphans are tolerated here ONLY because a
+            # storm can kill the recording peer after the fact (its
+            # ring dies with it); the scripted-failover tier
+            # (tests/test_spans.py) asserts zero orphans.
+            cp = run_cli(cluster, "trace", "--last-failover", "-j",
+                         timeout=60)
+            if cp.returncode == 0:
+                tr = _json.loads(cp.stdout)
+                assert tr["spans"], "trace resolved but no spans"
+                assert tr["roots"], "span forest has no root"
+                assert tr["open"] == [], \
+                    "completed failover left spans open: %r" % tr["open"]
+                by_id = {s["span"]: s for s in tr["spans"]}
+                orphan_ids = set(tr["orphans"])
+                for s in tr["spans"]:
+                    assert s["dur"] is not None and s["dur"] >= 0, s
+                    assert s["parent"] is None \
+                        or s["parent"] in by_id \
+                        or s["span"] in orphan_ids, \
+                        "span %r neither resolves nor is a reported " \
+                        "orphan" % s
+                assert tr["critical_path"]["total_s"] > 0
+                print("chaos: last failover trace %s: %d spans, "
+                      "%d orphan(s), critical path %.3fs"
+                      % (tr["trace"], len(tr["spans"]),
+                         len(orphan_ids),
+                         tr["critical_path"]["total_s"]), flush=True)
+            else:
+                # every journal that witnessed a failover died in the
+                # storm: legitimate, but say so
+                print("chaos: no failover trace resolvable from "
+                      "surviving journals (rc %d)" % cp.returncode,
+                      flush=True)
+
             # the snapshotter trio survived the storm: snapshots kept
             # flowing, GC held the bound, no spurious stuck alarm
             from manatee_tpu.storage import DirBackend

@@ -24,7 +24,7 @@ DATA = Path(__file__).parent / "data" / "lint"
 NEW_RULES = {
     "orphan-task", "blocking-call-in-async", "blocking-io-in-async",
     "swallowed-cancellation", "cancel-without-await", "lock-discipline",
-    "unbounded-wait",
+    "unbounded-wait", "span-not-closed",
 }
 PORTED_RULES = {
     "syntax", "unused-import", "shadowed-def", "bare-except",
@@ -432,6 +432,57 @@ def test_unbounded_wait_configurable_primitives():
     cfg = Config(unbounded_methods=frozenset({"drain"}))
     assert "unbounded-wait" in rules_of(
         "async def f(w):\n    await w.drain()\n", cfg)
+
+
+# ---- span-not-closed ----
+
+def test_span_not_closed_bare_call():
+    assert "span-not-closed" in rules_of("""\
+        from manatee_tpu.obs import span
+        def f():
+            span("stage")
+    """)
+    # a bound-but-never-entered handle leaks an open span just the same
+    assert "span-not-closed" in rules_of("""\
+        from manatee_tpu.obs import span
+        def f():
+            cm = span("stage", role="primary")
+            cm.__enter__()
+    """)
+    # dotted obs/spans receivers are ours too
+    assert "span-not-closed" in rules_of("""\
+        from manatee_tpu import obs
+        def f():
+            obs.span("stage")
+    """)
+
+
+def test_span_not_closed_negative():
+    assert "span-not-closed" not in rules_of("""\
+        from manatee_tpu.obs import span
+        async def f():
+            with span("stage", role="sync"):
+                await g()
+    """)
+    # multiple context managers in one with statement
+    assert "span-not-closed" not in rules_of("""\
+        from manatee_tpu.obs import bind_trace, span
+        def f(tid):
+            with bind_trace(tid), span("stage") as sp:
+                sp.attrs["mode"] = "reload"
+    """)
+    # other libraries' .span() APIs are not ours to police
+    assert "span-not-closed" not in rules_of("""\
+        def f(tracer):
+            tracer.span("stage")
+    """)
+    # the explicit manual API is the sanctioned escape hatch
+    assert "span-not-closed" not in rules_of("""\
+        from manatee_tpu.obs import get_span_store
+        def f():
+            sp = get_span_store().start("failover", root=True)
+            return sp
+    """)
 
 
 # ---- suppressions ----

@@ -52,3 +52,15 @@ def test_span_and_table_rendering(tmp_path):
     assert "one" in out and "two" in out
     # separator row dropped
     assert "---" not in out
+
+
+def test_renders_trace_man_page(tmp_path):
+    out = render((REPO / "docs" / "man"
+                  / "manatee-adm-trace.md").read_text(), tmp_path)
+    for section in (".SH SYNOPSIS", ".SH DESCRIPTION", ".SH OPTIONS",
+                    ".SH OUTPUT", ".SH EXIT STATUS", ".SH SEE ALSO"):
+        assert section in out, "missing %s" % section
+    # the waterfall example survives as a literal block, markdown
+    # stripped
+    assert ".nf" in out and "critical path" in out
+    assert "`" not in out and "**" not in out

@@ -1,0 +1,533 @@
+"""Span subsystem: store/context units, ring-wrap pagination, the
+clock-skew merge tiebreak, tree assembly + critical path, the /spans
+endpoint contract, and the full-stack acceptance check — after an
+induced primary failure, `manatee-adm trace --last-failover`
+reconstructs a single rooted cross-peer span tree whose critical-path
+total matches the observed failover_duration_seconds sample."""
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+
+from tests.harness import ClusterHarness, cli_env
+from tests.test_integration import converged
+from tests.test_utils import parse_exposition
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- units: context API ----
+
+def test_span_nesting_parents_and_trace():
+    from manatee_tpu.obs import bind_trace, span
+    from manatee_tpu.obs.spans import SpanStore
+    import manatee_tpu.obs.spans as spans_mod
+
+    store = SpanStore()
+    orig = spans_mod._STORE
+    spans_mod._STORE = store
+    try:
+        with bind_trace("t" * 16):
+            with span("outer", role="primary") as outer:
+                with span("inner"):
+                    pass
+        with span("detached"):
+            pass
+    finally:
+        spans_mod._STORE = orig
+    inner, outer_rec, detached = store.spans()
+    assert inner["name"] == "inner"
+    assert inner["parent"] == outer.span_id == outer_rec["span"]
+    assert inner["trace"] == outer_rec["trace"] == "t" * 16
+    assert outer_rec["parent"] is None
+    assert outer_rec["role"] == "primary"
+    assert detached["trace"] is None and detached["parent"] is None
+    assert all(s["dur"] >= 0 and s["status"] == "ok"
+               for s in store.spans())
+    assert store.open_spans() == []
+
+
+def test_span_status_error_cancelled_and_root():
+    from manatee_tpu.obs import span
+    from manatee_tpu.obs.spans import SpanStore
+    import manatee_tpu.obs.spans as spans_mod
+
+    store = SpanStore()
+    orig = spans_mod._STORE
+    spans_mod._STORE = store
+    try:
+        try:
+            with span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+
+        async def cancelled_span():
+            with span("cut"):
+                await asyncio.sleep(30)
+
+        async def go():
+            t = asyncio.create_task(cancelled_span())
+            await asyncio.sleep(0.02)
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        asyncio.run(go())
+        with span("under"):
+            with span("top", root=True):
+                pass
+    finally:
+        spans_mod._STORE = orig
+    by_name = {s["name"]: s for s in store.spans()}
+    assert by_name["boom"]["status"] == "error"
+    assert by_name["boom"]["error"] == "ValueError"
+    assert by_name["cut"]["status"] == "cancelled"
+    # root=True severs the parent link even inside another span
+    assert by_name["top"]["parent"] is None
+    assert store.open_spans() == []
+
+
+def test_task_snapshots_span_context():
+    from manatee_tpu.obs import current_span_id, span
+
+    async def go():
+        with span("parent") as sp:
+            task = asyncio.create_task(_read())
+        # binding ended here, but the task carries the snapshot
+        assert await task == sp.span_id
+
+    async def _read():
+        await asyncio.sleep(0.01)
+        return current_span_id()
+
+    asyncio.run(go())
+
+
+def test_bind_parent_foreign_id_and_manual_lifecycle():
+    from manatee_tpu.obs import bind_parent, span
+    from manatee_tpu.obs.spans import SpanStore
+    import manatee_tpu.obs.spans as spans_mod
+
+    store = SpanStore()
+    orig = spans_mod._STORE
+    spans_mod._STORE = store
+    try:
+        with bind_parent("f" * 16):
+            with span("reaction"):
+                pass
+        with bind_parent(None):           # None = passthrough
+            with span("still-root"):
+                pass
+        # manual (callback-split) lifecycle: start now, end later
+        sp = store.start("failover", trace_id="a" * 16, root=True)
+        assert [o["span"] for o in store.open_spans()] == [sp.span_id]
+        rec = sp.end(status="aborted", why="test")
+        assert rec["status"] == "aborted" and rec["why"] == "test"
+        assert sp.end() is None            # idempotent
+    finally:
+        spans_mod._STORE = orig
+    by_name = {s["name"]: s for s in store.spans()}
+    assert by_name["reaction"]["parent"] == "f" * 16
+    assert by_name["still-root"]["parent"] is None
+    assert by_name["failover"]["trace"] == "a" * 16
+    assert store.open_spans() == []
+
+
+def test_record_span_posthoc_and_traced_decorator():
+    from manatee_tpu.obs import span, traced
+    from manatee_tpu.obs.spans import SpanStore
+    import manatee_tpu.obs.spans as spans_mod
+
+    store = SpanStore()
+    orig = spans_mod._STORE
+    spans_mod._STORE = store
+    try:
+        with span("probe-ctx") as ctx_sp:
+            store.record("sitter.probe", ts=time.time() - 0.5, dur=0.1,
+                         status="error", verdict="offline")
+
+        @traced("work", kind="demo")
+        async def work():
+            return 7
+
+        assert asyncio.run(work()) == 7
+    finally:
+        spans_mod._STORE = orig
+    probe = store.spans()[0]
+    assert probe["name"] == "sitter.probe"
+    assert probe["parent"] == ctx_sp.span_id   # context still applies
+    assert probe["status"] == "error" and probe["verdict"] == "offline"
+    by_name = {s["name"]: s for s in store.spans()}
+    assert by_name["work"]["kind"] == "demo"
+
+
+# ---- units: pagination at the ring wrap (satellite regression) ----
+
+def test_ring_wrap_pagination_off_by_one():
+    from manatee_tpu.obs import EventJournal
+    from manatee_tpu.obs.spans import SpanStore
+
+    j = EventJournal(capacity=4)
+    for i in range(10):
+        j.record("tick", n=i)
+    # ring holds seqs 7..10; an evicted `since` must not swallow the
+    # oldest survivor, and `since` == a survivor must exclude exactly it
+    assert [e["seq"] for e in j.events(since=6)] == [7, 8, 9, 10]
+    assert [e["seq"] for e in j.events(since=7)] == [8, 9, 10]
+    assert [e["seq"] for e in j.events(since=10)] == []
+    # limit keeps the NEWEST n of the since-filtered window
+    assert [e["seq"] for e in j.events(since=6, limit=2)] == [9, 10]
+    assert [e["seq"] for e in j.events(limit=0)] == []
+
+    s = SpanStore(capacity=4)
+    for i in range(10):
+        s.record("t", ts=time.time(), dur=0.0, n=i)
+    assert [x["seq"] for x in s.spans(since=6)] == [7, 8, 9, 10]
+    assert [x["seq"] for x in s.spans(since=7)] == [8, 9, 10]
+    assert [x["seq"] for x in s.spans(since=6, limit=2)] == [9, 10]
+    assert [x["seq"] for x in s.spans(limit=0)] == []
+    # trace filter composes with since/limit
+    s.record("t", ts=time.time(), dur=0.0, trace_id="x" * 16)
+    s.record("t", ts=time.time(), dur=0.0, trace_id="x" * 16)
+    got = s.spans(trace="x" * 16, limit=1)
+    assert len(got) == 1 and got[0]["seq"] == 12
+
+
+# ---- units: deterministic merge under clock skew (satellite) ----
+
+def test_merge_events_breaks_timestamp_ties_deterministically():
+    from manatee_tpu.adm import merge_events
+
+    # two peers whose clocks quantize to the same millisecond, fetched
+    # in opposite orders — the merge must render identically
+    a = [{"ts": 5.000, "peer": "peerB", "seq": 2, "event": "x"},
+         {"ts": 5.000, "peer": "peerA", "seq": 9, "event": "y"},
+         {"ts": 5.000, "peer": "peerA", "seq": 8, "event": "z"},
+         {"ts": 4.999, "peer": "peerB", "seq": 1, "event": "w"}]
+    m1 = merge_events(list(a))
+    m2 = merge_events(list(reversed(a)))
+    assert m1 == m2
+    assert [(e["peer"], e["seq"]) for e in m1] == \
+        [("peerB", 1), ("peerA", 8), ("peerA", 9), ("peerB", 2)]
+    # a peer whose clock stepped BACKWARD between records still keeps
+    # its own ring order within equal timestamps, and missing fields
+    # don't crash the key
+    skew = [{"ts": 7.0, "peer": "p1", "seq": 3},
+            {"ts": 7.0, "peer": "p1", "seq": 2},
+            {"peer": None, "seq": None}]
+    m3 = merge_events(skew)
+    assert [e.get("seq") for e in m3] == [None, 2, 3]
+
+
+# ---- units: tree assembly + critical path ----
+
+def _rec(span_id, name, ts, dur, parent=None, peer="p1", **at):
+    d = {"span": span_id, "name": name, "ts": ts, "dur": dur,
+         "parent": parent, "peer": peer, "seq": 0, "trace": "t" * 16,
+         "status": "ok"}
+    d.update(at)
+    return d
+
+
+def test_assemble_tree_dedups_and_surfaces_orphans():
+    from manatee_tpu.obs.spans import assemble_tree
+
+    spans = [
+        _rec("r1", "root", 0.0, 10.0),
+        _rec("c1", "child", 1.0, 2.0, parent="r1"),
+        _rec("c1", "child-dup", 1.0, 2.0, parent="r1"),   # dup id
+        _rec("o1", "orphan", 3.0, 1.0, parent="gone"),
+    ]
+    roots, children, orphans = assemble_tree(spans)
+    assert [r["span"] for r in roots] == ["r1", "o1"]
+    assert [c["span"] for c in children["r1"]] == ["c1"]
+    assert [o["span"] for o in orphans] == ["o1"]
+
+
+def test_critical_path_descends_into_deep_bounding_child():
+    from manatee_tpu.obs.spans import assemble_tree, critical_path
+
+    # root [0,10]; early child A [1,3]; child B [2,4] spawns grandchild
+    # C [3,9.5] that OUTLIVES B — the takeover shape (catchup outlives
+    # the reconfigure that spawned it).  C must dominate the path.
+    spans = [
+        _rec("r", "root", 0.0, 10.0),
+        _rec("a", "A", 1.0, 2.0, parent="r"),
+        _rec("b", "B", 2.0, 2.0, parent="r"),
+        _rec("c", "C", 3.0, 6.5, parent="b"),
+    ]
+    roots, children, _ = assemble_tree(spans)
+    cp = critical_path(roots[0], children)
+    by_name = {s["name"]: s for s in cp["stages"]}
+    assert abs(by_name["C"]["self_s"] - 6.5) < 1e-6
+    # the frontier before C belongs to B (from 2.0 to C's start at 3.0)
+    assert abs(by_name["B"]["self_s"] - 1.0) < 1e-6
+    # before B started, A was the in-flight work: its window clamps to
+    # [1.0, 2.0]
+    assert abs(by_name["A"]["self_s"] - 1.0) < 1e-6
+    # root owns [0,1] before A plus the tail [9.5,10]
+    assert abs(by_name["root"]["self_s"] - 1.5) < 1e-6
+    # the segments partition the window: self times telescope to it
+    assert abs(cp["total_s"] - 10.0) < 1e-6
+    assert abs(sum(s["self_s"] for s in cp["stages"]) - 10.0) < 1e-6
+    assert abs(sum(s["pct"] for s in cp["stages"]) - 100.0) < 0.5
+    # chronological stage order
+    starts = [s["start_s"] for s in cp["stages"]]
+    assert starts == sorted(starts)
+
+
+def test_critical_path_clamps_to_root_window():
+    from manatee_tpu.obs.spans import assemble_tree, critical_path
+
+    # a descendant that OUTLIVES the root — an async peer still
+    # restoring long after the failover completed — is that peer's
+    # catch-up work, not part of the window being explained.  The walk
+    # must clamp to the root's own end or the total inflates past the
+    # SLI sample and the real bounding stage (catchup) is evicted.
+    spans = [
+        _rec("r", "failover", 0.0, 1.0),
+        _rec("t", "state.transition", 0.05, 0.1, parent="r"),
+        _rec("rst", "pg.restore", 0.1, 30.0, parent="t", peer="p3"),
+        _rec("cu", "pg.catchup", 0.2, 0.79, parent="r"),
+    ]
+    roots, children, _ = assemble_tree(spans)
+    cp = critical_path(roots[0], children)
+    assert abs(cp["total_s"] - 1.0) < 1e-6
+    assert abs(cp["root_dur_s"] - 1.0) < 1e-6
+    by_name = {s["name"]: s for s in cp["stages"]}
+    # within the window the restore is in flight until the frontier
+    # reaches catchup's completion at 0.99 — catchup bounds the tail
+    assert "pg.catchup" in by_name
+    assert sum(s["self_s"] for s in cp["stages"]) <= 1.0 + 1e-6
+
+
+def test_render_waterfall_shape():
+    from manatee_tpu.obs.spans import assemble_tree, render_waterfall
+
+    spans = [
+        _rec("r", "root", 0.0, 2.0),
+        _rec("k", "kid", 0.5, 1.0, parent="r", peer="p2",
+             status="error"),
+    ]
+    roots, children, _ = assemble_tree(spans)
+    lines = render_waterfall(roots, children, width=20)
+    assert len(lines) == 3                     # header + 2 spans
+    assert "SPAN" in lines[0] and "PEER" in lines[0]
+    assert lines[1].startswith("root")
+    assert lines[2].lstrip().startswith("kid")   # indented child
+    assert "=" in lines[1] and "|" in lines[1]
+    assert lines[2].rstrip().endswith("error")   # non-ok status shown
+
+
+# ---- transition span rooting ----
+
+def test_ordinary_transition_span_roots_its_own_trace():
+    """An ordinary transition (sync appointment, async adoption) runs
+    while the evaluate span of the PREVIOUS transition's trace is
+    ambient.  Its state.transition span must root the FRESH trace it
+    mints — a cross-trace parent link would make every normal trace
+    look orphaned in `manatee-adm trace`.  A caller-minted trace (the
+    takeover) keeps the ambient parent: that is the failover root."""
+    from manatee_tpu.obs import bind_parent, bind_trace, get_span_store
+    from manatee_tpu.state.machine import PeerStateMachine
+
+    class ZK:
+        cluster_state = None
+        cluster_state_version = None
+        active = []
+
+        def on(self, *_a):
+            pass
+
+        async def put_cluster_state(self, state, expected_version=None):
+            pass
+
+    class Pg:
+        async def reconfigure(self, cfg):
+            pass
+
+        async def get_xlog_location(self):
+            return "0/0000000"
+
+    sm = PeerStateMachine(zk=ZK(), pg=Pg(),
+                          self_info={"id": "p1", "zoneId": "p1"})
+    store = get_span_store()
+    before = store.spans()
+    since = before[-1]["seq"] if before else 0
+
+    async def go():
+        # ambient context: the previous transition's trace and span
+        with bind_trace("a" * 16), bind_parent("b" * 16):
+            assert await sm._write_state({"generation": 1},
+                                         "adopted async", 0)
+        with bind_trace("c" * 16), bind_parent("d" * 16):
+            assert await sm._write_state({"generation": 2},
+                                         "takeover (primary death)", 0,
+                                         trace_id="c" * 16)
+    asyncio.run(go())
+
+    trans = [s for s in store.spans(since=since)
+             if s["name"] == "state.transition"]
+    assert len(trans) == 2
+    ordinary, takeover = trans
+    assert ordinary["trace"] not in ("a" * 16, None)   # fresh trace
+    assert ordinary["parent"] is None                   # own root
+    assert takeover["trace"] == "c" * 16
+    assert takeover["parent"] == "d" * 16      # under the failover root
+
+
+# ---- the /spans endpoint contract ----
+
+def test_spans_endpoint_content_type_pagination_and_trace_filter():
+    from manatee_tpu.obs import get_span_store, new_trace_id
+    from manatee_tpu.status_server import StatusServer
+
+    async def go():
+        import aiohttp
+
+        store = get_span_store()
+        tid = new_trace_id()
+        first = store.record("stage.one", ts=time.time(), dur=0.01,
+                             trace_id=tid)
+        store.record("stage.two", ts=time.time(), dur=0.02,
+                     trace_id=tid)
+        store.record("other", ts=time.time(), dur=0.03,
+                     trace_id=new_trace_id())
+        open_sp = store.start("inflight", trace_id=tid)
+        srv = StatusServer(host="127.0.0.1", port=0)
+        await srv.start()
+        try:
+            base = "http://127.0.0.1:%d" % srv.port
+            async with aiohttp.ClientSession() as http:
+                async with http.get(base + "/spans?trace=" + tid) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "application/json")
+                    body = await r.json()
+                names = [s["name"] for s in body["spans"]]
+                assert names == ["stage.one", "stage.two"]
+                assert [o["name"] for o in body["open"]
+                        if o["trace"] == tid] == ["inflight"]
+                # since excludes exactly the named seq; limit keeps
+                # the newest
+                async with http.get(
+                        "%s/spans?trace=%s&since=%d"
+                        % (base, tid, first["seq"])) as r:
+                    body = await r.json()
+                assert [s["name"] for s in body["spans"]] == \
+                    ["stage.two"]
+                async with http.get(base + "/spans?limit=1") as r:
+                    body = await r.json()
+                assert len(body["spans"]) == 1
+                # /events sets the explicit content type too
+                async with http.get(base + "/events") as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "application/json")
+                # malformed pagination is a clean 400
+                async with http.get(base + "/spans?since=zap") as r:
+                    assert r.status == 400
+                async with http.get(base + "/events?limit=zap") as r:
+                    assert r.status == 400
+        finally:
+            open_sp.end()
+            await srv.stop()
+
+    run(go())
+
+
+# ---- full stack: the acceptance criterion ----
+
+def test_trace_last_failover_reconstructs_critical_path(tmp_path):
+    """Induced primary failure on the harness: `manatee-adm trace
+    --last-failover` must reassemble ONE rooted cross-peer tree whose
+    spans cover at least the sync and the async, with every parent id
+    resolving, no span left open under the trace, and a critical-path
+    total within 10% of the failover_duration_seconds sample."""
+    async def go():
+        import aiohttp
+
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster)
+
+            primary.kill()
+            await cluster.wait_topology(primary=sync, asyncs=[],
+                                        sync=asyncs[0], timeout=60)
+            await cluster.wait_writable(sync, "post-failover")
+            await asyncio.sleep(0.5)     # let trailing spans commit
+
+            cp = await asyncio.to_thread(
+                subprocess.run,
+                [sys.executable, "-m", "manatee_tpu.cli", "trace",
+                 "--last-failover", "-j"],
+                capture_output=True, text=True, timeout=60,
+                env=cli_env(cluster.coord_connstr))
+            assert cp.returncode == 0, cp.stderr
+            out = json.loads(cp.stdout)
+
+            # a single rooted tree: one root, the failover clock, on
+            # the taking-over sync; zero orphans (every parent id
+            # resolves across the fan-out) and nothing left open
+            assert len(out["roots"]) == 1, out["roots"]
+            assert out["orphans"] == []
+            assert out["open"] == []
+            spans = out["spans"]
+            by_id = {s["span"]: s for s in spans}
+            root = by_id[out["roots"][0]]
+            assert root["name"] == "failover"
+            assert root["peer"] == sync.ident
+            for s in spans:
+                assert s["parent"] is None or s["parent"] in by_id, \
+                    "unresolved parent on %r" % s
+                assert s["dur"] is not None and s["dur"] >= 0
+
+            # cross-peer: the tree contains spans from the sync AND
+            # the async (whose restore the takeover caused)
+            peers = {s["peer"] for s in spans}
+            assert {sync.ident, asyncs[0].ident} <= peers, peers
+            names = {s["name"] for s in spans}
+            assert {"state.transition", "state.evaluate",
+                    "pg.reconfigure"} <= names, names
+
+            # critical path total vs the SLI sample on the new primary
+            async with aiohttp.ClientSession() as http:
+                async with http.get("http://127.0.0.1:%d/metrics"
+                                    % sync.status_port) as r:
+                    fams = parse_exposition(await r.text())
+            fam = fams["manatee_failover_duration_seconds"]
+            total = [float(v) for n, _l, v in fam["samples"]
+                     if n.endswith("_sum")][0]
+            count = [float(v) for n, _l, v in fam["samples"]
+                     if n.endswith("_count")][0]
+            assert count >= 1
+            sample = total / count
+            cp_total = out["critical_path"]["total_s"]
+            assert abs(cp_total - sample) <= 0.1 * max(sample, cp_total), \
+                "critical path %.3fs vs SLI %.3fs" % (cp_total, sample)
+            # and the per-stage percentages account for the window
+            pcts = sum(s["pct"]
+                       for s in out["critical_path"]["stages"])
+            assert 95.0 <= pcts <= 105.0
+
+            # the human rendering carries the waterfall + critical path
+            cp2 = await asyncio.to_thread(
+                subprocess.run,
+                [sys.executable, "-m", "manatee_tpu.cli", "trace",
+                 root["trace"]],
+                capture_output=True, text=True, timeout=60,
+                env=cli_env(cluster.coord_connstr))
+            assert cp2.returncode == 0, cp2.stderr
+            assert "critical path" in cp2.stdout
+            assert "failover" in cp2.stdout
+        finally:
+            await cluster.stop()
+
+    run(go())
