@@ -8,7 +8,8 @@ PYTHON ?= python3
 .DELETE_ON_ERROR:
 
 .PHONY: all test test-unit test-integ test-integ-postgres lint bench \
-    devcluster native clean modelcheck chaos chaos-postgres man \
+    devcluster native clean modelcheck chaos chaos-postgres \
+    chaos-partition man \
     train-health eval-recorded
 
 all: lint test
@@ -49,6 +50,13 @@ chaos:
 
 chaos-postgres:
 	MANATEE_CHAOS=1 MANATEE_ENGINE=postgres \
+	    $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
+
+# the same storm + live asymmetric network partitions armed through
+# `manatee-adm fault` (docs/fault-injection.md), with the continuous
+# split-brain probe
+chaos-partition:
+	MANATEE_CHAOS=1 MANATEE_CHAOS_PARTITION=1 \
 	    $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
 
 # reproduces the packaged weights: synthetic degradation batches plus

@@ -815,6 +815,42 @@ class AdmClient:
             peers.setdefault(a["id"], ent)
         return peers
 
+    @staticmethod
+    def peer_http_targets(peers: dict[str, dict], *,
+                          include_backup: bool = False
+                          ) -> tuple[list[tuple[str, str]],
+                                     dict[str, str]]:
+        """THE peer→HTTP mapping, shared by every fan-out (/events,
+        /spans, /faults): (label, base URL) of each peer's status
+        server (pgPort+1) — plus its backupserver (label 'id/backup')
+        when *include_backup* — and an errors map for peers that could
+        not be mapped (unsupported pgUrl), so no fan-out can silently
+        skip a peer."""
+        targets: list[tuple[str, str]] = []
+        errors: dict[str, str] = {}
+        for p in peers.values():
+            try:
+                _s, host, pg_port = parse_pg_url(p.get("pgUrl") or "")
+            except PgError:
+                errors[p["id"]] = ("unsupported pgUrl %r"
+                                   % p.get("pgUrl"))
+                continue
+            targets.append((p["id"],
+                            "http://%s:%d" % (host, pg_port + 1)))
+            if include_backup:
+                # a separate daemon (the backup sender's spans and
+                # stream faults live there, not in the sitter); a peer
+                # record WITHOUT a backupUrl is reported, not silently
+                # skipped — its backupserver could still hold armed
+                # rules a shard-wide clear must not miss
+                if p.get("backupUrl"):
+                    targets.append((p["id"] + "/backup",
+                                    p["backupUrl"].rstrip("/")))
+                else:
+                    errors[p["id"] + "/backup"] = \
+                        "peer record has no backupUrl"
+        return targets, errors
+
     async def _fan_out(self, peers: dict[str, dict], path: str,
                        keys: tuple[str, ...], *, timeout: float,
                        query: str = "",
@@ -827,7 +863,9 @@ class AdmClient:
         import aiohttp
 
         out: dict[str, list] = {k: [] for k in keys}
-        errors: dict[str, str] = {}
+        targets, errors = self.peer_http_targets(
+            peers, include_backup=include_backup)
+        by_label = {p["id"]: p for p in peers.values()}
 
         async def fetch(peer: dict, url: str, err_key: str,
                         http) -> None:
@@ -853,30 +891,12 @@ class AdmClient:
                         ent["peer"] = peer["id"]
                     out[key].append(ent)
 
-        jobs = []
         http_timeout = aiohttp.ClientTimeout(total=timeout)
         async with aiohttp.ClientSession(timeout=http_timeout) as http:
-            for peer in peers.values():
-                try:
-                    _s, host, pg_port = parse_pg_url(
-                        peer.get("pgUrl") or "")
-                except PgError:
-                    errors[peer["id"]] = ("unsupported pgUrl %r"
-                                          % peer.get("pgUrl"))
-                    continue
-                jobs.append(fetch(
-                    peer,
-                    "http://%s:%d%s%s" % (host, pg_port + 1, path,
-                                          query),
-                    peer["id"], http))
-                if include_backup and peer.get("backupUrl"):
-                    # the backup sender's spans live in the
-                    # backupserver daemon, a separate process
-                    jobs.append(fetch(
-                        peer,
-                        peer["backupUrl"].rstrip("/") + path + query,
-                        peer["id"] + "/backup", http))
-            await asyncio.gather(*jobs)
+            await asyncio.gather(*(
+                fetch(by_label[label.split("/", 1)[0]],
+                      base + path + query, label, http)
+                for label, base in targets))
         return out, errors
 
     async def shard_events(self, shard: str, *,
@@ -923,6 +943,60 @@ class AdmClient:
             opens = [o for o in opens if o.get("trace") == trace]
         return {"spans": merge_events(got["spans"]), "open": opens,
                 "errors": errors}
+
+    # -- live fault injection (manatee-adm fault set|list|clear) --
+
+    async def fault_targets(self, shard: str, *,
+                            zonename: str | None = None,
+                            backup: bool = False
+                            ) -> tuple[list[tuple[str, str]],
+                                       dict[str, str]]:
+        """(label, base URL) of every targeted peer's status server —
+        plus its backupserver when *backup* — resolved from the durable
+        topology + election via the same mapping the /events and /spans
+        fan-outs use.  *zonename* (a zoneId or full peer id) narrows to
+        one peer.  Unmappable peers come back in the errors map: a
+        shard-wide `fault clear` must never silently skip a peer that
+        could still be armed."""
+        peers = await self._shard_peers(shard)
+        if zonename is not None:
+            peers = {pid: p for pid, p in peers.items()
+                     if zonename in (p.get("zoneId"), p["id"])}
+            if not peers:
+                raise AdmError("no peer matches %r" % zonename)
+        return self.peer_http_targets(peers, include_backup=backup)
+
+    @staticmethod
+    async def fault_request(targets: list[tuple[str, str]],
+                            method: str, *, payload: dict | None = None,
+                            query: str = "",
+                            timeout: float = 5.0) -> dict[str, dict]:
+        """Issue one /faults request per (label, base URL); returns
+        {label: body-or-{"error": ...}}."""
+        import aiohttp
+
+        out: dict[str, dict] = {}
+
+        async def one(label: str, base: str, http) -> None:
+            try:
+                async with http.request(
+                        method, base + "/faults" + query,
+                        json=payload) as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        body = {"error": body.get("error")
+                                or ("HTTP %d" % resp.status)}
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                body = {"error": str(e) or type(e).__name__}
+            out[label] = body
+
+        http_timeout = aiohttp.ClientTimeout(total=timeout)
+        async with aiohttp.ClientSession(timeout=http_timeout) as http:
+            await asyncio.gather(*(one(label, base, http)
+                                   for label, base in targets))
+        return out
 
     async def last_failover_trace(self, shard: str, *,
                                   timeout: float = 5.0) -> str:

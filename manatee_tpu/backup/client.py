@@ -26,6 +26,7 @@ import logging
 
 import aiohttp
 
+from manatee_tpu import faults
 from manatee_tpu.obs import (
     current_span_id,
     current_trace,
@@ -49,15 +50,28 @@ def _iso_now() -> str:
 class RestoreClient:
     def __init__(self, storage: StorageBackend, *, dataset: str,
                  mountpoint: str, listen_host: str = "127.0.0.1",
-                 listen_port: int = 0, poll_interval: float = 1.0):
+                 listen_port: int = 0, poll_interval: float = 1.0,
+                 http_connect_timeout: float = 10.0,
+                 http_read_timeout: float = 30.0):
         """*listen_host/port*: where the sender connects back (the
-        zfsHost/zfsPort of etc/sitter.json)."""
+        zfsHost/zfsPort of etc/sitter.json).
+
+        *http_connect_timeout*/*http_read_timeout*: per-socket budgets
+        for the POST /backup and job-poll requests.  Deliberately NOT a
+        ``total`` budget: a restore session legitimately spans hours,
+        and a whole-request wall-clock cap (the old
+        ``ClientTimeout(total=30)``) killed any transfer whose polling
+        session outlived it — only silence (no connect, no bytes) is
+        evidence of a dead upstream."""
         self.storage = storage
         self.dataset = dataset
         self.mountpoint = mountpoint
         self.listen_host = listen_host
         self.listen_port = listen_port
         self.poll_interval = poll_interval
+        self.http_timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=float(http_connect_timeout),
+            sock_read=float(http_read_timeout))
         self.current_job: dict | None = None   # for GET /restore
         # monotonically numbers restore attempts so observers (the
         # rebuild CLI's RESTORE_RETRIES accounting, lib/adm.js:71) can
@@ -146,6 +160,12 @@ class RestoreClient:
         async def _handle(reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
             try:
+                # drop = the accepted stream is severed before a byte
+                # is consumed: the sender sees a broken pipe, the poll
+                # loop sees its job fail — a died link mid-restore
+                if await faults.point("backup.recv.stream") == "drop":
+                    raise RestoreError(
+                        "receive stream severed (fault)")
                 await self.storage.recv(self.dataset, reader,
                                         progress_cb=progress)
                 if not recv_done.done():
@@ -186,7 +206,14 @@ class RestoreClient:
                                             self.listen_port)
         port = server.sockets[0].getsockname()[1]
         try:
-            async with aiohttp.ClientSession() as http:
+            async with aiohttp.ClientSession(
+                    timeout=self.http_timeout) as http:
+                if await faults.point("backup.post") == "drop":
+                    # black-holed request: what the sock_connect budget
+                    # would surface for an unreachable backup server
+                    raise asyncio.TimeoutError(
+                        "POST %s/backup black-holed (fault)"
+                        % backup_url.rstrip("/"))
                 async with http.post(
                         backup_url.rstrip("/") + "/backup",
                         json={"host": self.listen_host, "port": port,
@@ -194,8 +221,7 @@ class RestoreClient:
                               # observability identity: the sender's
                               # span parents under our receive span
                               "trace": current_trace(),
-                              "span": current_span_id()},
-                        timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                              "span": current_span_id()}) as resp:
                     if resp.status != 201:
                         raise RestoreError(
                             "backup request refused: %d %s"
@@ -212,9 +238,8 @@ class RestoreClient:
                         break
                     try:
                         async with http.get(
-                                backup_url.rstrip("/") + job_path,
-                                timeout=aiohttp.ClientTimeout(
-                                    total=10)) as jr:
+                                backup_url.rstrip("/")
+                                + job_path) as jr:
                             remote = await jr.json()
                     except (aiohttp.ClientError,
                             asyncio.TimeoutError) as e:

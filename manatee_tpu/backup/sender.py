@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
 from manatee_tpu.obs import bind_parent, bind_trace, span
 from manatee_tpu.storage.base import StorageBackend, StorageError
@@ -71,6 +72,11 @@ class BackupSender:
             # bounded connect: a requester that vanished between the
             # POST and our dial must fail the job, not wedge the send
             # loop
+            if await faults.point("backup.send.connect") == "drop":
+                # black-holed SYN: what the bounded dial would yield
+                raise asyncio.TimeoutError(
+                    "dial-back to %s:%d black-holed (fault)"
+                    % (job.host, job.port))
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(job.host, job.port),
                 CONNECT_TIMEOUT)
@@ -81,6 +87,9 @@ class BackupSender:
                     job.size = total
 
             try:
+                # stall = a wedged send stream the receiver's poll loop
+                # must notice; error fails the job like a died pipe
+                await faults.point("backup.send.stream")
                 await self.storage.send(self.dataset, snap.name, writer,
                                         progress_cb=progress)
                 writer.close()
@@ -88,7 +97,12 @@ class BackupSender:
                     await writer.wait_closed()
                 except (ConnectionError, OSError):
                     pass
-            except StorageError:
+            except asyncio.CancelledError:
+                writer.close()
+                raise
+            except Exception:
+                # StorageError, or an injected stream fault: either way
+                # the half-sent socket must not leak with the job
                 writer.close()
                 raise
             job.done = True

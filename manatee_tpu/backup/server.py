@@ -17,6 +17,7 @@ import logging
 
 from aiohttp import web
 
+from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
 from manatee_tpu.obs import get_span_store
 from manatee_tpu.obs.spans import spans_http_reply
@@ -35,6 +36,9 @@ class BackupRestServer:
         app.router.add_post("/backup", self._post_backup)
         app.router.add_get("/backup/{uuid}", self._get_backup)
         app.router.add_get("/spans", self._spans)
+        # the backupserver daemon's own registry (the sender's stream
+        # faults live in THIS process, not the sitter)
+        faults.attach_http(app)
         self._app = app
 
     async def start(self) -> None:

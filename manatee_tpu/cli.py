@@ -667,6 +667,139 @@ def cmd_trace(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_fault(args) -> int:
+    """Live fault injection (docs/fault-injection.md): arm, list, and
+    clear named-failpoint rules on the shard's daemons over their
+    ``/faults`` endpoints.  ``set`` arms spec strings
+    (``point=action[:arg][,k=v...]``) on ONE peer (-n) or an explicit
+    --url (e.g. coordd's metrics listener); ``list``/``clear`` default
+    to the whole shard.  Specs come right after the verb, flags last
+    (argparse cannot resume the spec list after an optional).  The
+    partition drill in the docs is two specs::
+
+        manatee-adm fault set coord.client.connect=drop \\
+            coord.client.send=drop -n peer1
+    """
+    from manatee_tpu.faults import CATALOG, FaultSpecError, validate_spec
+
+    async def go():
+        if args.verb == "set":
+            if not args.args:
+                die("fault set requires at least one spec "
+                    "(point=action[:arg][,k=v...])")
+            for spec in args.args:
+                # fail fast with the FULL arm-time checks (catalog
+                # membership included), before any arming anywhere
+                try:
+                    validate_spec(spec)
+                except FaultSpecError as e:
+                    die(str(e))
+            if not args.url and not args.zonename:
+                die("fault set requires a target: -n ZONENAME (one "
+                    "peer) or --url (one server)")
+        elif args.verb == "clear":
+            if len(args.args) > 1:
+                die("fault clear takes at most one point name")
+            if args.args and args.args[0] not in CATALOG:
+                # same typo protection as set: a mistyped heal that
+                # clears nothing while exiting 0 leaves the fault armed
+                die("unknown failpoint %r (see docs/fault-injection.md)"
+                    % args.args[0])
+        elif args.args:
+            die("fault list takes no positional arguments")
+        if args.url and (args.zonename or args.backup):
+            # silently preferring one target would leave the operator
+            # believing the other was armed
+            die("--url conflicts with -n/--backup: name exactly one "
+                "target")
+
+        skipped: dict = {}
+        if args.url:
+            targets = [(args.url, args.url.rstrip("/"))]
+        else:
+            async with AdmClient(_coord(args)) as adm:
+                targets, skipped = await adm.fault_targets(
+                    _shard(args), zonename=args.zonename,
+                    backup=args.backup or args.verb != "set")
+        if not targets:
+            die("no targetable peer%s"
+                % ("".join("; %s: %s" % kv
+                           for kv in sorted(skipped.items()))))
+
+        if args.verb == "set":
+            results = await AdmClient.fault_request(
+                targets, "POST", payload={"specs": list(args.args)})
+        elif args.verb == "clear":
+            q = "?point=%s" % args.args[0] if args.args else ""
+            results = await AdmClient.fault_request(targets, "DELETE",
+                                                    query=q)
+        else:
+            results = await AdmClient.fault_request(targets, "GET")
+        # unmappable peers surface as errors (nonzero exit): a clear
+        # that skipped a peer may have left it armed
+        results.update({label: {"error": why}
+                        for label, why in skipped.items()})
+
+        if args.json:
+            print(json.dumps(results, indent=2, sort_keys=True))
+            return 0 if not any("error" in b for b in results.values()) \
+                else 1
+
+        rc = 0
+        if args.verb == "list":
+            cols = [
+                {"name": "target", "label": "TARGET", "width": 27},
+                {"name": "point", "label": "POINT", "width": 22},
+                {"name": "action", "label": "ACTION", "width": 7},
+                {"name": "hits", "label": "HITS", "width": 5},
+                {"name": "count", "label": "COUNT", "width": 5},
+                {"name": "prob", "label": "PROB", "width": 5},
+                {"name": "source", "label": "SOURCE", "width": 7},
+            ]
+            rows = []
+            for label in sorted(results):
+                body = results[label]
+                if "error" in body:
+                    sys.stderr.write("warning: %s: %s\n"
+                                     % (label, body["error"]))
+                    rc = 1
+                    continue
+                for r in body.get("armed") or []:
+                    rows.append({
+                        "target": label,
+                        "point": r["point"],
+                        "action": (r["action"] + ("!" if r["exhausted"]
+                                                  else "")),
+                        "hits": r["hits"],
+                        "count": ("-" if r["count"] is None
+                                  else r["count"]),
+                        "prob": ("-" if r["prob"] is None
+                                 else "%.2f" % r["prob"]),
+                        "source": r["source"],
+                    })
+            if rows:
+                emit_table(cols, rows, omit_header=args.omit_header)
+            else:
+                print("no faults armed on %d target(s)" % len(targets))
+            return rc
+
+        for label in sorted(results):
+            body = results[label]
+            if "error" in body:
+                sys.stderr.write("error: %s: %s\n"
+                                 % (label, body["error"]))
+                rc = 1
+            elif args.verb == "set":
+                for r in body.get("armed") or []:
+                    print("%s: armed %s -> %s (rule %d)"
+                          % (label, r["point"], r["action"], r["id"]))
+            else:
+                print("%s: cleared %d rule(s)"
+                      % (label, body.get("cleared", 0)))
+        return rc
+    return asyncio.run(go())
+
+
 def cmd_rebuild(args) -> int:
     """Guarded rebuild flow (lib/adm.js:1319-1684): refuse on the
     primary; deposed peers get their dataset destroyed and their deposed
@@ -920,6 +1053,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help='sort field: "zkSeq" (default) or "time"')
     sp.add_argument("-v", "--verbose", action="store_true",
                     help="include the per-transition SUMMARY column")
+
+    sp = add("fault", cmd_fault,
+             "arm/list/clear live fault injection on the shard")
+    sp.add_argument("verb", choices=["set", "list", "clear"],
+                    help="set = arm specs on one target; list/clear = "
+                         "whole shard by default")
+    sp.add_argument("args", nargs="*",
+                    help="set: spec strings "
+                         "(point=action[:arg][,k=v...]); clear: an "
+                         "optional point name")
+    sp.add_argument("-n", "--zonename", default=None,
+                    help="target one peer (zoneId or full peer id)")
+    sp.add_argument("--backup", action="store_true",
+                    help="for set: also arm the peer's backupserver "
+                         "process (list/clear always include it)")
+    sp.add_argument("--url", default=None,
+                    help="target one server directly, e.g. coordd's "
+                         "metrics listener http://host:port")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
 
     sp = add("rebuild", cmd_rebuild, "rebuild this peer from upstream")
     sp.add_argument("-c", "--config",

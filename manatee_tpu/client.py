@@ -17,6 +17,7 @@ from typing import Callable
 
 from manatee_tpu.coord.api import CoordError, NoNodeError
 from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.utils.retry import Backoff
 
 log = logging.getLogger("manatee.client")
 
@@ -89,6 +90,12 @@ class ManateeClient:
             await self._client.close()
 
     async def _run(self) -> None:
+        # jittered exponential backoff between (re)connect attempts: a
+        # coordd outage ends with every database client in the fleet
+        # re-dialing, and the old fixed 1s sleep made them hammer the
+        # recovering daemon in lockstep — the thundering herd the
+        # shared retry policy exists to break
+        bo = Backoff("client.reconnect", base=0.5, cap=10.0)
         while not self._closed:
             client = None
             try:
@@ -99,11 +106,17 @@ class ManateeClient:
                 expired = asyncio.Event()
                 client.on_session_event(
                     lambda ev: expired.set() if ev == "expired" else None)
-                await self._watch_loop(client, expired)
+                # the backoff resets only once the session demonstrably
+                # SERVES (a first successful read inside the watch
+                # loop) — resetting on mere connect would let a coordd
+                # that accepts sessions and then dies keep the whole
+                # fleet re-dialing at base cadence
+                await self._watch_loop(client, expired, bo)
             except asyncio.CancelledError:
                 return
             except (CoordError, OSError) as e:
-                log.warning("client coordination error: %s; retrying", e)
+                log.warning("client coordination error: %s; retrying "
+                            "(attempt %d)", e, bo.attempts + 1)
                 self._emit("error", e)
             finally:
                 if client is not None:
@@ -111,10 +124,11 @@ class ManateeClient:
                         await client.close()
                     except (CoordError, OSError):
                         pass
-            await asyncio.sleep(1.0)
+            await bo.sleep()
 
     async def _watch_loop(self, client: NetCoord,
-                          expired: asyncio.Event) -> None:
+                          expired: asyncio.Event,
+                          bo: Backoff | None = None) -> None:
         while not self._closed and not expired.is_set():
             changed = asyncio.Event()
             try:
@@ -123,10 +137,16 @@ class ManateeClient:
             except NoNodeError:
                 stat = await client.exists(self._path,
                                            watch=lambda e: changed.set())
+                if bo is not None:
+                    bo.reset()   # the session answered; it serves
                 if stat is None:
                     await self._wait_either(changed, expired)
                     continue
                 data, _v = await client.get(self._path)
+            # first successful read: the session serves, so the next
+            # failure's backoff schedule starts from the base again
+            if bo is not None:
+                bo.reset()
             try:
                 state = json.loads(data.decode())
                 urls = topology_urls(state)

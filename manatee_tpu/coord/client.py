@@ -39,6 +39,7 @@ from manatee_tpu.coord.api import (
     WatchCb,
     WatchEvent,
 )
+from manatee_tpu import faults
 from manatee_tpu.obs import (
     current_span_id,
     current_trace,
@@ -46,6 +47,7 @@ from manatee_tpu.obs import (
     get_registry,
     get_span_store,
 )
+from manatee_tpu.utils.retry import Backoff
 
 log = logging.getLogger("manatee.coord.client")
 
@@ -69,6 +71,17 @@ _ERRS = {
 
 HANDSHAKE_TIMEOUT = 5.0
 MAX_LINE = 8 * 1024 * 1024  # must match coordd's stream limit
+
+
+def _reply_deadline(session_timeout: float) -> float:
+    """Client-side bound on any RPC reply.  A request whose reply never
+    arrives — a one-way partition where our frames reach the server
+    (keeping the session alive!) but its replies vanish — would
+    otherwise pin the caller forever: the server sees heartbeats, so
+    NEITHER side ever detects the partition.  ZooKeeper clients bound
+    replies the same way.  Generous (never below 2x the handshake
+    budget): false positives sever a healthy stream."""
+    return max(session_timeout, 2 * HANDSHAKE_TIMEOUT)
 
 
 def parse_connstr(connstr: str, default_port: int = 2281
@@ -200,6 +213,12 @@ class NetCoord(CoordClient):
 
     async def _open_conn(self, resume: bool) -> None:
         host, port = self._addrs[self._addr_idx]
+        if await faults.point("coord.client.connect") == "drop":
+            # black-holed SYN: indistinguishable from an unreachable
+            # route — the partition primitive for (re)connects
+            self._rotate()
+            raise ConnectionLossError(
+                "connect to %s:%d black-holed (fault)" % (host, port))
         try:
             # bounded: a SYN into a blackholed route would otherwise pin
             # the connect for kernel-retry minutes
@@ -329,6 +348,8 @@ class NetCoord(CoordClient):
                     msg = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if await faults.point("coord.client.recv") == "drop":
+                    continue    # the frame vanished in flight
                 if "watch" in msg:
                     self._deliver_watch(msg["watch"])
                     continue
@@ -354,8 +375,15 @@ class NetCoord(CoordClient):
 
     async def _reconnect(self) -> None:
         deadline = time.monotonic() + self._timeout
+        # jittered backoff, floored at the reconnect delay and bounded
+        # by the session deadline: a coordd outage must not have every
+        # client in the shard redialing in lockstep (thundering herd),
+        # and the first attempt still lands well inside any
+        # disconnect_grace (first delay <= 2 * RECONNECT_DELAY)
+        bo = Backoff("coord.reconnect", base=RECONNECT_DELAY, cap=2.0,
+                     deadline=deadline)
         while not self._closed and time.monotonic() < deadline:
-            await asyncio.sleep(RECONNECT_DELAY)
+            await bo.sleep()
             try:
                 await self._open_conn(resume=True)
             except (ConnectionLossError, NotLeaderError, OSError):
@@ -446,12 +474,45 @@ class NetCoord(CoordClient):
         t0_wall = time.time()
         try:
             try:
-                self._writer.write((json.dumps(req) + "\n").encode())
-                await self._writer.drain()
+                if await faults.point("coord.client.send") == "drop":
+                    # black-holed frame: we believe it was sent; the
+                    # reply never comes.  The caller blocks until the
+                    # server heartbeat-expires the silent session and
+                    # severs us (or, failing that, until our own reply
+                    # deadline below) — exactly an asymmetric partition.
+                    pass
+                else:
+                    self._writer.write(
+                        (json.dumps(req) + "\n").encode())
+                    await self._writer.drain()
             except (ConnectionError, RuntimeError) as e:
                 self._pending.pop(xid, None)
                 raise ConnectionLossError(str(e)) from None
-            msg = await fut
+            except BaseException:
+                # anything else out of the send path (an injected
+                # coord.client.send=error, a cancellation) must not
+                # strand the xid in _pending for the connection's life
+                self._pending.pop(xid, None)
+                raise
+            try:
+                msg = await asyncio.wait_for(
+                    fut, _reply_deadline(self._timeout))
+            except asyncio.TimeoutError:
+                # reply never came while the connection looks healthy:
+                # a one-way partition (or a wedged server).  Sever the
+                # transport so the read loop unwinds into the normal
+                # disconnect/reconnect path — our FIN also lets the
+                # server apply its fast disconnect-grace expiry.
+                self._pending.pop(xid, None)
+                writer = self._writer
+                if writer is not None:
+                    try:
+                        writer.transport.abort()
+                    except (AttributeError, RuntimeError):
+                        pass
+                raise ConnectionLossError(
+                    "no reply to %s within %.1fs (one-way partition?)"
+                    % (op, _reply_deadline(self._timeout))) from None
         except BaseException as e:
             if op != "ping":
                 get_span_store().record(

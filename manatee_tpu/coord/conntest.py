@@ -10,18 +10,29 @@ Usage: python -m manatee_tpu.coord.conntest HOST:PORT
 from __future__ import annotations
 
 import asyncio
+import itertools
+import os
 import sys
-import time
+import uuid
 
 from manatee_tpu.coord.api import NodeExistsError
 from manatee_tpu.coord.client import NetCoord
+
+# pid + per-process counter + a random component: the old
+# epoch-millisecond name collided whenever two probes (a provisioning
+# script fanning out) landed in the same millisecond, and pid alone
+# still collides across pid namespaces (two containers both probing as
+# pid 1).  The random suffix makes the path unique for the probe's
+# whole lifetime, so no probe can delete another's scratch node.
+_probe_seq = itertools.count(1)
 
 
 async def conntest(addr: str, timeout: float = 10.0) -> None:
     host, _, port = addr.partition(":")
     client = NetCoord(host, int(port or 2281), session_timeout=10)
     await asyncio.wait_for(client.connect(), timeout)
-    path = "/conntest-%d" % int(time.time() * 1000)
+    path = "/conntest-%d-%d-%s" % (os.getpid(), next(_probe_seq),
+                                   uuid.uuid4().hex[:8])
     try:
         await client.create(path, b"ping", ephemeral=True)
     except NodeExistsError:

@@ -29,6 +29,7 @@ import json
 import logging
 from typing import Awaitable, Callable
 
+from manatee_tpu import faults
 from manatee_tpu.coord.api import (
     ConnectionLossError,
     CoordClient,
@@ -39,10 +40,15 @@ from manatee_tpu.coord.api import (
 )
 from manatee_tpu.obs import get_journal
 from manatee_tpu.utils.aio import cancel_requests
+from manatee_tpu.utils.retry import Backoff, backoff_sleep
 
 log = logging.getLogger("manatee.coord")
 
-RETRY_DELAY = 5.0  # re-register backoff on watch errors (zookeeperMgr.js:253)
+# cap of the re-register/setup backoff on watch and session errors
+# (zookeeperMgr.js:253 hardwires a fixed 5s; here it is the CEILING of
+# a jittered exponential schedule so a coordd outage is not followed by
+# every peer re-registering in lockstep)
+RETRY_DELAY = 5.0
 
 
 def parse_and_unique_actives(names: list[str]) -> list[dict]:
@@ -288,6 +294,7 @@ class ConsensusMgr:
         self._generation_of_setup += 1
         gen = self._generation_of_setup
         self._ready = False
+        bo = Backoff("coord.setup", base=0.5, cap=RETRY_DELAY)
         while not self._closed:
             client = None
             try:
@@ -333,9 +340,9 @@ class ConsensusMgr:
                         await client.close()
                     except (CoordError, OSError):
                         pass
-                log.warning("coord setup failed (%s); retrying in %.1fs",
-                            e, RETRY_DELAY)
-                await asyncio.sleep(RETRY_DELAY)
+                log.warning("coord setup failed (%s); retrying "
+                            "(attempt %d)", e, bo.attempts + 1)
+                await bo.sleep()
 
     def _schedule_resetup(self) -> None:
         if self._setup_task and not self._setup_task.done():
@@ -399,11 +406,15 @@ class ConsensusMgr:
                                     handler.__name__, e)
                         retry = True
                 if retry:
-                    # sleep OUTSIDE the lock: holding it for RETRY_DELAY
+                    # sleep OUTSIDE the lock: holding it for the delay
                     # would stall every other watch handler (e.g. the
                     # activeChange that kicks a takeover) behind one
-                    # failing re-read
-                    await asyncio.sleep(RETRY_DELAY)
+                    # failing re-read.  RETRY_DELAY plus up-to-one-
+                    # delay of jitter: decorrelated across the shard,
+                    # never retrying FASTER than the reference's fixed
+                    # schedule against a struggling coordd.
+                    await backoff_sleep("coord.watch_rearm",
+                                        RETRY_DELAY)
                     fired(None)
 
             t = asyncio.create_task(rearm())
@@ -519,6 +530,10 @@ class ConsensusMgr:
             raise ConnectionLossError("not connected")
         if "generation" not in state:
             raise CoordError("cluster state requires a generation")
+        # the durable-write seam: error/delay/stall here models a
+        # coordination service that stops accepting (or slows) the one
+        # write HA correctness rides on
+        await faults.point("coord.put_state")
         version = (expected_version if expected_version is not None
                    else self._cluster_state_version)
         res = await self._client.multi(cluster_state_txn(

@@ -91,6 +91,7 @@ import signal
 import time
 from pathlib import Path
 
+from manatee_tpu import faults
 from manatee_tpu.coord import model
 from manatee_tpu.coord.api import (
     RECONNECT_DELAY,
@@ -593,6 +594,10 @@ class CoordServer:
             line = (json.dumps({"seq": seq, "req": wire,
                                 "expect": expect}) + "\n").encode()
             try:
+                # error:OSError here injects a failed disk write at THE
+                # durability point, exercising the synchronous-snapshot
+                # fallback and the refuse-writes-when-broken contract
+                await faults.point("coordd.oplog.append")
                 if self._oplog_fh is None:
                     path = self._segment_path(seq)
 
@@ -913,6 +918,7 @@ class CoordServer:
         app = web.Application()
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/spans", spans)
+        faults.attach_http(app)
         self._metrics_runner = web.AppRunner(app)
         await self._metrics_runner.setup()
         site = web.TCPSite(self._metrics_runner, self.host,
@@ -1089,6 +1095,10 @@ class CoordServer:
         xid = req.get("xid")
         op = req.get("op")
         try:
+            # server-side black hole: the request is consumed but never
+            # answered — the client's frame hangs like a dropped packet
+            if await faults.point("coordd.dispatch") == "drop":
+                return
             if op == "sync_ack":
                 # follower ack of a replicated op/snapshot: resolve the
                 # waiters, no reply (acks must not generate traffic).

@@ -35,6 +35,13 @@ async def start_backupserver(cfg: dict):
     # build_ident), so this process's backup.send spans merge under
     # the peer's identity in the `manatee-adm trace` fan-out
     set_peer(build_ident(cfg)["id"])
+    # boot-time fault arming for THIS process (the sender's stream
+    # faults live here, not in the sitter); runtime arming needs the
+    # same explicit opt-in as the sitter
+    from manatee_tpu import faults
+    faults.arm_specs(cfg.get("faults"), source="config")
+    if cfg.get("faultsEnabled"):
+        faults.enable_http()
     storage = build_storage(cfg)
     queue = BackupQueue()
     server = BackupRestServer(queue,

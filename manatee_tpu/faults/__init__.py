@@ -1,0 +1,608 @@
+"""Live fault injection: a process-wide registry of named failpoints.
+
+The model checker (`state/modelcheck.py`) explores partitions and
+stalls in a simulated world; this package is the LIVE-stack
+counterpart: every real I/O seam — coord RPC framing, the backup
+stream, pg manager transitions, storage commands, the durable
+cluster-state write — calls :func:`point` with a name from
+:mod:`manatee_tpu.faults.catalog`, and operators/tests arm faults
+against those names to reproduce the ugly failure modes SIGKILL cannot:
+alive-but-unreachable peers, slow links, stalled transfers, failed disk
+writes.
+
+Actions (per armed rule):
+
+- ``error``   raise a typed exception (``error:<TypeName>``; default
+  :class:`FaultError`) — the call site's own handling then runs;
+- ``delay``   sleep ``delay`` seconds (plus up to ``jitter`` more) and
+  continue — a slow link/disk;
+- ``stall``   block until the rule is cleared — a wedge an operator
+  heals with ``manatee-adm fault clear``;
+- ``drop``    black-hole: :func:`point` returns ``"drop"`` and the call
+  site applies its documented no-bytes-travel behavior (skip the
+  write, discard the frame, refuse the connect).  Arming ``drop`` on
+  the ``coord.client.*`` points of one peer is a live asymmetric
+  network partition: the process stays up, its pg keeps running, but
+  its coordination traffic vanishes — the real-stack analogue of the
+  model checker's ``partition`` scenario.
+
+Triggers compose onto any action: ``count=N`` injects at most N times
+(``count=1`` = one-shot), ``prob=P`` injects each pass with probability
+P.  An exhausted rule stays listed (hits visible) until cleared.
+
+Arming surfaces:
+
+- boot: the ``MANATEE_FAULTS`` environment variable (``;``-separated
+  specs) or a ``faults`` list in the sitter/backupserver config;
+- runtime: ``POST /faults`` on the status server, the backup REST
+  server, and coordd's metrics listener (``GET`` lists, ``DELETE``
+  clears) — each arms the registry of ITS OWN process;
+- operator: ``manatee-adm fault set|list|clear`` fans out over the
+  shard's peers.
+
+Spec syntax (shared by all of the above)::
+
+    <point>=<action>[:<arg>][,<key>=<val>...]
+
+    coord.client.send=drop
+    pg.restore=error:StorageError,count=1
+    coord.client.recv=delay:0.5,jitter=0.3,prob=0.2
+    backup.send.stream=stall
+
+The fast path — no fault armed anywhere — is a None check; a shard
+that never arms anything pays nothing measurable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import time
+
+from manatee_tpu.faults.catalog import CATALOG, actions_for
+from manatee_tpu.obs import get_journal, get_registry
+
+log = logging.getLogger("manatee.faults")
+
+_REG = get_registry()
+_INJECTIONS = _REG.counter(
+    "fault_injections_total",
+    "faults injected at live failpoints", ("point", "action"))
+
+ACTIONS = ("error", "delay", "drop", "stall")
+
+
+class FaultError(Exception):
+    """The default injected error (also the arming-API error type)."""
+
+
+class FaultSpecError(FaultError):
+    """A malformed or uncataloged fault spec."""
+
+
+# error: names resolvable without import cycles; module-path entries
+# resolve lazily at raise time
+_BUILTIN_ERRORS = {
+    "FaultError": lambda: FaultError,
+    "OSError": lambda: OSError,
+    "ConnectionError": lambda: ConnectionError,
+    "ConnectionResetError": lambda: ConnectionResetError,
+    "TimeoutError": lambda: asyncio.TimeoutError,
+}
+_LAZY_ERRORS = {
+    "CoordError": ("manatee_tpu.coord.api", "CoordError"),
+    "ConnectionLossError": ("manatee_tpu.coord.api", "ConnectionLossError"),
+    "PgError": ("manatee_tpu.pg.engine", "PgError"),
+    "StorageError": ("manatee_tpu.storage.base", "StorageError"),
+}
+
+
+def resolve_error(name: str):
+    """The exception class an ``error:<name>`` spec raises."""
+    if name in _BUILTIN_ERRORS:
+        return _BUILTIN_ERRORS[name]()
+    entry = _LAZY_ERRORS.get(name)
+    if entry is None:
+        raise FaultSpecError(
+            "unknown error type %r (known: %s)"
+            % (name, ", ".join(sorted(list(_BUILTIN_ERRORS)
+                                      + list(_LAZY_ERRORS)))))
+    import importlib
+    mod = importlib.import_module(entry[0])
+    return getattr(mod, entry[1])
+
+
+class FaultRule:
+    """One armed fault: an action plus its triggers, bound to a point."""
+
+    __slots__ = ("rule_id", "pt", "action", "error", "delay", "jitter",
+                 "count", "prob", "hits", "armed_at", "source",
+                 "_cleared")
+
+    def __init__(self, rule_id: int, pt: str, action: str, *,
+                 error: str = "FaultError", delay: float = 0.0,
+                 jitter: float = 0.0, count: int | None = None,
+                 prob: float | None = None, source: str = "api"):
+        self.rule_id = rule_id
+        self.pt = pt
+        self.action = action
+        self.error = error
+        self.delay = float(delay)
+        self.jitter = float(jitter)
+        self.count = None if count is None else int(count)
+        self.prob = None if prob is None else float(prob)
+        self.hits = 0
+        self.armed_at = time.time()
+        self.source = source
+        # stall rules block on this; clear() releases them.  Event() is
+        # loop-agnostic at construction (py>=3.10), so env-time arming
+        # (no loop yet) is safe.
+        self._cleared = asyncio.Event()
+
+    @property
+    def exhausted(self) -> bool:
+        return self.count is not None and self.hits >= self.count
+
+    def should_fire(self) -> bool:
+        if self.exhausted:
+            return False
+        if self.prob is not None and random.random() >= self.prob:
+            return False
+        return True
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.rule_id,
+            "point": self.pt,
+            "action": self.action,
+            "error": self.error if self.action == "error" else None,
+            "delay": self.delay if self.action == "delay" else None,
+            "jitter": self.jitter if self.action == "delay" else None,
+            "count": self.count,
+            "prob": self.prob,
+            "hits": self.hits,
+            "exhausted": self.exhausted,
+            "armed_at": round(self.armed_at, 3),
+            "source": self.source,
+        }
+
+
+def parse_spec(spec: str) -> dict:
+    """``point=action[:arg][,k=v...]`` -> arm() kwargs.  Raises
+    :class:`FaultSpecError` with a usable message on any malformation
+    (this surfaces verbatim in the CLI and the HTTP 400)."""
+    spec = spec.strip()
+    pt, sep, rest = spec.partition("=")
+    if not sep or not pt or not rest:
+        raise FaultSpecError(
+            "bad fault spec %r (want point=action[:arg][,k=v...])"
+            % spec)
+    head, *opts = rest.split(",")
+    action, _, arg = head.partition(":")
+    action = action.strip()
+    if action not in ACTIONS:
+        raise FaultSpecError("unknown action %r (one of %s)"
+                             % (action, "/".join(ACTIONS)))
+    kw: dict = {"point": pt.strip(), "action": action}
+    if arg:
+        if action == "error":
+            kw["error"] = arg.strip()
+        elif action == "delay":
+            try:
+                kw["delay"] = float(arg)
+            except ValueError:
+                raise FaultSpecError("bad delay %r" % arg) from None
+        else:
+            raise FaultSpecError("action %r takes no argument" % action)
+    for opt in opts:
+        k, s, v = opt.partition("=")
+        k = k.strip()
+        if not s or k not in ("count", "prob", "delay", "jitter",
+                              "error"):
+            raise FaultSpecError("bad fault option %r" % opt)
+        try:
+            if k == "count":
+                kw[k] = int(v)
+            elif k in ("prob", "delay", "jitter"):
+                kw[k] = float(v)
+            else:
+                kw[k] = v.strip()
+        except ValueError:
+            raise FaultSpecError("bad value for %s: %r" % (k, v)) \
+                from None
+    return kw
+
+
+def validate_arm(*, point: str, action: str,
+                 error: str = "FaultError", delay: float = 0.0,
+                 jitter: float = 0.0, count: int | None = None,
+                 prob: float | None = None) -> None:
+    """Every arm-time check, side-effect free — so batch arming can
+    validate ALL specs before arming ANY (a multi-spec `fault set`
+    with a typo must not leave the target half-armed), and the CLI can
+    fail fast client-side with the same rules.  Options irrelevant to
+    the action are rejected too: a misdirected option means the
+    operator expects behavior the rule will never deliver."""
+    if point not in CATALOG:
+        raise FaultSpecError(
+            "unknown failpoint %r (see docs/fault-injection.md; "
+            "GET /faults lists the catalog)" % point)
+    if action not in ACTIONS:
+        raise FaultSpecError("unknown action %r" % action)
+    if action not in actions_for(point):
+        raise FaultSpecError(
+            "point %r does not support %r (supported: %s)"
+            % (point, action, "/".join(actions_for(point))))
+    if action == "error":
+        resolve_error(error)            # typo protection at arm time
+    elif error != "FaultError":
+        raise FaultSpecError(
+            "error=%s only applies to the error action" % error)
+    if action == "delay":
+        if delay <= 0:
+            raise FaultSpecError("delay must be > 0 (got %r)" % delay)
+        if jitter < 0:
+            raise FaultSpecError("jitter must be >= 0 (got %r)"
+                                 % jitter)
+    elif delay or jitter:
+        raise FaultSpecError(
+            "delay/jitter only apply to the delay action")
+    if count is not None and count < 1:
+        raise FaultSpecError("count must be >= 1")
+    if prob is not None and not (0.0 < prob <= 1.0):
+        raise FaultSpecError("prob must be in (0, 1]")
+
+
+def validate_spec(spec: str) -> dict:
+    """Parse AND fully validate one spec string (catalog membership,
+    supported action, trigger ranges); returns the arm() kwargs."""
+    kw = parse_spec(spec)
+    validate_arm(**kw)
+    return kw
+
+
+class FaultRegistry:
+    """Per-process armed-fault state.  One instance per daemon (see
+    :func:`get_faults`); everything is event-loop-thread confined, like
+    the obs registries."""
+
+    def __init__(self):
+        self._rules: dict[str, list[FaultRule]] = {}
+        self._next_id = 1
+
+    # -- arming --
+
+    def arm(self, *, point: str, action: str, error: str = "FaultError",
+            delay: float = 0.0, jitter: float = 0.0,
+            count: int | None = None, prob: float | None = None,
+            source: str = "api") -> FaultRule:
+        validate_arm(point=point, action=action, error=error,
+                     delay=delay, jitter=jitter, count=count,
+                     prob=prob)
+        rule = FaultRule(self._next_id, point, action, error=error,
+                         delay=delay, jitter=jitter, count=count,
+                         prob=prob, source=source)
+        self._next_id += 1
+        self._rules.setdefault(point, []).append(rule)
+        log.warning("fault armed: %s -> %s (count=%s prob=%s) [%s]",
+                    point, action, count, prob, source)
+        get_journal().record("fault.armed", point=point, action=action,
+                             count=count, prob=prob, source=source)
+        return rule
+
+    def arm_spec(self, spec: str, *, source: str = "api") -> FaultRule:
+        return self.arm(source=source, **parse_spec(spec))
+
+    # -- clearing --
+
+    def clear(self, point: str | None = None,
+              rule_id: int | None = None) -> int:
+        """Disarm rules (all, one point's, or one id); stalled callers
+        are released and proceed.  Returns the number removed."""
+        removed: list[FaultRule] = []
+        for pt in list(self._rules):
+            if point is not None and pt != point:
+                continue
+            keep = []
+            for r in self._rules[pt]:
+                if rule_id is not None and r.rule_id != rule_id:
+                    keep.append(r)
+                else:
+                    removed.append(r)
+            if keep:
+                self._rules[pt] = keep
+            else:
+                del self._rules[pt]
+        for r in removed:
+            r._cleared.set()
+        if removed:
+            get_journal().record(
+                "fault.cleared", point=point or "*",
+                rules=[r.rule_id for r in removed])
+            log.warning("fault cleared: %s (%d rule(s))",
+                        point or "*", len(removed))
+        return len(removed)
+
+    def list(self) -> list[dict]:
+        out = []
+        for pt in sorted(self._rules):
+            out.extend(r.to_dict() for r in self._rules[pt])
+        return out
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._rules.values())
+
+    # -- firing --
+
+    async def fire(self, name: str) -> str:
+        rules = self._rules.get(name)
+        if not rules:
+            return "ok"
+        verdict = "ok"
+        for rule in list(rules):
+            # re-check liveness each pass: an earlier rule's await (a
+            # stall the operator just released, a delay) may have seen
+            # the WHOLE point cleared — a caller released by `fault
+            # clear` must not go on to execute other cleared rules
+            # from the stale snapshot
+            if rule not in self._rules.get(name, ()):
+                continue
+            if not rule.should_fire():
+                continue
+            rule.hits += 1
+            _INJECTIONS.inc(point=name, action=rule.action)
+            if rule.hits == 1:
+                # journal the FIRST hit per rule only: per-frame
+                # failpoints (a partition black-holing every ping, a
+                # delay on every inbound frame) fire many times a
+                # second and would evict real transition/failover
+                # events from the ring — the volume lives in the
+                # fault_injections_total counter instead
+                get_journal().record(
+                    "fault.injected", point=name, action=rule.action)
+            if rule.action == "delay":
+                d = rule.delay
+                if rule.jitter:
+                    d += random.random() * rule.jitter
+                await asyncio.sleep(d)
+            elif rule.action == "stall":
+                log.warning("failpoint %s stalled (rule %d; release "
+                            "with fault clear)", name, rule.rule_id)
+                await rule._cleared.wait()
+            elif rule.action == "error":
+                raise resolve_error(rule.error)(
+                    "injected fault at %s" % name)
+            elif rule.action == "drop":
+                verdict = "drop"
+        return verdict
+
+
+# ---- process singleton ----
+
+_REGISTRY: FaultRegistry | None = None
+
+# Runtime-arming gate: POST/DELETE /faults are refused (403) unless
+# fault injection was explicitly enabled for this process — via
+# MANATEE_FAULTS_ENABLED=1, by ACTUALLY arming something at boot
+# (MANATEE_FAULTS or a config `faults` list: arm_specs calls
+# enable_http only when a spec armed — the mere presence of a refused
+# typo'd spec must not open the surface), or by a config
+# `faultsEnabled: true` (what the test harness sets).  Without the
+# gate every production daemon would ship an unauthenticated
+# wedge-this-shard endpoint on ports dashboards already reach.
+# GET stays open: listing armed rules and the catalog is read-only
+# introspection like /metrics.
+_HTTP_ENABLED = bool(os.environ.get("MANATEE_FAULTS_ENABLED"))
+
+
+def enable_http() -> None:
+    """Opt this process into runtime fault arming (config wiring)."""
+    global _HTTP_ENABLED
+    _HTTP_ENABLED = True
+
+
+def http_arming_enabled() -> bool:
+    return _HTTP_ENABLED
+
+
+def get_faults() -> FaultRegistry:
+    """The process-wide registry (created on first use)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = FaultRegistry()
+    return _REGISTRY
+
+
+async def point(name: str) -> str:
+    """THE failpoint API: call at an I/O seam with a cataloged name.
+    Returns ``"ok"`` (proceed) or ``"drop"`` (the call site applies its
+    documented black-hole behavior); may sleep, stall, or raise per the
+    armed rules.  With nothing armed this is a None check."""
+    reg = _REGISTRY
+    if reg is None or not reg._rules:
+        return "ok"
+    return await reg.fire(name)
+
+
+def _rule_signature(kw: dict) -> tuple:
+    """Dedup key over parsed-spec kwargs AND listed-rule dicts (the
+    latter null out fields irrelevant to the action — normalize both
+    shapes to the arm() defaults)."""
+    return (kw["point"], kw["action"],
+            kw.get("error") or "FaultError",
+            kw.get("delay") or 0.0, kw.get("jitter") or 0.0,
+            kw.get("count"), kw.get("prob"))
+
+
+def arm_specs(specs, *, source: str) -> int:
+    """Arm a batch of spec strings (config/env boot path).  Bad specs
+    are logged and skipped — a typo in a drill config must not keep an
+    HA daemon from booting.  A spec identical to an already-armed live
+    rule is skipped too: MANATEE_FAULTS and a config `faults` list
+    naming the same spec must not stack two rules and inject double
+    what the operator asked for.  Boot-time arming is the opt-in: it
+    also enables the runtime POST/DELETE surface."""
+    reg = get_faults()
+    live = {_rule_signature(r) for r in reg.list()
+            if not r["exhausted"]}
+    n = 0
+    for spec in specs or ():
+        try:
+            kw = parse_spec(str(spec))
+            sig = _rule_signature(kw)
+            if sig in live:
+                log.warning("fault spec %r already armed at boot; "
+                            "not stacking a duplicate", spec)
+                continue
+            reg.arm(source=source, **kw)
+            live.add(sig)
+            n += 1
+        except FaultSpecError as e:
+            log.error("ignoring bad fault spec %r: %s", spec, e)
+    if n:
+        # only ACTUAL arming is the opt-in: a config whose every spec
+        # was refused must not leave the runtime surface open while
+        # the operator believes fault injection failed to engage
+        enable_http()
+    return n
+
+
+def _arm_from_env() -> None:
+    env = os.environ.get("MANATEE_FAULTS")
+    if env:
+        arm_specs([s for s in env.split(";") if s.strip()],
+                  source="env")
+
+
+_arm_from_env()
+
+
+# ---- HTTP glue (shared by the status server, the backup REST server,
+# and coordd's metrics listener — aiohttp stays out of this module) ----
+
+_DISABLED_MSG = ("runtime fault arming is disabled on this daemon; "
+                 "enable with MANATEE_FAULTS_ENABLED=1 (or the "
+                 "`faultsEnabled` config key) and restart")
+
+
+def http_list_reply() -> tuple[dict, int]:
+    """GET /faults payload: armed rules + the full catalog."""
+    return ({
+        "armed": get_faults().list(),
+        "arming_enabled": http_arming_enabled(),
+        "catalog": {name: {"desc": ent[0], "actions": list(ent[2])}
+                    for name, ent in sorted(CATALOG.items())},
+    }, 200)
+
+
+def http_arm_reply(body) -> tuple[dict, int]:
+    """POST /faults body: ``{"spec": "..."}"``, ``{"specs": [...]}``,
+    or explicit fields ``{"point":..., "action":..., ...}``."""
+    if not http_arming_enabled():
+        return {"error": _DISABLED_MSG}, 403
+    if not isinstance(body, dict):
+        return {"error": "body must be a JSON object"}, 400
+    specs: list[str] = []
+    if isinstance(body.get("spec"), str):
+        specs.append(body["spec"])
+    for s in body.get("specs") or []:
+        if isinstance(s, str):
+            specs.append(s)
+    armed = []
+    try:
+        if specs:
+            # validate EVERY spec before arming ANY: a typo in a batch
+            # (e.g. a two-spec partition drill) must not leave the
+            # target half-armed with nothing reporting it
+            parsed = [validate_spec(s) for s in specs]
+            for kw in parsed:
+                armed.append(get_faults().arm(source="http", **kw))
+        elif body.get("point"):
+            kw = {k: body[k]
+                  for k in ("point", "action", "error", "delay",
+                            "jitter", "count", "prob") if k in body}
+            armed.append(get_faults().arm(source="http", **kw))
+        else:
+            return {"error": "provide spec/specs or point+action"}, 400
+    except FaultSpecError as e:
+        return {"error": str(e)}, 400
+    except (TypeError, ValueError) as e:
+        return {"error": "bad arm request: %s" % e}, 400
+    return {"armed": [r.to_dict() for r in armed]}, 200
+
+
+def http_clear_reply(query) -> tuple[dict, int]:
+    """DELETE /faults[?point=NAME][&id=N] — no params clears all."""
+    if not http_arming_enabled():
+        return {"error": _DISABLED_MSG}, 403
+    pt = query.get("point") or None
+    if pt is not None and pt not in CATALOG:
+        # same typo protection as arming, on BOTH surfaces: a 200
+        # {"cleared": 0} for a misspelled heal would leave the fault
+        # armed with the operator believing it healed
+        return {"error": "unknown failpoint %r" % pt}, 400
+    rid = query.get("id")
+    try:
+        rid = int(rid) if rid not in (None, "") else None
+    except ValueError:
+        return {"error": "id must be an integer"}, 400
+    n = get_faults().clear(pt, rule_id=rid)
+    return {"cleared": n}, 200
+
+
+def attach_http(app) -> None:
+    """Register ``GET/POST/DELETE /faults`` on an aiohttp application —
+    the one runtime arming surface, shared verbatim by the status
+    server, the backup REST server, and coordd's metrics listener (each
+    arms the registry of its OWN process)."""
+    from aiohttp import web
+
+    async def faults_get(_req):
+        body, status = http_list_reply()
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def faults_post(req):
+        try:
+            payload = await req.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            payload = None
+        body, status = http_arm_reply(payload)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def faults_delete(req):
+        body, status = http_clear_reply(req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    app.router.add_get("/faults", faults_get)
+    app.router.add_post("/faults", faults_post)
+    app.router.add_delete("/faults", faults_delete)
+
+
+__all__ = [
+    "ACTIONS",
+    "CATALOG",
+    "FaultError",
+    "FaultRegistry",
+    "FaultRule",
+    "FaultSpecError",
+    "arm_specs",
+    "attach_http",
+    "enable_http",
+    "get_faults",
+    "http_arming_enabled",
+    "http_arm_reply",
+    "http_clear_reply",
+    "http_list_reply",
+    "parse_spec",
+    "point",
+    "resolve_error",
+    "validate_arm",
+    "validate_spec",
+]

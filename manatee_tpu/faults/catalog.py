@@ -1,0 +1,155 @@
+"""The failpoint catalog: every named fault-injection seam in the tree.
+
+One entry per point: a human description plus the source files allowed
+to invoke it.  The catalog is the single source of truth three consumers
+share:
+
+- :func:`manatee_tpu.faults.point` refuses to ARM a name that is not
+  here (typo protection: a fault armed against a misspelled point would
+  silently never fire);
+- the ``faultpoint-unregistered`` mnt-lint rule verifies every
+  ``faults.point("...")`` call site names a cataloged point AND lives in
+  the file the catalog binds it to (which is what makes point names
+  globally unique — two seams cannot share a name);
+- ``docs/fault-injection.md`` documents exactly this set, and
+  tests/test_faults.py asserts the doc and the catalog cannot drift.
+
+Keep entries sorted by name.  ``drop`` support is a per-seam property
+(a black hole only means something where bytes travel); the lists here
+say which actions each site honors.
+"""
+
+from __future__ import annotations
+
+# name -> (description, (allowed source files...), (supported actions...))
+# Paths are repo-relative and matched by suffix, so the rule works no
+# matter how the linter was invoked.
+CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
+    "backup.post": (
+        "restore client's POST /backup to the upstream's backup server; "
+        "drop = the request is black-holed (reads as a timeout)",
+        ("manatee_tpu/backup/client.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "backup.recv.stream": (
+        "restore client's inbound snapshot stream, at accept time; "
+        "drop = the accepted connection is severed before any byte "
+        "is consumed",
+        ("manatee_tpu/backup/client.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "backup.send.connect": (
+        "backup sender's dial-back to the requester's receive "
+        "listener; drop = the SYN is black-holed (reads as a connect "
+        "timeout)",
+        ("manatee_tpu/backup/sender.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "backup.send.stream": (
+        "backup sender's snapshot stream, before the first byte; "
+        "stall models a wedged send",
+        ("manatee_tpu/backup/sender.py",),
+        ("error", "delay", "stall"),
+    ),
+    "coord.client.connect": (
+        "sitter-side dial+handshake to coordd; drop = the SYN is "
+        "black-holed (connection loss), the partition primitive",
+        ("manatee_tpu/coord/client.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "coord.client.recv": (
+        "inbound coordd frame delivery (replies and watch pushes); "
+        "drop = the frame vanishes in flight — a ONE-way partition "
+        "(outbound heartbeats keep the session alive) the client "
+        "detects via its reply deadline and severs",
+        ("manatee_tpu/coord/client.py",),
+        ("delay", "drop"),
+    ),
+    "coord.client.send": (
+        "outbound coordd RPC frame write (pings included); drop = the "
+        "frame is black-holed — the session dies of heartbeat silence "
+        "while the process lives, the partition primitive",
+        ("manatee_tpu/coord/client.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "coord.put_state": (
+        "consensus manager's durable cluster-state transaction "
+        "(state + history, one multi)",
+        ("manatee_tpu/coord/manager.py",),
+        ("error", "delay", "stall"),
+    ),
+    "coordd.dispatch": (
+        "coordd server-side request dispatch; drop = the request is "
+        "consumed but never answered",
+        ("manatee_tpu/coord/server.py",),
+        ("error", "delay", "stall", "drop"),
+    ),
+    "coordd.oplog.append": (
+        "coordd durable op-log append (error injects a disk-write "
+        "failure, exercising the synchronous-snapshot fallback)",
+        ("manatee_tpu/coord/server.py",),
+        ("error", "delay", "stall"),
+    ),
+    "pg.catchup": (
+        "primary's wait-for-standby-catchup poll loop (each pass); "
+        "stall keeps the primary read-only — a stalled takeover",
+        ("manatee_tpu/pg/manager.py",),
+        ("error", "delay", "stall"),
+    ),
+    "pg.promote": (
+        "pg manager's primary transition, before promotion",
+        ("manatee_tpu/pg/manager.py",),
+        ("error", "delay", "stall"),
+    ),
+    "pg.repoint": (
+        "standby's live upstream re-point (reload fast path)",
+        ("manatee_tpu/pg/manager.py",),
+        ("error", "delay", "stall"),
+    ),
+    "pg.restore": (
+        "standby's full restore from the upstream's backup server, "
+        "before the transfer starts",
+        ("manatee_tpu/pg/manager.py",),
+        ("error", "delay", "stall"),
+    ),
+    "state.write": (
+        "state machine's durable CAS write of a decided transition",
+        ("manatee_tpu/state/machine.py",),
+        ("error", "delay", "stall"),
+    ),
+    "storage.recv": (
+        "dir-backend stream receive into a dataset (restore data "
+        "path)",
+        ("manatee_tpu/storage/dirstore.py",),
+        ("error", "delay", "stall"),
+    ),
+    "storage.send": (
+        "dir-backend snapshot stream send (backup data path)",
+        ("manatee_tpu/storage/dirstore.py",),
+        ("error", "delay", "stall"),
+    ),
+    "storage.snapshot": (
+        "dir-backend snapshot creation (the transition snapshot and "
+        "the snapshotter ride this)",
+        ("manatee_tpu/storage/dirstore.py",),
+        ("error", "delay", "stall"),
+    ),
+    "storage.zfs.exec": (
+        "every zfs(8) command the ZFS backend runs (one seam for the "
+        "whole command family)",
+        ("manatee_tpu/storage/zfsbackend.py",),
+        ("error", "delay", "stall"),
+    ),
+}
+
+
+def describe(name: str) -> str:
+    return CATALOG[name][0]
+
+
+def files_for(name: str) -> tuple[str, ...]:
+    return CATALOG[name][1]
+
+
+def actions_for(name: str) -> tuple[str, ...]:
+    return CATALOG[name][2]

@@ -24,6 +24,7 @@ from manatee_tpu.lint.engine import (
 # importing the rule modules populates the registry
 from manatee_tpu.lint import rules_style  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_async  # noqa: F401  (registration)
+from manatee_tpu.lint import rules_faults  # noqa: F401  (registration)
 
 __all__ = [
     "RULES",
@@ -35,4 +36,5 @@ __all__ = [
     "main",
     "rules_style",
     "rules_async",
+    "rules_faults",
 ]

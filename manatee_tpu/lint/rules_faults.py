@@ -1,0 +1,77 @@
+"""Fault-injection call-site discipline.
+
+The failpoint catalog (:mod:`manatee_tpu.faults.catalog`) is the single
+source of truth for which seams exist; a ``faults.point("...")`` whose
+name is not there can never be armed (typos silently never fire), and a
+name reused across seams makes arming ambiguous.  This rule keeps call
+sites honest:
+
+- the first argument must be a string literal (a computed name defeats
+  both this rule and the catalog's typo protection);
+- the literal must be a cataloged point name;
+- within one file a point name may be invoked once (one seam, one
+  name); the catalog additionally binds each name to the file(s)
+  allowed to invoke it, which is what makes names unique TREE-wide —
+  a second file borrowing a name is flagged here.
+
+The file-binding check applies to production sources (paths under
+``manatee_tpu/``); lint fixtures and tests exercise the other checks
+with arbitrary paths.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from manatee_tpu.lint.engine import FileContext, dotted, rule
+
+RULE = "faultpoint-unregistered"
+
+
+def _is_point_call(name: str | None) -> bool:
+    return name is not None and (name == "faults.point"
+                                 or name.endswith(".faults.point"))
+
+
+@rule(RULE, "faults.point() names must be literal, cataloged, and "
+            "unique to their seam")
+def faultpoint_unregistered(ctx: FileContext):
+    from manatee_tpu.faults.catalog import CATALOG, files_for
+
+    seen: dict[str, int] = {}
+    path = ctx.path.replace("\\", "/")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not _is_point_call(dotted(node.func)):
+            continue
+        arg = node.args[0] if node.args else None
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            yield ctx.finding(
+                node.lineno, RULE,
+                "faults.point() takes a string-literal point name "
+                "(computed names defeat the catalog's typo "
+                "protection)")
+            continue
+        pt = arg.value
+        if pt not in CATALOG:
+            yield ctx.finding(
+                node.lineno, RULE,
+                "failpoint %r is not in the catalog "
+                "(manatee_tpu/faults/catalog.py) — it can never be "
+                "armed" % pt)
+            continue
+        if pt in seen:
+            yield ctx.finding(
+                node.lineno, RULE,
+                "failpoint %r already invoked at line %d in this "
+                "file (one seam, one name)" % (pt, seen[pt]))
+        else:
+            seen[pt] = node.lineno
+        if "manatee_tpu/" in path \
+                and not any(path.endswith(f) for f in files_for(pt)):
+            yield ctx.finding(
+                node.lineno, RULE,
+                "failpoint %r is registered to %s, not this file "
+                "(names are bound to their seam)"
+                % (pt, ", ".join(files_for(pt))))

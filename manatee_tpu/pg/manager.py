@@ -35,6 +35,7 @@ import time
 from pathlib import Path
 from typing import Awaitable, Callable
 
+from manatee_tpu import faults
 from manatee_tpu.health.telemetry import STATUS_EVERY
 from manatee_tpu.obs import get_journal, get_registry, record_span, span
 from manatee_tpu.pg.engine import Engine, PgError, parse_pg_url
@@ -323,6 +324,9 @@ class PostgresMgr:
         # only the restart path's kill escalation recovers it
         promoted = False
         with span("pg.promote") as psp:
+            # an injected PgError fails the whole reconfigure and the
+            # state machine's retry loop backs off and re-drives it
+            await faults.point("pg.promote")
             if (self.running and self._online
                     and self.engine.promotable_in_place
                     and self._applied
@@ -393,6 +397,9 @@ class PostgresMgr:
         deadline = time.monotonic() + float(self.cfg["replicationTimeout"])
         with span("pg.catchup", standby=standby_id):
             while not self._closed:
+                # stall here keeps the new primary read-only — the
+                # stalled-takeover drill; delay stretches the window
+                await faults.point("pg.catchup")
                 try:
                     res = await self._local_query({"op": "status"}, 5.0)
                     row = next((r for r in res.get("replication", [])
@@ -457,6 +464,7 @@ class PostgresMgr:
             log.info("%s: re-pointing standby upstream to %s (reload, "
                      "no restart)", self.peer_id, upstream.get("id"))
             with span("pg.repoint", upstream=upstream.get("id")):
+                await faults.point("pg.repoint")
                 self.engine.write_config(
                     self.datadir, host=self.host, port=self.port,
                     peer_id=self.peer_id, read_only=True,
@@ -496,6 +504,11 @@ class PostgresMgr:
                                  reason=str(e))
             with span("pg.restore", upstream=upstream.get("id")):
                 try:
+                    # error:StorageError = a restore that fails before
+                    # the first byte; stall = one wedged indefinitely
+                    # (heal with `fault clear` — the transition stays
+                    # cancelable throughout)
+                    await faults.point("pg.restore")
                     await self.restore_fn(upstream)
                 except asyncio.CancelledError:
                     raise

@@ -22,6 +22,7 @@ them (the dev image does not).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import re

@@ -51,6 +51,7 @@ import logging
 import time
 from typing import Callable
 
+from manatee_tpu import faults
 from manatee_tpu.coord.api import (
     BadVersionError,
     NodeExistsError,
@@ -105,6 +106,15 @@ from manatee_tpu.utils import iso_ms as _now_iso  # noqa: E402
 _sleep = asyncio.sleep
 
 
+def _retry_backoff(op: str):
+    """A jittered-backoff helper whose sleeps route through the
+    swappable :data:`_sleep`, so the model checker's zero-delay
+    exploration still covers every retry path at full speed."""
+    from manatee_tpu.utils.retry import Backoff
+    return Backoff(op, base=RETRY_DELAY, cap=5 * RETRY_DELAY,
+                   sleep_fn=lambda d: _sleep(d))
+
+
 def _iso_to_ts(s: str) -> float:
     try:
         return datetime.datetime.fromisoformat(
@@ -149,6 +159,11 @@ class PeerStateMachine:
         self._pg_task: asyncio.Task | None = None
         self._pg_target: dict | None = None
         self._pg_applied: dict | None = None
+        # jittered retry schedules (reset on success): consecutive
+        # failures back off instead of hammering a struggling database
+        # or coordination service at a fixed cadence
+        self._eval_retry = _retry_backoff("state.evaluate")
+        self._pg_retry = _retry_backoff("pg.reconfigure")
         self._listeners: dict[str, list[Callable]] = {}
         # failover SLI bookkeeping: monotonic stamp of the moment this
         # peer (as sync) detected the primary's loss, and the trace id
@@ -287,6 +302,7 @@ class PeerStateMachine:
             self._kick.clear()
             try:
                 await self._evaluate()
+                self._eval_retry.reset()
             except asyncio.CancelledError:
                 return
             except BadVersionError:
@@ -295,7 +311,7 @@ class PeerStateMachine:
                 log.info("cluster-state CAS conflict; deferring")
             except Exception:
                 log.exception("state machine evaluation failed")
-                await _sleep(RETRY_DELAY)
+                await self._eval_retry.sleep()
                 self._kick.set()
 
     # ---- the decision procedure ----
@@ -653,6 +669,10 @@ class PeerStateMachine:
         reacting to the watch (and the coordd that stored it) log,
         journal, and span under the same identity, parented to this
         write."""
+        # the decided-transition seam: error/delay/stall here models a
+        # peer that decides a topology change but cannot commit it (the
+        # worker's jittered-backoff retry re-drives the evaluation)
+        await faults.point("state.write")
         tid = trace_id or new_trace_id()
         state = dict(state)
         state["trace"] = tid
@@ -766,6 +786,7 @@ class PeerStateMachine:
         try:
             await self.pg.reconfigure(cfg)
             self._pg_applied = cfg
+            self._pg_retry.reset()
             self._emit("pgApplied", cfg)
         except asyncio.CancelledError:
             raise
@@ -773,5 +794,5 @@ class PeerStateMachine:
             log.exception("pg reconfigure to %s failed; will retry",
                           cfg.get("role"))
             self._pg_target = None
-            await _sleep(RETRY_DELAY)
+            await self._pg_retry.sleep()
             self.kick()

@@ -19,7 +19,10 @@ scrape bunyan logs):
   merges into the shard timeline;
 - ``GET /spans``   this peer's completed-span ring
   (``?since=SEQ&limit=N&trace=ID``) plus its open spans — the per-peer
-  feed `manatee-adm trace` reassembles into the cross-peer tree.
+  feed `manatee-adm trace` reassembles into the cross-peer tree;
+- ``GET/POST/DELETE /faults`` the sitter process's live fault-injection
+  surface (`manatee_tpu.faults`): list armed rules + the failpoint
+  catalog, arm by spec, disarm — what `manatee-adm fault` talks to.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import time
 
 from aiohttp import web
 
+from manatee_tpu import faults
 from manatee_tpu.obs import get_journal, get_registry, get_span_store
 from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
 
@@ -52,6 +56,7 @@ class StatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/events", self._events)
         app.router.add_get("/spans", self._spans)
+        faults.attach_http(app)
         self._app = app
 
     async def start(self) -> None:
@@ -69,7 +74,8 @@ class StatusServer:
 
     async def _routes(self, _req: web.Request) -> web.Response:
         return web.json_response(["/ping", "/state", "/restore",
-                                  "/metrics", "/events", "/spans"])
+                                  "/metrics", "/events", "/spans",
+                                  "/faults"])
 
     async def _ping(self, _req: web.Request) -> web.Response:
         healthy = bool(self.pg_mgr and self.pg_mgr.online)

@@ -27,6 +27,7 @@ import shutil
 import time
 from pathlib import Path
 
+from manatee_tpu import faults
 from manatee_tpu.storage.base import (
     ProgressCb,
     Snapshot,
@@ -219,6 +220,9 @@ class DirBackend(StorageBackend):
     # ---- snapshots ----
 
     async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot:
+        # error:StorageError models a failed disk write at snapshot
+        # time (callers like _snapshot_safe must tolerate it)
+        await faults.point("storage.snapshot")
         name = name or snapshot_name_now()
         meta = self._load_meta(dataset)
         if name in meta["snaps"]:
@@ -297,6 +301,7 @@ class DirBackend(StorageBackend):
         src = self._dspath(dataset) / "@snapshots" / name
         if not src.exists():
             raise StorageError("no such snapshot: %s@%s" % (dataset, name))
+        await faults.point("storage.send")
         size = await self.estimate_send_size(dataset, name)
         header = json.dumps({"snapshot": name, "size": size}) + "\n"
         try:
@@ -394,6 +399,7 @@ class DirBackend(StorageBackend):
         reader: asyncio.StreamReader,
         progress_cb: ProgressCb | None = None,
     ) -> None:
+        await faults.point("storage.recv")
         hdr_line = await reader.readline()
         if not hdr_line:
             raise StorageError("empty recv stream")

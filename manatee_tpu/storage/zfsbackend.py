@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import re
 
+from manatee_tpu import faults
 from manatee_tpu.storage.base import (
     ProgressCb,
     Snapshot,
@@ -64,6 +65,9 @@ class ZfsBackend(StorageBackend):
         self.zfs = zfs_cmd
 
     async def _zfs(self, *args: str, check: bool = True):
+        # one seam for the whole zfs(8) command family: error/delay/
+        # stall any dataset operation without root or a zpool
+        await faults.point("storage.zfs.exec")
         try:
             return await run([self.zfs, *args], empty_env=True, check=check)
         except ExecError as e:

@@ -1,0 +1,134 @@
+"""Shared resilience: exponential backoff with full jitter.
+
+Before this module every reconnect/retry loop in the tree slept a fixed
+delay (``asyncio.sleep(1.0)`` in the client watcher, ``RETRY_DELAY`` in
+the consensus manager and state machine, ``RECONNECT_DELAY`` in the
+coord client).  Fixed delays synchronize: after a coordd outage every
+client in the shard — and every client of every shard on the box —
+retries in lockstep, and the recovering daemon takes the whole herd at
+once, repeatedly.  Replicated-transaction systems engineer this out
+with exponential backoff plus jitter (SafarDB in PAPERS.md; the classic
+AWS full-jitter analysis); this module is that policy, once, with the
+observability the rest of the tree expects:
+
+- every backoff sleep increments ``retry_attempts_total{op=...}``;
+- every backoff sleep records a ``retry.backoff`` span (op, attempt,
+  requested delay), so a partition-era reconnect storm is visible in
+  the span feed next to the failover it delayed.
+
+Jitter is "equal jitter" (the AWS backoff analysis's middle scheme):
+uniform in ``[d/2, d]`` where ``d`` is the capped exponential delay —
+decorrelated across the fleet, never more than 2x the schedule's
+retry rate, and no pathological near-zero sleeps busy-spinning a
+refused connect.  Deliberate design choice vs the reference's FIXED
+delays: early retries are faster (a transient connect blip must not
+cost an HA daemon a full 5s of coordination absence), growing to the
+old cadence within a few attempts of a sustained outage; the
+stateless :func:`backoff_sleep` (watch re-arm, no attempt counter to
+grow) instead jitters UP from its fixed delay so that path never
+retries faster than the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+from manatee_tpu.obs import get_registry, record_span
+
+_REG = get_registry()
+_ATTEMPTS = _REG.counter(
+    "retry_attempts_total",
+    "backoff sleeps taken by retry/reconnect loops", ("op",))
+
+DEFAULT_BASE = 0.5
+DEFAULT_CAP = 10.0
+DEFAULT_FACTOR = 2.0
+
+
+class RetryPolicy:
+    """Pure delay schedule: ``min(cap, base * factor**(attempt-1))``
+    with optional jitter.  Stateless; share freely."""
+
+    __slots__ = ("base", "cap", "factor", "jitter")
+
+    def __init__(self, *, base: float = DEFAULT_BASE,
+                 cap: float = DEFAULT_CAP,
+                 factor: float = DEFAULT_FACTOR,
+                 jitter: bool = True):
+        if base <= 0 or cap < base or factor < 1.0:
+            raise ValueError("need 0 < base <= cap and factor >= 1")
+        self.base = float(base)
+        self.cap = float(cap)
+        self.factor = float(factor)
+        self.jitter = bool(jitter)
+
+    def delay_for(self, attempt: int) -> float:
+        """Delay before retry *attempt* (1-based)."""
+        raw = min(self.cap,
+                  self.base * self.factor ** max(0, attempt - 1))
+        if not self.jitter:
+            return raw
+        return random.uniform(raw / 2.0, raw)
+
+
+class Backoff:
+    """One retry loop's state: an attempt counter over a policy.
+
+    ``await bo.sleep()`` before each retry; ``bo.reset()`` on success
+    so the next failure starts from the base again.  *deadline*
+    (monotonic-clock, optional) caps each sleep so a loop bounded by a
+    session timeout never oversleeps its budget.  *sleep_fn* lets the
+    state machine keep routing through its swappable ``_sleep`` (the
+    model checker replaces it with a zero-delay yield)."""
+
+    __slots__ = ("op", "policy", "deadline", "attempts", "_sleep_fn")
+
+    def __init__(self, op: str, *, policy: RetryPolicy | None = None,
+                 deadline: float | None = None, sleep_fn=None,
+                 **policy_kw):
+        self.op = op
+        self.policy = policy or RetryPolicy(**policy_kw)
+        self.deadline = deadline
+        self.attempts = 0
+        self._sleep_fn = sleep_fn or asyncio.sleep
+
+    def reset(self) -> None:
+        self.attempts = 0
+
+    async def sleep(self) -> float:
+        """Count the attempt, sleep the policy's next delay (clamped to
+        the deadline), record metric + span; returns the slept delay."""
+        self.attempts += 1
+        d = self.policy.delay_for(self.attempts)
+        if self.deadline is not None:
+            d = max(0.0, min(d, self.deadline - time.monotonic()))
+        _ATTEMPTS.inc(op=self.op)
+        t0_wall = time.time()
+        t0 = time.monotonic()
+        await self._sleep_fn(d)
+        record_span("retry.backoff", ts=t0_wall,
+                    dur=time.monotonic() - t0, op=self.op,
+                    attempt=self.attempts, delay=round(d, 3))
+        return d
+
+
+async def backoff_sleep(op: str, delay: float) -> float:
+    """One-off jittered sleep for retry paths without loop state (e.g.
+    the consensus manager's watch re-arm, whose retry chain is rebuilt
+    per firing so no attempt counter survives): sleeps at least
+    *delay* plus up to one extra *delay* of decorrelation jitter.
+    Jittering DOWN from a fixed delay would be a regression there —
+    uniform[0.1d, d] averages ~0.55d, retrying nearly twice as often
+    as the fixed schedule against a daemon already struggling.
+    Counted and spanned like :meth:`Backoff.sleep`."""
+    d = delay + random.uniform(0.0, delay)
+    _ATTEMPTS.inc(op=op)
+    t0_wall = time.time()
+    t0 = time.monotonic()
+    await asyncio.sleep(d)
+    record_span("retry.backoff", ts=t0_wall,
+                dur=time.monotonic() - t0, op=op, attempt=1,
+                delay=round(d, 3))
+    return d

@@ -42,6 +42,13 @@ def leaky(obs):
     obs.span("stage")                      # span-not-closed
 
 
+async def faulty(faults, pick):
+    await faults.point(pick())             # faultpoint-unregistered
+    await faults.point("no.such.point")    # faultpoint-unregistered
+    await faults.point("pg.restore")
+    await faults.point("pg.restore")       # faultpoint-unregistered
+
+
 def shadowed():
     return 1
 

@@ -45,6 +45,15 @@ def cli_env(coord_addr: str, shard: str = "1") -> dict:
     return env
 
 
+def run_cli(cluster: "ClusterHarness", *args, timeout=120):
+    """Run the real manatee-adm CLI against *cluster* — the ONE
+    subprocess wrapper the chaos/partition suites share."""
+    return subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", *args],
+        capture_output=True, text=True,
+        env=cli_env(cluster.coord_connstr), timeout=timeout)
+
+
 def alloc_port_block(n: int) -> int:
     """A contiguous block of *n* free ports BELOW the kernel's ephemeral
     range (so in-flight connections cannot steal them between allocation
@@ -109,6 +118,10 @@ class Peer:
             "storageBackend": "dir",
             "storageRoot": store_root,
             "pgEngine": self.cluster.engine,
+            # runtime fault arming (POST /faults, `manatee-adm fault`)
+            # is opt-in; the test fixture always opts in so the
+            # partition/fault drills can drive live daemons
+            "faultsEnabled": True,
         }
         if self.cluster.engine == "postgres":
             # the real PostgresEngine driving the fakepg binaries — the

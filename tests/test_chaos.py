@@ -7,16 +7,28 @@ peer SIGKILLs (primary included), restarts, REAL `manatee-adm rebuild`
 runs for deposed returners, coordination-member kills/restarts, and
 operator freeze/unfreeze through the CLI, for a wall-clock budget.
 
+With MANATEE_CHAOS_PARTITION=1 the storm additionally arms LIVE
+asymmetric network partitions through `manatee-adm fault`
+(docs/fault-injection.md): a peer — the primary when possible — stays
+up while its coordination traffic is black-holed, and heals later.
+While a partition is in play a split-brain probe runs continuously:
+once the cluster has durably moved past the partitioned ex-primary
+(generation bumped AND a write acked under the new generation), the
+isolated peer must never ack a synchronous write again.
+
 Invariants, checked continuously:
 
   * DURABILITY: every synchronously-acknowledged write remains readable
     from every later writable primary (the reference's core promise —
     synchronous_commit means an ack implies the sync has it);
   * the durable generation never decreases;
+  * NO SPLIT BRAIN: never two write-enabled primaries (probed whenever
+    a partition is active);
   * afterwards, the cluster converges to `manatee-adm verify` clean
     with every peer back in the topology.
 
 Run:  make chaos            (120 s storm)
+      make chaos-partition  (the same storm + live partitions)
       MANATEE_CHAOS=1 MANATEE_CHAOS_SECONDS=600 \
           python3 -m pytest tests/test_chaos.py -x -q -s
 """
@@ -24,14 +36,12 @@ Run:  make chaos            (120 s storm)
 import asyncio
 import os
 import random
-import subprocess
-import sys
 import time
 from pathlib import Path
 
 import pytest
 
-from tests.harness import ClusterHarness
+from tests.harness import ClusterHarness, run_cli
 from tests.test_integration import converged
 
 pytestmark = pytest.mark.skipif(
@@ -41,13 +51,7 @@ pytestmark = pytest.mark.skipif(
 
 REPO = Path(__file__).resolve().parent.parent
 
-
-def run_cli(cluster, *args, timeout=120):
-    from tests.harness import cli_env   # the ONE env contract
-    return subprocess.run(
-        [sys.executable, "-m", "manatee_tpu.cli", *args],
-        capture_output=True, text=True,
-        env=cli_env(cluster.coord_connstr), timeout=timeout)
+PARTITION = bool(os.environ.get("MANATEE_CHAOS_PARTITION"))
 
 
 class Chaos:
@@ -60,6 +64,12 @@ class Chaos:
         self.gen_watermark = -1
         self.actions: list[str] = []
         self.rebuilds = 0
+        # live-partition episode: (peer, generation at arm time), and
+        # the newest generation a write was acked under — the probe
+        # only fires once the cluster provably moved past the episode
+        self.partitioned: tuple | None = None
+        self.partitions = 0
+        self.last_ack_gen = -1
 
     def note(self, what: str) -> None:
         self.actions.append(what)
@@ -101,6 +111,8 @@ class Chaos:
             return
         if res.get("ok"):
             self.acked.append(value)
+            self.last_ack_gen = max(self.last_ack_gen,
+                                    st["generation"])
             self.note("write acked: %s" % value)
 
     async def verify_durability(self) -> None:
@@ -193,6 +205,97 @@ class Chaos:
             cp = run_cli(self.cluster, "unfreeze", timeout=30)
             self.note("unfroze (rc %d)" % cp.returncode)
 
+    # -- live asymmetric partitions (MANATEE_CHAOS_PARTITION=1) --
+
+    async def partition_peer(self) -> None:
+        """Black-hole one live peer's coordination traffic through the
+        real `manatee-adm fault` CLI — the primary when possible (the
+        interesting victim for the split-brain probe)."""
+        if self.partitioned is not None:
+            return
+        st = await self.state()
+        if not st:
+            return
+        try:
+            peer = self.cluster.peer_by_id(st["primary"]["id"])
+        except KeyError:
+            return
+        if peer in self.dead:
+            alive = [p for p in self.cluster.peers
+                     if p not in self.dead]
+            if not alive:
+                return
+            peer = self.rng.choice(alive)
+        cp = run_cli(self.cluster, "fault", "set",
+                     "coord.client.connect=drop",
+                     "coord.client.send=drop", "-n", peer.name,
+                     timeout=30)
+        if cp.returncode != 0:
+            # the CLI failing does NOT prove nothing armed (the reply
+            # may have been lost after the server armed atomically):
+            # heal by URL best-effort so no untracked partition can
+            # linger, then try again later
+            run_cli(self.cluster, "fault", "clear", "--url",
+                    "http://127.0.0.1:%d" % peer.status_port,
+                    timeout=30)
+            return
+        self.partitioned = (peer, st["generation"])
+        self.partitions += 1
+        self.note("partitioned %s (coord traffic black-holed)"
+                  % peer.name)
+
+    async def heal_partition(self) -> None:
+        if self.partitioned is None:
+            return
+        peer, _gen = self.partitioned
+        if peer not in self.dead:
+            # faults live in the process registry; a killed peer was
+            # healed by its own death (a restart arms nothing).  The
+            # heal targets the peer's status server DIRECTLY (--url):
+            # it must work even while the coordination plane is down.
+            cp = run_cli(self.cluster, "fault", "clear", "--url",
+                         "http://127.0.0.1:%d" % peer.status_port,
+                         timeout=30)
+            if cp.returncode != 0:
+                self.note("heal of %s failed (rc %d); retrying later"
+                          % (peer.name, cp.returncode))
+                return
+        self.partitioned = None
+        self.note("healed partition of %s" % peer.name)
+
+    async def assert_no_split_brain(self) -> None:
+        """Once the cluster durably moved past a partitioned ex-primary
+        (generation bumped AND a write acked under the new
+        generation), the isolated peer must never ack a synchronous
+        write: its sync left, so synchronous commit cannot complete
+        there.  An ack here is a second write-enabled primary."""
+        if self.partitioned is None:
+            return
+        peer, gen0 = self.partitioned
+        if peer in self.dead:
+            self.partitioned = None      # killed: faults died with it
+            return
+        if self.last_ack_gen <= gen0:
+            return
+        st = await self.state()
+        if not st or st["primary"]["id"] == peer.ident:
+            return
+        acked = False
+        try:
+            res = await peer.pg_query(
+                {"op": "insert", "value": "split-brain-probe",
+                 "timeout": 0.8}, 2.5)
+            acked = bool(res.get("ok"))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass      # refused / timed out / process gone: all fine
+        assert not acked, \
+            "SPLIT BRAIN: partitioned ex-primary %s acked a write " \
+            "after the cluster moved to gen %d (armed at gen %d; " \
+            "last actions: %s)" % (peer.name, self.last_ack_gen,
+                                   gen0, self.actions[-5:])
+
 
 def test_chaos(tmp_path):
     seconds = float(os.environ.get("MANATEE_CHAOS_SECONDS", "120"))
@@ -220,15 +323,21 @@ def test_chaos(tmp_path):
                 [chaos.freeze_cycle] * 1 +
                 [chaos.try_write] * 5
             )
+            if PARTITION:
+                weighted += ([chaos.partition_peer] * 2 +
+                             [chaos.heal_partition] * 2)
             while time.monotonic() < deadline:
                 await rng.choice(weighted)()
                 await asyncio.sleep(rng.uniform(0.1, 1.5))
                 await chaos.check_invariants()
                 await chaos.verify_durability()
+                await chaos.assert_no_split_brain()
 
-            # convergence: everything comes back
+            # convergence: everything comes back (coordination first —
+            # the partition heal is a CLI fan-out that needs a leader)
             while chaos.dead_coordd:
                 cluster.start_coordd(chaos.dead_coordd.pop())
+            await chaos.heal_partition()
             while chaos.dead:
                 p = chaos.dead.pop()
                 p.start()
@@ -239,6 +348,8 @@ def test_chaos(tmp_path):
             deadline = time.monotonic() + 180
             ok = False
             while time.monotonic() < deadline:
+                if chaos.partitioned is not None:
+                    await chaos.heal_partition()   # retry failed heals
                 st = await chaos.state()
                 if st and st.get("deposed"):
                     for d in list(st["deposed"]):
@@ -377,8 +488,13 @@ def test_chaos(tmp_path):
             assert snapshotting_peers >= 2, \
                 "snapshot stream dried up under chaos"
             print("chaos: survived %d actions, %d acked writes, "
-                  "%d rebuilds" % (len(chaos.actions), len(chaos.acked),
-                                   chaos.rebuilds), flush=True)
+                  "%d rebuilds, %d partitions"
+                  % (len(chaos.actions), len(chaos.acked),
+                     chaos.rebuilds, chaos.partitions), flush=True)
+            if PARTITION:
+                assert chaos.partitions > 0, \
+                    "partition tier requested but no partition was " \
+                    "ever armed"
         finally:
             await cluster.stop()
 

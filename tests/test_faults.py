@@ -1,0 +1,643 @@
+"""The fault-injection subsystem: actions, triggers, arming surfaces.
+
+Covers the registry unit-by-unit (every action x every trigger kind),
+the spec parser, env/config arming, the HTTP round trip on a real
+status server, the `manatee-adm fault` CLI in --url mode (no cluster
+needed), a real seam firing (the dir backend's snapshot point), the
+shared retry layer's schedule/metrics/spans, and the catalog<->docs
+sync.  The live partition drill that composes all of this end to end
+is tests/test_partition.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu import faults
+from manatee_tpu.faults import (
+    FaultRegistry,
+    FaultSpecError,
+    parse_spec,
+)
+from manatee_tpu.storage.base import StorageError
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def freg(monkeypatch):
+    """A fresh registry swapped in as the process singleton, so
+    faults.point() in production code routes to it and nothing leaks
+    between tests.  Runtime HTTP arming is opted in (what the harness's
+    faultsEnabled config key does in real daemons)."""
+    reg = FaultRegistry()
+    monkeypatch.setattr(faults, "_REGISTRY", reg)
+    monkeypatch.setattr(faults, "_HTTP_ENABLED", True)
+    return reg
+
+
+# ---- spec parsing ----
+
+def test_parse_spec_forms():
+    assert parse_spec("coord.client.send=drop") == {
+        "point": "coord.client.send", "action": "drop"}
+    assert parse_spec("pg.restore=error:StorageError,count=1") == {
+        "point": "pg.restore", "action": "error",
+        "error": "StorageError", "count": 1}
+    assert parse_spec("coord.client.recv=delay:0.5,jitter=0.3,prob=0.2") \
+        == {"point": "coord.client.recv", "action": "delay",
+            "delay": 0.5, "jitter": 0.3, "prob": 0.2}
+    assert parse_spec("backup.send.stream=stall") == {
+        "point": "backup.send.stream", "action": "stall"}
+
+
+@pytest.mark.parametrize("bad", [
+    "", "nope", "p=", "=drop", "p=explode", "p=drop:arg",
+    "p=stall:arg", "p=delay:soon", "p=drop,count=zero",
+    "p=drop,bogus=1", "p=error,prob=oops",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_arm_validates_against_catalog(freg):
+    with pytest.raises(FaultSpecError):
+        freg.arm(point="no.such.point", action="drop")
+    with pytest.raises(FaultSpecError):
+        # pg.restore supports error/delay/stall, not drop
+        freg.arm(point="pg.restore", action="drop")
+    with pytest.raises(FaultSpecError):
+        freg.arm(point="pg.restore", action="error",
+                 error="NoSuchError")
+    with pytest.raises(FaultSpecError):
+        freg.arm(point="pg.restore", action="error", count=0)
+    with pytest.raises(FaultSpecError):
+        freg.arm(point="pg.restore", action="error", prob=1.5)
+
+
+@pytest.mark.parametrize("spec", [
+    "pg.catchup=delay:-3",                 # negative delay no-ops
+    "pg.catchup=delay",                    # zero delay no-ops
+    "pg.catchup=delay:0.5,jitter=-0.5",    # negative jitter
+    "coord.client.send=drop,delay=1",      # option foreign to action
+    "pg.restore=stall,error=OSError",      # error= on a non-error rule
+])
+def test_validate_rejects_misdirected_options(spec):
+    # a spec whose option the rule would silently ignore means the
+    # operator expects behavior the drill will never deliver
+    with pytest.raises(FaultSpecError):
+        faults.validate_spec(spec)
+
+
+# ---- actions ----
+
+def test_error_action_raises_typed(freg):
+    freg.arm_spec("pg.restore=error:StorageError")
+
+    async def go():
+        with pytest.raises(StorageError):
+            await faults.point("pg.restore")
+    asyncio.run(go())
+
+
+def test_error_action_default_type(freg):
+    freg.arm_spec("pg.promote=error")
+
+    async def go():
+        with pytest.raises(faults.FaultError):
+            await faults.point("pg.promote")
+    asyncio.run(go())
+
+
+def test_delay_action_sleeps(freg):
+    freg.arm_spec("pg.catchup=delay:0.15")
+
+    async def go():
+        t0 = time.monotonic()
+        assert await faults.point("pg.catchup") == "ok"
+        assert time.monotonic() - t0 >= 0.14
+    asyncio.run(go())
+
+
+def test_drop_action_verdict(freg):
+    freg.arm_spec("coord.client.send=drop")
+
+    async def go():
+        assert await faults.point("coord.client.send") == "drop"
+        # an unarmed point is always ok
+        assert await faults.point("coord.client.recv") == "ok"
+    asyncio.run(go())
+
+
+def test_stall_blocks_until_cleared(freg):
+    freg.arm_spec("backup.send.stream=stall")
+
+    async def go():
+        task = asyncio.create_task(
+            faults.point("backup.send.stream"))
+        await asyncio.sleep(0.1)
+        assert not task.done()      # wedged, as armed
+        assert freg.clear("backup.send.stream") == 1
+        assert await asyncio.wait_for(task, 2.0) == "ok"
+    asyncio.run(go())
+
+
+def test_clear_releases_without_firing_later_rules(freg):
+    """A caller released by `fault clear` must proceed CLEAN: rules
+    armed after the stall on the same point were cleared too, and must
+    not fire from the stale snapshot."""
+    freg.arm_spec("pg.restore=stall")
+    freg.arm_spec("pg.restore=error:StorageError")
+
+    async def go():
+        task = asyncio.create_task(faults.point("pg.restore"))
+        await asyncio.sleep(0.1)
+        assert not task.done()
+        assert freg.clear("pg.restore") == 2
+        # released AND the (cleared) error rule did not fire
+        assert await asyncio.wait_for(task, 2.0) == "ok"
+    asyncio.run(go())
+
+
+# ---- triggers ----
+
+def test_one_shot_fires_once(freg):
+    freg.arm_spec("coord.client.send=drop,count=1")
+
+    async def go():
+        assert await faults.point("coord.client.send") == "drop"
+        assert await faults.point("coord.client.send") == "ok"
+        rule = freg.list()[0]
+        assert rule["hits"] == 1 and rule["exhausted"]
+    asyncio.run(go())
+
+
+def test_count_limited(freg):
+    freg.arm_spec("coord.client.send=drop,count=3")
+
+    async def go():
+        verdicts = [await faults.point("coord.client.send")
+                    for _ in range(5)]
+        assert verdicts == ["drop"] * 3 + ["ok"] * 2
+    asyncio.run(go())
+
+
+def test_probabilistic(freg, monkeypatch):
+    freg.arm_spec("coord.client.send=drop,prob=0.5")
+    rolls = iter([0.4, 0.6, 0.1, 0.9])
+    monkeypatch.setattr(faults.random, "random", lambda: next(rolls))
+
+    async def go():
+        assert [await faults.point("coord.client.send")
+                for _ in range(4)] == ["drop", "ok", "drop", "ok"]
+    asyncio.run(go())
+
+
+def test_probabilistic_with_count_budget(freg, monkeypatch):
+    freg.arm_spec("coord.client.send=drop,prob=0.5,count=1")
+    monkeypatch.setattr(faults.random, "random", lambda: 0.0)
+
+    async def go():
+        assert await faults.point("coord.client.send") == "drop"
+        # the budget is spent even though prob would keep matching
+        assert await faults.point("coord.client.send") == "ok"
+    asyncio.run(go())
+
+
+def test_clear_by_point_and_all(freg):
+    freg.arm_spec("coord.client.send=drop")
+    freg.arm_spec("coord.client.recv=drop")
+    assert len(freg) == 2
+    assert freg.clear("coord.client.send") == 1
+    assert [r["point"] for r in freg.list()] == ["coord.client.recv"]
+    assert freg.clear() == 1
+    assert freg.list() == []
+
+
+# ---- env/config arming ----
+
+def test_env_arming(freg, monkeypatch):
+    monkeypatch.setenv(
+        "MANATEE_FAULTS",
+        "coord.client.send=drop; pg.restore=error:StorageError,count=1")
+    faults._arm_from_env()
+    armed = {r["point"]: r for r in freg.list()}
+    assert set(armed) == {"coord.client.send", "pg.restore"}
+    assert all(r["source"] == "env" for r in armed.values())
+
+
+def test_arm_specs_skips_bad_entries(freg):
+    # boot path: a typo must not keep a daemon from starting
+    n = faults.arm_specs(["coord.client.send=drop", "bogus"],
+                         source="config")
+    assert n == 1 and len(freg) == 1
+
+
+def test_arm_specs_dedupes_env_plus_config(freg):
+    """MANATEE_FAULTS and a config faults list naming the same spec
+    must not stack two rules (double injection)."""
+    spec = "pg.restore=error:StorageError,count=1"
+    assert faults.arm_specs([spec], source="env") == 1
+    assert faults.arm_specs([spec], source="config") == 0
+    assert len(freg) == 1
+
+    # ... but a spec matching only an EXHAUSTED rule re-arms (the
+    # whole point of re-running a one-shot drill)
+    async def go():
+        with pytest.raises(StorageError):
+            await faults.point("pg.restore")
+    asyncio.run(go())
+    assert freg.list()[0]["exhausted"]
+    assert faults.arm_specs([spec], source="config") == 1
+    assert len(freg) == 2
+
+
+# ---- a real seam fires ----
+
+def test_dirstore_snapshot_seam(freg, tmp_path):
+    from manatee_tpu.storage import DirBackend
+    freg.arm_spec("storage.snapshot=error:StorageError,count=1")
+
+    async def go():
+        be = DirBackend(tmp_path)
+        await be.create("ds")
+        with pytest.raises(StorageError, match="injected fault"):
+            await be.snapshot("ds")
+        # one-shot: the next snapshot succeeds
+        snap = await be.snapshot("ds")
+        assert snap.dataset == "ds"
+    asyncio.run(go())
+
+
+def test_injection_metrics(freg):
+    from manatee_tpu.obs import get_registry
+    counter = get_registry().counter(
+        "fault_injections_total", "", ("point", "action"))
+    before = counter.value(point="coord.client.send", action="drop")
+    freg.arm_spec("coord.client.send=drop,count=2")
+
+    async def go():
+        await faults.point("coord.client.send")
+        await faults.point("coord.client.send")
+    asyncio.run(go())
+    assert counter.value(point="coord.client.send",
+                         action="drop") == before + 2
+
+
+# ---- the one-way partition (recv drop) is DETECTED, not a wedge ----
+
+def test_recv_drop_detected_by_reply_deadline(freg, monkeypatch):
+    """coord.client.recv=drop is a one-way partition: our frames reach
+    the server (keeping the session alive) but replies vanish.  The
+    client's reply deadline must turn that into a ConnectionLossError
+    + local sever — without it, callers pin forever and NEITHER side
+    ever notices."""
+    from manatee_tpu.coord import client as client_mod
+    from manatee_tpu.coord.api import CoordError
+    from manatee_tpu.coord.client import NetCoord
+    from manatee_tpu.coord.server import CoordServer
+
+    # shrink the deadline floor (2 * handshake timeout) for test speed
+    monkeypatch.setattr(client_mod, "HANDSHAKE_TIMEOUT", 0.4)
+
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port, session_timeout=1)
+            await c.connect()
+            await c.create("/x", b"1")
+            freg.arm_spec("coord.client.recv=drop")
+            t0 = time.monotonic()
+            with pytest.raises(CoordError):
+                await c.get("/x")
+            # bounded by the reply deadline, not hung forever
+            assert time.monotonic() - t0 < 5.0
+            await c.close()
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+# ---- HTTP round trip on a real status server ----
+
+def test_http_round_trip(freg):
+    import aiohttp
+
+    from manatee_tpu.status_server import StatusServer
+
+    async def go():
+        server = StatusServer(host="127.0.0.1", port=0)
+        await server.start()
+        base = "http://127.0.0.1:%d" % server.port
+        try:
+            async with aiohttp.ClientSession() as http:
+                # catalog served even with nothing armed
+                async with http.get(base + "/faults") as r:
+                    assert r.status == 200
+                    body = await r.json()
+                assert body["armed"] == []
+                assert "coord.client.send" in body["catalog"]
+
+                # arm by spec; the reply echoes the rule
+                async with http.post(base + "/faults", json={
+                        "spec": "coord.client.send=drop,count=2"}) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                assert body["armed"][0]["point"] == "coord.client.send"
+                assert len(freg) == 1
+
+                # a bad spec is a 400 with the parser's message
+                async with http.post(base + "/faults", json={
+                        "spec": "nope"}) as r:
+                    assert r.status == 400
+                    assert "bad fault spec" in \
+                        (await r.json())["error"]
+
+                # list reflects the armed rule
+                async with http.get(base + "/faults") as r:
+                    body = await r.json()
+                assert [a["point"] for a in body["armed"]] == \
+                    ["coord.client.send"]
+
+                # clear disarms
+                async with http.delete(
+                        base + "/faults",
+                        params={"point": "coord.client.send"}) as r:
+                    assert (await r.json())["cleared"] == 1
+                assert len(freg) == 0
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_http_arming_gate(freg, monkeypatch):
+    """Without the explicit opt-in, POST/DELETE are refused (403) but
+    the read-only GET stays open — production daemons must not ship a
+    default-on unauthenticated fault surface."""
+    import aiohttp
+
+    from manatee_tpu.status_server import StatusServer
+
+    monkeypatch.setattr(faults, "_HTTP_ENABLED", False)
+
+    async def go():
+        server = StatusServer(host="127.0.0.1", port=0)
+        await server.start()
+        base = "http://127.0.0.1:%d" % server.port
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(base + "/faults") as r:
+                    assert r.status == 200
+                    body = await r.json()
+                assert body["arming_enabled"] is False
+                async with http.post(base + "/faults", json={
+                        "spec": "coord.client.send=drop"}) as r:
+                    assert r.status == 403
+                    assert "disabled" in (await r.json())["error"]
+                assert len(freg) == 0
+                async with http.delete(base + "/faults") as r:
+                    assert r.status == 403
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_http_batch_arming_is_atomic(freg):
+    """A batch with one bad spec arms NOTHING — a typo in a two-spec
+    partition drill must not leave the target half-partitioned."""
+    body, status = faults.http_arm_reply({"specs": [
+        "coord.client.connect=drop", "coord.client.sned=drop"]})
+    assert status == 400
+    assert "unknown failpoint" in body["error"]
+    assert len(freg) == 0
+
+
+def test_http_clear_rejects_typo(freg):
+    """A misspelled heal over raw HTTP is a 400, not a 200 cleared:0
+    that leaves the fault armed with the operator believing it healed."""
+    freg.arm_spec("coord.client.send=drop")
+    body, status = faults.http_clear_reply(
+        {"point": "coord.client.snd"})
+    assert status == 400 and "unknown failpoint" in body["error"]
+    assert len(freg) == 1
+    body, status = faults.http_clear_reply(
+        {"point": "coord.client.send"})
+    assert status == 200 and body["cleared"] == 1
+
+
+def test_all_bad_boot_specs_do_not_open_http(freg, monkeypatch):
+    """A config whose every spec was refused arms nothing AND must not
+    opt the daemon into runtime arming."""
+    monkeypatch.setattr(faults, "_HTTP_ENABLED", False)
+    assert faults.arm_specs(["coord.client.snd=drop"],
+                            source="config") == 0
+    assert not faults.http_arming_enabled()
+    assert faults.arm_specs(["coord.client.send=drop"],
+                            source="config") == 1
+    assert faults.http_arming_enabled()
+
+
+def test_env_presence_alone_does_not_open_http(freg, monkeypatch):
+    """MANATEE_FAULTS containing only refused specs must not open the
+    runtime surface either — ACTUAL arming is the opt-in, on every
+    boot path."""
+    monkeypatch.setattr(faults, "_HTTP_ENABLED", False)
+    monkeypatch.setenv("MANATEE_FAULTS", "coord.client.snd=drop")
+    faults._arm_from_env()
+    assert len(freg) == 0 and not faults.http_arming_enabled()
+    monkeypatch.setenv("MANATEE_FAULTS", "coord.client.send=drop")
+    faults._arm_from_env()
+    assert len(freg) == 1 and faults.http_arming_enabled()
+
+
+def test_pending_not_leaked_on_injected_send_error(freg):
+    """An injected coord.client.send=error must pop the request's
+    _pending entry — stale xids must not accumulate for the life of a
+    never-severed connection."""
+    from manatee_tpu.coord.client import NetCoord
+    from manatee_tpu.coord.server import CoordServer
+
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await c.connect()
+            freg.arm_spec("coord.client.send=error,count=3")
+            for _ in range(3):
+                with pytest.raises(faults.FaultError):
+                    await c.create("/x", b"1")
+            assert not c._pending, \
+                "injected send errors leaked pending futures"
+            # the connection survived the injections and still serves
+            await c.create("/x", b"1")
+            await c.close()
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+# ---- the CLI in --url mode (no cluster required) ----
+
+def run_fault_cli(*args, timeout=60):
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    return subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", "fault", *args],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def test_cli_url_round_trip(freg):
+    from manatee_tpu.status_server import StatusServer
+
+    async def go():
+        server = StatusServer(host="127.0.0.1", port=0)
+        await server.start()
+        url = "http://127.0.0.1:%d" % server.port
+        try:
+            # NOTE the argument order: specs directly after the verb,
+            # flags last (argparse cannot resume a trailing positional
+            # list after an optional)
+            cp = await asyncio.to_thread(
+                run_fault_cli, "set",
+                "coord.client.send=drop,count=1", "--url", url)
+            assert cp.returncode == 0, cp.stderr
+            assert "armed coord.client.send -> drop" in cp.stdout
+
+            cp = await asyncio.to_thread(
+                run_fault_cli, "list", "--url", url)
+            assert cp.returncode == 0, cp.stderr
+            assert "coord.client.send" in cp.stdout
+
+            # a bad spec dies client-side, before any arming
+            cp = await asyncio.to_thread(
+                run_fault_cli, "set", "bogus", "--url", url)
+            assert cp.returncode != 0
+            assert "bad fault spec" in cp.stderr
+
+            # conflicting targets are refused, not silently resolved
+            cp = await asyncio.to_thread(
+                run_fault_cli, "set", "coord.client.send=drop",
+                "--url", url, "-n", "peer1")
+            assert cp.returncode != 0
+            assert "conflicts" in cp.stderr
+
+            # a typo'd heal must not exit 0 having cleared nothing
+            cp = await asyncio.to_thread(
+                run_fault_cli, "clear", "coord.client.conect",
+                "--url", url)
+            assert cp.returncode != 0
+            assert "unknown failpoint" in cp.stderr
+
+            cp = await asyncio.to_thread(
+                run_fault_cli, "clear", "--url", url)
+            assert cp.returncode == 0, cp.stderr
+            assert "cleared 1 rule(s)" in cp.stdout
+            assert len(freg) == 0
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+# ---- the shared retry layer ----
+
+def test_retry_policy_schedule():
+    from manatee_tpu.utils.retry import RetryPolicy
+    p = RetryPolicy(base=0.5, cap=4.0, factor=2.0, jitter=False)
+    assert [p.delay_for(i) for i in (1, 2, 3, 4, 5)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0]
+    # equal jitter: decorrelated but never more than 2x the
+    # schedule's retry rate
+    pj = RetryPolicy(base=1.0, cap=8.0)
+    for attempt in (1, 3, 7):
+        raw = min(8.0, 2.0 ** (attempt - 1))
+        d = pj.delay_for(attempt)
+        assert raw / 2.0 <= d <= raw
+
+
+def test_backoff_counts_metrics_and_spans():
+    from manatee_tpu.obs import get_registry, get_span_store
+    from manatee_tpu.utils.retry import Backoff
+    counter = get_registry().counter("retry_attempts_total", "",
+                                     ("op",))
+    before = counter.value(op="test.op")
+    store = get_span_store()
+    seen_before = len([s for s in store.spans()
+                       if s["name"] == "retry.backoff"
+                       and s.get("op") == "test.op"])
+
+    async def go():
+        bo = Backoff("test.op", base=0.01, cap=0.02)
+        await bo.sleep()
+        await bo.sleep()
+        assert bo.attempts == 2
+        bo.reset()
+        assert bo.attempts == 0
+    asyncio.run(go())
+    assert counter.value(op="test.op") == before + 2
+    spans = [s for s in store.spans() if s["name"] == "retry.backoff"
+             and s.get("op") == "test.op"]
+    assert len(spans) == seen_before + 2
+    assert spans[-1]["attempt"] == 2
+
+
+def test_backoff_sleep_never_faster_than_fixed():
+    """The stateless one-off helper (watch re-arm) jitters UP from the
+    fixed delay, never below it — jittering down would retry MORE
+    often than the fixed schedule it replaced."""
+    from manatee_tpu.utils.retry import backoff_sleep
+
+    async def go():
+        for _ in range(20):
+            d = await backoff_sleep("test.rearm", 0.005)
+            assert 0.005 <= d <= 0.01
+    asyncio.run(go())
+
+
+def test_backoff_deadline_clamp():
+    from manatee_tpu.utils.retry import Backoff
+
+    async def go():
+        bo = Backoff("test.deadline", base=5.0, cap=10.0,
+                     deadline=time.monotonic() + 0.05)
+        t0 = time.monotonic()
+        await bo.sleep()
+        assert time.monotonic() - t0 < 1.0
+    asyncio.run(go())
+
+
+def test_backoff_custom_sleep_fn():
+    from manatee_tpu.utils.retry import Backoff
+    slept: list[float] = []
+
+    async def fake_sleep(d):
+        slept.append(d)
+
+    async def go():
+        bo = Backoff("test.swap", base=1.0, cap=2.0,
+                     sleep_fn=fake_sleep)
+        await bo.sleep()
+    asyncio.run(go())
+    assert len(slept) == 1 and 0.5 <= slept[0] <= 1.0
+
+
+# ---- catalog <-> docs sync ----
+
+def test_docs_list_every_failpoint():
+    doc = (REPO / "docs" / "fault-injection.md").read_text()
+    for name in faults.CATALOG:
+        assert "`%s`" % name in doc, \
+            "docs/fault-injection.md is missing failpoint %s" % name
+
+
+def test_man_page_has_fault_section():
+    man = (REPO / "docs" / "man" / "manatee-adm.md").read_text()
+    assert "fault set" in man and "fault clear" in man

@@ -24,7 +24,7 @@ DATA = Path(__file__).parent / "data" / "lint"
 NEW_RULES = {
     "orphan-task", "blocking-call-in-async", "blocking-io-in-async",
     "swallowed-cancellation", "cancel-without-await", "lock-discipline",
-    "unbounded-wait", "span-not-closed",
+    "unbounded-wait", "span-not-closed", "faultpoint-unregistered",
 }
 PORTED_RULES = {
     "syntax", "unused-import", "shadowed-def", "bare-except",
@@ -483,6 +483,74 @@ def test_span_not_closed_negative():
             sp = get_span_store().start("failover", root=True)
             return sp
     """)
+
+
+# ---- faultpoint-unregistered ----
+
+def test_faultpoint_literal_and_catalog():
+    # cataloged literal name: quiet
+    assert "faultpoint-unregistered" not in rules_of("""\
+        from manatee_tpu import faults
+        async def f():
+            await faults.point("pg.restore")
+    """)
+    # computed name defeats the catalog
+    assert "faultpoint-unregistered" in rules_of("""\
+        from manatee_tpu import faults
+        async def f(name):
+            await faults.point(name)
+    """)
+    # a name missing from the catalog can never be armed
+    assert "faultpoint-unregistered" in rules_of("""\
+        from manatee_tpu import faults
+        async def f():
+            await faults.point("pg.rsetore")
+    """)
+    # other libraries' point() APIs are not ours to police
+    assert "faultpoint-unregistered" not in rules_of("""\
+        async def f(geom):
+            await geom.point("x")
+    """)
+
+
+def test_faultpoint_duplicate_in_file():
+    res = lint("""\
+        from manatee_tpu import faults
+        async def f():
+            await faults.point("pg.restore")
+        async def g():
+            await faults.point("pg.restore")
+    """)
+    dupes = [f for f in res.findings
+             if f.rule == "faultpoint-unregistered"]
+    assert len(dupes) == 1 and "already invoked" in dupes[0].msg
+
+
+def test_faultpoint_file_binding():
+    import textwrap as tw
+    src = tw.dedent("""\
+        from manatee_tpu import faults
+        async def f():
+            await faults.point("pg.restore")
+    """)
+    # production sources are bound to the catalog's seam file ...
+    res = check_source(src, "manatee_tpu/coord/server.py")
+    assert any(f.rule == "faultpoint-unregistered"
+               and "registered to" in f.msg for f in res.findings)
+    # ... the registered file itself is quiet
+    res2 = check_source(src, "manatee_tpu/pg/manager.py")
+    assert not [f for f in res2.findings
+                if f.rule == "faultpoint-unregistered"]
+
+
+def test_faultpoint_catalog_integrity():
+    # every catalog entry names at least one seam file and a non-empty
+    # action set drawn from the known actions
+    from manatee_tpu.faults import ACTIONS
+    from manatee_tpu.faults.catalog import CATALOG
+    for name, (desc, files, actions) in CATALOG.items():
+        assert desc and files and actions, name
+        assert set(actions) <= set(ACTIONS), name
 
 
 # ---- suppressions ----

@@ -1,0 +1,268 @@
+"""Scripted live asymmetric-partition failover drill (no env gate).
+
+The real-stack counterpart of the model checker's `partition` scenario,
+induced purely through `manatee-adm fault` (docs/fault-injection.md):
+the primary's PROCESS stays alive — its database keeps serving, its
+status server answers — while its coordination traffic is black-holed
+(no FIN is ever sent, so this drives the full heartbeat-expiry
+detection path, not the fast FIN path the SIGKILL suites take).
+
+Proves the three acceptance invariants end to end:
+
+- **single writable primary**: a write-authority HANDOVER, never an
+  overlap — once the taking-over sync acks its first synchronous
+  write, the partitioned ex-primary never acks again (its sync left,
+  so synchronous commit can never complete there), and no third peer
+  ever acks;
+- **durability**: every synchronously-acked write — from before the
+  partition, from the handover window, and from after — is readable
+  on the post-recovery primary;
+- **observability**: the partition-era backoff storm on the isolated
+  peer is visible as `retry_attempts_total` metrics and as
+  `retry.backoff` spans, and `manatee-adm trace --last-failover`
+  reassembles the takeover with no spans left open.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import time
+
+import aiohttp
+
+from tests.harness import ClusterHarness, run_cli
+from tests.test_integration import converged
+
+
+class AckSampler:
+    """Continuously offers a synchronous write to EVERY peer and
+    records who acked when — the live probe behind the
+    single-writable-primary invariant."""
+
+    def __init__(self, cluster: ClusterHarness):
+        self.cluster = cluster
+        self.acks: list[tuple[str, float, str]] = []  # (peer, t, value)
+        self._n = 0
+        self._stop = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    async def _offer(self, peer) -> None:
+        self._n += 1
+        value = "sample-%s-%d" % (peer.name, self._n)
+        try:
+            res = await peer.pg_query(
+                {"op": "insert", "value": value, "timeout": 0.8}, 2.5)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        if isinstance(res, dict) and res.get("ok"):
+            self.acks.append((peer.name, time.monotonic(), value))
+
+    async def _run(self, peer) -> None:
+        # one loop PER peer: the partitioned peer's probe burns its
+        # full timeout every round, and serializing behind it would
+        # starve sampling of the healthy peers
+        while not self._stop.is_set():
+            await self._offer(peer)
+            await asyncio.sleep(0.05)
+
+    def start(self) -> None:
+        self._tasks = [asyncio.create_task(self._run(p))
+                       for p in self.cluster.peers]
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+    async def wait_ack_from(self, peer_name: str,
+                            timeout: float = 20.0) -> None:
+        """Block until the sampler itself has recorded an ack from
+        *peer_name* — the handover assertion needs first-hand evidence
+        of the new primary acking, not just wait_writable's."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if any(p == peer_name for p, _t, _v in self.acks):
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError(
+            "sampler never observed an ack from %s" % peer_name)
+
+    def acked_values(self) -> list[str]:
+        return [v for _p, _t, v in self.acks]
+
+    def assert_handover(self, old: str, new: str) -> None:
+        ackers = {p for p, _t, _v in self.acks}
+        assert ackers <= {old, new}, \
+            "a peer that was never primary acked writes: %r" % ackers
+        old_times = [t for p, t, _v in self.acks if p == old]
+        new_times = [t for p, t, _v in self.acks if p == new]
+        assert new_times, "the taking-over sync never acked a write"
+        if old_times:
+            assert max(old_times) < min(new_times), \
+                "write authority OVERLAPPED: %s acked at %.3f after " \
+                "%s first acked at %.3f (two write-enabled primaries)" \
+                % (old, max(old_times), new, min(new_times))
+
+
+async def http_get(url: str, timeout: float = 5.0):
+    tmo = aiohttp.ClientTimeout(total=timeout)
+    async with aiohttp.ClientSession(timeout=tmo) as http:
+        async with http.get(url) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            if "json" in ctype:
+                return resp.status, await resp.json()
+            return resp.status, await resp.text()
+
+
+def test_partition_failover_drill(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        sampler = AckSampler(cluster)
+        try:
+            await cluster.start()
+            primary, sync, _asyncs = await converged(cluster, n=3)
+            gen0 = (await cluster.cluster_state())["generation"]
+            # durability seed: one write acked before any fault exists
+            await cluster.wait_writable(primary, "pre-partition")
+            sampler.start()
+
+            # -- induce the partition purely through manatee-adm fault
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "fault", "set",
+                "coord.client.connect=drop", "coord.client.send=drop",
+                "-n", primary.name)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            assert cp.stdout.count("armed") == 2, cp.stdout
+
+            # the CLI's own list round-trip sees both rules on the
+            # partitioned peer (its status server still answers!)
+            cp = await asyncio.to_thread(run_cli, cluster, "fault",
+                                         "list", "-j")
+            assert cp.returncode == 0, cp.stderr
+            listed = json.loads(cp.stdout)
+            armed = [r["point"] for r in
+                     listed.get(primary.ident, {}).get("armed", [])]
+            assert sorted(armed) == ["coord.client.connect",
+                                     "coord.client.send"]
+
+            # -- failover: coordd heartbeat-expires the silent session,
+            # the sync takes over with a generation bump
+            st = await cluster.wait_topology(primary=sync, timeout=30)
+            assert st["generation"] > gen0
+            await cluster.wait_writable(sync, "post-failover")
+
+            # the partitioned ex-primary is ALIVE (that is the point):
+            # its status server answers and its database still serves
+            status, body = await http_get(
+                "http://127.0.0.1:%d/ping" % primary.status_port)
+            assert status in (200, 503) and isinstance(body, dict)
+            # ... but it can never complete a synchronous write (its
+            # sync detached to take over), so there is no second
+            # write-enabled primary
+            from manatee_tpu.pg.engine import PgError
+            acked = False
+            try:
+                res = await primary.pg_query(
+                    {"op": "insert", "value": "must-not-ack",
+                     "timeout": 0.8}, 2.5)
+                acked = bool(res.get("ok"))
+            except (PgError, asyncio.TimeoutError):
+                pass     # refused/timed out: exactly what must happen
+            assert not acked, \
+                "partitioned ex-primary acked a synchronous write"
+
+            # -- the partition-era backoff storm is observable on the
+            # isolated peer: jittered reconnect/setup attempts as
+            # metrics and as retry.backoff spans
+            deadline = time.monotonic() + 15
+            attempts = 0.0
+            while time.monotonic() < deadline and attempts == 0.0:
+                _s, metrics = await http_get(
+                    "http://127.0.0.1:%d/metrics"
+                    % primary.status_port)
+                for m in re.finditer(
+                        r'retry_attempts_total\{op="([^"]+)"\} (\d+)',
+                        metrics):
+                    if m.group(1) in ("coord.reconnect",
+                                      "coord.setup"):
+                        attempts += float(m.group(2))
+                if attempts == 0.0:
+                    await asyncio.sleep(0.5)
+            assert attempts > 0, \
+                "no partition-era backoff attempts in /metrics"
+            assert "fault_injections_total" in metrics
+            _s, spans_body = await http_get(
+                "http://127.0.0.1:%d/spans" % primary.status_port)
+            backoffs = [s for s in spans_body["spans"]
+                        if s["name"] == "retry.backoff"
+                        and s.get("op") in ("coord.reconnect",
+                                            "coord.setup")]
+            assert backoffs, "no retry.backoff spans on the " \
+                             "partitioned peer"
+
+            # -- single-writable-primary + durability over the window
+            # (don't stop sampling until the sampler has first-hand
+            # evidence of the new primary acking — a fast run could
+            # otherwise stop before any of its own probes landed)
+            await sampler.wait_ack_from(sync.name)
+            await sampler.stop()
+            sampler.assert_handover(primary.name, sync.name)
+            res = await sync.pg_query({"op": "select"}, 5.0)
+            rows = set(res["rows"])
+            expected = {"setup-write", "pre-partition",
+                        "post-failover"} | set(sampler.acked_values())
+            missing = sorted(expected - rows)
+            assert not missing, "ACKED WRITES LOST: %r" % missing
+
+            # -- heal: clear the faults; the ex-primary rejoins,
+            # observes itself deposed, and is rebuilt the operator way
+            cp = await asyncio.to_thread(run_cli, cluster, "fault",
+                                         "clear", "-n", primary.name)
+            assert cp.returncode == 0, cp.stderr
+            await cluster.wait_for(
+                lambda s: any(d["id"] == primary.ident
+                              for d in s.get("deposed") or []),
+                20, "ex-primary deposed after heal")
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "rebuild", "-y", "-c",
+                str(primary.root / "sitter.json"), "--timeout", "90")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                cp = await asyncio.to_thread(run_cli, cluster,
+                                             "verify", timeout=30)
+                if cp.returncode == 0:
+                    break
+                await asyncio.sleep(1.0)
+            assert cp.returncode == 0, \
+                "never converged to verify-clean after the heal:\n%s" \
+                % cp.stdout
+
+            # durability again, post-recovery, through the NEW primary
+            st = await cluster.cluster_state()
+            cur = cluster.peer_by_id(st["primary"]["id"])
+            res = await cur.pg_query({"op": "select"}, 5.0)
+            missing = sorted(expected - set(res["rows"]))
+            assert not missing, \
+                "ACKED WRITES LOST AFTER RECOVERY: %r" % missing
+
+            # -- the takeover's trace reassembles cleanly
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "trace", "--last-failover", "-j")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            tr = json.loads(cp.stdout)
+            assert tr["spans"] and tr["roots"]
+            assert tr["open"] == [], \
+                "failover left spans open: %r" % tr["open"]
+        finally:
+            await sampler.stop()
+            await cluster.stop()
+
+    asyncio.run(go())
