@@ -34,6 +34,7 @@ from manatee_tpu.obs import (
     span,
 )
 from manatee_tpu.storage.base import StorageBackend
+from manatee_tpu.utils.aio import cancel_requests
 
 log = logging.getLogger("manatee.backup.client")
 
@@ -240,6 +241,19 @@ class RestoreClient:
                         async with http.get(
                                 backup_url.rstrip("/")
                                 + job_path) as jr:
+                            if jr.status == 404:
+                                # the server no longer knows our job:
+                                # it restarted (e.g. crashed mid-send)
+                                # and its queue died with it.  The
+                                # dial-back will never come — without
+                                # this check the poll loop spins
+                                # FOREVER on the 404 body (the crash
+                                # sweep's backup.send.connect scenario
+                                # caught exactly that wedge)
+                                poll_error = ("restore job vanished "
+                                              "on the sender (server "
+                                              "restarted?)")
+                                break
                             remote = await jr.json()
                     except (aiohttp.ClientError,
                             asyncio.TimeoutError) as e:
@@ -257,6 +271,28 @@ class RestoreClient:
                 await recv_done
             job["done"] = True
         except asyncio.CancelledError:
+            cur = asyncio.current_task()
+            if recv_done.cancelled() \
+                    and hasattr(cur, "cancelling") \
+                    and cancel_requests(cur) == 0:
+                # The HANDLER task was cancelled by something that did
+                # not cancel US (only our own finally-sweep does today,
+                # but e.g. a 3.12 server teardown could): re-raising
+                # would propagate a spurious CancelledError out of an
+                # UNcancelled _receive and label the job 'cancelled',
+                # masking the real abort — surface it as the restore
+                # failure it is (ADVICE r5).  cancelling() (3.11+) is
+                # what proves nobody cancelled us; on 3.10 the counter
+                # does not exist and the two cases cannot be told
+                # apart (awaiting a future and being cancelled cancels
+                # the future too), so the old re-raise behavior stands
+                # there rather than risk converting a genuine caller
+                # cancellation into a RestoreError.
+                job["done"] = "failed"
+                job["error"] = "receive handler aborted"
+                raise RestoreError(
+                    "restore receive handler was cancelled while the "
+                    "restore itself was not") from None
             job["done"] = "failed"
             job["error"] = "cancelled"
             if not recv_done.done():

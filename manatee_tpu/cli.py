@@ -800,6 +800,102 @@ def cmd_fault(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_doctor(args) -> int:
+    """Store integrity verifier (docs/crash-recovery.md): offline
+    checks of coordd data dirs (--coord-data) and dir-backend store
+    roots (--store-root / -c sitter config), plus online cluster-state
+    schema/generation checks against the durable history and the
+    merged event journal.  Exit 0 when no DAMAGE was found (notes and
+    warnings are recoverable crash leftovers); nonzero otherwise —
+    the crash-recovery sweep runs this after every recovery."""
+    from manatee_tpu.doctor import (
+        NOTE,
+        WARNING,
+        check_cluster,
+        check_coordd_store,
+        check_dirstore,
+        finding,
+        summarize,
+    )
+
+    findings: list[dict] = []
+    store_roots = list(args.store_root or [])
+    cfgpath = args.config or os.environ.get("MANATEE_SITTER_CONFIG")
+    if cfgpath:
+        from manatee_tpu.utils.validation import load_json_config
+        cfg = load_json_config(cfgpath, None, name="sitter config")
+        if cfg.get("storageBackend", "zfs") == "dir":
+            store_roots.append(cfg["storageRoot"])
+        else:
+            findings.append(finding(
+                NOTE, "store-not-dir", cfgpath,
+                "storageBackend %r has no offline verifier (zfs "
+                "scrub owns that); skipping the store checks"
+                % cfg.get("storageBackend", "zfs")))
+    for d in args.coord_data or []:
+        findings.extend(check_coordd_store(d))
+    for root in store_roots:
+        findings.extend(check_dirstore(root))
+
+    coord_addr = args.coord or os.environ.get("COORD_ADDR") \
+        or os.environ.get("ZK_IPS")
+    online = not args.offline and coord_addr
+    if online:
+        shard = _shard(args)
+
+        async def go():
+            async with AdmClient(coord_addr) as adm:
+                state, _v = await adm.get_state(shard)
+                hist = await adm.get_history(shard)
+                events: list[dict] = []
+                if state is not None:
+                    try:
+                        events = (await adm.shard_events(
+                            shard))["events"]
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        findings.append(finding(
+                            NOTE, "journal-unavailable", "cluster",
+                            "no event journal reachable (%s); "
+                            "generation checks ran against the "
+                            "history only" % e))
+                return state, hist, events
+        try:
+            state, hist, events = asyncio.run(go())
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            # an unreachable coordination service is NOT store damage:
+            # keep the offline findings, report the online phase as
+            # skipped, and let the exit code reflect the stores alone
+            # (use --offline to silence this when coordd is known down)
+            findings.append(finding(
+                WARNING, "coord-unreachable", coord_addr,
+                "online cluster checks skipped: %s" % e))
+        else:
+            findings.extend(check_cluster(state, hist, events))
+    elif not (args.coord_data or store_roots or findings):
+        # findings counts: a zfs-backend -c config produced a
+        # store-not-dir NOTE — that is an answer, not a usage error
+        die("nothing to verify: provide --coord-data, --store-root "
+            "or -c for offline checks, and/or a coordination address "
+            "(-z/COORD_ADDR) for the online checks")
+
+    s = summarize(findings)
+    if args.json:
+        print(json.dumps(s, indent=2))
+    else:
+        for f in findings:
+            print("%-8s %-22s %s" % (f["level"].upper(), f["check"],
+                                     f["target"]))
+            print("         %s" % f["detail"])
+        print("doctor: %d damage, %d warning(s), %d note(s) — %s"
+              % (s["damage"], s["warnings"], s["notes"],
+                 "CLEAN" if s["ok"] else "DAMAGED"))
+    return 0 if s["ok"] else 1
+
+
 def cmd_rebuild(args) -> int:
     """Guarded rebuild flow (lib/adm.js:1319-1684): refuse on the
     primary; deposed peers get their dataset destroyed and their deposed
@@ -1074,6 +1170,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-j", "--json", action="store_true")
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")
+
+    sp = add("doctor", cmd_doctor,
+             "verify store integrity (coordd op log, dir-backend "
+             "datasets, cluster state vs history/journal)")
+    sp.add_argument("--coord-data", action="append", default=None,
+                    metavar="DIR",
+                    help="verify a coordd --data-dir offline "
+                         "(repeatable)")
+    sp.add_argument("--store-root", action="append", default=None,
+                    metavar="DIR",
+                    help="verify a dir-backend store root offline "
+                         "(repeatable)")
+    sp.add_argument("-c", "--config", default=None,
+                    help="sitter config to derive the store root from "
+                         "(env: MANATEE_SITTER_CONFIG)")
+    sp.add_argument("--offline", action="store_true",
+                    help="skip the online cluster-state checks even "
+                         "when a coordination address is available")
+    sp.add_argument("-j", "--json", action="store_true",
+                    help="machine-readable findings + summary")
 
     sp = add("rebuild", cmd_rebuild, "rebuild this peer from upstream")
     sp.add_argument("-c", "--config",

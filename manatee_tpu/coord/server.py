@@ -144,6 +144,28 @@ MIN_DISCONNECT_GRACE = RECONNECT_DELAY + 0.15
 _MUTATING = frozenset({"create", "set", "delete", "multi"})
 
 
+def parse_segment_name(p) -> tuple[int, int] | None:
+    """(epoch, start_seq) from an op-log segment path, or None when
+    the name is unrecognizable (startup deletes those as stale).
+    Shared with `manatee-adm doctor` (manatee_tpu/doctor.py) so the
+    on-disk naming contract cannot drift between writer and
+    verifier."""
+    parts = p.stem.split("-")
+    try:
+        return int(parts[-2][1:]), int(parts[-1])
+    except (ValueError, IndexError):
+        return None
+
+
+def snapshot_shape_ok(snap) -> bool:
+    """The loadable-snapshot shape contract ({v:1, root, seq, epoch})
+    — shared with `manatee-adm doctor` for the same no-drift reason.
+    seq/epoch are load-bearing: a snapshot missing them would default
+    the epoch to 0 and delete the real-epoch segments as stale."""
+    return (isinstance(snap, dict) and snap.get("v") == 1
+            and "root" in snap and "seq" in snap and "epoch" in snap)
+
+
 def _b64(data: bytes) -> str:
     return base64.b64encode(data).decode()
 
@@ -405,13 +427,9 @@ class CoordServer:
         want = self._persist_epoch if epoch is None else epoch
         out = []
         for p in Path(self.data_dir).glob("coordd-oplog-*.jsonl"):
-            parts = p.stem.split("-")
-            try:
-                e, start = int(parts[-2][1:]), int(parts[-1])
-            except (ValueError, IndexError):
-                continue
-            if e == want:
-                out.append((start, p))
+            key = parse_segment_name(p)
+            if key is not None and key[0] == want:
+                out.append((key[1], p))
         out.sort()
         return [p for _s, p in out]
 
@@ -420,13 +438,8 @@ class CoordServer:
         and orphaned snapshot tmp files — safe to delete."""
         out = []
         for p in Path(self.data_dir).glob("coordd-oplog-*.jsonl"):
-            parts = p.stem.split("-")
-            try:
-                e = int(parts[-2][1:])
-            except (ValueError, IndexError):
-                out.append(p)
-                continue
-            if e != self._persist_epoch:
+            key = parse_segment_name(p)
+            if key is None or key[0] != self._persist_epoch:
                 out.append(p)
         out.extend(Path(self.data_dir).glob("coordd-tree.json.tmp*"))
         return out
@@ -440,9 +453,7 @@ class CoordServer:
         if path.exists():
             try:
                 snap = json.loads(path.read_text())
-                if not isinstance(snap, dict) or snap.get("v") != 1 \
-                        or "root" not in snap \
-                        or "seq" not in snap or "epoch" not in snap:
+                if not snapshot_shape_ok(snap):
                     # from_snapshot is lenient (it returns an EMPTY
                     # tree for an unrecognized shape — right for wire
                     # adoption, catastrophic here: an empty tree with

@@ -1,0 +1,381 @@
+"""Store integrity verification for `manatee-adm doctor`.
+
+The crash-recovery sweep (docs/crash-recovery.md) crashes a daemon at
+every cataloged failpoint and restarts it on the same data dir; doctor
+is the judge that the stores it recovered from — and the ones it left
+behind — are sound.  Three families of checks, all READ-ONLY (doctor
+must be safe to run against a live shard and must never "helpfully"
+repair what an operator needs to inspect):
+
+- **coordd store** (``--coord-data``): the snapshot + op-log layout of
+  coord/server.py, verified by replaying it exactly as recovery would,
+  without mutating a byte.  A torn final line of the final segment is
+  *classified* (crash mid-append; necessarily unacked; recovery
+  truncates it) and reported as a note, NOT damage — distinguishing it
+  from mid-stream corruption, seq gaps, replay divergence, and
+  malformed snapshots, all of which mean acked writes are at risk and
+  the server itself would refuse to start.
+- **dirstore** (``--store-root``): dataset shape (@data/@snapshots/
+  @meta.json), meta parseability (the empty/truncated meta an
+  un-fsynced tmp-rename crash used to install), and the
+  dataset↔meta cross-check: every snapshot meta names must exist on
+  disk, every on-disk snapshot should be in meta (an orphan dir is the
+  crash window between copytree and meta install — recoverable,
+  reported as a warning).
+- **cluster state** (online): schema shape of the state object,
+  generation monotonicity across the durable history, and agreement
+  with the event journal (a journal that has seen a HIGHER generation
+  than the stored state means the store rolled back an acked
+  transition).
+
+Findings carry a severity: ``damage`` (acked data at risk — nonzero
+exit), ``warning`` (recoverable inconsistency worth an operator's
+look), ``note`` (expected crash leftovers recovery cleans).  Every
+check function here is pure/synchronous so it can run offline, in
+tests, and under ``asyncio.to_thread`` from the CLI alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from manatee_tpu.storage.dirstore import META_KEYS, _RESERVED
+
+DAMAGE = "damage"
+WARNING = "warning"
+NOTE = "note"
+
+
+def finding(level: str, check: str, target: str, detail: str) -> dict:
+    return {"level": level, "check": check, "target": str(target),
+            "detail": detail}
+
+
+def summarize(findings: list[dict]) -> dict:
+    counts = {DAMAGE: 0, WARNING: 0, NOTE: 0}
+    for f in findings:
+        counts[f["level"]] += 1
+    return {"findings": findings, "damage": counts[DAMAGE],
+            "warnings": counts[WARNING], "notes": counts[NOTE],
+            "ok": counts[DAMAGE] == 0}
+
+
+# ---- coordd store ----
+
+def _snapshot_stamp(d: Path):
+    """Identity of the installed snapshot, for the live-compaction
+    retry: every segment deletion coordd performs is preceded by a
+    snapshot install (a rename, so a new inode), so an unchanged stamp
+    across a scan proves the scan saw a consistent store."""
+    try:
+        st = (d / "coordd-tree.json").stat()
+        return (st.st_ino, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+
+
+def check_coordd_store(data_dir: str | Path) -> list[dict]:
+    """Verify a coordd --data-dir the way server recovery would load
+    it, read-only: snapshot shape, current-epoch segment replay with
+    seq continuity and acked-result agreement, torn-tail
+    classification, and crash-leftover (stale epoch / tmp snapshot)
+    accounting.
+
+    Safe against a LIVE coordd: the snapshot and the segments are read
+    non-atomically, and a compaction landing between the two reads
+    would make a healthy store look gap-damaged (the new snapshot
+    supersedes segments the scan already planned on).  The scan
+    retries while the snapshot identity moved underneath it."""
+    d = Path(data_dir)
+    out: list[dict] = []
+    for _attempt in range(3):
+        before = _snapshot_stamp(d)
+        out = _scan_coordd_store(d)
+        if _snapshot_stamp(d) == before:
+            break           # nothing moved: the scan was consistent
+    return out
+
+
+def _scan_coordd_store(d: Path) -> list[dict]:
+    # imported lazily so an offline dirstore-only doctor run does not
+    # pull the whole coordination stack.  parse_segment_name /
+    # snapshot_shape_ok / _apply_wire_op / _seed_seq_counters come
+    # from the SERVER so the on-disk contract this verifier enforces
+    # is the writer's own code, never a drifting copy.
+    from manatee_tpu.coord import model
+    from manatee_tpu.coord.api import CoordError
+    from manatee_tpu.coord.server import (
+        _apply_wire_op,
+        _seed_seq_counters,
+        parse_segment_name,
+        snapshot_shape_ok,
+    )
+
+    out: list[dict] = []
+    if not d.is_dir():
+        out.append(finding(DAMAGE, "coord-dir-missing", d,
+                           "data dir does not exist"))
+        return out
+
+    snap_path = d / "coordd-tree.json"
+    tree = model.ZNodeTree()
+    seq = 0
+    epoch: int | None = None
+    if snap_path.exists():
+        try:
+            snap = json.loads(snap_path.read_text())
+            if not snapshot_shape_ok(snap):
+                raise ValueError("unrecognized snapshot shape")
+            tree = model.ZNodeTree.from_snapshot(snap)
+            seq = int(snap["seq"])
+            epoch = int(snap["epoch"])
+        except (ValueError, OSError, KeyError, TypeError) as e:
+            out.append(finding(
+                DAMAGE, "coord-snapshot-corrupt", snap_path,
+                "snapshot exists but cannot be loaded (%s); the "
+                "server would refuse to start" % e))
+            return out
+
+    segs: list[tuple[int, int, Path]] = []
+    for p in d.glob("coordd-oplog-*.jsonl"):
+        key = parse_segment_name(p)
+        if key is None:
+            out.append(finding(NOTE, "oplog-unrecognized-name", p,
+                               "unparseable segment name (startup "
+                               "removes it as stale)"))
+            continue
+        segs.append((key[0], key[1], p))
+    if epoch is None:
+        epoch = max((e for e, _s, _p in segs), default=0)
+    stale = [p for e, _s, p in segs if e != epoch]
+    for p in sorted(stale):
+        out.append(finding(NOTE, "oplog-stale-epoch", p,
+                           "segment from epoch superseded by a resync "
+                           "snapshot (startup removes it)"))
+    for p in sorted(d.glob("coordd-tree.json.tmp*")):
+        out.append(finding(NOTE, "snapshot-tmp-orphan", p,
+                           "snapshot tmp file a crashed compaction "
+                           "never installed (startup removes it)"))
+
+    current = sorted(((s, p) for e, s, p in segs if e == epoch))
+    paths = [p for _s, p in current]
+    for i, path in enumerate(paths):
+        try:
+            raw = path.read_bytes()
+        except OSError as e:
+            out.append(finding(DAMAGE, "oplog-unreadable", path,
+                               str(e)))
+            return out
+        nonempty = [part for part in raw.split(b"\n") if part]
+        for j, line in enumerate(nonempty):
+            last_line = (i == len(paths) - 1
+                         and j == len(nonempty) - 1)
+            try:
+                ent = json.loads(line)
+                entry_seq = int(ent["seq"])
+                req = ent["req"]
+            except (ValueError, KeyError, TypeError):
+                if last_line:
+                    out.append(finding(
+                        NOTE, "oplog-torn-tail", path,
+                        "final line is torn (crash mid-append; it "
+                        "was never acked — recovery truncates it)"))
+                    break
+                out.append(finding(
+                    DAMAGE, "oplog-corrupt", path,
+                    "unparseable entry mid-stream (line %d of the "
+                    "non-empty lines); acked writes would be lost"
+                    % (j + 1)))
+                return out
+            if entry_seq <= seq:
+                continue            # covered by the snapshot
+            if entry_seq != seq + 1:
+                out.append(finding(
+                    DAMAGE, "oplog-gap", path,
+                    "entry seq %d follows %d; acked writes in the "
+                    "gap are gone" % (entry_seq, seq)))
+                return out
+            expect = ent.get("expect")
+            try:
+                _seed_seq_counters(tree, req, expect)
+                got = _apply_wire_op(tree, req)
+            except CoordError as e:
+                out.append(finding(
+                    DAMAGE, "oplog-apply-failed", path,
+                    "entry seq %d does not apply (%s)"
+                    % (entry_seq, e)))
+                return out
+            if "expect" in ent and got != expect:
+                out.append(finding(
+                    DAMAGE, "oplog-diverged", path,
+                    "replaying seq %d produced %r but %r was acked"
+                    % (entry_seq, got, expect)))
+                return out
+            seq = entry_seq
+    return out
+
+
+# ---- dirstore ----
+
+def _dataset_dirs(root: Path) -> list[Path]:
+    """Every directory under datasets/ that looks like a dataset (has
+    any of the reserved members), deepest-last."""
+    base = root / "datasets"
+    out = []
+    if not base.is_dir():
+        return out
+    for dirpath, dirnames, filenames in os.walk(base):
+        members = set(dirnames) | set(filenames)
+        # never descend into dataset CONTENT (restored pg trees can be
+        # arbitrarily deep and could even contain reserved names)
+        dirnames[:] = [n for n in dirnames
+                       if n not in ("@data", "@snapshots")]
+        if members & _RESERVED:
+            out.append(Path(dirpath))
+    out.sort()
+    return out
+
+
+def check_dirstore(root: str | Path) -> list[dict]:
+    """Verify a dir-backend store root: per-dataset shape, meta
+    parseability, and the dataset↔meta snapshot cross-check."""
+    root = Path(root)
+    out: list[dict] = []
+    if not (root / "datasets").is_dir():
+        out.append(finding(WARNING, "no-datasets-dir", root,
+                           "not a dir-backend store root (no "
+                           "datasets/ directory)"))
+        return out
+    for ds in _dataset_dirs(root):
+        rel = ds.relative_to(root / "datasets")
+        meta_path = ds / "@meta.json"
+        for tmp in sorted(ds.glob("@meta.json.tmp*")):
+            out.append(finding(NOTE, "meta-tmp-orphan", tmp,
+                               "tmp meta a crashed save never "
+                               "installed (safe to remove)"))
+        if not meta_path.exists():
+            out.append(finding(DAMAGE, "meta-missing", ds,
+                               "dataset %s has content but no "
+                               "@meta.json" % rel))
+            continue
+        try:
+            meta = json.loads(meta_path.read_text())
+            if not isinstance(meta, dict):
+                raise ValueError("meta is not an object")
+        except (ValueError, OSError) as e:
+            out.append(finding(
+                DAMAGE, "meta-corrupt", meta_path,
+                "unreadable/unparseable @meta.json (%s) — the "
+                "empty/truncated install a non-fsynced tmp rename "
+                "leaves after a crash" % e))
+            continue
+        missing = [k for k in META_KEYS if k not in meta]
+        if missing:
+            out.append(finding(DAMAGE, "meta-malformed", meta_path,
+                               "missing keys: %s" % ", ".join(missing)))
+            continue
+        if not (ds / "@data").is_dir():
+            out.append(finding(DAMAGE, "data-missing", ds,
+                               "dataset %s has no @data directory"
+                               % rel))
+        snapdir = ds / "@snapshots"
+        if not snapdir.is_dir():
+            out.append(finding(DAMAGE, "snapdir-missing", ds,
+                               "dataset %s has no @snapshots "
+                               "directory" % rel))
+            continue
+        snaps_meta = meta.get("snaps")
+        if not isinstance(snaps_meta, dict):
+            out.append(finding(DAMAGE, "meta-malformed", meta_path,
+                               "snaps is not an object"))
+            continue
+        on_disk = {p.name for p in snapdir.iterdir() if p.is_dir()}
+        for name in sorted(set(snaps_meta) - on_disk):
+            out.append(finding(
+                DAMAGE, "snapshot-missing", ds,
+                "meta records snapshot %r but @snapshots/%s does "
+                "not exist" % (name, name)))
+        for name in sorted(on_disk - set(snaps_meta)):
+            out.append(finding(
+                WARNING, "snapshot-orphan", snapdir / name,
+                "snapshot directory not recorded in meta (crash "
+                "between copy and meta install; safe to remove)"))
+        if meta.get("mounted"):
+            mp = meta.get("mountpoint")
+            target = str((ds / "@data").resolve())
+            if not mp or not Path(mp).is_symlink() \
+                    or os.path.realpath(mp) != target:
+                out.append(finding(
+                    WARNING, "mount-stale", ds,
+                    "meta says mounted but the mountpoint symlink "
+                    "is absent or points elsewhere (is_mounted "
+                    "treats the symlink as ground truth)"))
+    return out
+
+
+# ---- cluster state vs history vs journal (online) ----
+
+def check_cluster(state: dict | None, history: list[dict],
+                  events: list[dict]) -> list[dict]:
+    """Pure checks over already-fetched cluster data: state schema,
+    generation monotonicity across the durable history, and journal
+    agreement (no peer's event ring may have seen a generation the
+    store has since lost)."""
+    out: list[dict] = []
+    if state is None:
+        out.append(finding(WARNING, "state-missing", "cluster",
+                           "no cluster state object (uninitialized "
+                           "shard?)"))
+        return out
+    bad = []
+    if not isinstance(state.get("generation"), int) \
+            or state["generation"] < 0:
+        bad.append("generation")
+    if not isinstance(state.get("primary"), dict) \
+            or not state["primary"].get("id"):
+        bad.append("primary")
+    if "initWal" not in state:
+        bad.append("initWal")
+    for key in ("async", "deposed"):
+        if state.get(key) is not None \
+                and not isinstance(state.get(key), list):
+            bad.append(key)
+    if bad:
+        out.append(finding(DAMAGE, "state-schema", "cluster",
+                           "state object malformed: %s"
+                           % ", ".join(bad)))
+        return out
+    gens = [(h.get("zkSeq"), h.get("generation")) for h in history
+            if isinstance(h.get("generation"), int)]
+    gens.sort(key=lambda t: (t[0] is None, t[0]))
+    last = None
+    for zkseq, g in gens:
+        if last is not None and g < last:
+            out.append(finding(
+                DAMAGE, "generation-regression", "cluster",
+                "history generation went backwards (%d after %d at "
+                "coordination seq %s)" % (g, last, zkseq)))
+        last = g
+    if last is not None and state["generation"] < last:
+        out.append(finding(
+            DAMAGE, "generation-regression", "cluster",
+            "stored state is at generation %d but the history has "
+            "seen %d — the store rolled back an acked transition"
+            % (state["generation"], last)))
+    # transition.committed ONLY: begin is journaled with the ATTEMPTED
+    # generation before the CAS write, and a lost race / connection
+    # error legitimately leaves a begin at g+1 in some ring with the
+    # store correctly still at g — only a committed event proves the
+    # write was acked
+    seen = [e.get("generation") for e in events
+            if e.get("event") == "transition.committed"
+            and isinstance(e.get("generation"), int)]
+    if seen and max(seen) > state["generation"]:
+        out.append(finding(
+            DAMAGE, "journal-generation-ahead", "cluster",
+            "a peer's event journal has seen generation %d but the "
+            "stored state is at %d — the store rolled back an acked "
+            "transition" % (max(seen), state["generation"])))
+    return out

@@ -25,6 +25,15 @@ Actions (per armed rule):
   network partition: the process stays up, its pg keeps running, but
   its coordination traffic vanishes — the real-stack analogue of the
   model checker's ``partition`` scenario.
+- ``crash``   the process terminates ITSELF at the seam, un-catchably
+  — ``crash`` / ``crash:exit`` is a hard ``os._exit(CRASH_EXIT_CODE)``
+  (no atexit, no finally, no daemon signal handlers), ``crash:kill``
+  is SIGKILL-to-self (the kernel path, indistinguishable from an OOM
+  kill).  This is what makes the crash-recovery sweep deterministic:
+  instead of killing a peer at a scheduler-chosen instant, the sweep
+  arms ``<point>=crash`` and the process dies exactly AT the
+  dangerous seam — mid-promote, mid-oplog-append, mid-restore
+  (docs/crash-recovery.md).
 
 Triggers compose onto any action: ``count=N`` injects at most N times
 (``count=1`` = one-shot), ``prob=P`` injects each pass with probability
@@ -59,6 +68,8 @@ import asyncio
 import logging
 import os
 import random
+import signal
+import sys
 import time
 
 from manatee_tpu.faults.catalog import CATALOG, actions_for
@@ -71,7 +82,14 @@ _INJECTIONS = _REG.counter(
     "fault_injections_total",
     "faults injected at live failpoints", ("point", "action"))
 
-ACTIONS = ("error", "delay", "drop", "stall")
+ACTIONS = ("error", "delay", "drop", "stall", "crash")
+
+# the os._exit status a `crash`/`crash:exit` rule dies with — distinctive
+# so a sweep can tell "crashed at the armed seam" (this code) from "died
+# of something else" (anything else); crash:kill dies of SIGKILL instead
+# (waitpid status -9), the kernel path no userland fingerprint survives
+CRASH_EXIT_CODE = 86
+CRASH_VARIANTS = ("exit", "kill")
 
 
 class FaultError(Exception):
@@ -119,16 +137,18 @@ class FaultRule:
 
     __slots__ = ("rule_id", "pt", "action", "error", "delay", "jitter",
                  "count", "prob", "hits", "armed_at", "source",
-                 "_cleared")
+                 "variant", "_cleared")
 
     def __init__(self, rule_id: int, pt: str, action: str, *,
                  error: str = "FaultError", delay: float = 0.0,
                  jitter: float = 0.0, count: int | None = None,
-                 prob: float | None = None, source: str = "api"):
+                 prob: float | None = None, variant: str = "exit",
+                 source: str = "api"):
         self.rule_id = rule_id
         self.pt = pt
         self.action = action
         self.error = error
+        self.variant = variant
         self.delay = float(delay)
         self.jitter = float(jitter)
         self.count = None if count is None else int(count)
@@ -158,6 +178,7 @@ class FaultRule:
             "point": self.pt,
             "action": self.action,
             "error": self.error if self.action == "error" else None,
+            "variant": self.variant if self.action == "crash" else None,
             "delay": self.delay if self.action == "delay" else None,
             "jitter": self.jitter if self.action == "delay" else None,
             "count": self.count,
@@ -194,13 +215,15 @@ def parse_spec(spec: str) -> dict:
                 kw["delay"] = float(arg)
             except ValueError:
                 raise FaultSpecError("bad delay %r" % arg) from None
+        elif action == "crash":
+            kw["variant"] = arg.strip()
         else:
             raise FaultSpecError("action %r takes no argument" % action)
     for opt in opts:
         k, s, v = opt.partition("=")
         k = k.strip()
         if not s or k not in ("count", "prob", "delay", "jitter",
-                              "error"):
+                              "error", "variant"):
             raise FaultSpecError("bad fault option %r" % opt)
         try:
             if k == "count":
@@ -218,7 +241,8 @@ def parse_spec(spec: str) -> dict:
 def validate_arm(*, point: str, action: str,
                  error: str = "FaultError", delay: float = 0.0,
                  jitter: float = 0.0, count: int | None = None,
-                 prob: float | None = None) -> None:
+                 prob: float | None = None,
+                 variant: str = "exit") -> None:
     """Every arm-time check, side-effect free — so batch arming can
     validate ALL specs before arming ANY (a multi-spec `fault set`
     with a typo must not leave the target half-armed), and the CLI can
@@ -240,6 +264,20 @@ def validate_arm(*, point: str, action: str,
     elif error != "FaultError":
         raise FaultSpecError(
             "error=%s only applies to the error action" % error)
+    if action == "crash":
+        if variant not in CRASH_VARIANTS:
+            raise FaultSpecError(
+                "unknown crash variant %r (one of %s)"
+                % (variant, "/".join(CRASH_VARIANTS)))
+        if prob is not None or count is not None:
+            # the process dies on the first hit — a count/prob trigger
+            # promises later injections that can never happen
+            raise FaultSpecError(
+                "count/prob do not apply to the crash action (the "
+                "first hit terminates the process)")
+    elif variant != "exit":
+        raise FaultSpecError(
+            "variant=%s only applies to the crash action" % variant)
     if action == "delay":
         if delay <= 0:
             raise FaultSpecError("delay must be > 0 (got %r)" % delay)
@@ -277,13 +315,13 @@ class FaultRegistry:
     def arm(self, *, point: str, action: str, error: str = "FaultError",
             delay: float = 0.0, jitter: float = 0.0,
             count: int | None = None, prob: float | None = None,
-            source: str = "api") -> FaultRule:
+            variant: str = "exit", source: str = "api") -> FaultRule:
         validate_arm(point=point, action=action, error=error,
                      delay=delay, jitter=jitter, count=count,
-                     prob=prob)
+                     prob=prob, variant=variant)
         rule = FaultRule(self._next_id, point, action, error=error,
                          delay=delay, jitter=jitter, count=count,
-                         prob=prob, source=source)
+                         prob=prob, variant=variant, source=source)
         self._next_id += 1
         self._rules.setdefault(point, []).append(rule)
         log.warning("fault armed: %s -> %s (count=%s prob=%s) [%s]",
@@ -362,7 +400,9 @@ class FaultRegistry:
                 # fault_injections_total counter instead
                 get_journal().record(
                     "fault.injected", point=name, action=rule.action)
-            if rule.action == "delay":
+            if rule.action == "crash":
+                _crash_now(name, rule)
+            elif rule.action == "delay":
                 d = rule.delay
                 if rule.jitter:
                     d += random.random() * rule.jitter
@@ -377,6 +417,31 @@ class FaultRegistry:
             elif rule.action == "drop":
                 verdict = "drop"
         return verdict
+
+
+def _crash_now(name: str, rule: FaultRule) -> None:
+    """Terminate THIS process at the seam, un-catchably.  ``exit`` is a
+    hard ``os._exit`` — no exception propagation, no finally blocks, no
+    atexit, no daemon signal handlers, exactly the guarantee the crash
+    sweep needs (a crash a supervisor could observe as a clean shutdown
+    would not be a crash).  ``kill`` raises SIGKILL against ourselves:
+    the kernel path, indistinguishable from an OOM kill to the parent.
+    The log line is best-effort breadcrumb only — the whole point is
+    that nothing after this instant is guaranteed to run."""
+    log.critical("failpoint %s: crashing the process (variant=%s, "
+                 "rule %d)", name, rule.variant, rule.rule_id)
+    try:
+        sys.stderr.flush()
+        sys.stdout.flush()
+    except Exception:
+        pass
+    if rule.variant == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        # SIGKILL is delivered on return to user mode; never fall
+        # through to executing the seam if delivery lags a tick
+        while True:                                # pragma: no cover
+            time.sleep(1)
+    os._exit(CRASH_EXIT_CODE)
 
 
 # ---- process singleton ----
@@ -432,6 +497,7 @@ def _rule_signature(kw: dict) -> tuple:
     shapes to the arm() defaults)."""
     return (kw["point"], kw["action"],
             kw.get("error") or "FaultError",
+            kw.get("variant") or "exit",
             kw.get("delay") or 0.0, kw.get("jitter") or 0.0,
             kw.get("count"), kw.get("prob"))
 
@@ -522,7 +588,8 @@ def http_arm_reply(body) -> tuple[dict, int]:
         elif body.get("point"):
             kw = {k: body[k]
                   for k in ("point", "action", "error", "delay",
-                            "jitter", "count", "prob") if k in body}
+                            "jitter", "count", "prob", "variant")
+                  if k in body}
             armed.append(get_faults().arm(source="http", **kw))
         else:
             return {"error": "provide spec/specs or point+action"}, 400
@@ -588,6 +655,8 @@ def attach_http(app) -> None:
 __all__ = [
     "ACTIONS",
     "CATALOG",
+    "CRASH_EXIT_CODE",
+    "CRASH_VARIANTS",
     "FaultError",
     "FaultRegistry",
     "FaultRule",

@@ -16,7 +16,11 @@ share:
 
 Keep entries sorted by name.  ``drop`` support is a per-seam property
 (a black hole only means something where bytes travel); the lists here
-say which actions each site honors.
+say which actions each site honors.  ``crash`` is supported at EVERY
+seam — process death is meaningful anywhere — and the crash-recovery
+sweep (tests/test_crash_sweep.py, docs/crash-recovery.md) enforces via
+a sync test that every entry here has a sweep scenario crashing a live
+shard exactly at that seam and proving recovery.
 """
 
 from __future__ import annotations
@@ -29,33 +33,33 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         "restore client's POST /backup to the upstream's backup server; "
         "drop = the request is black-holed (reads as a timeout)",
         ("manatee_tpu/backup/client.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "backup.recv.stream": (
         "restore client's inbound snapshot stream, at accept time; "
         "drop = the accepted connection is severed before any byte "
         "is consumed",
         ("manatee_tpu/backup/client.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "backup.send.connect": (
         "backup sender's dial-back to the requester's receive "
         "listener; drop = the SYN is black-holed (reads as a connect "
         "timeout)",
         ("manatee_tpu/backup/sender.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "backup.send.stream": (
         "backup sender's snapshot stream, before the first byte; "
         "stall models a wedged send",
         ("manatee_tpu/backup/sender.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "coord.client.connect": (
         "sitter-side dial+handshake to coordd; drop = the SYN is "
         "black-holed (connection loss), the partition primitive",
         ("manatee_tpu/coord/client.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "coord.client.recv": (
         "inbound coordd frame delivery (replies and watch pushes); "
@@ -63,82 +67,82 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         "(outbound heartbeats keep the session alive) the client "
         "detects via its reply deadline and severs",
         ("manatee_tpu/coord/client.py",),
-        ("delay", "drop"),
+        ("delay", "drop", "crash"),
     ),
     "coord.client.send": (
         "outbound coordd RPC frame write (pings included); drop = the "
         "frame is black-holed — the session dies of heartbeat silence "
         "while the process lives, the partition primitive",
         ("manatee_tpu/coord/client.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "coord.put_state": (
         "consensus manager's durable cluster-state transaction "
         "(state + history, one multi)",
         ("manatee_tpu/coord/manager.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "coordd.dispatch": (
         "coordd server-side request dispatch; drop = the request is "
         "consumed but never answered",
         ("manatee_tpu/coord/server.py",),
-        ("error", "delay", "stall", "drop"),
+        ("error", "delay", "stall", "drop", "crash"),
     ),
     "coordd.oplog.append": (
         "coordd durable op-log append (error injects a disk-write "
         "failure, exercising the synchronous-snapshot fallback)",
         ("manatee_tpu/coord/server.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "pg.catchup": (
         "primary's wait-for-standby-catchup poll loop (each pass); "
         "stall keeps the primary read-only — a stalled takeover",
         ("manatee_tpu/pg/manager.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "pg.promote": (
         "pg manager's primary transition, before promotion",
         ("manatee_tpu/pg/manager.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "pg.repoint": (
         "standby's live upstream re-point (reload fast path)",
         ("manatee_tpu/pg/manager.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "pg.restore": (
         "standby's full restore from the upstream's backup server, "
         "before the transfer starts",
         ("manatee_tpu/pg/manager.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "state.write": (
         "state machine's durable CAS write of a decided transition",
         ("manatee_tpu/state/machine.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "storage.recv": (
         "dir-backend stream receive into a dataset (restore data "
         "path)",
         ("manatee_tpu/storage/dirstore.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "storage.send": (
         "dir-backend snapshot stream send (backup data path)",
         ("manatee_tpu/storage/dirstore.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "storage.snapshot": (
         "dir-backend snapshot creation (the transition snapshot and "
         "the snapshotter ride this)",
         ("manatee_tpu/storage/dirstore.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
     "storage.zfs.exec": (
         "every zfs(8) command the ZFS backend runs (one seam for the "
         "whole command family)",
         ("manatee_tpu/storage/zfsbackend.py",),
-        ("error", "delay", "stall"),
+        ("error", "delay", "stall", "crash"),
     ),
 }
 

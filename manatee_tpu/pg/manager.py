@@ -460,7 +460,13 @@ class PostgresMgr:
         if (not force_restore and self.running and self._online
                 and self.engine.reloadable_upstream
                 and self._applied
-                and self._applied.get("role") in ("sync", "async")):
+                and self._applied.get("role") in ("sync", "async")
+                # the running db must actually BE a standby: an
+                # applied config with no upstream booted it
+                # non-recovery, and no reload can flip a running
+                # primary-mode process into recovery — only the
+                # restart path below can
+                and self._applied.get("upstream")):
             log.info("%s: re-pointing standby upstream to %s (reload, "
                      "no restart)", self.peer_id, upstream.get("id"))
             with span("pg.repoint", upstream=upstream.get("id")):

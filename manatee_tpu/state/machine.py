@@ -766,7 +766,15 @@ class PeerStateMachine:
                     "downstream": asyncs[0] if asyncs else None}
         idx = next(i for i, a in enumerate(asyncs)
                    if a["id"] == self.self_id)
-        upstream = st.get("sync") if idx == 0 else asyncs[idx - 1]
+        # the preceding peer in the daisy chain.  A takeover written
+        # while every standby candidate was dead leaves sync=None with
+        # asyncs listed (the crash sweep's state.write scenario hits
+        # exactly this window); the chain then collapses to
+        # primary <- async0, and an upstream of None here would boot
+        # the async as a NON-recovery database that never streams —
+        # a silent permanent wedge
+        upstream = (st.get("sync") or st.get("primary")) if idx == 0 \
+            else asyncs[idx - 1]
         downstream = asyncs[idx + 1] if idx + 1 < len(asyncs) else None
         return {"role": "async", "upstream": upstream,
                 "downstream": downstream}

@@ -24,6 +24,7 @@ import asyncio
 import json
 import os
 import shutil
+import threading
 import time
 from pathlib import Path
 
@@ -40,14 +41,43 @@ from manatee_tpu.storage.base import (
 from manatee_tpu.utils.executil import drain_and_reap
 
 _RESERVED = {"@data", "@snapshots", "@meta.json"}
+# the keys every @meta.json carries (create() writes exactly these).
+# Together with _RESERVED this IS the on-disk contract `manatee-adm
+# doctor` verifies (manatee_tpu/doctor.py imports both) — change them
+# here and the verifier follows.
+META_KEYS = ("mountpoint", "mounted", "props", "snaps")
 
 
 class DirBackend(StorageBackend):
     def __init__(self, root: str | Path):
         self.root = Path(root)
         (self.root / "datasets").mkdir(parents=True, exist_ok=True)
+        self._sweep_meta_tmp()
 
     # ---- internals ----
+
+    def _sweep_meta_tmp(self, min_age_s: float = 60.0) -> None:
+        """Startup cleanup of ``@meta.json.tmp-<pid>-<tid>`` files a
+        crashed save never renamed into place — the same discipline
+        coordd applies to its snapshot tmp orphans.  Only files older
+        than *min_age_s* go: a sibling process (the snapshotter saving
+        this dataset's meta right now) has an in-flight tmp that is
+        milliseconds old, and unlinking it would fail that save."""
+        now = time.time()
+        base = self.root / "datasets"
+        for dirpath, dirnames, filenames in os.walk(base):
+            # never descend into dataset content
+            dirnames[:] = [n for n in dirnames
+                           if n not in ("@data", "@snapshots")]
+            for name in filenames:
+                if not name.startswith("@meta.json.tmp"):
+                    continue
+                p = Path(dirpath) / name
+                try:
+                    if now - p.stat().st_mtime >= min_age_s:
+                        p.unlink()
+                except OSError:
+                    pass
 
     def _dspath(self, dataset: str) -> Path:
         if not dataset or dataset.startswith("/") or ".." in dataset.split("/"):
@@ -67,10 +97,41 @@ class DirBackend(StorageBackend):
             raise StorageError("dataset does not exist: %s" % dataset) from None
 
     def _save_meta(self, dataset: str, meta: dict) -> None:
+        # crash-safe install, same discipline as coordd's snapshot
+        # path: tmp write, fsync the FILE (rename-before-data can
+        # install an empty/truncated meta — the very damage
+        # `manatee-adm doctor` classifies), atomic rename, fsync the
+        # parent dir so the rename itself survives a power loss.
+        # DELIBERATELY synchronous from the event loop: every caller
+        # is a load-modify-save section whose atomicity the loop
+        # guarantees only while there is no await between the load
+        # and the installed save — pushing the fsyncs to a thread
+        # would let a cancelled transition's orphaned save land AFTER
+        # a successor's, reinstating stale meta.  Meta is tiny and
+        # saves are rare (snapshots, mounts, transitions), so the
+        # bounded fsync stall is the cheaper side of the trade.
+        # The tmp name is per-writer-unique: the sitter AND the
+        # snapshotter both save this dataset's meta, and a SHARED tmp
+        # path lets one writer truncate the file another is about to
+        # rename into place — installing torn meta (the storm suite
+        # caught exactly that once the fsync widened the window)
         p = self._meta_path(dataset)
-        tmp = p.with_name(p.name + ".tmp")
-        tmp.write_text(json.dumps(meta, indent=2))
-        tmp.replace(p)
+        tmp = p.with_name("%s.tmp-%d-%d"
+                          % (p.name, os.getpid(),
+                             threading.get_ident()))
+        with open(tmp, "w") as f:
+            f.write(json.dumps(meta, indent=2))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+        try:
+            fd = os.open(p.parent, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
 
     def _exists_sync(self, dataset: str) -> bool:
         return self._meta_path(dataset).exists()

@@ -33,6 +33,39 @@ from manatee_tpu.storage import DirBackend              # noqa: E402
 FAKEPG_BIN = str(REPO / "tests" / "fakepg")
 
 
+def _group_has_members(pgid: int) -> bool:
+    """True when any live process belongs to process group *pgid*.
+    Read from /proc: once the group LEADER has been reaped its pid no
+    longer answers os.getpgid, yet orphaned members (a crashed
+    sitter's database child) keep the group alive and killable."""
+    for ent in os.listdir("/proc"):
+        if not ent.isdigit():
+            continue
+        try:
+            with open("/proc/%s/stat" % ent) as fh:
+                stat = fh.read()
+            # "pid (comm) state ppid pgrp ..." — comm can contain
+            # spaces/parens, so split on the LAST ')'
+            if int(stat.rsplit(")", 1)[1].split()[2]) == pgid:
+                return True
+        except (OSError, ValueError, IndexError):
+            continue
+    return False
+
+
+def _killpg_remnants(proc, sig: int) -> None:
+    """killpg a spawned daemon's process group, including after the
+    leader itself exited (a crash failpoint) — but ONLY while the
+    group still has members: once the leader is reaped AND the group
+    is empty, the pid is free for reuse, and an unconditional killpg
+    could SIGKILL an unrelated process that recycled it."""
+    if proc.poll() is None or _group_has_members(proc.pid):
+        try:
+            os.killpg(proc.pid, sig)
+        except ProcessLookupError:
+            pass
+
+
 def cli_env(coord_addr: str, shard: str = "1") -> dict:
     """Environment for invoking the manatee-adm CLI as a subprocess —
     the ONE place the CLI's env contract (COORD_ADDR/SHARD/PYTHONPATH,
@@ -161,26 +194,43 @@ class Peer:
 
     # -- processes --
 
-    def _spawn(self, module: str, cfg: str, logname: str) -> subprocess.Popen:
+    def _spawn(self, module: str, cfg: str, logname: str,
+               extra_env: dict | None = None) -> subprocess.Popen:
         env = dict(os.environ, PYTHONPATH=str(REPO))
+        if extra_env:
+            env.update(extra_env)
         logf = open(self.root / logname, "ab")
         return subprocess.Popen(
             [sys.executable, "-m", module, "-f", cfg],
             stdout=logf, stderr=logf, env=env,
             start_new_session=True, cwd=str(self.root))
 
-    def start(self, *, snapshotter: bool | None = None) -> None:
+    @staticmethod
+    def _faults_env(specs) -> dict | None:
+        """Boot-arm fault specs for ONE daemon spawn via the
+        MANATEE_FAULTS env contract — unlike a config `faults` list
+        this does not persist, so the crash sweep's restart-clean step
+        needs no config rewrite."""
+        return ({"MANATEE_FAULTS": ";".join(specs)} if specs else None)
+
+    def start(self, *, snapshotter: bool | None = None,
+              sitter_faults=(), backup_faults=()) -> None:
         """*snapshotter=None* inherits the cluster-wide setting, so
         storm/chaos revive paths bring back the FULL daemon trio the
-        reference fixture always runs (testManatee.js:99-398)."""
+        reference fixture always runs (testManatee.js:99-398).
+        *sitter_faults*/*backup_faults*: fault specs boot-armed on that
+        one daemon for THIS spawn only (the crash sweep's arm-at-the-
+        seam path)."""
         if snapshotter is None:
             snapshotter = self.cluster.snapshotter
         self.sitter_proc = self._spawn(
             "manatee_tpu.daemons.sitter",
-            str(self.root / "sitter.json"), "sitter.log")
+            str(self.root / "sitter.json"), "sitter.log",
+            self._faults_env(sitter_faults))
         self.backup_proc = self._spawn(
             "manatee_tpu.daemons.backupserver",
-            str(self.root / "backupserver.json"), "backupserver.log")
+            str(self.root / "backupserver.json"), "backupserver.log",
+            self._faults_env(backup_faults))
         if snapshotter:
             self.snap_proc = self._spawn(
                 "manatee_tpu.daemons.snapshotter",
@@ -188,13 +238,14 @@ class Peer:
 
     def kill(self, sig: int = signal.SIGKILL) -> None:
         """SIGKILL the whole peer (sitter + database child +
-        backupserver), testManatee.js kill() parity."""
+        backupserver), testManatee.js kill() parity.  The killpg runs
+        even for a daemon that already EXITED: a sitter that crashed
+        via a `crash` failpoint (os._exit) leaves its database child
+        alive in the process group, and skipping the dead leader would
+        strand that child holding the pg port across a restart."""
         for proc in (self.sitter_proc, self.backup_proc, self.snap_proc):
-            if proc and proc.poll() is None:
-                try:
-                    os.killpg(proc.pid, sig)
-                except ProcessLookupError:
-                    pass
+            if proc:
+                _killpg_remnants(proc, sig)
         for proc in (self.sitter_proc, self.backup_proc, self.snap_proc):
             if proc:
                 try:
@@ -203,20 +254,53 @@ class Peer:
                     pass
         self.sitter_proc = self.backup_proc = self.snap_proc = None
 
-    def start_sitter_only(self) -> None:
+    def wait_daemon_exit(self, which: str = "sitter",
+                         timeout: float = 60.0) -> int:
+        """Block until one of this peer's daemons exits ON ITS OWN
+        (the crash sweep's evidence that the armed seam fired) and
+        return its exit status: faults.CRASH_EXIT_CODE for
+        crash/crash:exit, -SIGKILL for crash:kill."""
+        proc = {"sitter": self.sitter_proc,
+                "backup": self.backup_proc,
+                "snapshotter": self.snap_proc}[which]
+        assert proc is not None, "%s not running" % which
+        return proc.wait(timeout=timeout)
+
+    def kill_backup_only(self, sig: int = signal.SIGKILL) -> None:
+        """Reap just the backupserver's process group (crashed or
+        alive), leaving sitter/snapshotter running."""
+        if self.backup_proc:
+            _killpg_remnants(self.backup_proc, sig)
+            try:
+                self.backup_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        self.backup_proc = None
+
+    def start_backup_only(self, *, faults=()) -> None:
+        self.backup_proc = self._spawn(
+            "manatee_tpu.daemons.backupserver",
+            str(self.root / "backupserver.json"), "backupserver.log",
+            self._faults_env(faults))
+
+    def start_sitter_only(self, *, faults=()) -> None:
         """Respawn just the sitter (backupserver/snapshotter keep
         running) — the fast-restart half of the MANATEE_206 scenario."""
         self.sitter_proc = self._spawn(
             "manatee_tpu.daemons.sitter",
-            str(self.root / "sitter.json"), "sitter.log")
+            str(self.root / "sitter.json"), "sitter.log",
+            self._faults_env(faults))
 
     def kill_sitter_only(self, sig: int = signal.SIGKILL) -> None:
-        if self.sitter_proc and self.sitter_proc.poll() is None:
+        # killpg even when the sitter itself already exited (a crash
+        # failpoint): its database child lives on in the group and
+        # must not survive into the respawn holding the pg port
+        if self.sitter_proc:
+            _killpg_remnants(self.sitter_proc, sig)
             try:
-                os.killpg(self.sitter_proc.pid, sig)
-            except ProcessLookupError:
+                self.sitter_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
                 pass
-            self.sitter_proc.wait(timeout=5)
         self.sitter_proc = None
 
     # -- queries --
@@ -279,8 +363,14 @@ class ClusterHarness:
         self.snapshotter = snapshotter
         self.snapshot_poll = snapshot_poll
         self.snapshot_number = snapshot_number
-        self.port_base = alloc_port_block(n_coord + 4 * n_peers)
+        # coord RPC ports first, then 4 ports per peer, then one
+        # metrics port per coord member (AT THE END so the peers'
+        # long-standing base offsets are untouched)
+        self.port_base = alloc_port_block(2 * n_coord + 4 * n_peers)
         self.coord_ports = [self.port_base + i for i in range(n_coord)]
+        self.coord_metrics_ports = [
+            self.port_base + n_coord + 4 * n_peers + i
+            for i in range(n_coord)]
         self.coord_port = self.coord_ports[0]
         self.coord_procs: list[subprocess.Popen | None] = [None] * n_coord
         self.peers = [Peer(self, i + 1) for i in range(n_peers)]
@@ -291,14 +381,30 @@ class ClusterHarness:
 
     # -- lifecycle --
 
-    def start_coordd(self, idx: int | None = None) -> None:
-        env = dict(os.environ, PYTHONPATH=str(REPO))
+    def coord_data_dir(self, idx: int = 0) -> Path:
+        return self.root / ("coord-data%d" % idx)
+
+    def coord_metrics_url(self, idx: int = 0) -> str:
+        """coordd's metrics listener — the /faults arming surface the
+        crash sweep targets with `manatee-adm fault set --url`."""
+        return "http://127.0.0.1:%d" % self.coord_metrics_ports[idx]
+
+    def start_coordd(self, idx: int | None = None, *,
+                     faults=()) -> None:
+        env = dict(os.environ, PYTHONPATH=str(REPO),
+                   # runtime /faults arming on the metrics listener is
+                   # opt-in; the fixture opts in like the peers'
+                   # faultsEnabled config key does
+                   MANATEE_FAULTS_ENABLED="1")
+        if faults:
+            env["MANATEE_FAULTS"] = ";".join(faults)
         which = range(self.n_coord) if idx is None else [idx]
         for i in which:
             logf = open(self.root / ("coordd%d.log" % i), "ab")
             argv = [sys.executable, "-m", "manatee_tpu.coord.server",
                     "--port", str(self.coord_ports[i]),
-                    "--data-dir", str(self.root / ("coord-data%d" % i)),
+                    "--data-dir", str(self.coord_data_dir(i)),
+                    "--metrics-port", str(self.coord_metrics_ports[i]),
                     "--tick", "0.1"]
             if self.n_coord > 1:
                 argv += ["--ensemble", self.coord_connstr,
@@ -326,6 +432,14 @@ class ClusterHarness:
                     pass
                 proc.wait(timeout=5)
             self.coord_procs[i] = None
+
+    def wait_coordd_exit(self, idx: int = 0,
+                         timeout: float = 60.0) -> int:
+        """Block until a coordd exits on its own (a crash failpoint
+        firing) and return its exit status."""
+        proc = self.coord_procs[idx]
+        assert proc is not None, "coordd %d not running" % idx
+        return proc.wait(timeout=timeout)
 
     # legacy single-server attribute for existing tests
     @property
@@ -363,6 +477,28 @@ class ClusterHarness:
             await self.peers[i].write_configs()
             self.peers[i].start()
             await asyncio.sleep(stagger)  # join order = peer order
+
+    async def wipe_dataset(self, peer: Peer) -> None:
+        """Destroy a (stopped) peer's pg dataset so its next boot takes
+        the full restore-from-upstream path — the inducement for the
+        restore-seam crash scenarios."""
+        be = DirBackend(str(peer.root / "store"))
+        if await be.exists("manatee/pg"):
+            await be.destroy("manatee/pg", recursive=True)
+
+    async def restart_peer(self, peer: Peer, *, wipe_data: bool = False,
+                           sitter_faults=(), backup_faults=()) -> None:
+        """The crash sweep's recovery primitive: bring a peer back ON
+        THE SAME data dir, ports, and identity — kill whatever is left
+        of it first (a crashed sitter's orphaned database child
+        included), optionally wipe the dataset (restore-path
+        scenarios), optionally boot-arm fault specs on one daemon for
+        the respawn."""
+        peer.kill()
+        if wipe_data:
+            await self.wipe_dataset(peer)
+        peer.start(sitter_faults=sitter_faults,
+                   backup_faults=backup_faults)
 
     async def stop(self) -> None:
         # dump only on FAILING teardowns: stop() runs in the tests'

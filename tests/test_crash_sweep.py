@@ -1,0 +1,452 @@
+"""Crash-at-every-seam recovery sweep (docs/crash-recovery.md).
+
+The kill suites (killstorms, chaos) SIGKILL peers at scheduler-chosen
+instants; this sweep is the deterministic complement: for EVERY
+failpoint in ``faults/catalog.py`` it runs a live 3-peer shard under a
+continuous acked-write workload, arms ``<point>=crash`` so the
+targeted daemon terminates itself exactly AT the seam (hard
+``os._exit`` or SIGKILL-to-self — never catchable), restarts the dead
+process on the same data dir/identity
+(``ClusterHarness.restart_peer``), and asserts the standing
+invariants:
+
+- never two write-enabled primaries (per-peer ack windows never
+  overlap);
+- every synchronously-acked write — before, during, and after the
+  crash window — is readable on the post-recovery primary;
+- the shard reconverges to a full verify-clean chain (deposed
+  ex-primaries rebuilt the operator way, ``manatee-adm rebuild``);
+- every store verifies clean under ``manatee-adm doctor`` (coordd
+  op log + snapshot, every peer's dir-backend store, cluster state vs
+  history vs journal);
+- no peer's span ring is left with open spans.
+
+``test_sweep_covers_every_failpoint`` keeps SCENARIOS in lockstep with
+the catalog (like the catalog↔docs sync test): adding a failpoint
+without teaching the sweep how to crash at it fails tier-1 CI.
+
+The live scenarios are marked ``slow`` (the full sweep is the
+chaos-cadence CI job); the ``crash_fast`` subset runs on the tier-1
+cadence as its own job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.faults import CATALOG, CRASH_EXIT_CODE
+from tests.harness import ClusterHarness, run_cli
+from tests.test_integration import converged
+from tests.test_partition import AckSampler, http_get
+
+REPO = Path(__file__).resolve().parent.parent
+
+# point -> how the sweep reaches that seam on a live shard.
+#
+# kind:
+#   boot_async    restart the async's sitter with the crash boot-armed
+#                 (wipe=True routes it through the full restore path
+#                 first), crash during (re)join, restart clean
+#   takeover      arm the SYNC's sitter at runtime, SIGKILL the
+#                 primary: the taking-over sync crashes mid-takeover;
+#                 restart both, rebuild the deposed ex-primary
+#   repoint       arm the ASYNC's sitter, then `manatee-adm promote`
+#                 it to sync: its upstream changes (old sync -> the
+#                 primary) while the process is fully healthy, so the
+#                 reload fast path — the seam — runs deterministically
+#                 (killing the sync instead would sometimes find the
+#                 async's pg momentarily unhealthy and take the
+#                 restart path, skipping the seam); the async crashes
+#                 mid-re-point and is restarted
+#   primary_write arm the PRIMARY's sitter, SIGKILL the async: the
+#                 primary crashes committing the topology change, the
+#                 sync takes over; restart both, rebuild the deposed
+#                 ex-primary
+#   sender        arm the sync's BACKUPSERVER (the restoring async's
+#                 upstream), wipe the async: the sender crashes
+#                 mid-backup-stream; restart it, the restore retries
+#                 to completion
+#   coordd        arm coordd via its metrics listener; crash at the
+#                 dispatch/durability seam, restart it on the same
+#                 data dir (op-log recovery), sessions re-register
+#   zfs_subproc   the zfs seam has no live dir-backend driver: a child
+#                 process runs ZfsBackend against the fake zfs(8) with
+#                 the crash armed, dies at the seam, and a clean rerun
+#                 recovers
+#
+# variant: "exit" (default, os._exit → CRASH_EXIT_CODE) or "kill"
+# (SIGKILL-to-self → waitpid -SIGKILL); both variants are exercised.
+SCENARIOS: dict[str, dict] = {
+    "backup.post":          dict(kind="boot_async", wipe=True),
+    "backup.recv.stream":   dict(kind="boot_async", wipe=True,
+                                 variant="kill"),
+    "backup.send.connect":  dict(kind="sender"),
+    "backup.send.stream":   dict(kind="sender", variant="kill"),
+    "coord.client.connect": dict(kind="boot_async"),
+    "coord.client.recv":    dict(kind="boot_async"),
+    "coord.client.send":    dict(kind="boot_async", variant="kill"),
+    "coord.put_state":      dict(kind="primary_write", variant="kill"),
+    "coordd.dispatch":      dict(kind="coordd", variant="kill"),
+    "coordd.oplog.append":  dict(kind="coordd", induce="freeze"),
+    "pg.catchup":           dict(kind="takeover", variant="kill"),
+    "pg.promote":           dict(kind="takeover"),
+    "pg.repoint":           dict(kind="repoint"),
+    "pg.restore":           dict(kind="boot_async", wipe=True),
+    "state.write":          dict(kind="primary_write"),
+    "storage.recv":         dict(kind="boot_async", wipe=True),
+    "storage.send":         dict(kind="sender"),
+    "storage.snapshot":     dict(kind="boot_async", wipe=True),
+    "storage.zfs.exec":     dict(kind="zfs_subproc"),
+}
+
+# The tier-1-cadence subset (~2-3 min total): one representative per
+# ARMING SURFACE — boot env (restore path), boot env (rejoin), runtime
+# CLI -n (takeover incl. the deposed-rebuild recovery), runtime --url
+# on a backupserver (sender), runtime --url on coordd, and the
+# subprocess zfs driver — with both crash variants present.  The
+# repoint and primary_write families ride the full chaos-cadence sweep
+# only; anything here also runs there.
+FAST_POINTS = {"backup.post", "coord.client.send",
+               "backup.send.stream", "coordd.dispatch",
+               "pg.promote", "storage.zfs.exec"}
+
+
+def test_sweep_covers_every_failpoint():
+    """The catalog↔sweep sync test: a new failpoint fails CI until it
+    is swept (mirror of the catalog↔docs test in test_faults.py)."""
+    missing = set(CATALOG) - set(SCENARIOS)
+    assert not missing, \
+        "failpoints with no crash-sweep scenario: %s — every " \
+        "cataloged seam must be swept (tests/test_crash_sweep.py, " \
+        "docs/crash-recovery.md)" % sorted(missing)
+    extra = set(SCENARIOS) - set(CATALOG)
+    assert not extra, "sweep scenarios for uncataloged points: %s" \
+        % sorted(extra)
+    assert FAST_POINTS <= set(SCENARIOS)
+    for point, scn in SCENARIOS.items():
+        assert "crash" in CATALOG[point][2], \
+            "%s does not list the crash action" % point
+        assert scn.get("variant", "exit") in ("exit", "kill")
+
+
+def spec_for(point: str, variant: str) -> str:
+    return "%s=crash%s" % (point, ":kill" if variant == "kill" else "")
+
+
+def crash_status(variant: str) -> int:
+    return -signal.SIGKILL if variant == "kill" else CRASH_EXIT_CODE
+
+
+def assert_no_overlapping_writers(acks) -> None:
+    """The single-writable-primary invariant over the whole run: each
+    peer's acked-write window must be disjoint from every other's — a
+    handover, never an overlap."""
+    windows: dict[str, tuple[float, float]] = {}
+    for peer, t, _v in acks:
+        lo, hi = windows.get(peer, (t, t))
+        windows[peer] = (min(lo, t), max(hi, t))
+    for a, b in itertools.combinations(sorted(windows), 2):
+        (alo, ahi), (blo, bhi) = windows[a], windows[b]
+        assert ahi < blo or bhi < alo, \
+            "write authority OVERLAPPED between %s %r and %s %r — " \
+            "two write-enabled primaries" \
+            % (a, windows[a], b, windows[b])
+
+
+async def arm_crash(cluster, point_spec: str, *target: str) -> None:
+    cp = await asyncio.to_thread(run_cli, cluster, "fault", "set",
+                                 point_spec, *target)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "armed" in cp.stdout, cp.stdout
+
+
+async def rebuild_deposed(cluster, timeout: float = 240.0) -> None:
+    """A crash that interrupted (or induced) a takeover leaves the
+    ex-primary deposed; recover it the operator way, as the partition
+    drill does.  Loops until the deposed list DRAINS: the crash window
+    can cascade (the sync crashes mid-takeover, the async takes over
+    and deposes IT too), so one snapshot of the list is not enough."""
+    await cluster.wait_for(lambda s: bool(s.get("deposed")),
+                           60, "ex-primary deposed")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = await cluster.cluster_state()
+        deposed = (st or {}).get("deposed") or []
+        if not deposed:
+            return
+        peer = cluster.peer_by_id(deposed[0]["id"])
+        cp = await asyncio.to_thread(
+            run_cli, cluster, "rebuild", "-y", "-c",
+            str(peer.root / "sitter.json"), "--timeout", "120")
+        assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    raise AssertionError("deposed list never drained")
+
+
+async def wait_verify_clean(cluster, timeout: float = 120.0):
+    """Poll `manatee-adm verify` until it exits clean."""
+    deadline = time.monotonic() + timeout
+    while True:
+        cp = await asyncio.to_thread(run_cli, cluster, "verify",
+                                     timeout=30)
+        if cp.returncode == 0 or time.monotonic() > deadline:
+            return cp
+        await asyncio.sleep(1.0)
+
+
+async def verify_recovery(cluster, sampler) -> None:
+    """The standing post-recovery invariants every scenario ends on."""
+    # -- full chain back, nobody deposed, writes enabled
+    await cluster.wait_for(
+        lambda s: s.get("primary") is not None
+        and s.get("sync") is not None
+        and len(s.get("async") or []) == 1
+        and not (s.get("deposed") or []),
+        120, "full chain after recovery")
+    # -- verify-clean FIRST (replication caught up, no issues), then
+    # writability: a just-re-formed chain's primary stays read-only
+    # until its new sync catches up, so asserting writes before
+    # replication convergence is ordering the proofs backwards.
+    # Generous budgets: a restore-path scenario's last retry may only
+    # have STARTED once the respawned sender came back, and the full
+    # transfer + replay + stream attach + catchup all sit between
+    # here and a clean verify — longer still under suite load.
+    cp = await wait_verify_clean(cluster, 180)
+    assert cp.returncode == 0, \
+        "never converged to verify-clean:\n%s" % cp.stdout
+    st = await cluster.cluster_state()
+    cur = cluster.peer_by_id(st["primary"]["id"])
+    await cluster.wait_writable(cur, "post-recovery", timeout=120)
+
+    # -- single writable primary + durability of EVERY acked write
+    await sampler.wait_ack_from(cur.name)
+    await sampler.stop()
+    assert_no_overlapping_writers(sampler.acks)
+    res = await cur.pg_query({"op": "select"}, 5.0)
+    rows = set(res["rows"])
+    expected = {"setup-write", "post-recovery"} \
+        | set(sampler.acked_values())
+    missing = sorted(expected - rows)
+    assert not missing, "ACKED WRITES LOST: %r" % missing
+
+    # -- no open spans leaked on any live peer
+    deadline = time.monotonic() + 20
+    leaked: dict = {}
+    while time.monotonic() < deadline:
+        leaked = {}
+        for p in cluster.peers:
+            _s, body = await http_get(
+                "http://127.0.0.1:%d/spans" % p.status_port)
+            if body.get("open"):
+                leaked[p.name] = body["open"]
+        if not leaked:
+            break
+        await asyncio.sleep(0.5)
+    assert not leaked, "open spans leaked after recovery: %r" % leaked
+
+    # -- every store verifies clean under the doctor (offline coordd +
+    # dirstore checks AND the online state/history/journal checks)
+    args = ["doctor", "--coord-data", str(cluster.coord_data_dir(0))]
+    for p in cluster.peers:
+        args += ["--store-root", str(p.root / "store")]
+    cp = await asyncio.to_thread(run_cli, cluster, *args, "-j")
+    assert cp.returncode == 0, \
+        "doctor found damage after recovery:\n%s\n%s" \
+        % (cp.stdout, cp.stderr)
+    body = json.loads(cp.stdout)
+    assert body["ok"] and body["damage"] == 0, body
+
+
+def _run_zfs_subproc_scenario(tmp_path, point: str, scn: dict) -> None:
+    """The one seam with no live dir-backend driver: a child process
+    runs ZfsBackend against the fake zfs(8), crashes at the seam, and
+    a clean rerun on the same state recovers."""
+    from tests.test_zfsbackend import make_zfs_shim
+    cmd, root = make_zfs_shim(tmp_path)
+    script = (
+        "import asyncio, sys\n"
+        "from manatee_tpu.storage import ZfsBackend\n"
+        "async def main():\n"
+        "    be = ZfsBackend(zfs_cmd=sys.argv[1])\n"
+        "    if not await be.exists('tank'):\n"
+        "        await be.create('tank')\n"
+        "    if not await be.exists('tank/pg'):\n"
+        "        await be.create('tank/pg')\n"
+        "    print('zfs-ok')\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_FAULTS": spec_for(point, variant)}
+    cp = subprocess.run([sys.executable, "-c", script, cmd],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "zfs-ok" not in cp.stdout
+    # recovery: the same state root, no fault armed — completes clean
+    env.pop("MANATEE_FAULTS")
+    cp = subprocess.run([sys.executable, "-c", script, cmd],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "zfs-ok" in cp.stdout
+    assert (root / "state.json").exists()
+
+
+@pytest.mark.parametrize(
+    "point",
+    [pytest.param(p,
+                  marks=([pytest.mark.slow, pytest.mark.crash_fast]
+                         if p in FAST_POINTS else [pytest.mark.slow]))
+     for p in sorted(SCENARIOS)])
+def test_crash_at_seam(tmp_path, point):
+    scn = SCENARIOS[point]
+    variant = scn.get("variant", "exit")
+    sp = spec_for(point, variant)
+    want = crash_status(variant)
+
+    if scn["kind"] == "zfs_subproc":
+        _run_zfs_subproc_scenario(tmp_path, point, scn)
+        return
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        sampler = AckSampler(cluster)
+        try:
+            await cluster.start()
+            primary, sync, asyncs = await converged(cluster, n=3)
+            a = asyncs[0]
+            # a fully-HEALTHY baseline, not just topology membership:
+            # the async's bootstrap restore must be done and its
+            # stream attached, or a scenario arming a seam on it races
+            # its own bring-up (e.g. the repoint fast path requires a
+            # successfully-applied standby config to exist)
+            cp = await wait_verify_clean(cluster, 90)
+            assert cp.returncode == 0, \
+                "shard never verify-clean before the scenario:\n%s" \
+                % cp.stdout
+            sampler.start()
+
+            if scn["kind"] == "boot_async":
+                await cluster.restart_peer(
+                    a, wipe_data=scn.get("wipe", False),
+                    sitter_faults=[sp])
+                status = await asyncio.to_thread(
+                    a.wait_daemon_exit, "sitter", 90)
+                assert status == want, \
+                    "sitter did not die AT the seam: %r" % status
+                await cluster.restart_peer(a)
+
+            elif scn["kind"] == "takeover":
+                await arm_crash(cluster, sp, "-n", sync.name)
+                primary.kill()
+                status = await asyncio.to_thread(
+                    sync.wait_daemon_exit, "sitter", 90)
+                assert status == want, \
+                    "taking-over sync did not die AT the seam: %r" \
+                    % status
+                await cluster.restart_peer(sync)
+                await cluster.restart_peer(primary)
+                await rebuild_deposed(cluster)
+
+            elif scn["kind"] == "repoint":
+                await arm_crash(cluster, sp, "-n", a.name)
+                # promote the armed async to sync: the primary writes
+                # the swapped topology, and applying it re-points the
+                # async's upstream (old sync -> primary) via the
+                # reload fast path — where it crashes.  The CLI's own
+                # completion watch may or may not outlive that crash;
+                # its exit status is not the assertion here.
+                # -r names the CURRENT role of the peer being
+                # promoted: the async moves up to sync.  Retried: the
+                # promote pre-checks refuse on TRANSIENT cluster
+                # errors (a pg status probe timing out under the
+                # sampler's load) that -y does not override — keep
+                # asking until the request lands and the crash fires.
+                deadline = time.monotonic() + 120
+                while a.sitter_proc.poll() is None \
+                        and time.monotonic() < deadline:
+                    try:
+                        await asyncio.to_thread(
+                            run_cli, cluster, "promote", "-r",
+                            "async", "-n", a.name, "-y", timeout=45)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    for _ in range(20):
+                        if a.sitter_proc.poll() is not None:
+                            break
+                        await asyncio.sleep(0.25)
+                status = a.sitter_proc.poll()
+                assert status == want, \
+                    "re-pointing async did not die AT the seam: %r" \
+                    % status
+                await cluster.restart_peer(a)
+
+            elif scn["kind"] == "primary_write":
+                await arm_crash(cluster, sp, "-n", primary.name)
+                a.kill()
+                status = await asyncio.to_thread(
+                    primary.wait_daemon_exit, "sitter", 90)
+                assert status == want, \
+                    "primary did not die AT the write seam: %r" \
+                    % status
+                await cluster.restart_peer(a)
+                await cluster.restart_peer(primary)
+                await rebuild_deposed(cluster)
+
+            elif scn["kind"] == "sender":
+                await arm_crash(cluster, sp, "--url",
+                                "http://127.0.0.1:%d"
+                                % sync.backup_port)
+                await cluster.restart_peer(a, wipe_data=True)
+                status = await asyncio.to_thread(
+                    sync.wait_daemon_exit, "backup", 90)
+                assert status == want, \
+                    "backup sender did not die AT the seam: %r" \
+                    % status
+                sync.kill_backup_only()
+                sync.start_backup_only()
+
+            elif scn["kind"] == "coordd":
+                await arm_crash(cluster, sp, "--url",
+                                cluster.coord_metrics_url(0))
+                if scn.get("induce") == "freeze":
+                    # a durable mutation drives the oplog seam; the
+                    # CLI call itself dies with coordd — that is the
+                    # point
+                    try:
+                        await asyncio.to_thread(
+                            run_cli, cluster, "freeze", "-r",
+                            "crash-sweep", timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pass
+                status = await asyncio.to_thread(
+                    cluster.wait_coordd_exit, 0, 90)
+                assert status == want, \
+                    "coordd did not die AT the seam: %r" % status
+                cluster.kill_coordd(0)
+                cluster.start_coordd(0)
+                await cluster._wait_port(cluster.coord_port)
+                if scn.get("induce") == "freeze":
+                    # whether or not the dying coordd committed the
+                    # freeze, leave the shard unfrozen for the verify
+                    await asyncio.to_thread(run_cli, cluster,
+                                            "unfreeze", timeout=30)
+
+            else:
+                raise AssertionError("unknown scenario kind %r"
+                                     % scn["kind"])
+
+            await verify_recovery(cluster, sampler)
+        finally:
+            await sampler.stop()
+            await cluster.stop()
+    asyncio.run(go())

@@ -1,0 +1,349 @@
+"""`manatee-adm doctor` — the store integrity verifier.
+
+Every fixture here is a REAL store produced by the production code
+(CoordServer writing its fsynced op log, DirBackend creating datasets
+and snapshots), then deliberately damaged the way a crash would damage
+it.  The assertions pin both directions of the contract: a clean or
+merely crash-littered store verifies CLEAN (exit 0 — torn tails, tmp
+orphans and stale epochs are what recovery handles), while every
+acked-data-at-risk corruption class is reported as DAMAGE with a
+nonzero exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.server import CoordServer
+from manatee_tpu.doctor import (
+    check_cluster,
+    check_coordd_store,
+    check_dirstore,
+    summarize,
+)
+from manatee_tpu.storage import DirBackend
+from tests.test_durability import crash
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def levels(findings):
+    return [(f["level"], f["check"]) for f in findings]
+
+
+def damage_checks(findings):
+    return {f["check"] for f in findings if f["level"] == "damage"}
+
+
+# ---- coordd store ----
+
+def make_coord_store(tmp: Path, writes: int = 4) -> Path:
+    """A real coordd data dir: op-log segments only (no compaction ever
+    ran), abandoned crash-style so only fsynced bytes exist."""
+    async def go():
+        server = CoordServer(port=0, tick=0.05, data_dir=str(tmp))
+        await server.start()
+        c = NetCoord("127.0.0.1:%d" % server.port, session_timeout=5)
+        await c.connect()
+        await c.create("/state", b"gen0")
+        for i in range(writes - 1):
+            await c.set("/state", b"gen%d" % (i + 1), i)
+        await c.close()
+        await crash(server)
+    run(go())
+    return tmp
+
+
+def segment_of(tmp: Path) -> Path:
+    segs = sorted(tmp.glob("coordd-oplog-*.jsonl"))
+    assert segs, "no op-log segment written"
+    return segs[-1]
+
+
+def test_coordd_clean_store_verifies(tmp_path):
+    make_coord_store(tmp_path)
+    assert check_coordd_store(tmp_path) == []
+
+
+def test_coordd_torn_tail_is_note_not_damage(tmp_path):
+    make_coord_store(tmp_path)
+    with open(segment_of(tmp_path), "ab") as f:
+        f.write(b'{"seq": 99, "req": {"op": "se')     # crash mid-append
+    findings = check_coordd_store(tmp_path)
+    assert levels(findings) == [("note", "oplog-torn-tail")]
+    assert summarize(findings)["ok"]
+
+
+def test_coordd_midstream_corruption_is_damage(tmp_path):
+    make_coord_store(tmp_path)
+    seg = segment_of(tmp_path)
+    lines = seg.read_bytes().splitlines()
+    lines[1] = b"\x00garbage\x00"
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    assert damage_checks(check_coordd_store(tmp_path)) == \
+        {"oplog-corrupt"}
+
+
+def test_coordd_seq_gap_is_damage(tmp_path):
+    make_coord_store(tmp_path)
+    seg = segment_of(tmp_path)
+    lines = seg.read_bytes().splitlines()
+    del lines[1]                      # acked seq 2 vanishes
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    assert damage_checks(check_coordd_store(tmp_path)) == {"oplog-gap"}
+
+
+def test_coordd_divergence_is_damage(tmp_path):
+    make_coord_store(tmp_path)
+    seg = segment_of(tmp_path)
+    lines = seg.read_bytes().splitlines()
+    ent = json.loads(lines[1])
+    ent["expect"] = 777               # not what replay will produce
+    lines[1] = json.dumps(ent).encode()
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    assert damage_checks(check_coordd_store(tmp_path)) == \
+        {"oplog-diverged"}
+
+
+def test_coordd_corrupt_snapshot_is_damage(tmp_path):
+    make_coord_store(tmp_path)
+    (tmp_path / "coordd-tree.json").write_text("{not json")
+    assert damage_checks(check_coordd_store(tmp_path)) == \
+        {"coord-snapshot-corrupt"}
+
+
+def test_coordd_crash_leftovers_are_notes(tmp_path):
+    make_coord_store(tmp_path)
+    # crash leftovers startup cleans, none of which put acked data at
+    # risk: an uninstalled snapshot tmp, a superseded-epoch segment
+    # (fabricate the CURRENT-epoch marker by taking a snapshot: run the
+    # server once more so compaction state exists — simpler: pin the
+    # epoch with a real snapshot written the server's way), and an
+    # unrecognizably-named segment
+    async def compact():
+        server = CoordServer(port=0, tick=0.05,
+                             data_dir=str(tmp_path))
+        assert server._persist_snapshot_now()
+        await crash(server)
+    run(compact())
+    (tmp_path / "coordd-tree.json.tmp-0-3").write_text("{}")
+    (tmp_path / ("coordd-oplog-e00000000-%016d.jsonl" % 1)).write_text(
+        '{"seq": 1, "req": {"op": "create", "path": "/state", '
+        '"data": ""}}\n')     # pre-resync epoch, superseded
+    (tmp_path / "coordd-oplog-bogusname.jsonl").write_text("junk\n")
+    findings = check_coordd_store(tmp_path)
+    assert not damage_checks(findings)
+    got = {lc for lc in levels(findings)}
+    assert ("note", "snapshot-tmp-orphan") in got
+    assert ("note", "oplog-stale-epoch") in got
+    assert ("note", "oplog-unrecognized-name") in got
+    assert summarize(findings)["ok"]
+
+
+def test_coordd_missing_dir_is_damage(tmp_path):
+    assert damage_checks(check_coordd_store(tmp_path / "nope")) == \
+        {"coord-dir-missing"}
+
+
+# ---- dirstore ----
+
+def make_dirstore(tmp: Path) -> tuple[DirBackend, Path]:
+    root = tmp / "store"
+
+    async def go():
+        be = DirBackend(root)
+        await be.create("manatee")
+        await be.create("manatee/pg")
+        (root / "datasets/manatee/pg/@data/wal").write_text("x" * 64)
+        await be.snapshot("manatee/pg", "snap1")
+        await be.snapshot("manatee/pg", "snap2")
+        return be
+    return run(go()), root
+
+
+def ds_path(root: Path) -> Path:
+    return root / "datasets" / "manatee" / "pg"
+
+
+def test_dirstore_clean_store_verifies(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    assert check_dirstore(root) == []
+
+
+def test_dirstore_truncated_meta_is_damage(tmp_path):
+    """THE bug the crash-safe _save_meta closes: a crash between the
+    tmp rename and the data reaching disk installs an empty meta."""
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@meta.json").write_text("")
+    assert damage_checks(check_dirstore(root)) == {"meta-corrupt"}
+
+
+def test_dirstore_malformed_meta_is_damage(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@meta.json").write_text('{"mountpoint": null}')
+    assert damage_checks(check_dirstore(root)) == {"meta-malformed"}
+
+
+def test_dirstore_meta_snapshot_without_dir_is_damage(tmp_path):
+    import shutil
+    _be, root = make_dirstore(tmp_path)
+    shutil.rmtree(ds_path(root) / "@snapshots" / "snap1")
+    findings = check_dirstore(root)
+    assert damage_checks(findings) == {"snapshot-missing"}
+
+
+def test_dirstore_orphan_snapshot_dir_is_warning(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@snapshots" / "half-copied").mkdir()
+    findings = check_dirstore(root)
+    assert not damage_checks(findings)
+    assert ("warning", "snapshot-orphan") in levels(findings)
+    assert summarize(findings)["ok"]
+
+
+def test_dirstore_missing_data_dir_is_damage(tmp_path):
+    import shutil
+    _be, root = make_dirstore(tmp_path)
+    shutil.rmtree(ds_path(root) / "@data")
+    assert "data-missing" in damage_checks(check_dirstore(root))
+
+
+def test_dirstore_meta_tmp_orphan_is_note(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@meta.json.tmp").write_text("{")
+    findings = check_dirstore(root)
+    assert levels(findings) == [("note", "meta-tmp-orphan")]
+
+
+def test_dirstore_stale_mount_flag_is_warning(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    meta_path = ds_path(root) / "@meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["mounted"] = True
+    meta["mountpoint"] = str(tmp_path / "nonexistent-link")
+    meta_path.write_text(json.dumps(meta))
+    findings = check_dirstore(root)
+    assert not damage_checks(findings)
+    assert ("warning", "mount-stale") in levels(findings)
+
+
+def test_dirstore_not_a_store_root_is_warning(tmp_path):
+    findings = check_dirstore(tmp_path)
+    assert levels(findings) == [("warning", "no-datasets-dir")]
+
+
+# ---- cluster state vs history vs journal (pure) ----
+
+GOOD_STATE = {"generation": 3, "primary": {"id": "a"}, "sync": None,
+              "async": [], "deposed": [], "initWal": "0/0"}
+
+
+def hist(*gens):
+    return [{"zkSeq": i, "generation": g} for i, g in enumerate(gens)]
+
+
+def test_cluster_clean():
+    assert check_cluster(GOOD_STATE, hist(1, 2, 3),
+                         [{"event": "transition.committed",
+                           "generation": 3}]) == []
+
+
+def test_cluster_state_schema_damage():
+    bad = dict(GOOD_STATE, generation="three")
+    assert damage_checks(check_cluster(bad, [], [])) == \
+        {"state-schema"}
+
+
+def test_cluster_generation_regression_in_history():
+    assert "generation-regression" in damage_checks(
+        check_cluster(GOOD_STATE, hist(1, 3, 2), []))
+
+
+def test_cluster_state_behind_history():
+    assert "generation-regression" in damage_checks(
+        check_cluster(dict(GOOD_STATE, generation=2), hist(1, 2, 3),
+                      []))
+
+
+def test_cluster_journal_ahead_of_store():
+    assert "journal-generation-ahead" in damage_checks(
+        check_cluster(GOOD_STATE, hist(1, 2, 3),
+                      [{"event": "transition.committed",
+                        "generation": 9}]))
+
+
+def test_cluster_attempted_transition_is_not_damage():
+    """transition.begin carries the ATTEMPTED generation before the
+    CAS write; a lost race leaves it in some ring with the store
+    legitimately behind — never acked, never damage."""
+    assert check_cluster(GOOD_STATE, hist(1, 2, 3),
+                         [{"event": "transition.begin",
+                           "generation": 9}]) == []
+
+
+def test_cluster_missing_state_is_warning():
+    findings = check_cluster(None, [], [])
+    assert levels(findings) == [("warning", "state-missing")]
+
+
+# ---- the real CLI, offline mode: exit-code contract ----
+
+def run_doctor(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", "doctor",
+         "--offline", *args],
+        capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"})
+
+
+def test_cli_doctor_clean_stores_exit_zero(tmp_path):
+    make_coord_store(tmp_path / "coord")
+    _be, root = make_dirstore(tmp_path)
+    cp = run_doctor("--coord-data", str(tmp_path / "coord"),
+                    "--store-root", str(root), "-j")
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    body = json.loads(cp.stdout)
+    assert body["ok"] and body["damage"] == 0
+
+
+def test_cli_doctor_damaged_store_exits_nonzero(tmp_path):
+    make_coord_store(tmp_path / "coord")
+    seg = segment_of(tmp_path / "coord")
+    lines = seg.read_bytes().splitlines()
+    del lines[1]
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@meta.json").write_text("")
+    cp = run_doctor("--coord-data", str(tmp_path / "coord"),
+                    "--store-root", str(root), "-j")
+    assert cp.returncode == 1, (cp.stdout, cp.stderr)
+    body = json.loads(cp.stdout)
+    assert not body["ok"]
+    checks = {f["check"] for f in body["findings"]
+              if f["level"] == "damage"}
+    assert checks == {"oplog-gap", "meta-corrupt"}
+
+
+def test_cli_doctor_human_output_lists_findings(tmp_path):
+    make_coord_store(tmp_path / "coord")
+    (tmp_path / "coord" / "coordd-tree.json").write_text("{bad")
+    cp = run_doctor("--coord-data", str(tmp_path / "coord"))
+    assert cp.returncode == 1
+    assert "DAMAGE" in cp.stdout and "coord-snapshot-corrupt" \
+        in cp.stdout
+    assert "DAMAGED" in cp.stdout
+
+
+def test_cli_doctor_nothing_to_verify_dies():
+    cp = run_doctor()
+    assert cp.returncode == 2
+    assert "nothing to verify" in cp.stderr

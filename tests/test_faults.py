@@ -137,6 +137,62 @@ def test_drop_action_verdict(freg):
     asyncio.run(go())
 
 
+def test_crash_spec_parsing_and_validation(freg):
+    assert parse_spec("pg.promote=crash") == {
+        "point": "pg.promote", "action": "crash"}
+    assert parse_spec("pg.promote=crash:kill") == {
+        "point": "pg.promote", "action": "crash", "variant": "kill"}
+    with pytest.raises(FaultSpecError):
+        faults.validate_spec("pg.promote=crash:explode")
+    # triggers promise later injections a dead process cannot deliver
+    with pytest.raises(FaultSpecError):
+        faults.validate_spec("pg.promote=crash,count=1")
+    with pytest.raises(FaultSpecError):
+        faults.validate_spec("pg.promote=crash,prob=0.5")
+    # variant is crash-only, like error= is error-only
+    with pytest.raises(FaultSpecError):
+        faults.validate_spec("pg.promote=stall,variant=kill")
+    rule = freg.arm_spec("pg.promote=crash:kill")
+    assert rule.to_dict()["variant"] == "kill"
+    assert rule.to_dict()["action"] == "crash"
+
+
+def test_every_catalog_point_supports_crash():
+    from manatee_tpu.faults.catalog import actions_for
+    for name in faults.CATALOG:
+        assert "crash" in actions_for(name), \
+            "%s does not support the crash action" % name
+
+
+@pytest.mark.parametrize("variant,status", [
+    ("", faults.CRASH_EXIT_CODE),
+    (":kill", -9),
+])
+def test_crash_action_terminates_uncatchably(variant, status):
+    """The whole point of crash vs error: NOTHING after the seam runs
+    — not the call site's except clauses, not atexit, not a daemon
+    signal handler.  Proven in a child process, where dying is ok."""
+    script = (
+        "import asyncio, atexit\n"
+        "from manatee_tpu import faults\n"
+        "atexit.register(lambda: print('ATEXIT-RAN', flush=True))\n"
+        "async def main():\n"
+        "    try:\n"
+        "        await faults.point('pg.promote')\n"
+        "    except BaseException as e:\n"
+        "        print('CAUGHT', type(e).__name__, flush=True)\n"
+        "asyncio.run(main())\n"
+        "print('SURVIVED', flush=True)\n")
+    cp = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+             "MANATEE_FAULTS": "pg.promote=crash%s" % variant})
+    assert cp.returncode == status, (cp.returncode, cp.stderr)
+    for marker in ("CAUGHT", "SURVIVED", "ATEXIT-RAN"):
+        assert marker not in cp.stdout, cp.stdout
+
+
 def test_stall_blocks_until_cleared(freg):
     freg.arm_spec("backup.send.stream=stall")
 

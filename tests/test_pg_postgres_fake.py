@@ -38,7 +38,24 @@ def free_port():
 
 
 def run(coro):
-    return asyncio.run(coro)
+    async def reaped():
+        try:
+            return await coro
+        finally:
+            # Reap subprocess transports (the engine's psql children)
+            # BEFORE asyncio.run closes the loop: a transport whose
+            # child-watcher callback has not run yet would otherwise be
+            # garbage-collected after loop close and emit a
+            # PytestUnraisableExceptionWarning ('Event loop is closed'
+            # from BaseSubprocessTransport.__del__) into the suite
+            # output (ADVICE r5).  One tick lets pending exit waiters
+            # run; gc forces any unreferenced transports to finalize
+            # while their loop is still alive.
+            import gc
+            await asyncio.sleep(0)
+            gc.collect()
+            await asyncio.sleep(0)
+    return asyncio.run(reaped())
 
 
 def make_engine(version="12.0"):

@@ -289,3 +289,29 @@ def test_destroy_snapshot_idempotent_under_replacement_races(be, tmp_path):
         assert any(s.name == s2.name
                    for s in await be.list_snapshots("isolated-pg"))
     run(go())
+
+
+def test_meta_save_is_crash_safe_and_tmp_swept(be, tmp_path):
+    """_save_meta installs via fsynced tmp + atomic rename with a
+    per-writer-unique tmp name; aged orphans (a crash between write
+    and rename) are swept at backend construction, while a FRESH tmp
+    (a sibling process's in-flight save) is left alone."""
+    import os
+    import time as _time
+
+    async def go():
+        await be.create("manatee")
+        await be.create("manatee/pg")
+    run(go())
+    ds = tmp_path / "store" / "datasets" / "manatee" / "pg"
+    # no tmp litter after normal saves
+    assert not list(ds.glob("@meta.json.tmp*"))
+    old = ds / "@meta.json.tmp-999-1"
+    old.write_text("{")
+    past = _time.time() - 3600
+    os.utime(old, (past, past))
+    fresh = ds / "@meta.json.tmp-999-2"
+    fresh.write_text("{")
+    DirBackend(tmp_path / "store")       # boot: sweeps aged orphans
+    assert not old.exists()
+    assert fresh.exists()                # in-flight sibling untouched
