@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Benchmark: failover-to-writable time.
+"""Benchmark: failover-to-writable time (+ restore throughput).
 
 The north-star metric defined by BASELINE.md: after SIGKILLing the
 primary of a live 3-peer shard, how long until the cluster accepts
@@ -9,9 +9,9 @@ benchmark numbers; its own integration suite's convergence budget is
 detection bounded by a 60 s coordination-session timeout
 (etc/sitter.json).
 
-Four configurations, full stack on localhost (coordination daemon(s),
-three sitters with database children, backup servers), 1 s session
-timeout, FIN fast-path crash detection:
+Four failover configurations, full stack on localhost (coordination
+daemon(s), three sitters with database children, backup servers), 1 s
+session timeout, FIN fast-path crash detection:
 
   - ensemble:                3-member replicated coordd — THE DEPLOYED
                              CONFIGURATION (README recommends ensembles
@@ -22,19 +22,37 @@ timeout, FIN fast-path crash detection:
                              commit must keep takeover latency flat
                              (coord/server.py _ship majority-ack);
   - ensemble_postgres:       3-member coordd with every database run
-                             through the REAL PostgresEngine (psql
-                             spawns, conf regeneration, pg_promote /
-                             reloadable-conninfo fast paths) against
-                             the fakepg binaries — the takeover path a
-                             postgres deployment pays, on top of the
-                             control plane the sim configs isolate
-                             (VERDICT r4 weak #1).
+                             through the REAL PostgresEngine (pooled
+                             psql control channel, conf regeneration,
+                             pg_promote / reloadable-conninfo fast
+                             paths) against the fakepg binaries — the
+                             takeover path a postgres deployment pays,
+                             on top of the control plane the sim
+                             configs isolate (VERDICT r4 weak #1).
+
+Plus one data-plane leg:
+
+  - restore_throughput:      MB/s for a fixed-size dataset rebuild
+                             through the full backup stack (REST
+                             negotiation, pipelined compressed stream,
+                             post-restore snapshot) — the denominator
+                             of every restore-bound failover.
+
+The ensemble_postgres leg also runs the PR 3 critical-path analyzer
+(`manatee-adm trace --last-failover -j`) after its final failover, so
+every perf PR's effect is attributable stage by stage; the breakdown
+rides the output JSON under "critical_path" and is echoed to stderr.
+
+MANATEE_BENCH_CONFIGS selects a comma-separated subset of the failover
+configs (plus "restore_throughput") — the CI bench smoke job runs
+"ensemble,single,restore_throughput" with MANATEE_BENCH_RUNS=1.
 
 Prints ONE JSON line; "value" is the (sim) ensemble median —
 the control plane is what is being measured — with the
 postgres-engine leg recorded alongside in "configs":
   {"metric": "failover_to_writable", "value": <seconds>, "unit": "s",
-   "vs_baseline": <30.0 / value>, "configs": {...}}
+   "vs_baseline": <30.0 / value>, "configs": {...},
+   "restore_throughput_mb_s": <MB/s>, "critical_path": {...}}
 """
 
 import asyncio
@@ -42,6 +60,7 @@ import json
 import os
 import signal
 import statistics
+import subprocess
 import sys
 import tempfile
 import time
@@ -49,7 +68,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from tests.harness import ClusterHarness  # noqa: E402
+from tests.harness import ClusterHarness, run_cli  # noqa: E402
 
 BASELINE_BUDGET_S = 30.0   # test/integ.test.js:52 convergence budget
 RUNS = int(os.environ.get("MANATEE_BENCH_RUNS", "3"))
@@ -62,14 +81,38 @@ SESSION_TIMEOUT = 1.0
 # immediately and never resumes.
 DISCONNECT_GRACE = 0.35
 
+ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
+               "ensemble_postgres", "restore_throughput")
+# raw payload of the restore_throughput leg: large enough that stream
+# setup (REST round trip, listener, tar spawn) is not the whole
+# number, small enough for a CI smoke lane
+RESTORE_MB = int(os.environ.get("MANATEE_BENCH_RESTORE_MB", "32"))
+
+
+def selected_configs() -> list[str]:
+    raw = os.environ.get("MANATEE_BENCH_CONFIGS", "")
+    if not raw.strip():
+        return list(ALL_CONFIGS)
+    picked = [c.strip() for c in raw.split(",") if c.strip()]
+    bad = [c for c in picked if c not in ALL_CONFIGS]
+    if bad:
+        raise SystemExit("unknown MANATEE_BENCH_CONFIGS entries: %s "
+                         "(known: %s)" % (bad, ", ".join(ALL_CONFIGS)))
+    return picked
+
 
 async def one_run(tmp: Path, *, n_coord: int,
                   hang_follower: bool = False,
-                  engine: str | None = None) -> float:
+                  engine: str | None = None,
+                  grab_trace: bool = False) -> tuple[float, dict | None]:
+    """One kill-and-recover cycle; returns (seconds, critical-path
+    breakdown or None).  *grab_trace* runs the `trace --last-failover`
+    analyzer against the live shard after recovery."""
     cluster = ClusterHarness(tmp, n_peers=3, n_coord=n_coord,
                              session_timeout=SESSION_TIMEOUT,
                              disconnect_grace=DISCONNECT_GRACE,
                              engine=engine)
+    breakdown = None
     try:
         await cluster.start()
         p1, p2, p3 = cluster.peers
@@ -87,45 +130,158 @@ async def one_run(tmp: Path, *, n_coord: int,
             p1.kill()
             await cluster.wait_topology(primary=p2, timeout=60)
             await cluster.wait_writable(p2, "post-failover", timeout=60)
-            return time.monotonic() - t0
+            dt = time.monotonic() - t0
         finally:
             if hung is not None:
                 cluster.signal_coordd(hung, signal.SIGCONT)
+        if grab_trace:
+            breakdown = await grab_breakdown(cluster)
+        return dt, breakdown
     finally:
         await cluster.stop()
 
 
-async def bench_config(name: str, **kw) -> float:
+async def grab_breakdown(cluster: ClusterHarness) -> dict | None:
+    """Fetch the last failover's per-stage critical path from the live
+    shard via the real analyzer CLI (best-effort: a bench must not die
+    on a missing span)."""
+    await asyncio.sleep(0.3)   # let the tail spans land in the rings
+    try:
+        cp = await asyncio.to_thread(
+            run_cli, cluster, "trace", "--last-failover", "-j")
+        if cp.returncode != 0:
+            return None
+        out = json.loads(cp.stdout)
+    except (OSError, ValueError, asyncio.TimeoutError,
+            subprocess.TimeoutExpired):
+        return None
+    path = out.get("critical_path")
+    if not path:
+        return None
+    return {
+        "trace": out.get("trace"),
+        "total_s": path.get("total_s"),
+        "stages": [{"name": st.get("name"),
+                    "peer": st.get("peer"),
+                    "start_s": st.get("start_s"),
+                    "self_s": st.get("self_s"),
+                    "pct": st.get("pct")}
+                   for st in path.get("stages", [])],
+    }
+
+
+async def bench_config(name: str, **kw) -> tuple[float, dict | None]:
     times = []
+    breakdown = None
     for i in range(RUNS):
         with tempfile.TemporaryDirectory(prefix="manatee-bench-") as d:
-            dt = await one_run(Path(d), **kw)
+            # the analyzer runs once, after the final run's failover
+            grab = kw.get("grab_trace") and i == RUNS - 1
+            dt, bd = await one_run(Path(d), **{**kw, "grab_trace": grab})
             print("%s run %d: %.2fs" % (name, i + 1, dt),
                   file=sys.stderr)
             times.append(dt)
-    return statistics.median(times)
+            breakdown = bd or breakdown
+    return statistics.median(times), breakdown
+
+
+async def bench_restore_throughput() -> float:
+    """MB/s for a fixed-size dataset rebuild through the full backup
+    stack, in-process: DirBackend dataset → REST-negotiated job →
+    pipelined (optionally compressed) stream → restored dataset +
+    post-restore snapshot.  Matches what a peer's restore path pays
+    minus the database replay."""
+    from manatee_tpu.backup.client import RestoreClient
+    from manatee_tpu.backup.queue import BackupQueue
+    from manatee_tpu.backup.sender import BackupSender
+    from manatee_tpu.backup.server import BackupRestServer
+    from manatee_tpu.storage import DirBackend
+
+    def _payload(dirpath: Path, total_mb: int) -> int:
+        """Semi-compressible content (~2:1-ish), several files."""
+        block = (os.urandom(32 * 1024) + b"\x00" * 32 * 1024)
+        per_file = max(1, total_mb // 8)
+        written = 0
+        for i in range(8):
+            with open(dirpath / ("blob-%d.bin" % i), "wb") as fh:
+                for _ in range(per_file * (1 << 20) // len(block)):
+                    fh.write(block)
+                    written += len(block)
+        return written
+
+    with tempfile.TemporaryDirectory(prefix="manatee-bench-rt-") as d:
+        root = Path(d)
+        be = DirBackend(root / "store")
+        await be.create("src")
+        data = root / "store" / "datasets" / "src" / "@data"
+        nbytes = await asyncio.to_thread(_payload, data, RESTORE_MB)
+        await be.snapshot("src")
+        queue = BackupQueue()
+        sender = BackupSender(queue, be, "src")
+        server = BackupRestServer(queue, host="127.0.0.1", port=0)
+        await server.start()
+        sender.start()
+        try:
+            rc = RestoreClient(be, dataset="dst",
+                               mountpoint=str(root / "mnt"),
+                               listen_host="127.0.0.1")
+            t0 = time.monotonic()
+            await rc.restore("http://127.0.0.1:%d" % server.port)
+            dt = time.monotonic() - t0
+        finally:
+            await sender.stop()
+            await server.stop()
+        mb_s = nbytes / dt / 1e6
+        print("restore_throughput: %d MB in %.2fs = %.1f MB/s"
+              % (nbytes // (1 << 20), dt, mb_s), file=sys.stderr)
+        return mb_s
 
 
 async def main() -> None:
-    ensemble = await bench_config("ensemble", n_coord=3)
-    single = await bench_config("single", n_coord=1)
-    hung = await bench_config("ensemble_hung_follower", n_coord=3,
-                              hang_follower=True)
-    pg = await bench_config("ensemble_postgres", n_coord=3,
-                            engine="postgres")
-    value = ensemble   # the deployed configuration is the one reported
-    print(json.dumps({
+    picked = selected_configs()
+    results: dict[str, float] = {}
+    breakdown = None
+    failover_kw = {
+        "ensemble": {"n_coord": 3},
+        "single": {"n_coord": 1},
+        "ensemble_hung_follower": {"n_coord": 3, "hang_follower": True},
+        "ensemble_postgres": {"n_coord": 3, "engine": "postgres",
+                              "grab_trace": True},
+    }
+    for name in picked:
+        if name == "restore_throughput":
+            continue
+        med, bd = await bench_config(name, **failover_kw[name])
+        results[name] = med
+        breakdown = bd or breakdown
+    throughput = None
+    if "restore_throughput" in picked:
+        throughput = await bench_restore_throughput()
+
+    # the deployed configuration is the one reported; CI smoke lanes
+    # that skip it fall back to whatever failover leg ran
+    value = results.get("ensemble") \
+        or next(iter(results.values()), None)
+    out = {
         "metric": "failover_to_writable",
-        "value": round(value, 3),
+        "value": round(value, 3) if value else None,
         "unit": "s",
-        "vs_baseline": round(BASELINE_BUDGET_S / value, 2),
-        "configs": {
-            "ensemble": round(ensemble, 3),
-            "single": round(single, 3),
-            "ensemble_hung_follower": round(hung, 3),
-            "ensemble_postgres": round(pg, 3),
-        },
-    }))
+        "vs_baseline": (round(BASELINE_BUDGET_S / value, 2)
+                        if value else None),
+        "configs": {k: round(v, 3) for k, v in results.items()},
+    }
+    if throughput is not None:
+        out["restore_throughput_mb_s"] = round(throughput, 1)
+    if breakdown is not None:
+        out["critical_path"] = breakdown
+        print("critical path (%.3fs total):"
+              % (breakdown.get("total_s") or 0.0), file=sys.stderr)
+        for st in breakdown["stages"]:
+            print("  %+8.3fs %8.3fs %5.1f%%  %-24s %s"
+                  % (st["start_s"], st["self_s"], st["pct"],
+                     st["name"], st.get("peer") or "-"),
+                  file=sys.stderr)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -542,7 +542,10 @@ class AdmClient:
             # never need sudo
             return PostgresEngine(
                 pg_bin_dir=os.environ.get("MANATEE_PG_BIN_DIR", ""),
-                use_sudo=False)
+                use_sudo=False,
+                # ad-hoc engines answer ONE query then evaporate: a
+                # pooled coprocess would only leak until process exit
+                session_pool=False)
         return None
 
     # -- state mutations (operator actions) --

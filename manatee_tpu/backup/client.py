@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import datetime
+import errno
 import logging
 
 import aiohttp
@@ -33,7 +34,8 @@ from manatee_tpu.obs import (
     get_journal,
     span,
 )
-from manatee_tpu.storage.base import StorageBackend
+from manatee_tpu.storage import stream as wirestream
+from manatee_tpu.storage.base import StorageBackend, StreamIdMismatch
 from manatee_tpu.utils.aio import cancel_requests
 
 log = logging.getLogger("manatee.backup.client")
@@ -157,6 +159,14 @@ class RestoreClient:
         # so leaving it running would block the teardown (and any lock
         # the caller holds) for the remainder of a multi-hour transfer
         handler_tasks: set[asyncio.Task] = set()
+        # OUR job's uuid, learned from the POST response: the stream
+        # id the sender stamps on the dial-back must match it, or the
+        # connection is a STALE job's (a cancelled predecessor whose
+        # sender dialed the port we rebound) and must be refused.  The
+        # dial-back can legitimately beat the POST response, so
+        # handlers wait for the id before consuming a byte.
+        expected = {"jobid": None}
+        job_known = asyncio.Event()
 
         async def _handle(reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -167,14 +177,28 @@ class RestoreClient:
                 if await faults.point("backup.recv.stream") == "drop":
                     raise RestoreError(
                         "receive stream severed (fault)")
-                await self.storage.recv(self.dataset, reader,
-                                        progress_cb=progress)
+                try:
+                    await asyncio.wait_for(job_known.wait(), 30)
+                except asyncio.TimeoutError:
+                    raise RestoreError(
+                        "dial-back arrived but no job was ever "
+                        "registered (stale sender?)") from None
+                await self.storage.recv(
+                    self.dataset, reader, progress_cb=progress,
+                    expect_stream_id=expected["jobid"])
                 if not recv_done.done():
                     recv_done.set_result(None)
             except asyncio.CancelledError:
                 if not recv_done.done():
                     recv_done.cancel()
                 raise
+            except StreamIdMismatch as e:
+                # a STALE job's dial-back (a cancelled predecessor's
+                # sender reaching the port we rebound): drop just this
+                # connection and keep listening for our own stream —
+                # the stale sender sees a broken pipe and fails its
+                # job, ours is still on its way
+                log.warning("refused stale restore stream: %s", e)
             except Exception as e:
                 if not recv_done.done():
                     recv_done.set_exception(e)
@@ -203,8 +227,59 @@ class RestoreClient:
 
             t.add_done_callback(_done)
 
-        server = await asyncio.start_server(handle, self.listen_host,
-                                            self.listen_port)
+        async def _bind():
+            try:
+                return await asyncio.start_server(
+                    handle, self.listen_host, self.listen_port)
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or not self.listen_port:
+                    raise
+                # the configured port can be squatted by ANY local
+                # socket — including a long-lived outbound connection
+                # whose ephemeral local port landed on it (observed
+                # live: a coordination session on the zfsPort wedged
+                # every restore attempt for a minute).  The dial-back
+                # port is advertised in each POST /backup body, so
+                # nothing requires the configured one: fall back to an
+                # ephemeral listener instead of retry-looping forever.
+                log.warning("restore listener port %d busy (%s); "
+                            "falling back to an ephemeral port",
+                            self.listen_port, e)
+                return await asyncio.start_server(
+                    handle, self.listen_host, 0)
+
+        # CANCEL-SAFE BIND.  loop.create_server's last step (3.10) is
+        # an `await sleep(0)` AFTER the socket is bound and listening:
+        # a cancellation landing exactly there (a topology change
+        # cancelling this restore in its first milliseconds — routine
+        # now that the takeover path is fast) raises out of
+        # start_server with the live Server object LOST, leaking the
+        # listening socket into the loop forever.  The leaked listener
+        # then shadows every later restore ('address already in use')
+        # and its orphan accept-handlers recv into the dataset behind
+        # the next attempt's back.  So: never cancel the bind itself —
+        # shield it, and on OUR cancellation await its (fast, local)
+        # completion and close whatever materialized.
+        bind = asyncio.create_task(_bind())
+        try:
+            server = await asyncio.shield(bind)
+        except asyncio.CancelledError:
+            try:
+                srv = await asyncio.wait_for(asyncio.shield(bind), 10)
+                srv.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                bind.cancel()
+                # reap: even a cancelled bind may hold a live server
+                try:
+                    srv = await bind
+                    srv.close()
+                except asyncio.CancelledError:
+                    pass
+                except Exception:
+                    pass
+            raise
         port = server.sockets[0].getsockname()[1]
         try:
             async with aiohttp.ClientSession(
@@ -222,13 +297,25 @@ class RestoreClient:
                               # observability identity: the sender's
                               # span parents under our receive span
                               "trace": current_trace(),
-                              "span": current_span_id()}) as resp:
+                              "span": current_span_id(),
+                              # wire codecs we can decode, best first;
+                              # an old server ignores the key and
+                              # streams raw (storage.stream)
+                              "compress": wirestream.available_codecs(),
+                              # we probe for the wire header and check
+                              # stream ids: the sender may stamp them
+                              "streamProto": 1,
+                              }) as resp:
                     if resp.status != 201:
                         raise RestoreError(
                             "backup request refused: %d %s"
                             % (resp.status, await resp.text()))
                     body = await resp.json()
                     job_path = body["jobPath"]
+                    jobid = body.get("jobid")
+                    expected["jobid"] = jobid \
+                        if isinstance(jobid, str) else None
+                    job_known.set()
 
                 # poll the job while receiving (zfsClient:685-754)
                 poll_error: str | None = None

@@ -29,6 +29,14 @@ class BackupJob:
     # the requester's restore tree despite living in another process
     trace: str | None = None
     span: str | None = None
+    # wire codecs the REQUESTER can decode (storage.stream), best
+    # first; the sender negotiates the actual stream codec from this.
+    # Empty/None (an old peer's POST) means raw.
+    compress: tuple = ()
+    # stream-protocol generation the requester declared: >= 1 means it
+    # probes for the wire header, so the sender may stamp the job uuid
+    # (and a codec) on the stream.  0 = old peer = raw unstamped wire.
+    stream_proto: int = 0
 
     def to_dict(self) -> dict:
         return {

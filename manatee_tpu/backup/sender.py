@@ -16,6 +16,7 @@ import logging
 from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
 from manatee_tpu.obs import bind_parent, bind_trace, span
+from manatee_tpu.storage import stream as wirestream
 from manatee_tpu.storage.base import StorageBackend, StorageError
 
 log = logging.getLogger("manatee.backup.sender")
@@ -61,8 +62,13 @@ class BackupSender:
         # the job carries the requester's trace/span ids (POST /backup):
         # this process's send span parents into the requester's restore
         # tree even though it lives in the backupserver daemon
+        # stream codec: best mutual pick from the requester's offer
+        # (raw when it offered nothing — an old peer — or nothing
+        # overlaps our own codec set)
+        codec = wirestream.negotiate(job.compress)
         with bind_trace(job.trace), bind_parent(job.span), \
-                span("backup.send", job=job.uuid, dataset=self.dataset):
+                span("backup.send", job=job.uuid, dataset=self.dataset,
+                     codec=codec or "raw"):
             snap = await self.storage.latest_backup_snapshot(self.dataset)
             if snap is None:
                 raise StorageError("no snapshots of %s eligible for "
@@ -90,8 +96,15 @@ class BackupSender:
                 # stall = a wedged send stream the receiver's poll loop
                 # must notice; error fails the job like a died pipe
                 await faults.point("backup.send.stream")
+                # stamp the job uuid on the stream for receivers that
+                # declared the protocol: their listener port can be a
+                # REBOUND one (a cancelled predecessor's), and the
+                # stamp is what lets them refuse our stream if we are
+                # the stale job
+                sid = job.uuid if job.stream_proto >= 1 else None
                 await self.storage.send(self.dataset, snap.name, writer,
-                                        progress_cb=progress)
+                                        progress_cb=progress,
+                                        compress=codec, stream_id=sid)
                 writer.close()
                 try:
                     await writer.wait_closed()

@@ -68,12 +68,21 @@ class BackupRestServer:
                 status=409)
         trace = params.get("trace")
         span_id = params.get("span")
+        # the requester's codec offer (absent/malformed = old peer =
+        # raw); only string names survive into the job
+        offered = params.get("compress")
+        if not isinstance(offered, list):
+            offered = []
+        proto = params.get("streamProto")
         job = BackupJob(host=str(params["host"]),
                         port=int(params["port"]),
                         dataset=str(params["dataset"]),
                         trace=trace if isinstance(trace, str) else None,
                         span=span_id if isinstance(span_id, str)
-                        else None)
+                        else None,
+                        compress=tuple(str(c) for c in offered),
+                        stream_proto=proto
+                        if isinstance(proto, int) else 0)
         self.queue.push(job)
         log.info("enqueued backup job %s -> %s:%d", job.uuid, job.host,
                  job.port)

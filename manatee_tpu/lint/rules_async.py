@@ -517,6 +517,74 @@ def unbounded_wait(ctx: FileContext):
             "unbounded-allow list" % hit)
 
 
+# ------------------------------------------------------ write-without-drain
+
+# receiver-name convention for asyncio StreamWriters in this tree:
+# `writer`, `*_writer`/`*writer`, and child-stdin pipes (`proc.stdin`)
+_WRITERISH_LAST = ("writer", "stdin")
+
+
+def _writerish(recv: str | None) -> bool:
+    if not recv:
+        return False
+    last = recv.rsplit(".", 1)[-1]
+    return last in _WRITERISH_LAST or last.endswith("writer")
+
+
+def _innermost_loop(ctx: FileContext, node):
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        cur = ctx.parents.get(cur)
+    return None
+
+
+@rule("write-without-drain",
+      "StreamWriter.write() in a loop with no await .drain()")
+def write_without_drain(ctx: FileContext):
+    """``writer.write()`` only queues bytes in the transport; without
+    ``await writer.drain()`` in the same loop, a receiver slower than
+    the producer grows the send buffer without bound — on the restore
+    path that is the whole dataset resident in the sender's memory.
+    Flagged: a write on a StreamWriter-named receiver (``writer``,
+    ``*_writer``, ``proc.stdin``) inside a loop whose body never
+    awaits ``.drain()`` on the SAME receiver.  A drain after the loop
+    does not count: the buffer already peaked at the full batch."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "write"):
+            continue
+        recv = dotted(node.func.value)
+        if not _writerish(recv):
+            continue
+        loop = _innermost_loop(ctx, node)
+        if loop is None:
+            continue
+        drained = False
+        for stmt in loop.body:
+            for sub in walk_no_defs(stmt):
+                if isinstance(sub, ast.Await) \
+                        and isinstance(sub.value, ast.Call) \
+                        and isinstance(sub.value.func, ast.Attribute) \
+                        and sub.value.func.attr == "drain" \
+                        and dotted(sub.value.func.value) == recv:
+                    drained = True
+                    break
+            if drained:
+                break
+        if not drained:
+            yield ctx.finding(
+                node.lineno, "write-without-drain",
+                "%s.write() in a loop without an 'await %s.drain()' in "
+                "the same loop: a slow receiver grows the send buffer "
+                "without bound — drain per iteration (or per bounded "
+                "batch)" % (recv, recv))
+
+
 # --------------------------------------------------------- span-not-closed
 
 @rule("span-not-closed", "obs span() entered without with/async with")

@@ -82,6 +82,11 @@ class Engine(abc.ABC):
         pg_stat_wal_receiver)."""
         return True
 
+    async def aclose(self) -> None:
+        """Release engine-held resources (PostgresEngine kills its
+        pooled psql coprocesses here); default engines hold none."""
+        return None
+
     # -- local cluster management --
 
     @abc.abstractmethod

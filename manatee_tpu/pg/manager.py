@@ -111,6 +111,12 @@ class PostgresMgr:
 
         self._proc: asyncio.subprocess.Process | None = None
         self._applied: dict | None = None   # last successful role config
+        # signature of the last server config actually written to the
+        # datadir: identical regenerations are skipped (no write, no
+        # SIGHUP) — a no-op reconfigure re-drive must not cost a
+        # config-reload cycle on the takeover path.  None = unknown
+        # (datadir replaced by initdb/restore/mount: must rewrite).
+        self._conf_sig: tuple | None = None
         self._online = False
         self._health_task: asyncio.Task | None = None
         self._catchup_task: asyncio.Task | None = None
@@ -179,6 +185,9 @@ class PostgresMgr:
             # itself is cancelled mid-reap (the kill() in _kill_proc is
             # synchronous, so it lands before any further await)
             await self._kill_proc()
+            # pooled psql coprocesses die with the manager
+            with contextlib.suppress(Exception):
+                await self.engine.aclose()
             if self._log_fh:
                 self._log_fh.close()
             if self._dump_fh:
@@ -305,6 +314,33 @@ class PostgresMgr:
             except Exception:
                 pass
 
+    # -- config generation --
+
+    def _apply_conf(self, *, read_only: bool,
+                    sync_standby_ids: list[str],
+                    upstream: dict | None) -> bool:
+        """Regenerate the server config ONLY when it differs from what
+        was last written to this datadir; returns True when a write
+        happened (callers pair a True with the reload/restart that
+        makes it take effect).  The signature covers every input
+        write_config folds into the files; anything that replaces the
+        datadir's content behind our back (initdb, restore, mount)
+        clears :attr:`_conf_sig` so the next apply always writes."""
+        sig = (bool(read_only), tuple(sync_standby_ids),
+               (upstream or {}).get("pgUrl"))
+        if sig == self._conf_sig:
+            return False
+        try:
+            self.engine.write_config(
+                self.datadir, host=self.host, port=self.port,
+                peer_id=self.peer_id, read_only=read_only,
+                sync_standby_ids=sync_standby_ids, upstream=upstream)
+        except Exception:
+            self._conf_sig = None
+            raise
+        self._conf_sig = sig
+        return True
+
     # -- primary --
 
     async def _primary(self, pgcfg: dict) -> None:
@@ -312,6 +348,11 @@ class PostgresMgr:
         downstream = pgcfg.get("downstream")
         singleton = bool(self.cfg.get("singleton"))
         sync_ids = [downstream["id"]] if downstream else []
+        # the overlapped-takeover barrier (state/machine.py): writes
+        # must not re-enable until the takeover's cluster-state CAS
+        # write is durable.  The promote itself is safe to run
+        # concurrently with the CAS (the database stays read-only).
+        gate = pgcfg.get("commitGate")
         # In-place promotion (pg_promote(), PostgreSQL 12+): a RUNNING
         # standby taking over exits recovery via conf rewrite + reload —
         # no database restart in the takeover path, and no down-window
@@ -333,12 +374,10 @@ class PostgresMgr:
                     and self._applied.get("role") in ("sync", "async")):
                 log.info("%s: promoting in place (no restart)",
                          self.peer_id)
-                self.engine.write_config(
-                    self.datadir, host=self.host, port=self.port,
-                    peer_id=self.peer_id,
-                    read_only=not singleton,
-                    sync_standby_ids=sync_ids, upstream=None)
-                self._reload()
+                if self._apply_conf(read_only=not singleton,
+                                    sync_standby_ids=sync_ids,
+                                    upstream=None):
+                    self._reload()
                 try:
                     # a healthy server promotes in well under a second;
                     # a short bound means a JUST-wedged one (health
@@ -361,16 +400,21 @@ class PostgresMgr:
                 # read-only until the sync catches up — taking writes
                 # before the sync is established risks data loss on the
                 # next failover
-                self.engine.write_config(
-                    self.datadir, host=self.host, port=self.port,
-                    peer_id=self.peer_id,
-                    read_only=not singleton,
-                    sync_standby_ids=sync_ids, upstream=None)
+                self._apply_conf(read_only=not singleton,
+                                 sync_standby_ids=sync_ids,
+                                 upstream=None)
                 await self._start()
-        await self._snapshot_safe()
+        # the catchup watcher arms BEFORE the transition snapshot: the
+        # snapshot (a full dataset copy on the dir backend) is not a
+        # prerequisite for write-enable, so it must not serialize ahead
+        # of the catchup wait on the failover critical path — the two
+        # overlap, and reconfigure still returns only after the
+        # snapshot completes (its failure stays non-fatal either way)
         if downstream:
             self._catchup_task = asyncio.create_task(
-                self._wait_for_standby(downstream["id"], sync_ids))
+                self._wait_for_standby(downstream["id"], sync_ids,
+                                       gate))
+        await self._snapshot_safe()
 
     async def _update_standby(self, pgcfg: dict) -> None:
         """Already primary; only the downstream changed: conf rewrite +
@@ -378,21 +422,27 @@ class PostgresMgr:
         downstream = pgcfg.get("downstream")
         singleton = bool(self.cfg.get("singleton"))
         sync_ids = [downstream["id"]] if downstream else []
-        self.engine.write_config(
-            self.datadir, host=self.host, port=self.port,
-            peer_id=self.peer_id,
-            read_only=not singleton,
-            sync_standby_ids=sync_ids, upstream=None)
-        self._reload()
+        if self._apply_conf(read_only=not singleton,
+                            sync_standby_ids=sync_ids, upstream=None):
+            self._reload()
         if downstream:
             self._catchup_task = asyncio.create_task(
-                self._wait_for_standby(downstream["id"], sync_ids))
+                self._wait_for_standby(downstream["id"], sync_ids,
+                                       pgcfg.get("commitGate")))
 
     async def _wait_for_standby(self, standby_id: str,
-                                sync_ids: list[str]) -> None:
+                                sync_ids: list[str],
+                                gate: asyncio.Event | None = None
+                                ) -> None:
         """Poll replication status until the downstream catches up
         (sent == flush), bounded by replicationTimeout of NO progress,
-        then enable writes (lib/postgresMgr.js:1037-1105, 2390-2555)."""
+        then enable writes (lib/postgresMgr.js:1037-1105, 2390-2555).
+
+        *gate* (overlapped takeover): write-enable additionally waits
+        for the takeover's cluster-state CAS write to be durable — the
+        downstream may ALREADY be streaming from us (it was our async
+        in the old chain), so catchup alone is not evidence that the
+        topology committed."""
         last_flush: str | None = None
         deadline = time.monotonic() + float(self.cfg["replicationTimeout"])
         with span("pg.catchup", standby=standby_id):
@@ -411,16 +461,21 @@ class PostgresMgr:
                             deadline = time.monotonic() + \
                                 float(self.cfg["replicationTimeout"])
                         if row["sent_lsn"] == row["flush_lsn"]:
+                            if gate is not None:
+                                # caught up, but the takeover's durable
+                                # write may still be in flight: writes
+                                # only re-enable once it lands (the
+                                # state machine sets the gate, or
+                                # cancels us on a lost CAS race)
+                                await gate.wait()
                             log.info("%s: standby %s caught up at %s; "
                                      "enabling writes", self.peer_id,
                                      standby_id, row["flush_lsn"])
-                            self.engine.write_config(
-                                self.datadir, host=self.host,
-                                port=self.port,
-                                peer_id=self.peer_id, read_only=False,
-                                sync_standby_ids=sync_ids,
-                                upstream=None)
-                            self._reload()
+                            if self._apply_conf(
+                                    read_only=False,
+                                    sync_standby_ids=sync_ids,
+                                    upstream=None):
+                                self._reload()
                             self._emit("writable", standby_id)
                             return
                     if time.monotonic() > deadline:
@@ -471,11 +526,10 @@ class PostgresMgr:
                      "no restart)", self.peer_id, upstream.get("id"))
             with span("pg.repoint", upstream=upstream.get("id")):
                 await faults.point("pg.repoint")
-                self.engine.write_config(
-                    self.datadir, host=self.host, port=self.port,
-                    peer_id=self.peer_id, read_only=True,
-                    sync_standby_ids=[], upstream=upstream)
-                self._reload()
+                if self._apply_conf(read_only=True,
+                                    sync_standby_ids=[],
+                                    upstream=upstream):
+                    self._reload()
             if self.engine.lingering_repoint_failure:
                 self._repoint_task = asyncio.create_task(
                     self._repoint_watchdog(pgcfg))
@@ -488,10 +542,8 @@ class PostgresMgr:
             await self._ensure_dataset_mounted(create=False)
             if not self.engine.is_initialized(self.datadir):
                 raise NeedsRestoreError("no local database")
-            self.engine.write_config(
-                self.datadir, host=self.host, port=self.port,
-                peer_id=self.peer_id, read_only=True,
-                sync_standby_ids=[], upstream=upstream)
+            self._apply_conf(read_only=True, sync_standby_ids=[],
+                             upstream=upstream)
             await self._start(allow_restore_exit=True)
         except asyncio.CancelledError:
             raise
@@ -528,11 +580,12 @@ class PostgresMgr:
                 get_journal().record("restore.done",
                                      upstream=upstream.get("id"))
                 self._emit("restoreDone", upstream)
+                # the restore replaced the datadir wholesale: whatever
+                # config it carried is not ours
+                self._conf_sig = None
                 await self._ensure_dataset_mounted(create=False)
-                self.engine.write_config(
-                    self.datadir, host=self.host, port=self.port,
-                    peer_id=self.peer_id, read_only=True,
-                    sync_standby_ids=[], upstream=upstream)
+                self._apply_conf(read_only=True, sync_standby_ids=[],
+                                 upstream=upstream)
                 # replay: boot the restored dataset and chew through
                 # its WAL until the server answers health probes — the
                 # second half of a restore's wall-clock cost
@@ -567,6 +620,19 @@ class PostgresMgr:
             await asyncio.wait_for(w.wait_closed(), 2.0)
         return True
 
+    async def _attached_quiet(self, upstream: dict) -> bool:
+        try:
+            return await self.engine.upstream_attached(
+                self.host, self.port, upstream, 5.0)
+        except PgError:
+            return False
+
+    async def _status_quiet(self) -> dict | None:
+        try:
+            return await self._local_query({"op": "status"}, 5.0)
+        except PgError:
+            return None
+
     async def _repoint_watchdog(self, pgcfg: dict) -> None:
         """After a standby transition on a real-postgres engine, verify
         the walreceiver actually attaches to the NEW upstream: a
@@ -597,15 +663,17 @@ class PostgresMgr:
         deadline = time.monotonic() + repl_timeout
         last_replay: str | None = None
         while not self._closed and time.monotonic() < deadline:
-            try:
-                if await self.engine.upstream_attached(
-                        self.host, self.port, upstream, 5.0):
-                    return
-            except PgError:
-                pass
+            # the attachment probe and the replay-progress read are
+            # independent questions about the same server: ask them
+            # concurrently instead of serializing two query round
+            # trips per poll tick
+            attached, res = await asyncio.gather(
+                self._attached_quiet(upstream),
+                self._status_quiet())
+            if attached:
+                return
             progressed = False
-            try:
-                res = await self._local_query({"op": "status"}, 5.0)
+            if res is not None:
                 replay = res.get("replay_location") \
                     or res.get("xlog_location")
                 if replay is not None and replay != last_replay:
@@ -613,8 +681,6 @@ class PostgresMgr:
                         progressed = True
                         deadline = time.monotonic() + repl_timeout
                     last_replay = replay
-            except PgError:
-                pass
             # only probe when this iteration saw neither attachment nor
             # replay progress — the only case where the unreachable
             # extension matters (every probe forks a real backend on
@@ -656,6 +722,7 @@ class PostgresMgr:
         if not self.engine.is_initialized(self.datadir):
             log.info("%s: initializing fresh database", self.peer_id)
             await self.engine.initdb(self.datadir)
+            self._conf_sig = None    # fresh datadir: nothing written yet
 
     async def _ensure_dataset_mounted(self, *, create: bool) -> None:
         if not self.dataset:
@@ -666,9 +733,13 @@ class PostgresMgr:
                 raise NeedsRestoreError("dataset %s missing" % self.dataset)
             await self.storage.create(self.dataset,
                                       mountpoint=self.datadir)
+            self._conf_sig = None    # brand-new dataset at the datadir
         if not await self.storage.is_mounted(self.dataset):
             await self.storage.set_mountpoint(self.dataset, self.datadir)
             await self.storage.mount(self.dataset)
+            # a (re)mount can change what lives at the datadir: the
+            # cached config signature no longer describes those files
+            self._conf_sig = None
 
     async def _snapshot_safe(self) -> None:
         """Snapshot at primary-transition time

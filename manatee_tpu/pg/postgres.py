@@ -18,15 +18,31 @@ Queries go through psql(1) so no driver dependency is needed; the result
 is normalized to the same structured dicts SimPgEngine returns.  This
 engine requires real binaries and is exercised only on hosts that have
 them (the dev image does not).
+
+Hot-path queries ride a POOLED LONG-LIVED psql coprocess per database
+(:class:`PsqlSession`): one spawn amortized over every probe tick and
+catchup poll, instead of fork+exec+connect per statement — the dominant
+cost of the takeover critical path on real engines (the PR 3 analyzer
+attributes ~150ms per spawn on a loaded box).  Sessions are framed with
+``\\echo`` sentinel markers carrying psql's ``:ERROR`` variable, spawn
+on demand, and session failures fall back to the original one-shot
+path — except a death AFTER a mutating statement was submitted, which
+surfaces as PgError rather than risk double-execution — so the pool is
+strictly an optimization (disable outright with
+``MANATEE_PSQL_SESSION=0`` or the ``pgSessionPool`` sitter config key).
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
+import logging
 import os
 import re
 from pathlib import Path
+
+log = logging.getLogger("manatee.pg.engine")
 
 from manatee_tpu.pg.engine import Engine, PgError, PgQueryTimeout, parse_pg_url
 from manatee_tpu.utils import ConfFile, ExecError, run
@@ -118,6 +134,262 @@ def wal_function_names(major: str) -> dict:
     }
 
 
+class PsqlSessionDied(PgError):
+    """The pooled psql coprocess died mid-exchange.  *submitted* says
+    whether any statement of the batch had already been handed to the
+    coprocess: if so, the server MAY have executed it, and replaying
+    through the one-shot path could double-execute (a pg_promote that
+    already promoted errors 'recovery is not in progress'; a probe
+    INSERT lands twice) — so only UNsubmitted deaths are retried."""
+
+    def __init__(self, msg: str, *, submitted: bool = False):
+        super().__init__(msg)
+        self.submitted = submitted
+
+
+class PsqlSessionBusy(PgError):
+    """The pooled session's lock stayed held past the caller's
+    timeout (a slow statement ahead in the queue, e.g. a bounded
+    pg_promote wait); callers fall back to the one-shot path so the
+    pool never makes a probe SLOWER than the pre-pool behavior."""
+
+
+class PsqlSession:
+    """One long-lived ``psql`` coprocess bound to a single database.
+
+    Statements are written to the coprocess's stdin one at a time,
+    each followed by ``\\echo <marker> :ERROR`` — psql prints the
+    marker line (with true/false for the statement's outcome) after
+    the statement's own output, which frames the reply stream without
+    any protocol support from the server.  The marker carries a
+    per-session random token plus a sequence number, so no plausible
+    result row can collide with it (same reasoning as the one-shot
+    batch path's section marker).
+
+    Crash semantics: a coprocess that exits (server restart, kill -9,
+    connection loss) surfaces as :class:`PsqlSessionDied`; the session
+    discards it and respawns on the next call, and the ENGINE falls
+    back to the one-shot path for the query in flight when that is
+    safe (read-only batches, or nothing submitted yet) — a session
+    failure costs one extra spawn, never a wrong answer."""
+
+    def __init__(self, engine: "PostgresEngine", host: str, port: int):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self._proc: asyncio.subprocess.Process | None = None
+        self._lock = asyncio.Lock()
+        self._err_task: asyncio.Task | None = None
+        self._err_buf: list[str] = []
+        self._token = os.urandom(8).hex()
+        self._seq = 0
+        self.spawns = 0          # exposed for the reuse tests
+
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.returncode is None
+
+    async def run(self, sqls: list[str], timeout: float) -> list[str]:
+        """Run *sqls* in order over the pooled coprocess; returns one
+        output string per statement.  Raises PgError for a statement
+        the server rejected, PgQueryTimeout when the exchange exceeds
+        *timeout* (the coprocess is then in an unknown state and is
+        killed), PsqlSessionDied when the coprocess itself died."""
+        # the session serializes callers: the LOCK WAIT counts against
+        # the caller's timeout too, or a slow statement ahead in the
+        # queue (pg_promote's promoteWait) would delay a health
+        # probe's verdict far past its configured bound
+        acquired = False
+        try:
+            try:
+                await asyncio.wait_for(self._lock.acquire(), timeout)
+                acquired = True
+            except asyncio.TimeoutError:
+                raise PsqlSessionBusy(
+                    "psql session busy for %ss (statement ahead in "
+                    "the queue still running)" % timeout) from None
+            if not self.alive:
+                try:
+                    await self._spawn(timeout)
+                except asyncio.CancelledError:
+                    # a cancel mid-spawn/handshake would otherwise
+                    # leave a LIVE coprocess whose unread handshake
+                    # reply desyncs the next caller's framing
+                    await self._close_locked()
+                    raise
+            try:
+                return await asyncio.wait_for(self._run_locked(sqls),
+                                              timeout)
+            except asyncio.TimeoutError:
+                # mid-statement: replies could arrive for a statement
+                # we gave up on — the session is out of sync, kill it
+                await self._close_locked()
+                raise PgQueryTimeout(
+                    "psql session query timed out after %ss"
+                    % timeout) from None
+            except PsqlSessionDied:
+                await self._close_locked()
+                raise
+            except PgError:
+                raise
+            except asyncio.CancelledError:
+                # the exchange was cut mid-reply: same out-of-sync
+                # hazard as the timeout
+                await self._close_locked()
+                raise
+            except OSError as e:
+                # transport-level failure mid-exchange (reset pipe,
+                # reader error): classify as a died session so the
+                # engine retries one-shot — a raw OSError would
+                # escape Engine.health()'s PgError filter and kill
+                # the caller's loop outright
+                await self._close_locked()
+                raise PsqlSessionDied("psql session I/O failed: %s"
+                                      % e, submitted=True) from None
+        finally:
+            if acquired:
+                self._lock.release()
+
+    async def close(self) -> None:
+        async with self._lock:
+            await self._close_locked()
+
+    # -- internals --
+
+    async def _spawn(self, timeout: float) -> None:
+        argv = [self.engine._cmd("psql"), "-h", self.host,
+                "-p", str(self.port), "-U", self.engine.pg_user,
+                "-d", "postgres", "-qAt", "-F", "\x1f"]
+        env = dict(os.environ)
+        env["PGCONNECT_TIMEOUT"] = str(max(1, int(timeout)))
+        self._proc = await asyncio.create_subprocess_exec(
+            *argv, stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE, env=env)
+        self.spawns += 1
+        self._err_buf = []
+        self._err_task = asyncio.create_task(
+            self._drain_stderr(self._proc))
+        # handshake: a bare marker proves the connection is up before
+        # the first statement is committed to this transport (psql
+        # connects at startup and exits on failure)
+        try:
+            await asyncio.wait_for(self._exchange_marker_only(), timeout)
+        except (asyncio.TimeoutError, PsqlSessionDied, OSError) as e:
+            # OSError: the coprocess connected-and-exited and the
+            # handshake write hit the closed pipe — the same
+            # server-down shape as an EOF, and it must surface as
+            # PgError (below), never escape raw into the health loop
+            err = self._take_stderr() or str(e)
+            await self._close_locked()
+            if "timeout" in err:
+                raise PgQueryTimeout(err) from None
+            raise PgError(err.strip() or "psql session failed to start") \
+                from None
+
+    async def _drain_stderr(self, proc) -> None:
+        try:
+            while True:
+                line = await proc.stderr.readline()
+                if not line:
+                    return
+                self._err_buf.append(line.decode("utf-8", "replace"))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+
+    def _take_stderr(self) -> str:
+        text, self._err_buf = "".join(self._err_buf), []
+        return text
+
+    async def _await_stderr(self) -> str:
+        """The error text lands on a DIFFERENT pipe than the marker
+        that reported it; give the drain task a brief window to
+        deliver it before giving up on the detail."""
+        for _ in range(3):
+            if self._err_buf:
+                break
+            await asyncio.sleep(0.01)
+        return self._take_stderr()
+
+    def _mark(self) -> str:
+        self._seq += 1
+        return "\x1e--psql-%s-%d--" % (self._token, self._seq)
+
+    async def _exchange_marker_only(self) -> None:
+        mark = self._mark()
+        self._proc.stdin.write(("\\echo %s\n" % mark).encode())
+        await self._proc.stdin.drain()
+        while True:
+            raw = await self._proc.stdout.readline()
+            if not raw:
+                raise PsqlSessionDied("psql session exited during "
+                                      "handshake")
+            if raw.decode("utf-8", "replace").rstrip("\n") == mark:
+                return
+
+    async def _run_locked(self, sqls: list[str]) -> list[str]:
+        out: list[str] = []
+        for sql in sqls:
+            # scope stderr to THIS statement: real psql emits NOTICEs/
+            # WARNINGs for successful statements too, and a long-lived
+            # session would otherwise attribute the whole backlog to
+            # the next failure (and a stale 'timeout' line would
+            # misclassify it as PgQueryTimeout)
+            self._err_buf.clear()
+            mark = self._mark()
+            # the fake (and the protocol) are line-framed; engine
+            # statements are single-line by construction, so the
+            # collapse is a no-op in practice
+            stmt = " ".join(sql.splitlines())
+            try:
+                self._proc.stdin.write(
+                    ("%s\n\\echo %s :ERROR\n" % (stmt, mark)).encode())
+                await self._proc.stdin.drain()
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                # the write MAY have reached the coprocess before it
+                # died: conservatively submitted (no replay)
+                raise PsqlSessionDied("psql session died: %s" % e,
+                                      submitted=True) from None
+            lines: list[str] = []
+            failed = False
+            while True:
+                raw = await self._proc.stdout.readline()
+                if not raw:
+                    raise PsqlSessionDied(
+                        "psql session died mid-statement: %s"
+                        % (await self._await_stderr()).strip(),
+                        submitted=True)
+                line = raw.decode("utf-8", "replace")
+                line = line[:-1] if line.endswith("\n") else line
+                if line.startswith(mark):
+                    failed = line[len(mark):].strip() == "true"
+                    break
+                lines.append(line)
+            if failed:
+                err = (await self._await_stderr()).strip()
+                if "timeout" in err:
+                    raise PgQueryTimeout(err)
+                raise PgError(err or "psql statement failed")
+            out.append("\n".join(lines))
+        return out
+
+    async def _close_locked(self) -> None:
+        proc, self._proc = self._proc, None
+        task, self._err_task = self._err_task, None
+        if proc is not None and proc.returncode is None:
+            with contextlib.suppress(ProcessLookupError):
+                proc.kill()
+        if proc is not None:
+            with contextlib.suppress(Exception):
+                await proc.wait()
+        if task is not None:
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await task
+
+
 class PostgresEngine(Engine):
     scheme = "tcp"
 
@@ -126,7 +398,8 @@ class PostgresEngine(Engine):
                  template: dict | None = None,
                  template_file: str | None = None,
                  hba_file: str | None = None,
-                 overrides: dict | None = None):
+                 overrides: dict | None = None,
+                 session_pool: bool | None = None):
         """*template_file*: a shipped postgresql.conf to regenerate from
         (etc/postgresql.conf; the reference always rewrites starting
         from its shipped per-major template, lib/postgresMgr.js:
@@ -153,6 +426,16 @@ class PostgresEngine(Engine):
         # scope: common -> major -> full version
         # (lib/postgresMgr.js:118-137, 527-560)
         self.template.update(merge_overrides(overrides, version))
+        # pooled psql coprocess per (host, port): statements on the
+        # probe/catchup hot path stop paying fork+exec+connect.  The
+        # \echo :ERROR framing needs psql >= 12; default on, killable
+        # with MANATEE_PSQL_SESSION=0 (or session_pool=False)
+        if session_pool is None:
+            session_pool = os.environ.get(
+                "MANATEE_PSQL_SESSION", "1") != "0"
+        self.session_pool = bool(session_pool) \
+            and float(self.major.split(".")[0]) >= 12
+        self._sessions: dict[tuple[str, int], PsqlSession] = {}
 
     def _cmd(self, name: str) -> str:
         return str(self.bin / name) if self.bin else name
@@ -280,7 +563,8 @@ class PostgresEngine(Engine):
         out = (await self._psql(
             host, port,
             "SELECT status || '\x1f' || conninfo "
-            "FROM pg_stat_wal_receiver;", timeout)).strip()
+            "FROM pg_stat_wal_receiver;", timeout,
+            replay_safe=True)).strip()
         if not out:
             return False
         status, _sep, conninfo = out.partition("\x1f")
@@ -291,8 +575,66 @@ class PostgresEngine(Engine):
 
     # -- queries via psql --
 
+    def _session(self, host: str, port: int) -> PsqlSession:
+        key = (host, port)
+        s = self._sessions.get(key)
+        if s is None:
+            s = self._sessions[key] = PsqlSession(self, host, port)
+        return s
+
+    async def aclose(self) -> None:
+        """Kill every pooled psql coprocess (manager/harness
+        teardown)."""
+        sessions, self._sessions = list(self._sessions.values()), {}
+        for s in sessions:
+            await s.close()
+
+    async def _exec(self, host: str, port: int, sqls: list[str],
+                    timeout: float, *, replay_safe: bool = False
+                    ) -> list[str]:
+        """Statement batch over the pooled session when enabled,
+        one-shot psql otherwise.  A BUSY session (lock held past the
+        timeout by a slow statement) and a session that died before
+        any statement of this batch was submitted fall back to the
+        one-shot path — the pool never makes a query slower or less
+        available than the pre-pool behavior.  A death AFTER
+        submission falls back only for *replay_safe* (read-only)
+        batches: the server may already have executed a submitted
+        statement, and replaying a mutating one could double-execute
+        (pg_promote errors 'recovery is not in progress'; the probe
+        INSERT lands twice) — those surface as PgError and the
+        caller's own retry logic decides."""
+        if self.session_pool:
+            try:
+                return await self._session(host, port).run(sqls, timeout)
+            except PsqlSessionDied as e:
+                if e.submitted and not replay_safe:
+                    raise PgError(str(e)) from None
+                log.debug("psql session to %s:%d died (%s); one-shot "
+                          "fallback", host, port, e)
+            except PsqlSessionBusy as e:
+                log.debug("psql session to %s:%d busy (%s); one-shot "
+                          "fallback", host, port, e)
+        if len(sqls) == 1:
+            return [await self._psql_oneshot(host, port, sqls[0],
+                                             timeout)]
+        return await self._psql_sections_oneshot(host, port, sqls,
+                                                 timeout)
+
     async def _psql(self, host: str, port: int, sql: str,
-                    timeout: float) -> str:
+                    timeout: float, *, replay_safe: bool = False
+                    ) -> str:
+        return (await self._exec(host, port, [sql], timeout,
+                                 replay_safe=replay_safe))[0]
+
+    async def _psql_sections(self, host: str, port: int,
+                             sqls: list[str], timeout: float, *,
+                             replay_safe: bool = False) -> list[str]:
+        return await self._exec(host, port, sqls, timeout,
+                                replay_safe=replay_safe)
+
+    async def _psql_oneshot(self, host: str, port: int, sql: str,
+                            timeout: float) -> str:
         argv = [self._cmd("psql"), "-h", host, "-p", str(port),
                 "-U", self.pg_user, "-d", "postgres",
                 "-At", "-F", "\x1f", "-c", sql]
@@ -318,12 +660,12 @@ class PostgresEngine(Engine):
     # collide with it and shift the section split (ADVICE r4)
     _SECTION_RS = "\x1e--manatee-section-9f4b2c17ab5e--"
 
-    async def _psql_sections(self, host: str, port: int,
-                             sqls: list[str], timeout: float
-                             ) -> list[str]:
+    async def _psql_sections_oneshot(self, host: str, port: int,
+                                     sqls: list[str], timeout: float
+                                     ) -> list[str]:
         if float(self.major) < 9.6:
             # pre-9.6 psql has no repeated -c: sequential fallback
-            return [await self._psql(host, port, s, timeout)
+            return [await self._psql_oneshot(host, port, s, timeout)
                     for s in sqls]
         # ON_ERROR_STOP: real psql's default is to CONTINUE past a
         # failed -c and still exit 0 — a mid-batch error would leave an
@@ -366,7 +708,8 @@ class PostgresEngine(Engine):
         kind = op.get("op")
         w = wal_function_names(self.major)
         if kind == "health":
-            await self._psql(host, port, "SELECT current_time;", timeout)
+            await self._psql(host, port, "SELECT current_time;",
+                             timeout, replay_safe=True)
             return {"ok": True}
         if kind == "status":
             # the whole op is ONE psql spawn (see _psql_sections);
@@ -415,7 +758,7 @@ class PostgresEngine(Engine):
                 host, port,
                 [in_rec_sql, xlog_sql, replay_sql, lag_sql, repl_sql,
                  ro_sql],
-                timeout)
+                timeout, replay_safe=True)
             in_rec = sec[0].strip() == "t"
             xlog = sec[1].strip()
             replay = sec[2].strip()
@@ -450,7 +793,8 @@ class PostgresEngine(Engine):
             return {"ok": True}
         if kind == "select":
             out = await self._psql(
-                host, port, "SELECT v FROM manatee_probe;", timeout)
+                host, port, "SELECT v FROM manatee_probe;", timeout,
+                replay_safe=True)
             return {"ok": True,
                     "rows": [json.loads(x) for x in out.splitlines() if x]}
         raise PgError("unknown op %r" % kind)

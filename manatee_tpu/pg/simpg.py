@@ -406,6 +406,11 @@ class SimPgServer:
                     writer.write((json.dumps(rec) + "\n").encode())
                     cursor = rec["lsn"]
                     st["sent"] = cursor
+                    # drain PER RECORD: a standby replaying a deep
+                    # backlog must exert backpressure here, not buffer
+                    # the whole backlog in our transport (drain is a
+                    # no-op while below the high-water mark)
+                    await writer.drain()
                 await writer.drain()
                 # wait for new records; idle-poll timeout just loops
                 ev = asyncio.Event()

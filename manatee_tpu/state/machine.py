@@ -89,12 +89,19 @@ _TRANSITION_DUR = _REG.histogram(
 # THE headline SLI: primary-loss-detection -> new-primary-writable,
 # observed by the taking-over sync (detection stamped in _sync_duties,
 # completion on the PG manager's 'writable' event)
+# Buckets resized for the sub-second regime the bench now lives in
+# (~0.5-0.8s end to end; the in-shard portion is tens of ms): the
+# original grid was cut for the 30s reference budget and lumped every
+# modern failover into its first two buckets.  Name and unit are
+# unchanged, so no deprecated alias is owed under the PR 1 naming
+# contract; the tail keeps the old coarse steps so a restore-bound
+# failover still lands in a finite bucket.
 _FAILOVER_DUR = _REG.histogram(
     "failover_duration_seconds",
     "primary loss detected by the sync until the new primary re-enabled "
     "writes",
-    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
-             300.0))
+    buckets=(0.05, 0.1, 0.15, 0.25, 0.4, 0.6, 0.8, 1.0, 1.5, 2.5, 5.0,
+             10.0, 30.0, 60.0, 120.0, 300.0))
 
 
 from manatee_tpu.utils import iso_ms as _now_iso  # noqa: E402
@@ -179,6 +186,11 @@ class PeerStateMachine:
         # state.evaluate span is recorded per observed transition (not
         # one per worker kick)
         self._reacted_span: str | None = None
+        # the write-enable gate of an in-flight overlapped takeover:
+        # created at promote start, opened when the CAS write lands,
+        # reused across takeover retries so the running reconfigure is
+        # not restarted per attempt
+        self._takeover_gate: asyncio.Event | None = None
 
         zk.on("init", self._on_zk_init)
         zk.on("activeChange", self._on_active_change)
@@ -292,8 +304,8 @@ class PeerStateMachine:
             "pgReady": self._pg_ready,
             "active": self._actives,
             "clusterState": self._state,
-            "pgTarget": self._pg_target,
-            "pgApplied": self._pg_applied,
+            "pgTarget": self._strip_cfg(self._pg_target),
+            "pgApplied": self._strip_cfg(self._pg_applied),
         }
 
     async def _worker(self) -> None:
@@ -644,15 +656,42 @@ class PeerStateMachine:
             get_journal().record("takeover.begin", why=why,
                                  old_primary=st["primary"]["id"],
                                  new_generation=new["generation"])
+            # OVERLAPPED TAKEOVER: the pg promotion starts while the
+            # durable CAS write is still in flight — the two stages
+            # are independent until write-enable.  Write authority is
+            # NOT weakened: the promoted database stays read-only
+            # until the commit gate opens, and the gate opens only
+            # after the CAS write lands (the catchup watcher awaits it
+            # even when the downstream is already caught up).  A
+            # retried takeover (CAS fault, conflict re-drive) reuses
+            # the SAME gate object so the in-flight reconfigure is
+            # neither restarted nor orphaned.
+            gate = self._takeover_gate
+            if gate is None or gate.is_set():
+                gate = self._takeover_gate = asyncio.Event()
+            cfg = self._pg_config_for(new, "primary")
+            cfg["commitGate"] = gate
+            await self._apply_pg(cfg)
             if not await self._write_state(new, "takeover (%s)" % why,
                                            ver, trace_id=tid,
                                            root=parent is None):
                 # lost the race (e.g. an operator freeze landed first):
-                # do NOT promote local postgres; re-evaluate against
-                # the winner
+                # withdraw the optimistic reconfigure — the gate never
+                # opens, so no write was ever enabled.  The retract
+                # cannot UNDO a pg_promote that already executed: if
+                # the winner's state still names us sync, the promoted
+                # (non-recovery, still read-only) database cannot
+                # re-enter recovery and ends up on the restore path —
+                # the deliberate cost of overlapping promote with the
+                # CAS write, paid only in the rare lost-race window
+                # and never as a write-authority violation.
+                self._retract_pg(cfg)
+                self._takeover_gate = None
                 return False
-            # the takeover is durable; we are the primary now
-            await self._apply_pg(self._pg_config_for(new, "primary"))
+            # the takeover is durable; we are the primary now — open
+            # the write-enable gate
+            gate.set()
+            self._takeover_gate = None
         return True
 
     # -- shared helpers --
@@ -779,8 +818,29 @@ class PeerStateMachine:
         return {"role": "async", "upstream": upstream,
                 "downstream": downstream}
 
+    @staticmethod
+    def _strip_cfg(cfg: dict | None) -> dict | None:
+        """The reconfigure contract minus the overlapped-takeover gate:
+        equality checks (and debug output) must see the same target
+        whether or not a commit gate rides along, or a committed
+        takeover's follow-up evaluation would cancel its own in-flight
+        promote just to restart it gateless."""
+        if cfg is None or "commitGate" not in cfg:
+            return cfg
+        return {k: v for k, v in cfg.items() if k != "commitGate"}
+
     async def _apply_pg(self, cfg: dict) -> None:
-        if cfg == self._pg_target:
+        if self._strip_cfg(cfg) == self._strip_cfg(self._pg_target):
+            if self._pg_target is not None \
+                    and "commitGate" in self._pg_target \
+                    and "commitGate" not in (cfg or {}):
+                # an UNGATED request for the same config can only come
+                # from reacting to the durable state itself — exactly
+                # the authority the gate guards.  Open any still-closed
+                # gate rather than leaving a gated catchup waiting on a
+                # takeover that concluded through another write (e.g. a
+                # lost CAS race whose winner still names us primary).
+                self._pg_target["commitGate"].set()
             return
         self._pg_target = cfg
         if self._pg_task and not self._pg_task.done():
@@ -789,6 +849,21 @@ class PeerStateMachine:
             # lib/postgresMgr.js:1263-1275)
             self._pg_task.cancel()
         self._pg_task = asyncio.create_task(self._run_pg(cfg))
+
+    def _retract_pg(self, cfg: dict) -> None:
+        """Withdraw an optimistic reconfigure whose durable write lost
+        its race: cancel the in-flight task (if it is still ours) and
+        clear the target so the winner's state re-drives pg.  Compared
+        by CONTENT (gate stripped): a retried takeover's cfg is a
+        fresh dict while the target still holds the first attempt's —
+        identity would no-op exactly when the retract matters most."""
+        if self._pg_target is None or \
+                self._strip_cfg(self._pg_target) != self._strip_cfg(cfg):
+            return               # something else took over the target
+        self._pg_target = None
+        if self._pg_task and not self._pg_task.done():
+            self._pg_task.cancel()
+        self.kick()
 
     async def _run_pg(self, cfg: dict) -> None:
         try:

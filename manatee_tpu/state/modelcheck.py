@@ -679,8 +679,12 @@ class World:
                 "evaled_current": p.eval_epoch >= p.view_epoch,
                 "view": self._sem(p.zk.cluster_state),
                 "view_actives": [a["id"] for a in p.zk.active],
-                "target": p.sm._pg_target,
-                "applied": p.sm._pg_applied,
+                # strip the overlapped-takeover commit gate: an Event
+                # is not JSON, and its identity is fresh per attempt —
+                # hashing it would defeat memoization exactly like the
+                # trace/span ids quotiented above
+                "target": p.sm._strip_cfg(p.sm._pg_target),
+                "applied": p.sm._strip_cfg(p.sm._pg_applied),
                 "role_note": p.sm._notified_role,
             }
         blob = json.dumps({

@@ -26,6 +26,14 @@ class StorageError(Exception):
     pass
 
 
+class StreamIdMismatch(StorageError):
+    """The recv stream's header names a different job than the one
+    this listener is serving: a STALE sender (a cancelled restore's
+    job dialing the port its successor rebound).  Raised before any
+    dataset mutation; receivers drop the connection and keep waiting
+    for their own stream rather than failing the restore."""
+
+
 @dataclass(frozen=True)
 class Snapshot:
     dataset: str
@@ -139,9 +147,17 @@ class StorageBackend(abc.ABC):
         name: str,
         writer: asyncio.StreamWriter,
         progress_cb: ProgressCb | None = None,
+        compress: str | None = None,
+        stream_id: str | None = None,
     ) -> None:
         """Stream snapshot *name* of *dataset* into *writer* (the
-        sender side of lib/backupSender.js:154-242)."""
+        sender side of lib/backupSender.js:154-242).  *compress* is a
+        NEGOTIATED codec name (storage.stream) the receiver offered,
+        or None for the raw wire format; the chosen codec is named in
+        the per-stream header so the receiver keys off the wire.
+        *stream_id* (the backup job uuid) rides the same header so the
+        receiver can reject a STALE sender's dial-back — a cancelled
+        restore's job connecting to the port its successor rebound."""
 
     @abc.abstractmethod
     async def recv(
@@ -149,10 +165,14 @@ class StorageBackend(abc.ABC):
         dataset: str,
         reader: asyncio.StreamReader,
         progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
     ) -> None:
         """Receive a stream produced by :meth:`send` into *dataset*,
         unmounted (zfs recv -u, lib/zfsClient.js:793).  The received
-        snapshot is preserved on the receiver."""
+        snapshot is preserved on the receiver.  A stream whose header
+        names a stream id different from *expect_stream_id* is
+        refused BEFORE any dataset mutation (a headerless/old-sender
+        stream cannot be verified and is accepted)."""
 
     # -- convenience shared across backends --
 

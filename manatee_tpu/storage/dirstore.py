@@ -29,6 +29,7 @@ import time
 from pathlib import Path
 
 from manatee_tpu import faults
+from manatee_tpu.storage import stream as wirestream
 from manatee_tpu.storage.base import (
     ProgressCb,
     Snapshot,
@@ -154,6 +155,23 @@ class DirBackend(StorageBackend):
             raise StorageError("parent dataset does not exist: %s"
                                % dataset.rpartition("/")[0])
         p = self._dspath(dataset)
+        if p.exists():
+            # @meta.json is the existence marker (doctor's
+            # dir-without-meta debris class): a create/recv cancelled
+            # between the mkdirs and the meta save strands exactly
+            # this shape, and destroy() cannot see it — without this
+            # sweep every later create of the same dataset dies on
+            # mkdir FileExistsError FOREVER (a restore-wedge the
+            # overlapped takeover's tighter cancel timing exposed in
+            # tier-1).  Only a CHILDLESS meta-less dir is debris; one
+            # holding child datasets is load-bearing structure.
+            children = [c.name for c in p.iterdir()
+                        if c.name not in _RESERVED]
+            if children:
+                raise StorageError(
+                    "dataset path %s exists without metadata and has "
+                    "children %s" % (dataset, children))
+            await asyncio.to_thread(shutil.rmtree, p)
         (p / "@data").mkdir(parents=True)
         (p / "@snapshots").mkdir()
         self._save_meta(dataset, {
@@ -358,13 +376,22 @@ class DirBackend(StorageBackend):
         name: str,
         writer: asyncio.StreamWriter,
         progress_cb: ProgressCb | None = None,
+        compress: str | None = None,
+        stream_id: str | None = None,
     ) -> None:
         src = self._dspath(dataset) / "@snapshots" / name
         if not src.exists():
             raise StorageError("no such snapshot: %s@%s" % (dataset, name))
         await faults.point("storage.send")
         size = await self.estimate_send_size(dataset, name)
-        header = json.dumps({"snapshot": name, "size": size}) + "\n"
+        hdr = {"snapshot": name, "size": size}
+        if compress:
+            # named in the per-stream header so the receiver keys its
+            # decompressor off the wire, not off config agreement
+            hdr["compression"] = compress
+        if stream_id:
+            hdr["stream"] = stream_id
+        header = json.dumps(hdr) + "\n"
         try:
             writer.write(header.encode())
             await writer.drain()
@@ -372,7 +399,11 @@ class DirBackend(StorageBackend):
             raise StorageError("send of %s@%s aborted: %s"
                                % (dataset, name, e)) from e
         from manatee_tpu import native
-        if native.enabled() and writer.get_extra_info("socket") is not None:
+        # the native splice pump moves the child's raw stdout in the
+        # kernel — compression needs the bytes in userspace, so a
+        # negotiated codec takes the python pipeline instead
+        if not compress and native.enabled() \
+                and writer.get_extra_info("socket") is not None:
             await self._send_native(dataset, name, src, size, writer,
                                     progress_cb)
             return
@@ -383,19 +414,15 @@ class DirBackend(StorageBackend):
         )
         # drain stderr CONCURRENTLY: a tar emitting more warnings than
         # the pipe buffer would block on stderr and stall stdout short
-        # of EOF, deadlocking the copy loop below
+        # of EOF, deadlocking the copy pipeline below
         t_err = asyncio.create_task(proc.stderr.read())
-        done = 0
         try:
-            while True:
-                chunk = await proc.stdout.read(1 << 16)
-                if not chunk:
-                    break
-                done += len(chunk)
-                writer.write(chunk)
-                await writer.drain()
-                if progress_cb:
-                    progress_cb(done, size)
+            with wirestream.recorded_stage("send", dataset,
+                                           compress) as st:
+                st.raw, st.wire = await wirestream.pipeline_copy(
+                    proc.stdout.read, writer, codec=compress,
+                    progress=(lambda d: progress_cb(d, size))
+                    if progress_cb else None)
         except asyncio.CancelledError:
             # our caller was cancelled (server shutdown, peer-handler
             # teardown): same cleanup, then let the cancel propagate —
@@ -459,6 +486,7 @@ class DirBackend(StorageBackend):
         dataset: str,
         reader: asyncio.StreamReader,
         progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
     ) -> None:
         await faults.point("storage.recv")
         hdr_line = await reader.readline()
@@ -470,12 +498,23 @@ class DirBackend(StorageBackend):
             size = hdr.get("size")
         except (json.JSONDecodeError, KeyError, TypeError):
             raise StorageError("bad recv stream header: %r" % hdr_line) from None
+        # stream identity, BEFORE any dataset mutation: a cancelled
+        # restore's job can dial back into the port its successor
+        # rebound, and receiving the stale stream would race (and
+        # corrupt) the fresh attempt's dataset.  A header without a
+        # stream id (an old sender) cannot be verified and passes.
+        wirestream.check_stream_id(hdr, expect_stream_id)
         # the snapshot name came off the wire: refuse anything that is not
         # a single safe path component
         if (not isinstance(snapname, str) or not snapname
                 or "/" in snapname or "\\" in snapname
                 or snapname in (".", "..") or snapname in _RESERVED):
             raise StorageError("bad snapshot name in stream: %r" % (snapname,))
+        # compression is whatever the SENDER named in the header (it
+        # only ever names a codec we offered); an absent key — an old
+        # sender — is raw
+        codec = hdr.get("compression")
+        feed = wirestream.make_feed(reader, codec)
 
         if self._exists_sync(dataset):
             raise StorageError(
@@ -483,22 +522,39 @@ class DirBackend(StorageBackend):
         await self.create(dataset)
         data = self._dspath(dataset) / "@data"
 
-        proc = await asyncio.create_subprocess_exec(
-            "tar", "-C", str(data), "-xf", "-",
-            stdin=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.PIPE,
-        )
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                "tar", "-C", str(data), "-xf", "-",
+                stdin=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+        except BaseException:
+            # a cancel landing on the spawn (a topology change
+            # cancelling the restore in its first milliseconds) must
+            # not strand the just-created dataset: it would shadow
+            # every later attempt with 'recv target exists'
+            await self._destroy_quietly(dataset)
+            raise
         # drain stderr CONCURRENTLY with the feed: a tar emitting more
         # warnings than the pipe buffer ('implausibly old time stamp',
         # unknown extended headers) would block on stderr, stop
         # reading stdin, and wedge the drain() below forever
         t_err = asyncio.create_task(proc.stderr.read())
+        seen = {"raw": 0}
+
+        def _prog(d: int) -> None:
+            seen["raw"] = d          # raw (post-inflate) bytes fed to tar
+            if progress_cb:
+                progress_cb(d, size)
+
         try:
-            err, rc = await pump_socket_to_child(
-                proc, reader, t_err,
-                on_progress=(lambda d: progress_cb(d, size))
-                if progress_cb else None,
-                label="recv into %s" % dataset)
+            with wirestream.recorded_stage("recv", dataset,
+                                           codec) as st:
+                err, rc = await pump_socket_to_child(
+                    proc, feed, t_err, on_progress=_prog,
+                    label="recv into %s" % dataset)
+                st.raw = seen["raw"]
+                st.wire = feed.wire_bytes if codec else st.raw
         except BaseException:
             # restore aborted (cancel, dead stream, anything): the
             # helper already reaped the child; remove the partial
@@ -536,5 +592,18 @@ class DirBackend(StorageBackend):
         try:
             await self.destroy(dataset, recursive=True)
         except (StorageError, OSError):
-            # OSError: destroy's rmtree/iterdir hit the vanish mid-way
-            pass
+            # OSError: destroy's rmtree/iterdir hit the vanish mid-way.
+            # StorageError can also mean a META-LESS partial (this very
+            # abort landed inside create(), before the meta save):
+            # destroy() cannot see it, so clear the debris directly —
+            # leaving it would fail every later recv with
+            # 'File exists' until an operator intervened.
+            try:
+                p = self._dspath(dataset)
+            except StorageError:
+                return
+            if p.exists() and not (p / "@meta.json").exists():
+                try:
+                    await asyncio.to_thread(shutil.rmtree, p)
+                except OSError:
+                    pass

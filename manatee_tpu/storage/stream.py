@@ -1,0 +1,390 @@
+"""Bulk-stream data plane: negotiated wire compression and a bounded
+read-ahead pipeline for the snapshot send/recv path.
+
+The restore stream is the biggest single payer on a restore-bound
+failover (the PR 3 analyzer attributes 90%+ of one to
+``pg.catchup``/``pg.restore``), and its costs are classic data-plane
+costs: disk read latency serialized with socket write latency, and raw
+bytes on the wire.  Two remedies here, both modeled on
+compression-accelerated collectives (gZCCL) and RPC-overhead work
+(RPCAcc) from the motivation papers:
+
+- :func:`pipeline_copy` — the producer (tar/zfs-send stdout) reads
+  ahead into a BOUNDED queue while the consumer compresses, writes,
+  and drains, so disk and network latency overlap instead of adding.
+  The bound is the backpressure contract: a slow receiver blocks
+  ``drain()``, the queue fills to ``readahead`` chunks, and the
+  producer stalls — sender memory never exceeds
+  ``readahead × chunk_size`` plus the transport's own buffer.
+
+- negotiated OPTIONAL compression — the restore client OFFERS the
+  codecs it can decode in its ``POST /backup`` body, the sender picks
+  the best mutual one (:func:`negotiate`) and names it in the stream
+  header, and the receiver keys its decompressor off that header.
+  Either side missing the feature degrades to raw: an old receiver
+  offers nothing, an old sender names nothing.  zlib is always
+  available (stdlib); zstd only when the ``zstandard`` module is
+  importable — never a hard dependency.
+
+Tuning knobs (docs/performance.md): ``MANATEE_STREAM_CHUNK_KB``
+(chunk size, default 256), ``MANATEE_STREAM_READAHEAD`` (queue depth,
+default 4), ``MANATEE_STREAM_COMPRESS`` (``zstd``/``zlib``/``off`` —
+restricts what the restore client offers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import time
+import zlib
+from typing import Awaitable, Callable
+
+from manatee_tpu.obs import get_registry
+
+CHUNK_SIZE = max(4096, int(os.environ.get(
+    "MANATEE_STREAM_CHUNK_KB", "256")) * 1024)
+READAHEAD = max(1, int(os.environ.get("MANATEE_STREAM_READAHEAD", "4")))
+
+# preference order when several codecs are mutually supported
+_PREFERENCE = ("zstd", "zlib")
+
+# wire-header magic for streams whose NATIVE format has no header to
+# extend (zfs send): written only when a codec was negotiated — and a
+# codec is only negotiated when the receiver OFFERED one, which is
+# exactly the evidence that the receiver knows how to probe for this
+# prefix.  Old peers never see it in either direction.
+WIRE_MAGIC = b"MNTSTRM1"
+
+_REG = get_registry()
+STREAM_BYTES = _REG.counter(
+    "stream_bytes_total", "raw snapshot bytes moved by bulk streams",
+    ("direction",))
+STREAM_WIRE_BYTES = _REG.counter(
+    "stream_wire_bytes_total",
+    "bulk-stream bytes on the wire (after compression)", ("direction",))
+# stream-stage latency in the sub-second-to-minutes regime (a small
+# dataset rebuild is tens of ms; a production one, minutes)
+STREAM_DUR = _REG.histogram(
+    "stream_stage_duration_seconds",
+    "wall-clock of one bulk-stream stage", ("direction",),
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+             60.0, 300.0, 1800.0))
+STREAM_THROUGHPUT = _REG.histogram(
+    "stream_throughput_mb_per_second",
+    "raw-byte throughput of one bulk-stream stage", ("direction",),
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0))
+
+
+def record_stream(direction: str, raw: int, wire: int,
+                  duration_s: float) -> None:
+    """Fold one completed stream stage into the registry; returns
+    nothing — callers stamp span attrs themselves."""
+    STREAM_BYTES.inc(raw, direction=direction)
+    STREAM_WIRE_BYTES.inc(wire, direction=direction)
+    STREAM_DUR.observe(duration_s, direction=direction)
+    if duration_s > 0:
+        STREAM_THROUGHPUT.observe(raw / duration_s / 1e6,
+                                  direction=direction)
+
+
+def throughput_mb_s(raw: int, duration_s: float) -> float | None:
+    return round(raw / duration_s / 1e6, 3) if duration_s > 0 else None
+
+
+class _Stage:
+    """Byte accounting a stream stage fills in; consumed by
+    :func:`recorded_stage` on successful exit."""
+
+    raw = 0
+    wire = 0
+
+
+@contextlib.contextmanager
+def recorded_stage(direction: str, dataset: str, codec: str | None):
+    """One bulk-stream stage's span + clock + registry fold, shared by
+    every backend's send/recv (the glue existed four times before).
+    The body sets ``st.raw``/``st.wire``; metrics and span attrs are
+    recorded only when the stage completes."""
+    from manatee_tpu.obs import span
+    st = _Stage()
+    with span("stream.%s" % direction, dataset=dataset,
+              codec=codec or "raw") as sp:
+        clock = StageClock()
+        yield st
+        dur = clock.elapsed()
+        record_stream(direction, st.raw, st.wire, dur)
+        sp.attrs.update(
+            bytes_total=st.raw, wire_bytes=st.wire,
+            throughput_mb_s=throughput_mb_s(st.raw, dur))
+
+
+def make_feed(reader, codec: str | None):
+    """The recv-side decoder for a stream's named *codec* (None =
+    raw passthrough); an unknown codec surfaces as StorageError —
+    shared by both backends so the error shape cannot drift."""
+    if not codec:
+        return reader
+    from manatee_tpu.storage.base import StorageError
+    try:
+        return DecompressingReader(reader, codec)
+    except ValueError as e:
+        raise StorageError(str(e)) from None
+
+
+def check_stream_id(hdr: dict | None, expected: str | None) -> None:
+    """Refuse a stream whose header names a different job than the
+    one this listener serves (a STALE sender's dial-back) — shared by
+    both backends, raised before any dataset mutation.  Headerless /
+    id-less streams (old senders) cannot be verified and pass."""
+    from manatee_tpu.storage.base import StreamIdMismatch
+    got = (hdr or {}).get("stream")
+    if expected and got and got != expected:
+        raise StreamIdMismatch(
+            "recv stream id %r does not match expected %r "
+            "(stale sender?)" % (got, expected))
+
+
+# ---------------------------------------------------------------- codecs
+
+def have_zstd() -> bool:
+    try:
+        import zstandard  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def available_codecs() -> list[str]:
+    """Codecs THIS process can decode, best first — what the restore
+    client offers in its POST /backup body.  MANATEE_STREAM_COMPRESS
+    restricts it: 'off' offers nothing (raw), a codec name offers just
+    that one."""
+    knob = os.environ.get("MANATEE_STREAM_COMPRESS", "").strip().lower()
+    if knob in ("off", "0", "none", "raw"):
+        return []
+    out = [c for c in _PREFERENCE
+           if c == "zlib" or (c == "zstd" and have_zstd())]
+    if knob:
+        out = [c for c in out if c == knob]
+    return out
+
+
+def negotiate(offered) -> str | None:
+    """The sender's half: best codec BOTH ends support, or None for
+    raw.  *offered* is whatever arrived in the POST body — absent or
+    malformed (an old peer) reads as an empty offer."""
+    if not isinstance(offered, (list, tuple)):
+        return None
+    offers = {str(o) for o in offered}
+    for codec in available_codecs():
+        if codec in offers:
+            return codec
+    return None
+
+
+class _ZstdCompressor:
+    def __init__(self):
+        import zstandard
+        self._c = zstandard.ZstdCompressor().compressobj()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def flush(self) -> bytes:
+        return self._c.flush()
+
+
+class _ZstdDecompressor:
+    def __init__(self):
+        import zstandard
+        self._d = zstandard.ZstdDecompressor().decompressobj()
+
+    def decompress(self, data: bytes) -> bytes:
+        return self._d.decompress(data)
+
+    def flush(self) -> bytes:
+        return b""
+
+
+class _ZlibDecompressor:
+    def __init__(self):
+        self._d = zlib.decompressobj()
+
+    def decompress(self, data: bytes) -> bytes:
+        return self._d.decompress(data)
+
+    def flush(self) -> bytes:
+        return self._d.flush()
+
+
+def make_compressor(codec: str | None):
+    if codec is None:
+        return None
+    if codec == "zlib":
+        return zlib.compressobj(6)
+    if codec == "zstd" and have_zstd():
+        return _ZstdCompressor()
+    raise ValueError("unsupported stream codec: %r" % codec)
+
+
+def make_decompressor(codec: str | None):
+    if codec is None:
+        return None
+    if codec == "zlib":
+        return _ZlibDecompressor()
+    if codec == "zstd" and have_zstd():
+        return _ZstdDecompressor()
+    raise ValueError("unsupported stream codec: %r" % codec)
+
+
+class PrefixedReader:
+    """StreamReader facade that replays already-probed bytes before
+    the live stream — the pushback half of the zfs wire-header probe
+    (a raw stream's first bytes were consumed looking for
+    :data:`WIRE_MAGIC` and must reach the child intact)."""
+
+    def __init__(self, prefix: bytes, reader: asyncio.StreamReader):
+        self._prefix = prefix
+        self._reader = reader
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._prefix:
+            out, self._prefix = self._prefix, b""
+            return out
+        return await self._reader.read(n)
+
+
+async def probe_wire_header(reader: asyncio.StreamReader):
+    """Receiver half of the headerless-format negotiation: read just
+    enough to decide whether the sender wrote a ``WIRE_MAGIC`` header
+    line.  Returns ``(header_dict | None, feed)`` where *feed* serves
+    the remaining stream (with any probed raw bytes replayed)."""
+    import json as _json
+    buf = b""
+    while len(buf) < len(WIRE_MAGIC):
+        chunk = await reader.read(len(WIRE_MAGIC) - len(buf))
+        if not chunk:
+            return None, PrefixedReader(buf, reader)
+        buf += chunk
+    if buf != WIRE_MAGIC:
+        return None, PrefixedReader(buf, reader)
+    line = await reader.readline()
+    try:
+        hdr = _json.loads(line)
+        if not isinstance(hdr, dict):
+            raise ValueError(hdr)
+    except ValueError:
+        raise ValueError("bad wire header after magic: %r" % line[:200]) \
+            from None
+    return hdr, reader
+
+
+class DecompressingReader:
+    """StreamReader facade that inflates a named codec; the recv-side
+    twin of the compressor in :func:`pipeline_copy`.  ``read()``
+    returns RAW (decompressed) bytes, so progress accounting and the
+    header's size estimate stay in one unit on both ends."""
+
+    def __init__(self, reader: asyncio.StreamReader, codec: str,
+                 chunk_size: int | None = None):
+        self._reader = reader
+        self._d = make_decompressor(codec)
+        self._chunk = chunk_size or CHUNK_SIZE
+        self._eof = False
+        self.wire_bytes = 0
+
+    async def read(self, n: int = -1) -> bytes:
+        while not self._eof:
+            chunk = await self._reader.read(self._chunk)
+            if not chunk:
+                self._eof = True
+                return self._d.flush()
+            self.wire_bytes += len(chunk)
+            out = self._d.decompress(chunk)
+            if out:
+                return out
+            # a compressed frame can span chunks: keep reading
+        return b""
+
+
+# -------------------------------------------------------------- pipeline
+
+async def pipeline_copy(
+    read_fn: Callable[[int], Awaitable[bytes]],
+    writer: asyncio.StreamWriter,
+    *,
+    codec: str | None = None,
+    chunk_size: int | None = None,
+    readahead: int | None = None,
+    progress: Callable[[int], None] | None = None,
+) -> tuple[int, int]:
+    """Copy ``read_fn`` → *writer* with bounded read-ahead and optional
+    compression; returns ``(raw_bytes, wire_bytes)``.
+
+    The producer task keeps ``readahead`` chunks in flight so the next
+    disk/child read overlaps the current socket write; every write is
+    followed by ``drain()``, so a slow receiver stalls the producer
+    through the full queue — the memory bound the backpressure test
+    pins.  A failed read surfaces on the consumer side (never a hung
+    queue); a failed write cancels the producer before propagating."""
+    chunk_size = chunk_size or CHUNK_SIZE
+    readahead = readahead or READAHEAD
+    comp = make_compressor(codec)
+    q: asyncio.Queue = asyncio.Queue(maxsize=readahead)
+
+    async def produce() -> None:
+        try:
+            while True:
+                chunk = await read_fn(chunk_size)
+                if not chunk:
+                    await q.put((None, None))
+                    return
+                await q.put((chunk, None))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # surface the read error THROUGH the queue: raising here
+            # alone would leave the consumer blocked on q.get forever
+            await q.put((None, e))
+
+    producer = asyncio.create_task(produce())
+    raw = wire = 0
+    try:
+        while True:
+            chunk, err = await q.get()
+            if err is not None:
+                raise err
+            if chunk is None:
+                break
+            raw += len(chunk)
+            data = comp.compress(chunk) if comp else chunk
+            if data:
+                wire += len(data)
+                writer.write(data)
+                await writer.drain()
+            if progress:
+                progress(raw)
+        if comp is not None:
+            tail = comp.flush()
+            if tail:
+                wire += len(tail)
+                writer.write(tail)
+                await writer.drain()
+    finally:
+        producer.cancel()
+        with contextlib.suppress(asyncio.CancelledError, Exception):
+            await producer
+    return raw, wire
+
+
+class StageClock:
+    """Tiny monotonic stopwatch shared by the send/recv stages."""
+
+    def __init__(self):
+        self.t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0

@@ -14,9 +14,11 @@ through the progress callback, and ``zfs recv -v -u`` receives unmounted.
 from __future__ import annotations
 
 import asyncio
+import json
 import re
 
 from manatee_tpu import faults
+from manatee_tpu.storage import stream as wirestream
 from manatee_tpu.storage.base import (
     ProgressCb,
     Snapshot,
@@ -193,9 +195,37 @@ class ZfsBackend(StorageBackend):
         name: str,
         writer: asyncio.StreamWriter,
         progress_cb: ProgressCb | None = None,
+        compress: str | None = None,
+        stream_id: str | None = None,
     ) -> None:
         from manatee_tpu import native
-        if native.enabled() and writer.get_extra_info("socket") is not None:
+
+        # zfs streams historically go raw with no header, so the codec
+        # and stream id ride a magic-prefixed wire header — written
+        # ONLY when the receiver's POST proved it knows how to probe
+        # for the magic (it offered codecs / declared the stream
+        # protocol; the sender gates stream_id/compress on that).  Old
+        # peers in either direction stay on the raw wire.
+        if compress or stream_id:
+            hdr = {"snapshot": name}
+            if compress:
+                hdr["compression"] = compress
+            if stream_id:
+                hdr["stream"] = stream_id
+            frame = wirestream.WIRE_MAGIC + json.dumps(hdr).encode() \
+                + b"\n"
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except Exception as e:
+                raise StorageError("zfs send of %s@%s aborted: %s"
+                                   % (dataset, name, e)) from e
+        if not compress and native.enabled() \
+                and writer.get_extra_info("socket") is not None:
+            # an UNCOMPRESSED body still rides the kernel splice pump
+            # even when a stream-id header was stamped (the pump's
+            # flush_transport pushes the header out first, exactly
+            # like DirBackend's header + native path)
             await self._send_native(dataset, name, writer, progress_cb)
             return
         proc = await asyncio.create_subprocess_exec(
@@ -208,16 +238,12 @@ class ZfsBackend(StorageBackend):
         err_chunks: list[bytes] = []
 
         async def pump_stdout():
-            done = 0
-            while True:
-                chunk = await proc.stdout.read(1 << 16)
-                if not chunk:
-                    return
-                done += len(chunk)
-                writer.write(chunk)
-                await writer.drain()
-                if progress_cb:
-                    progress_cb(done, state.size)
+            with wirestream.recorded_stage("send", dataset,
+                                           compress) as st:
+                st.raw, st.wire = await wirestream.pipeline_copy(
+                    proc.stdout.read, writer, codec=compress,
+                    progress=(lambda d: progress_cb(d, state.size))
+                    if progress_cb else None)
 
         t_err = asyncio.create_task(
             _watch_send_stderr(proc, state, err_chunks, progress_cb))
@@ -290,7 +316,20 @@ class ZfsBackend(StorageBackend):
         dataset: str,
         reader: asyncio.StreamReader,
         progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
     ) -> None:
+        # wire-header probe: a negotiating sender prefixed the stream
+        # with WIRE_MAGIC + codec/stream id; a raw stream's probed
+        # bytes are replayed into the child untouched
+        try:
+            hdr, feed = await wirestream.probe_wire_header(reader)
+        except ValueError as e:
+            raise StorageError(str(e)) from None
+        # a stale sender's dial-back (its job predates this attempt)
+        # is refused before zfs recv touches anything
+        wirestream.check_stream_id(hdr, expect_stream_id)
+        codec = (hdr or {}).get("compression")
+        feed = wirestream.make_feed(feed, codec)
         proc = await asyncio.create_subprocess_exec(
             self.zfs, "recv", "-v", "-u", dataset,
             stdin=asyncio.subprocess.PIPE,
@@ -301,14 +340,22 @@ class ZfsBackend(StorageBackend):
         # send paths: a verbose recv blocking on a full stderr pipe
         # stops reading stdin and wedges the drain() below)
         t_err = asyncio.create_task(proc.stderr.read())
+        seen = {"raw": 0}
+
+        def _prog(d: int) -> None:
+            seen["raw"] = d
+            if progress_cb:
+                progress_cb(d, None)
+
         # a killed zfs recv discards the incomplete stream itself, so
         # unlike DirBackend there is no partial dataset to remove on
         # abort — the helper's reap is the whole cleanup
-        err, rc = await pump_socket_to_child(
-            proc, reader, t_err,
-            on_progress=(lambda d: progress_cb(d, None))
-            if progress_cb else None,
-            label="zfs recv into %s" % dataset)
+        with wirestream.recorded_stage("recv", dataset, codec) as st:
+            err, rc = await pump_socket_to_child(
+                proc, feed, t_err, on_progress=_prog,
+                label="zfs recv into %s" % dataset)
+            st.raw = seen["raw"]
+            st.wire = feed.wire_bytes if codec else st.raw
         if rc != 0:
             raise StorageError("zfs recv failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))

@@ -42,6 +42,12 @@ def leaky(obs):
     obs.span("stage")                      # span-not-closed
 
 
+async def undrained(writer, chunks):
+    for chunk in chunks:
+        writer.write(chunk)                # write-without-drain
+    await writer.drain()
+
+
 async def faulty(faults, pick):
     await faults.point(pick())             # faultpoint-unregistered
     await faults.point("no.such.point")    # faultpoint-unregistered

@@ -87,14 +87,31 @@ def run_cli(cluster: "ClusterHarness", *args, timeout=120):
         env=cli_env(cluster.coord_connstr), timeout=timeout)
 
 
+def _ephemeral_floor() -> int:
+    """Lower bound of the kernel's ephemeral (outbound) port range.
+    Containers ship surprising values — this box says 16000, not the
+    textbook 32768 — and a daemon port allocated INSIDE the range gets
+    randomly squatted by long-lived outbound sockets (a coord session
+    holding some peer's zfsPort as its local port wedged restores for
+    a full minute before this was read from /proc)."""
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as fh:
+            return int(fh.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return 32768
+
+
 def alloc_port_block(n: int) -> int:
     """A contiguous block of *n* free ports BELOW the kernel's ephemeral
     range (so in-flight connections cannot steal them between allocation
     and daemon bind — the TOCTOU that made per-port allocation flaky).
     Verified by binding the whole block at once."""
     import random
+    hi = min(28000, _ephemeral_floor())
+    if hi - 10000 < max(2000, 2 * n):
+        hi = 28000       # degenerate range: keep the legacy block
     for _ in range(300):
-        base = random.randrange(10000, 28000 - n)
+        base = random.randrange(10000, hi - n)
         socks = []
         try:
             for i in range(n):
@@ -511,6 +528,15 @@ class ClusterHarness:
         for p in self.peers:
             p.kill()
         self.kill_coordd()
+        # reap the query engine's pooled psql coprocesses while the
+        # loop is still alive (subprocess transports must not be GC'd
+        # after loop close)
+        try:
+            await self.query_engine.aclose()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
 
     async def _dump_obs(self) -> None:
         """Best-effort observability dump into the cluster root BEFORE

@@ -25,6 +25,7 @@ NEW_RULES = {
     "orphan-task", "blocking-call-in-async", "blocking-io-in-async",
     "swallowed-cancellation", "cancel-without-await", "lock-discipline",
     "unbounded-wait", "span-not-closed", "faultpoint-unregistered",
+    "write-without-drain",
 }
 PORTED_RULES = {
     "syntax", "unused-import", "shadowed-def", "bare-except",
@@ -435,6 +436,61 @@ def test_unbounded_wait_configurable_primitives():
 
 
 # ---- span-not-closed ----
+
+def test_write_without_drain_positive():
+    # writer in a loop, drain only after: the buffer peaks at the batch
+    assert "write-without-drain" in rules_of("""\
+        async def f(writer, chunks):
+            for c in chunks:
+                writer.write(c)
+            await writer.drain()
+    """)
+    # dotted receivers: the child's stdin pipe is a StreamWriter too
+    assert "write-without-drain" in rules_of("""\
+        async def f(proc, reader):
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                proc.stdin.write(chunk)
+    """)
+    # attribute-held writers
+    assert "write-without-drain" in rules_of("""\
+        async def f(self, recs):
+            for r in recs:
+                self._writer.write(r)
+            await self._writer.drain()
+    """)
+
+
+def test_write_without_drain_negative():
+    # drain in the same loop: the backpressure contract holds
+    assert "write-without-drain" not in rules_of("""\
+        async def f(writer, chunks):
+            for c in chunks:
+                writer.write(c)
+                await writer.drain()
+    """)
+    # non-StreamWriter receivers (files, buffers) are not flagged
+    assert "write-without-drain" not in rules_of("""\
+        def f(fh, rows):
+            for r in rows:
+                fh.write(r)
+    """)
+    # a write OUTSIDE any loop needs no per-iteration drain
+    assert "write-without-drain" not in rules_of("""\
+        async def f(writer, data):
+            writer.write(data)
+            await writer.drain()
+    """)
+    # draining a DIFFERENT writer does not cover this one
+    assert "write-without-drain" in rules_of("""\
+        async def f(a_writer, b_writer, chunks):
+            for c in chunks:
+                a_writer.write(c)
+                await b_writer.drain()
+    """)
+
 
 def test_span_not_closed_bare_call():
     assert "span-not-closed" in rules_of("""\

@@ -1,0 +1,573 @@
+"""Failover critical-path fast paths (PR 8): pooled psql control
+channel, no-op config-regeneration skip, overlapped takeover commit
+gate, and the pipelined/negotiated-compression restore stream.
+
+Each fast path gets its failure mode exercised alongside its happy
+path: the psql coprocess is killed mid-life (fallback + respawn), the
+commit gate is checked against a CAS write still in flight, the codec
+negotiation runs its old-peer fallbacks in both directions, and the
+backpressure test pins the sender-memory bound a slow receiver must
+impose."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.pg.engine import PgError
+from manatee_tpu.pg.manager import PostgresMgr
+from manatee_tpu.pg.postgres import PostgresEngine
+from manatee_tpu.storage import DirBackend
+from manatee_tpu.storage import stream as wirestream
+from manatee_tpu.utils.confparser import ConfFile
+
+FAKEBIN = str(Path(__file__).parent / "fakepg")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run(coro):
+    async def reaped():
+        try:
+            return await coro
+        finally:
+            # reap subprocess transports before asyncio.run closes the
+            # loop (same discipline as test_pg_postgres_fake)
+            import gc
+            await asyncio.sleep(0)
+            gc.collect()
+            await asyncio.sleep(0)
+    return asyncio.run(reaped())
+
+
+class FakeDb:
+    """A live fakepg postgres child listening on a free port."""
+
+    def __init__(self, tmp_path, name="db"):
+        self.datadir = tmp_path / name
+        self.datadir.mkdir(parents=True)
+        self.port = free_port()
+        self.proc = None
+
+    async def start(self, *, standby_of: int | None = None):
+        conf = ConfFile({"port": str(self.port)})
+        if standby_of is not None:
+            conf.set("primary_conninfo",
+                     "'host=127.0.0.1 port=%d user=postgres "
+                     "application_name=me'" % standby_of)
+            (self.datadir / "standby.signal").touch()
+        conf.write(self.datadir / "postgresql.conf")
+        (self.datadir / "PG_VERSION").write_text("13\n")
+        self.proc = await asyncio.create_subprocess_exec(
+            FAKEBIN + "/postgres", "-D", str(self.datadir),
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL)
+        # wait for the listener
+        for _ in range(100):
+            try:
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", self.port), 1.0)
+                w.close()
+                return
+            except OSError:
+                await asyncio.sleep(0.05)
+        raise RuntimeError("fake postgres never came up")
+
+    async def stop(self):
+        if self.proc and self.proc.returncode is None:
+            self.proc.kill()
+        if self.proc:
+            await self.proc.wait()
+
+
+# ---------------------------------------------------------------- psql pool
+
+def test_psql_session_reuse(tmp_path):
+    """N hot-path queries ride ONE coprocess spawn."""
+    async def go():
+        db = FakeDb(tmp_path)
+        await db.start()
+        eng = PostgresEngine(pg_bin_dir=FAKEBIN, use_sudo=False,
+                             version="13.0")
+        try:
+            assert eng.session_pool
+            for _ in range(10):
+                st = await eng.query("127.0.0.1", db.port,
+                                     {"op": "status"}, 5.0)
+                assert st["ok"]
+            sess = eng._session("127.0.0.1", db.port)
+            assert sess.spawns == 1
+        finally:
+            await eng.aclose()
+            await db.stop()
+    run(go())
+
+
+def test_psql_session_coprocess_crash_respawns(tmp_path):
+    """A killed coprocess costs one fallback/respawn, never a wrong
+    answer — the query in flight when death is DISCOVERED still
+    succeeds."""
+    async def go():
+        db = FakeDb(tmp_path)
+        await db.start()
+        eng = PostgresEngine(pg_bin_dir=FAKEBIN, use_sudo=False,
+                             version="13.0")
+        try:
+            await eng.query("127.0.0.1", db.port, {"op": "health"}, 5.0)
+            sess = eng._session("127.0.0.1", db.port)
+            assert sess.spawns == 1
+            sess._proc.kill()
+            await sess._proc.wait()
+            # discovered dead -> immediate respawn inside the session
+            st = await eng.query("127.0.0.1", db.port,
+                                 {"op": "status"}, 5.0)
+            assert st["ok"] and sess.spawns == 2
+        finally:
+            await eng.aclose()
+            await db.stop()
+    run(go())
+
+
+def test_psql_session_death_mid_exchange_falls_back(tmp_path):
+    """The server dying under the session surfaces as PgError (exactly
+    like the one-shot path), and a restarted server is picked up by a
+    fresh spawn."""
+    async def go():
+        db = FakeDb(tmp_path)
+        await db.start()
+        eng = PostgresEngine(pg_bin_dir=FAKEBIN, use_sudo=False,
+                             version="13.0")
+        try:
+            await eng.query("127.0.0.1", db.port, {"op": "health"}, 5.0)
+            await db.stop()
+            with pytest.raises(PgError):
+                await eng.query("127.0.0.1", db.port,
+                                {"op": "health"}, 3.0)
+            # a NEW server on the same port: sessions respawn on demand
+            db2 = FakeDb(tmp_path, "db2")
+            db2.port = db.port
+            try:
+                await db2.start()
+                st = await eng.query("127.0.0.1", db.port,
+                                     {"op": "status"}, 5.0)
+                assert st["ok"]
+            finally:
+                await db2.stop()
+        finally:
+            await eng.aclose()
+    run(go())
+
+
+def test_psql_session_disabled_uses_oneshot(tmp_path):
+    async def go():
+        db = FakeDb(tmp_path)
+        await db.start()
+        eng = PostgresEngine(pg_bin_dir=FAKEBIN, use_sudo=False,
+                             version="13.0", session_pool=False)
+        try:
+            st = await eng.query("127.0.0.1", db.port,
+                                 {"op": "status"}, 5.0)
+            assert st["ok"]
+            assert eng._sessions == {}
+        finally:
+            await eng.aclose()
+            await db.stop()
+    run(go())
+
+
+# ---------------------------------------------------------- config diff skip
+
+def test_apply_conf_skips_noop_regeneration(tmp_path):
+    """Identical config regenerations are skipped; any input change —
+    or a datadir invalidation — writes again."""
+    async def go():
+        eng = PostgresEngine(pg_bin_dir=FAKEBIN, use_sudo=False,
+                             version="13.0")
+        writes = []
+        real = eng.write_config
+
+        def counting(*a, **kw):
+            writes.append(kw)
+            return real(*a, **kw)
+        eng.write_config = counting
+        mgr = PostgresMgr(
+            engine=eng, storage=DirBackend(tmp_path / "store"),
+            config={"peer_id": "p1", "port": free_port(),
+                    "datadir": str(tmp_path / "data"), "dataset": None})
+        (tmp_path / "data").mkdir()
+        up = {"pgUrl": "tcp://postgres@127.0.0.1:5555/postgres"}
+        assert mgr._apply_conf(read_only=True, sync_standby_ids=[],
+                               upstream=up) is True
+        assert mgr._apply_conf(read_only=True, sync_standby_ids=[],
+                               upstream=up) is False
+        assert len(writes) == 1
+        # a changed input writes
+        assert mgr._apply_conf(read_only=False, sync_standby_ids=["s"],
+                               upstream=None) is True
+        # same again: skipped
+        assert mgr._apply_conf(read_only=False, sync_standby_ids=["s"],
+                               upstream=None) is False
+        assert len(writes) == 2
+        # datadir replaced behind our back (restore/initdb/mount)
+        mgr._conf_sig = None
+        assert mgr._apply_conf(read_only=False, sync_standby_ids=["s"],
+                               upstream=None) is True
+        assert len(writes) == 3
+        await mgr.engine.aclose()
+    run(go())
+
+
+# ------------------------------------------------------- codec negotiation
+
+def test_negotiate_matrix(monkeypatch):
+    monkeypatch.delenv("MANATEE_STREAM_COMPRESS", raising=False)
+    codecs = wirestream.available_codecs()
+    assert "zlib" in codecs
+    # zstd only when the module exists — and then it is preferred
+    if wirestream.have_zstd():
+        assert codecs[0] == "zstd"
+        assert wirestream.negotiate(["zlib", "zstd"]) == "zstd"
+    else:
+        assert "zstd" not in codecs
+        assert wirestream.negotiate(["zstd"]) is None
+    assert wirestream.negotiate(["zlib"]) == "zlib"
+    # old peers: absent / malformed / empty offers mean raw
+    assert wirestream.negotiate(None) is None
+    assert wirestream.negotiate([]) is None
+    assert wirestream.negotiate("zlib") is None      # not a list
+    assert wirestream.negotiate(["gzip9"]) is None   # unknown name
+    # the operator kill switch
+    monkeypatch.setenv("MANATEE_STREAM_COMPRESS", "off")
+    assert wirestream.available_codecs() == []
+    assert wirestream.negotiate(["zlib"]) is None
+    monkeypatch.setenv("MANATEE_STREAM_COMPRESS", "zlib")
+    assert wirestream.available_codecs() == ["zlib"]
+
+
+@pytest.mark.parametrize("codec", [None, "zlib"] +
+                         (["zstd"] if wirestream.have_zstd() else []))
+def test_dirstore_stream_roundtrip(tmp_path, codec):
+    """send → recv over a real socket, each codec plus raw; content
+    identical, header names the codec, compressible payload shrinks
+    on the wire."""
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("src")
+        data = tmp_path / "store" / "datasets" / "src" / "@data"
+        payload = b"manatee " * 65536      # 512 KiB, compressible
+        (data / "blob").write_bytes(payload)
+        snap = await be.snapshot("src")
+
+        done: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        async def serve(reader, writer):
+            try:
+                await be.recv("dst", reader)
+                if not done.done():
+                    done.set_result(None)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if not done.done():
+                    done.set_exception(e)
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 5.0)
+            await be.send("src", snap.name, writer, compress=codec)
+            writer.close()
+            await asyncio.wait_for(done, 30)
+        finally:
+            server.close()
+            await server.wait_closed()
+        restored = (tmp_path / "store" / "datasets" / "dst" / "@data"
+                    / "blob").read_bytes()
+        assert restored == payload
+    run(go())
+
+
+def test_restore_end_to_end_negotiates_and_falls_back(tmp_path,
+                                                      monkeypatch):
+    """Full backup stack: the client's POST offers codecs, the sender
+    negotiates, wire bytes shrink; with the offer suppressed (an old
+    peer) the same stack streams raw."""
+    from manatee_tpu.backup.client import RestoreClient
+    from manatee_tpu.backup.queue import BackupQueue
+    from manatee_tpu.backup.sender import BackupSender
+    from manatee_tpu.backup.server import BackupRestServer
+
+    async def one(offer_env: str | None, dst: str) -> tuple[int, int]:
+        if offer_env is None:
+            monkeypatch.delenv("MANATEE_STREAM_COMPRESS", raising=False)
+        else:
+            monkeypatch.setenv("MANATEE_STREAM_COMPRESS", offer_env)
+        be = DirBackend(tmp_path / "store")
+        if not await be.exists("src"):
+            await be.create("src")
+            data = tmp_path / "store" / "datasets" / "src" / "@data"
+            (data / "blob").write_bytes(b"manatee " * (1 << 18))
+            await be.snapshot("src")
+        queue = BackupQueue()
+        sender = BackupSender(queue, be, "src")
+        server = BackupRestServer(queue, host="127.0.0.1", port=0)
+        await server.start()
+        sender.start()
+        raw0 = wirestream.STREAM_BYTES.value(direction="send")
+        wire0 = wirestream.STREAM_WIRE_BYTES.value(direction="send")
+        try:
+            rc = RestoreClient(be, dataset=dst,
+                               mountpoint=str(tmp_path / ("mnt-" + dst)),
+                               listen_host="127.0.0.1")
+            await rc.restore("http://127.0.0.1:%d" % server.port)
+        finally:
+            await sender.stop()
+            await server.stop()
+        return (int(wirestream.STREAM_BYTES.value(direction="send")
+                    - raw0),
+                int(wirestream.STREAM_WIRE_BYTES.value(direction="send")
+                    - wire0))
+
+    async def go():
+        raw, wire = await one("zlib", "dst1")
+        assert raw > 0 and wire < raw // 4, (raw, wire)
+        raw2, wire2 = await one("off", "dst2")
+        assert raw2 > 0 and wire2 == raw2
+    run(go())
+
+
+def test_zfs_wire_probe():
+    """probe_wire_header: magic prefix parses, raw streams (including
+    ones shorter than the magic) replay byte-for-byte."""
+    async def go():
+        # magic + header + payload
+        r = asyncio.StreamReader()
+        r.feed_data(wirestream.WIRE_MAGIC
+                    + json.dumps({"compression": "zlib"}).encode()
+                    + b"\n" + b"PAYLOAD")
+        r.feed_eof()
+        hdr, feed = await wirestream.probe_wire_header(r)
+        assert hdr == {"compression": "zlib"}
+        assert await feed.read(100) == b"PAYLOAD"
+
+        # raw stream starting with non-magic bytes (fakezfs JSON)
+        r = asyncio.StreamReader()
+        blob = b'{"snapshot": "x", "data": "y"}'
+        r.feed_data(blob)
+        r.feed_eof()
+        hdr, feed = await wirestream.probe_wire_header(r)
+        assert hdr is None
+        got = b""
+        while True:
+            chunk = await feed.read(8)
+            if not chunk:
+                break
+            got += chunk
+        assert got == blob
+
+        # stream shorter than the magic
+        r = asyncio.StreamReader()
+        r.feed_data(b"abc")
+        r.feed_eof()
+        hdr, feed = await wirestream.probe_wire_header(r)
+        assert hdr is None
+        assert await feed.read(100) == b"abc"
+    run(go())
+
+
+# ------------------------------------------------------------- backpressure
+
+def test_backpressure_bounds_sender_readahead(tmp_path):
+    """A receiver that stops reading must stall the producer through
+    the bounded queue: the source is never read more than
+    (transport high-water + readahead × chunk + one chunk in flight)
+    ahead of what the socket accepted."""
+    async def go():
+        CHUNK = 64 * 1024
+        READAHEAD = 2
+        read_pos = {"n": 0}
+        total = 64 * CHUNK     # 4 MiB source
+
+        async def read_fn(n):
+            take = min(n, total - read_pos["n"])
+            if take <= 0:
+                return b""
+            read_pos["n"] += take
+            return b"x" * take
+
+        stop_reading = asyncio.Event()
+        received = {"n": 0}
+
+        async def serve(reader, writer):
+            while True:
+                await stop_reading.wait()
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                received["n"] += len(chunk)
+            writer.close()
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 5.0)
+        writer.transport.set_write_buffer_limits(high=CHUNK)
+        try:
+            copy = asyncio.create_task(wirestream.pipeline_copy(
+                read_fn, writer, chunk_size=CHUNK,
+                readahead=READAHEAD))
+            # receiver asleep: the pipeline must wedge against the
+            # bounded queue, not inhale the source
+            await asyncio.sleep(0.5)
+            assert not copy.done()
+            # bound: transport buffer (high-water) + kernel socket
+            # buffers (both ends) + queued chunks + one in each hand
+            kernel = 4 * 1024 * 1024   # generous cap on socket buffers
+            bound = CHUNK + kernel + (READAHEAD + 2) * CHUNK
+            assert read_pos["n"] <= bound, \
+                "sender read %d bytes ahead (bound %d)" \
+                % (read_pos["n"], bound)
+            assert read_pos["n"] < total, \
+                "source fully consumed despite a stalled receiver"
+            # wake the receiver: the copy completes and every byte lands
+            stop_reading.set()
+            raw, wire = await asyncio.wait_for(copy, 30)
+            assert raw == total and wire == total
+            writer.close()
+            for _ in range(200):
+                if received["n"] == total:
+                    break
+                await asyncio.sleep(0.02)
+            assert received["n"] == total
+        finally:
+            server.close()
+            await server.wait_closed()
+    run(go())
+
+
+def test_recv_refuses_stale_stream_id(tmp_path):
+    """A dial-back whose header names a DIFFERENT job (a cancelled
+    predecessor's sender reaching the rebound port) is refused before
+    any dataset mutation — and with a matching/absent id the stream is
+    accepted."""
+    from manatee_tpu.storage.base import StreamIdMismatch
+
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        stale = asyncio.StreamReader()
+        stale.feed_data(json.dumps(
+            {"snapshot": "1700000000000", "stream": "job-OLD"}
+        ).encode() + b"\n")
+        stale.feed_eof()
+        with pytest.raises(StreamIdMismatch):
+            await be.recv("dst", stale, expect_stream_id="job-NEW")
+        # the refusal happened BEFORE create: no dataset, no debris
+        assert not await be.exists("dst")
+        assert not (tmp_path / "store" / "datasets" / "dst").exists()
+    run(go())
+
+
+def test_create_clears_aborted_create_debris(tmp_path):
+    """A create/recv cancelled between the mkdirs and the meta save
+    strands a META-LESS dataset dir that destroy() cannot see; a later
+    create must treat it as debris (the tier-1 restore wedge the
+    overlapped takeover's tighter cancel timing exposed), while a
+    meta-less dir HOLDING child datasets stays protected."""
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("manatee")
+        # simulate the cancelled create: @data exists, no @meta.json
+        debris = tmp_path / "store" / "datasets" / "manatee" / "pg"
+        (debris / "@data").mkdir(parents=True)
+        assert not await be.exists("manatee/pg")
+        await be.create("manatee/pg")          # must clear the debris
+        assert await be.exists("manatee/pg")
+        # recv into a debris-shadowed dataset works end to end
+        payload = b"wal " * 4096
+        (tmp_path / "store" / "datasets" / "manatee" / "pg" / "@data"
+         / "blob").write_bytes(payload)
+        snap = await be.snapshot("manatee/pg")
+        await be.destroy("manatee/pg", recursive=True)
+        # ... but a meta-less dir with CHILD datasets is structure
+        (tmp_path / "store" / "datasets" / "plain").mkdir()
+        (tmp_path / "store" / "datasets" / "plain" / "child"
+         / "@data").mkdir(parents=True)
+        with pytest.raises(Exception):
+            await be.create("plain")
+    run(go())
+
+
+# --------------------------------------------------- overlapped takeover
+
+def test_overlapped_takeover_gate(tmp_path):
+    """The promote starts while the CAS write is in flight, but the
+    commit gate only opens once the write lands — write authority
+    still follows durability."""
+    from manatee_tpu.coord import CoordSpace
+    from tests.test_state_machine import SimPeer, wait_for
+
+    async def go():
+        space = CoordSpace()
+        p1 = SimPeer(space, "p1")
+        p2 = SimPeer(space, "p2")
+        await p1.start()
+        await p2.start()
+        await wait_for(lambda: p2.pg.cfg
+                       and p2.pg.cfg.get("role") == "sync",
+                       what="p2 sync")
+
+        events = []
+        real_put = p2.zk.put_cluster_state
+        slow_cas = asyncio.Event()
+
+        async def slow_put(state, **kw):
+            events.append(("cas.begin",))
+            await slow_cas.wait()
+            out = await real_put(state, **kw)
+            events.append(("cas.done",))
+            return out
+        p2.zk.put_cluster_state = slow_put
+
+        real_reconf = p2.pg.reconfigure
+
+        async def spy_reconf(cfg):
+            gate = cfg.get("commitGate")
+            events.append(("pg.reconfigure", cfg.get("role"),
+                           gate.is_set() if gate else None))
+            return await real_reconf(cfg)
+        p2.pg.reconfigure = spy_reconf
+
+        await p1.kill()
+        # the overlapped promote must arrive while the CAS is parked
+        await wait_for(lambda: any(e[0] == "pg.reconfigure"
+                                   and e[1] == "primary"
+                                   for e in events),
+                       what="promote during CAS")
+        assert ("cas.done",) not in events, \
+            "promote should have started BEFORE the CAS completed"
+        promote = next(e for e in events
+                       if e[0] == "pg.reconfigure" and e[1] == "primary")
+        assert promote[2] is False, \
+            "commit gate must be CLOSED while the CAS is in flight"
+        gate = p2.sm._pg_target.get("commitGate")
+        assert gate is not None and not gate.is_set()
+        # release the CAS: the gate opens and the takeover is durable
+        slow_cas.set()
+        await wait_for(gate.is_set, what="gate opened on commit")
+        await wait_for(lambda: ("cas.done",) in events, what="cas done")
+        await p2.close()
+        assert not p2.violations
+    run(go())
