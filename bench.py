@@ -30,13 +30,20 @@ session timeout, FIN fast-path crash detection:
                              on top of the control plane the sim
                              configs isolate (VERDICT r4 weak #1).
 
-Plus one data-plane leg:
+Plus two data-plane legs:
 
   - restore_throughput:      MB/s for a fixed-size dataset rebuild
                              through the full backup stack (REST
                              negotiation, pipelined compressed stream,
                              post-restore snapshot) — the denominator
                              of every restore-bound failover.
+  - incremental_rebuild:     the same dataset rebuilt twice: a full
+                             bootstrap, then ~5% of it dirtied past a
+                             common snapshot and rebuilt again — the
+                             second run negotiates the common base and
+                             ships only the delta.  Reports duration
+                             AND wire bytes for full vs incremental
+                             (docs/performance.md).
 
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
@@ -82,7 +89,8 @@ SESSION_TIMEOUT = 1.0
 DISCONNECT_GRACE = 0.35
 
 ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
-               "ensemble_postgres", "restore_throughput")
+               "ensemble_postgres", "restore_throughput",
+               "incremental_rebuild")
 # raw payload of the restore_throughput leg: large enough that stream
 # setup (REST round trip, listener, tar spawn) is not the whole
 # number, small enough for a CI smoke lane
@@ -237,6 +245,105 @@ async def bench_restore_throughput() -> float:
         return mb_s
 
 
+async def bench_incremental_rebuild() -> dict:
+    """Full bootstrap, dirty ~5% past a common snapshot, rebuild: the
+    duration and wire-byte saving of common-snapshot negotiation +
+    delta send over shipping the whole dataset again."""
+    import math
+
+    from manatee_tpu.backup.client import RestoreClient
+    from manatee_tpu.backup.queue import BackupQueue
+    from manatee_tpu.backup.sender import BackupSender
+    from manatee_tpu.backup.server import BackupRestServer
+    from manatee_tpu.storage import DirBackend
+    from manatee_tpu.storage.stream import STREAM_WIRE_BYTES
+
+    nfiles = 32
+    fsize = max(1, RESTORE_MB // nfiles) * (1 << 20)
+
+    def _payload(dirpath: Path) -> int:
+        # unique-random half + zero half per file: ~2:1 compressible,
+        # no cross-file repetition a codec could flatten away (which
+        # would make the full stream artificially tiny and the ratio
+        # meaningless)
+        for i in range(nfiles):
+            (dirpath / ("blob-%03d.bin" % i)).write_bytes(
+                os.urandom(fsize // 2) + b"\x00" * (fsize // 2))
+        return nfiles * fsize
+
+    def wire(basis: str) -> int:
+        return int(STREAM_WIRE_BYTES.value(direction="recv",
+                                           basis=basis))
+
+    with tempfile.TemporaryDirectory(prefix="manatee-bench-ir-") as d:
+        root = Path(d)
+        be = DirBackend(root / "store")
+        await be.create("src")
+        data = root / "store" / "datasets" / "src" / "@data"
+        nbytes = await asyncio.to_thread(_payload, data)
+        await be.snapshot("src")
+        queue = BackupQueue()
+        sender = BackupSender(queue, be, "src")
+        server = BackupRestServer(queue, host="127.0.0.1", port=0,
+                                  storage=be, dataset="src")
+        await server.start()
+        sender.start()
+        try:
+            rc = RestoreClient(be, dataset="dst",
+                               mountpoint=str(root / "mnt"),
+                               listen_host="127.0.0.1")
+            url = "http://127.0.0.1:%d" % server.port
+            w0 = wire("full")
+            t0 = time.monotonic()
+            await rc.restore(url)
+            full_s = time.monotonic() - t0
+            full_wire = wire("full") - w0
+
+            # dirty ~5% of the dataset past the common snapshot
+            dirty = max(1, math.ceil(nfiles * 0.05))
+
+            def _dirty() -> None:
+                for i in range(dirty):
+                    (data / ("blob-%03d.bin" % i)).write_bytes(
+                        os.urandom(fsize // 2)
+                        + b"\x00" * (fsize // 2))
+                (data / "fresh.bin").write_bytes(os.urandom(64 * 1024))
+                (data / ("blob-%03d.bin" % (nfiles - 1))).unlink()
+
+            await asyncio.to_thread(_dirty)
+            await be.snapshot("src")
+
+            w0 = wire("incremental")
+            t0 = time.monotonic()
+            await rc.restore(url)
+            incr_s = time.monotonic() - t0
+            incr_wire = wire("incremental") - w0
+            basis = (rc.current_job or {}).get("basis")
+        finally:
+            await sender.stop()
+            await server.stop()
+        out = {
+            "dataset_mb": nbytes // (1 << 20),
+            "dirty_files": dirty,
+            "basis": basis,
+            "full_s": round(full_s, 3),
+            "full_wire_bytes": full_wire,
+            "incremental_s": round(incr_s, 3),
+            "incremental_wire_bytes": incr_wire,
+            "wire_ratio": (round(incr_wire / full_wire, 4)
+                           if full_wire else None),
+            "speedup": (round(full_s / incr_s, 2) if incr_s else None),
+        }
+        print("incremental_rebuild: full %.2fs / %.1f MB wire; "
+              "incremental (%s) %.2fs / %.2f MB wire = %.1f%% of the "
+              "full stream"
+              % (full_s, full_wire / 1e6, basis, incr_s,
+                 incr_wire / 1e6,
+                 100.0 * incr_wire / full_wire if full_wire else 0.0),
+              file=sys.stderr)
+        return out
+
+
 async def main() -> None:
     picked = selected_configs()
     results: dict[str, float] = {}
@@ -249,7 +356,7 @@ async def main() -> None:
                               "grab_trace": True},
     }
     for name in picked:
-        if name == "restore_throughput":
+        if name in ("restore_throughput", "incremental_rebuild"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -257,6 +364,9 @@ async def main() -> None:
     throughput = None
     if "restore_throughput" in picked:
         throughput = await bench_restore_throughput()
+    incremental = None
+    if "incremental_rebuild" in picked:
+        incremental = await bench_incremental_rebuild()
 
     # the deployed configuration is the one reported; CI smoke lanes
     # that skip it fall back to whatever failover leg ran
@@ -272,6 +382,8 @@ async def main() -> None:
     }
     if throughput is not None:
         out["restore_throughput_mb_s"] = round(throughput, 1)
+    if incremental is not None:
+        out["incremental_rebuild"] = incremental
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

@@ -45,6 +45,13 @@ class RestoreError(Exception):
     pass
 
 
+class DeltaRefused(RestoreError):
+    """The sender's reply made the negotiated delta unusable (e.g. a
+    base we never offered): the attempt must not consume that stream,
+    but a FULL retry is still worth making — unlike a connectivity
+    failure, which would fail identically on the retry."""
+
+
 def _iso_now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%S.%f")
@@ -80,6 +87,10 @@ class RestoreClient:
         # rebuild CLI's RESTORE_RETRIES accounting, lib/adm.js:71) can
         # distinguish a NEW failed attempt from the same failed job
         self.attempts = 0
+        # where the previous dataset went, when this restore isolated
+        # one (set per attempt; full restores always isolate, delta
+        # applies isolate only when the live dataset held the base)
+        self.last_isolated: str | None = None
 
     async def isolate(self, prefix: str) -> str | None:
         """Move the current dataset out of the way; returns the isolated
@@ -98,19 +109,62 @@ class RestoreClient:
         return target
 
     async def restore(self, backup_url: str, *,
-                      isolate_prefix: str = "autorebuild") -> None:
-        """Full restore from *backup_url* (the upstream PeerInfo's
-        backupUrl)."""
-        isolated = await self.isolate(isolate_prefix)
+                      isolate_prefix: str = "autorebuild",
+                      incremental: bool = True) -> None:
+        """Restore from *backup_url* (the upstream PeerInfo's
+        backupUrl).  With *incremental* (the default), local epoch-ms
+        snapshots are offered as candidate delta bases in the POST;
+        the sender picks the newest common one and ships only the
+        delta.  No common base, an old peer on either side, or ANY
+        failure along the incremental path degrades to the classic
+        full stream — a bad base can cost a re-transfer, never a wrong
+        dataset."""
         journal = get_journal()
+        self.last_isolated = None
+        bases, base_src = await self._delta_plan(incremental)
         journal.record("restore.receive.start", url=backup_url,
-                       dataset=self.dataset)
+                       dataset=self.dataset, bases=len(bases))
+        basis = "full"
         try:
             # one span for the whole snapshot transfer; its id rides
             # the POST so the sender's backup.send parents under it
             with span("restore.receive", url=backup_url,
-                      dataset=self.dataset):
-                await self._receive(backup_url)
+                      dataset=self.dataset) as sp:
+                if bases:
+                    try:
+                        basis = await self._receive(
+                            backup_url, bases=bases, base_src=base_src,
+                            isolate_prefix=isolate_prefix)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        # only failures SPECIFIC to the delta path are
+                        # worth a full retry: the negotiation landed
+                        # on incremental (or was itself unusable) and
+                        # something after it went wrong.  A failure
+                        # BEFORE that — a dead upstream, a refused
+                        # POST — would fail the full retry identically
+                        # and double the dead-upstream latency (and
+                        # the rebuild CLI's failed-attempt budget).
+                        delta_specific = (
+                            isinstance(e, DeltaRefused)
+                            or (self.current_job or {}).get("basis")
+                            == "incremental")
+                        if not delta_specific:
+                            raise
+                        # the partial (if any) was destroyed by
+                        # recv_delta; whatever held the base is intact
+                        # — retry the whole transfer full
+                        log.warning("incremental restore failed (%s); "
+                                    "retrying with a full stream", e)
+                        journal.record("restore.delta.fallback",
+                                       url=backup_url, error=str(e))
+                        basis = await self._receive(
+                            backup_url, isolate_prefix=isolate_prefix)
+                else:
+                    basis = await self._receive(
+                        backup_url, isolate_prefix=isolate_prefix)
+                sp.attrs["basis"] = basis
         except Exception as e:
             # the failed partial was cleaned by storage.recv; the
             # isolated dataset is left for operator recovery, as the
@@ -119,25 +173,82 @@ class RestoreClient:
                            error=str(e))
             raise
         journal.record(
-            "restore.receive.done", url=backup_url,
+            "restore.receive.done", url=backup_url, basis=basis,
             bytes=(self.current_job or {}).get("completed"))
         await self.storage.set_mountpoint(self.dataset, self.mountpoint)
         await self.storage.mount(self.dataset)
         await self.storage.snapshot(self.dataset)   # initial snapshot
-        if isolated:
+        if self.last_isolated:
             log.info("restore complete; previous data preserved at %s",
-                     isolated)
+                     self.last_isolated)
 
     async def destroy_isolated(self, isolated: str) -> None:
         await self.storage.destroy(isolated, recursive=True)
 
-    async def _receive(self, backup_url: str) -> None:
+    async def _delta_plan(self, incremental: bool) \
+            -> tuple[list[str], str | None]:
+        """(candidate base names to offer, dataset holding them) — or
+        ``([], None)`` when this restore must be full: incremental
+        disabled, backend without delta support, nothing to offer, or
+        half-applied debris from a crashed previous apply (doubt)."""
+        if not incremental or not self.storage.supports_delta():
+            return [], None
+        try:
+            if await self.storage.sweep_delta_debris(self.dataset):
+                log.warning("swept a half-applied delta of %s; "
+                            "forcing a FULL restore", self.dataset)
+                get_journal().record("restore.delta.debris_swept",
+                                     dataset=self.dataset)
+                return [], None
+            bases, src = await self.storage.delta_candidates(
+                self.dataset, await self._newest_isolated())
+            # newest first, capped: the server picks the newest common
+            # one anyway, and the offer must stay a bounded request
+            return sorted(bases, reverse=True)[:32], src
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("delta eligibility probe failed (%s); "
+                        "full restore", e)
+            return [], None
+
+    async def _newest_isolated(self) -> str | None:
+        """The newest dataset `manatee-adm rebuild` isolated (prefix
+        ``rebuild-``): its snapshots can still serve as delta bases —
+        that is exactly what makes an operator rebuild incremental.
+        ``fullrebuild-`` isolations (the --full escape hatch) are
+        never offered, AND a fullrebuild newer than every rebuild
+        suppresses the older ones too: the newest isolation is the
+        operator's latest word, and that word was 'full'."""
+        parent, _, _leaf = self.dataset.rpartition("/")
+        iso_parent = (parent + "/isolated") if parent else "isolated"
+        if not await self.storage.exists(iso_parent):
+            return None
+        best: tuple[str, str, bool] | None = None   # (ts, name, full?)
+        for k in await self.storage.list_children(iso_parent):
+            leaf = k.rsplit("/", 1)[-1]
+            for pfx, is_full in (("fullrebuild-", True),
+                                 ("rebuild-", False)):
+                if leaf.startswith(pfx):
+                    ts = leaf[len(pfx):]
+                    if best is None or ts > best[0]:
+                        best = (ts, k, is_full)
+                    break
+        if best is None or best[2]:
+            return None
+        return best[1]
+
+    async def _receive(self, backup_url: str, *,
+                       bases: list[str] | None = None,
+                       base_src: str | None = None,
+                       isolate_prefix: str = "autorebuild") -> str:
         recv_done: asyncio.Future = asyncio.get_running_loop() \
             .create_future()
         self.attempts += 1
         import uuid
         job: dict = {"done": False, "size": None, "completed": 0,
                      "url": backup_url, "attempt": self.attempts,
+                     "basis": "full",
                      # globally unique, unlike the counter: a sitter
                      # restart mid-rebuild resets attempts to 1, and
                      # the CLI's failed-attempt dedup must not mistake
@@ -167,6 +278,10 @@ class RestoreClient:
         # handlers wait for the id before consuming a byte.
         expected = {"jobid": None}
         job_known = asyncio.Event()
+        # how the accepted stream will be applied, decided from the
+        # POST response BEFORE job_known opens the gate: the classic
+        # full receive, or a delta apply against the negotiated base
+        mode: dict = {"basis": "full", "base": None, "base_src": None}
 
         async def _handle(reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -183,9 +298,16 @@ class RestoreClient:
                     raise RestoreError(
                         "dial-back arrived but no job was ever "
                         "registered (stale sender?)") from None
-                await self.storage.recv(
-                    self.dataset, reader, progress_cb=progress,
-                    expect_stream_id=expected["jobid"])
+                if mode["basis"] == "incremental":
+                    await self.storage.recv_delta(
+                        self.dataset, reader, base=mode["base"],
+                        base_src=mode["base_src"],
+                        progress_cb=progress,
+                        expect_stream_id=expected["jobid"])
+                else:
+                    await self.storage.recv(
+                        self.dataset, reader, progress_cb=progress,
+                        expect_stream_id=expected["jobid"])
                 if not recv_done.done():
                     recv_done.set_result(None)
             except asyncio.CancelledError:
@@ -290,22 +412,26 @@ class RestoreClient:
                     raise asyncio.TimeoutError(
                         "POST %s/backup black-holed (fault)"
                         % backup_url.rstrip("/"))
+                post_body = {"host": self.listen_host, "port": port,
+                             "dataset": self.dataset,
+                             # observability identity: the sender's
+                             # span parents under our receive span
+                             "trace": current_trace(),
+                             "span": current_span_id(),
+                             # wire codecs we can decode, best first;
+                             # an old server ignores the key and
+                             # streams raw (storage.stream)
+                             "compress": wirestream.available_codecs(),
+                             # we probe for the wire header, check
+                             # stream ids, and apply delta streams
+                             "streamProto": 2}
+                if bases:
+                    # candidate common bases, newest first; an old
+                    # server ignores the key and streams full
+                    post_body["bases"] = list(bases)
                 async with http.post(
                         backup_url.rstrip("/") + "/backup",
-                        json={"host": self.listen_host, "port": port,
-                              "dataset": self.dataset,
-                              # observability identity: the sender's
-                              # span parents under our receive span
-                              "trace": current_trace(),
-                              "span": current_span_id(),
-                              # wire codecs we can decode, best first;
-                              # an old server ignores the key and
-                              # streams raw (storage.stream)
-                              "compress": wirestream.available_codecs(),
-                              # we probe for the wire header and check
-                              # stream ids: the sender may stamp them
-                              "streamProto": 1,
-                              }) as resp:
+                        json=post_body) as resp:
                     if resp.status != 201:
                         raise RestoreError(
                             "backup request refused: %d %s"
@@ -315,6 +441,41 @@ class RestoreClient:
                     jobid = body.get("jobid")
                     expected["jobid"] = jobid \
                         if isinstance(jobid, str) else None
+                    # decide how the stream will be applied, and make
+                    # room for it, BEFORE the handler gate opens: a
+                    # full stream lands in a fresh dataset (isolate
+                    # whatever exists, as always); a delta applies
+                    # against the base — whose content must survive
+                    # the isolation when it lives in the dataset being
+                    # replaced
+                    basis = body.get("basis")
+                    if bases and isinstance(basis, dict) \
+                            and basis.get("mode") == "incremental":
+                        b = basis.get("base")
+                        if b not in bases:
+                            raise DeltaRefused(
+                                "sender negotiated base %r we never "
+                                "offered" % (b,))
+                        src = base_src
+                        if not self.storage.delta_in_place \
+                                and await self.storage.exists(
+                                    self.dataset):
+                            self.last_isolated = await self.isolate(
+                                isolate_prefix)
+                            if src == self.dataset:
+                                src = self.last_isolated
+                        mode.update(basis="incremental", base=b,
+                                    base_src=src)
+                    else:
+                        # full (old server, or no common base); keep
+                        # any earlier attempt's isolation on record —
+                        # a full retry after a failed delta has
+                        # nothing left to isolate, but the operator
+                        # still wants to know where the data went
+                        iso = await self.isolate(isolate_prefix)
+                        if iso:
+                            self.last_isolated = iso
+                    job["basis"] = mode["basis"]
                     job_known.set()
 
                 # poll the job while receiving (zfsClient:685-754)
@@ -404,3 +565,4 @@ class RestoreClient:
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
             await server.wait_closed()
+        return mode["basis"]

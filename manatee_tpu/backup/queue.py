@@ -35,8 +35,14 @@ class BackupJob:
     compress: tuple = ()
     # stream-protocol generation the requester declared: >= 1 means it
     # probes for the wire header, so the sender may stamp the job uuid
-    # (and a codec) on the stream.  0 = old peer = raw unstamped wire.
+    # (and a codec) on the stream; >= 2 means it also understands
+    # delta streams.  0 = old peer = raw unstamped wire.
     stream_proto: int = 0
+    # the negotiated common-base snapshot (POST-time intersection of
+    # the requester's offer with our own snapshot list), or None for a
+    # full stream.  The sender ships `zfs send -i base` / the dirstore
+    # manifest delta when set.
+    base: str | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -49,6 +55,8 @@ class BackupJob:
             "size": self.size,
             "completed": self.completed,
             "trace": self.trace,
+            "basis": "incremental" if self.base else "full",
+            "base": self.base,
         }
 
 

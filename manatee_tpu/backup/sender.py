@@ -66,15 +66,21 @@ class BackupSender:
         # (raw when it offered nothing — an old peer — or nothing
         # overlaps our own codec set)
         codec = wirestream.negotiate(job.compress)
+        # the POST-time negotiation picked the common base; the target
+        # is OUR latest snapshot at send time.  If the base cannot be
+        # served anymore (GC race, backend without delta support), the
+        # send raises, the job fails, and the requester retries full —
+        # a failed job is the degrade path, never a wrong stream.
+        basis = "incremental" if job.base else "full"
         with bind_trace(job.trace), bind_parent(job.span), \
                 span("backup.send", job=job.uuid, dataset=self.dataset,
-                     codec=codec or "raw"):
+                     codec=codec or "raw", basis=basis):
             snap = await self.storage.latest_backup_snapshot(self.dataset)
             if snap is None:
                 raise StorageError("no snapshots of %s eligible for "
                                    "backup" % self.dataset)
-            log.info("sending %s to %s:%d for job %s", snap.full,
-                     job.host, job.port, job.uuid)
+            log.info("sending %s to %s:%d for job %s (basis=%s)",
+                     snap.full, job.host, job.port, job.uuid, basis)
             # bounded connect: a requester that vanished between the
             # POST and our dial must fail the job, not wedge the send
             # loop
@@ -104,7 +110,8 @@ class BackupSender:
                 sid = job.uuid if job.stream_proto >= 1 else None
                 await self.storage.send(self.dataset, snap.name, writer,
                                         progress_cb=progress,
-                                        compress=codec, stream_id=sid)
+                                        compress=codec, stream_id=sid,
+                                        from_snapshot=job.base)
                 writer.close()
                 try:
                     await writer.wait_closed()

@@ -8,6 +8,17 @@ Beyond parity: the POST may carry the requester's ``trace``/``span``
 ids, which ride the job into the sender so the snapshot stream's span
 parents into the requester's restore tree; ``GET /spans`` serves this
 process's span ring for the `manatee-adm trace` fan-out.
+
+Incremental rebuild negotiation: the POST may also carry ``bases`` —
+the epoch-ms snapshot names the requester holds locally and can apply
+a delta onto.  When this server was built with a storage backend, it
+intersects that offer with its OWN snapshot list, picks the newest
+common name, and answers with ``basis`` so the requester knows — before
+the stream arrives — whether to prepare a delta apply or the classic
+full receive.  The negotiated base rides the job into the sender, which
+names {base, target} in the stream header; any doubt at ANY stage (no
+storage wired, malformed offer, negotiation error, base vanished by
+send time) degrades to the full stream.
 """
 
 from __future__ import annotations
@@ -21,16 +32,52 @@ from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
 from manatee_tpu.obs import get_span_store
 from manatee_tpu.obs.spans import spans_http_reply
+from manatee_tpu.storage.base import (
+    StorageBackend,
+    is_epoch_ms_snapshot,
+)
 
 log = logging.getLogger("manatee.backup.server")
+
+# a requester only ever holds snapshot_number (default 50) epoch-ms
+# snapshots; anything past this is a malformed offer, not a bigger one
+MAX_BASE_OFFER = 64
+
+
+async def negotiate_base(storage: StorageBackend, dataset: str,
+                         offered) -> str | None:
+    """The sender's half of common-snapshot negotiation: newest
+    epoch-ms snapshot name present both locally and in the requester's
+    offer, or None for full.  Only 13-digit epoch names are even
+    considered — they are the only cross-peer-stable names (a received
+    snapshot keeps its sender's name), and anything else off the wire
+    is noise."""
+    await faults.point("backup.negotiate_base")
+    if not isinstance(offered, (list, tuple)):
+        return None
+    offers = {str(o) for o in offered[:MAX_BASE_OFFER]
+              if isinstance(o, str) and is_epoch_ms_snapshot(o)}
+    if not offers:
+        return None
+    mine = {s.name for s in await storage.list_snapshots(dataset)
+            if is_epoch_ms_snapshot(s.name)}
+    common = mine & offers
+    return max(common, key=int) if common else None
 
 
 class BackupRestServer:
     def __init__(self, queue: BackupQueue, *, host: str = "0.0.0.0",
-                 port: int = 12345):
+                 port: int = 12345,
+                 storage: StorageBackend | None = None,
+                 dataset: str | None = None):
+        """*storage*/*dataset* (the same pair the sender streams from)
+        enable common-base negotiation; without them every job is a
+        full stream, exactly as before."""
         self.queue = queue
         self.host = host
         self.port = port
+        self.storage = storage
+        self.dataset = dataset
         self._runner: web.AppRunner | None = None
         app = web.Application()
         app.router.add_post("/backup", self._post_backup)
@@ -74,6 +121,21 @@ class BackupRestServer:
         if not isinstance(offered, list):
             offered = []
         proto = params.get("streamProto")
+        proto = proto if isinstance(proto, int) else 0
+        base = None
+        if self.storage is not None and self.dataset \
+                and proto >= 2 and params.get("bases"):
+            try:
+                base = await negotiate_base(self.storage, self.dataset,
+                                            params.get("bases"))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # any doubt — a fault, an unlistable dataset — serves
+                # the full stream rather than refusing the rebuild
+                log.warning("base negotiation failed (%s); serving a "
+                            "full stream", e)
+                base = None
         job = BackupJob(host=str(params["host"]),
                         port=int(params["port"]),
                         dataset=str(params["dataset"]),
@@ -81,13 +143,18 @@ class BackupRestServer:
                         span=span_id if isinstance(span_id, str)
                         else None,
                         compress=tuple(str(c) for c in offered),
-                        stream_proto=proto
-                        if isinstance(proto, int) else 0)
+                        stream_proto=proto,
+                        base=base)
         self.queue.push(job)
-        log.info("enqueued backup job %s -> %s:%d", job.uuid, job.host,
-                 job.port)
+        log.info("enqueued backup job %s -> %s:%d (basis=%s)",
+                 job.uuid, job.host, job.port,
+                 "incremental from %s" % base if base else "full")
         return web.json_response(
-            {"jobid": job.uuid, "jobPath": "/backup/%s" % job.uuid},
+            {"jobid": job.uuid, "jobPath": "/backup/%s" % job.uuid,
+             # the requester prepares its receive path off this BEFORE
+             # the stream arrives (old requesters ignore the key)
+             "basis": ({"mode": "incremental", "base": base}
+                       if base else {"mode": "full"})},
             status=201)
 
     async def _get_backup(self, req: web.Request) -> web.Response:

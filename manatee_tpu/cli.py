@@ -951,9 +951,18 @@ def cmd_rebuild(args) -> int:
                 from manatee_tpu.backup.client import RestoreClient
                 rc = RestoreClient(storage, dataset=ds,
                                    mountpoint=cfg["dataDir"])
-                name = await rc.isolate("rebuild")
+                # the "rebuild-" prefix is what the restore plane
+                # recognizes as an incremental-base source; --full
+                # isolates under "fullrebuild-", which it never
+                # offers — the negotiation is skipped and the classic
+                # full stream runs
+                name = await rc.isolate(
+                    "fullrebuild" if args.full else "rebuild")
                 print("Isolated existing dataset as: %s" % name
                       if name else "No existing dataset detected.")
+                if name and args.full:
+                    print("(--full: isolated snapshots will not be "
+                          "offered as incremental bases)")
 
             # watch the sitter recover naturally (restore progress via
             # its status server, lib/adm.js:1550-1678); a restore that
@@ -1196,6 +1205,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sitter config (env: MANATEE_SITTER_CONFIG)")
     sp.add_argument("-y", "--yes", action="store_true")
     sp.add_argument("--timeout", type=float, default=3600.0)
+    sp.add_argument("--full", action="store_true",
+                    help="skip common-snapshot negotiation: isolate "
+                         "the dataset under a name the restore plane "
+                         "never offers as a delta base, forcing the "
+                         "classic full stream")
 
     return p
 

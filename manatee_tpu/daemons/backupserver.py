@@ -44,9 +44,13 @@ async def start_backupserver(cfg: dict):
         faults.enable_http()
     storage = build_storage(cfg)
     queue = BackupQueue()
+    # storage + dataset let the POST handler negotiate a common delta
+    # base against our own snapshot list (incremental rebuild)
     server = BackupRestServer(queue,
                               host=cfg.get("listenHost", "0.0.0.0"),
-                              port=int(cfg["backupPort"]))
+                              port=int(cfg["backupPort"]),
+                              storage=storage,
+                              dataset=cfg["dataset"])
     sender = BackupSender(queue, storage, cfg["dataset"])
     await server.start()
     sender.start()

@@ -17,11 +17,18 @@ repair what an operator needs to inspect):
   the server itself would refuse to start.
 - **dirstore** (``--store-root``): dataset shape (@data/@snapshots/
   @meta.json), meta parseability (the empty/truncated meta an
-  un-fsynced tmp-rename crash used to install), and the
+  un-fsynced tmp-rename crash used to install), the
   dataset↔meta cross-check: every snapshot meta names must exist on
   disk, every on-disk snapshot should be in meta (an orphan dir is the
   crash window between copytree and meta install — recoverable,
-  reported as a warning).
+  reported as a warning), and the manifest↔snapshot cross-check: each
+  @manifests/<name>.json must structurally agree (paths, types, sizes,
+  link targets) with its snapshot directory.  The manifest is the
+  delta plane's ground truth for incremental-rebuild eligibility — a
+  PARSEABLE manifest that diverges from its immutable snapshot could
+  ship (and verify!) a wrong delta, so divergence is damage, while an
+  unreadable manifest merely forces a lazy recompute (warning) and a
+  manifest for a destroyed snapshot is sweepable debris (note).
 - **cluster state** (online): schema shape of the state object,
   generation monotonicity across the durable history, and agreement
   with the event journal (a journal that has seen a HIGHER generation
@@ -41,7 +48,12 @@ import json
 import os
 from pathlib import Path
 
-from manatee_tpu.storage.dirstore import META_KEYS, _RESERVED
+from manatee_tpu.storage.dirstore import (
+    META_KEYS,
+    _RESERVED,
+    manifest_diff_paths,
+    manifest_scan,
+)
 
 DAMAGE = "damage"
 WARNING = "warning"
@@ -231,7 +243,8 @@ def _dataset_dirs(root: Path) -> list[Path]:
         # never descend into dataset CONTENT (restored pg trees can be
         # arbitrarily deep and could even contain reserved names)
         dirnames[:] = [n for n in dirnames
-                       if n not in ("@data", "@snapshots")]
+                       if n not in ("@data", "@snapshots",
+                                    "@manifests")]
         if members & _RESERVED:
             out.append(Path(dirpath))
     out.sort()
@@ -312,6 +325,59 @@ def check_dirstore(root: str | Path) -> list[dict]:
                     "meta says mounted but the mountpoint symlink "
                     "is absent or points elsewhere (is_mounted "
                     "treats the symlink as ground truth)"))
+        if meta.get("applying"):
+            out.append(finding(
+                NOTE, "delta-apply-in-progress", ds,
+                "half-applied incremental restore (crash mid-apply); "
+                "the restore plane sweeps it and retries full"))
+        out.extend(_check_manifests(ds, rel, on_disk))
+    return out
+
+
+def _check_manifests(ds: Path, rel, on_disk: set) -> list[dict]:
+    """The manifest↔snapshot cross-check: incremental-rebuild
+    eligibility ground truth.  Structural (paths/types/sizes/modes/
+    link targets, no hashing): snapshot dirs are immutable after creation,
+    so ANY disagreement means the manifest lies about what a delta
+    sender would ship — and a lying manifest can produce a delta that
+    verifies against itself while diverging from the real snapshot."""
+    out: list[dict] = []
+    mandir = ds / "@manifests"
+    if not mandir.is_dir():
+        return out          # pre-manifest dataset: backfilled lazily
+    for tmp in sorted(mandir.glob("*.json.tmp*")):
+        out.append(finding(NOTE, "manifest-tmp-orphan", tmp,
+                           "tmp manifest a crashed write never "
+                           "installed (safe to remove)"))
+    for mf in sorted(mandir.glob("*.json")):
+        name = mf.name[:-5]
+        if name not in on_disk:
+            out.append(finding(
+                NOTE, "manifest-orphan", mf,
+                "manifest for a snapshot that no longer exists "
+                "(destroyed mid-GC; safe to remove)"))
+            continue
+        try:
+            man = json.loads(mf.read_text())
+            files = man["files"]
+            if not isinstance(files, dict):
+                raise ValueError("files is not an object")
+        except (ValueError, KeyError, OSError) as e:
+            out.append(finding(
+                WARNING, "manifest-corrupt", mf,
+                "unreadable/unparseable manifest (%s) — lazily "
+                "recomputed from the snapshot dir on next use" % e))
+            continue
+        scan = manifest_scan(ds / "@snapshots" / name, with_hash=False)
+        bad = manifest_diff_paths(scan, files, with_hash=False)
+        if bad:
+            out.append(finding(
+                DAMAGE, "manifest-diverged", mf,
+                "manifest disagrees with the (immutable) snapshot "
+                "directory of %s@%s at %d path(s) (first: %s) — a "
+                "delta sent from it could install wrong content; "
+                "remove the manifest so it is recomputed"
+                % (rel, name, len(bad), ", ".join(bad[:5]))))
     return out
 
 

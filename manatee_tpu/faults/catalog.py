@@ -29,6 +29,13 @@ from __future__ import annotations
 # Paths are repo-relative and matched by suffix, so the rule works no
 # matter how the linter was invoked.
 CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
+    "backup.negotiate_base": (
+        "backup server's common-base intersection for an incremental "
+        "rebuild (POST /backup `bases` offer); error degrades the job "
+        "to a full stream",
+        ("manatee_tpu/backup/server.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
     "backup.post": (
         "restore client's POST /backup to the upstream's backup server; "
         "drop = the request is black-holed (reads as a timeout)",
@@ -119,6 +126,23 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
     "state.write": (
         "state machine's durable CAS write of a decided transition",
         ("manatee_tpu/state/machine.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "storage.delta.apply": (
+        "delta apply on the restore receiver, after the target "
+        "dataset materialized but before the base clone + extraction "
+        "(both backends' apply seam; dirstore call site) — a crash "
+        "here leaves the half-applied debris the sweep destroys, and "
+        "the retry goes full",
+        ("manatee_tpu/storage/dirstore.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "storage.delta.send": (
+        "incremental snapshot send (manifest diff + changed-file "
+        "stream), before anything is written to the wire (both "
+        "backends; dirstore and zfs call sites)",
+        ("manatee_tpu/storage/dirstore.py",
+         "manatee_tpu/storage/zfsbackend.py"),
         ("error", "delay", "stall", "crash"),
     ),
     "storage.recv": (

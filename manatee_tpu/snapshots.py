@@ -17,6 +17,7 @@ from typing import Callable
 
 import aiohttp
 
+from manatee_tpu.obs import get_registry
 from manatee_tpu.storage.base import (
     StorageBackend,
     StorageError,
@@ -24,6 +25,13 @@ from manatee_tpu.storage.base import (
 )
 
 log = logging.getLogger("manatee.snapshotter")
+
+# epoch-ms snapshots still held after a cleanup pass: the pool of
+# candidate delta bases this peer can offer or serve (one dataset per
+# snapshotter process, so no labels)
+SNAPS_RETAINED = get_registry().gauge(
+    "snapshots_retained",
+    "epoch-ms snapshots retained after the last cleanup pass")
 
 
 class SnapShotter:
@@ -132,8 +140,16 @@ class SnapShotter:
         # only 13-digit epoch names are ours to manage
         # (snapShotter.js:251)
         ours = [s for s in snaps if is_epoch_ms_snapshot(s.name)]
-        excess = len(ours) - self.snapshot_number
+        # RETENTION PIN: the newest epoch-ms snapshot is the best
+        # common-base candidate a peer can offer for an incremental
+        # rebuild (and the one the backup sender streams) — the
+        # cleanup pass must NEVER destroy it, even under a zero/absurd
+        # snapshotNumber.  keep-newest-N mostly covers this already;
+        # the floor makes it explicit.
+        keep = max(1, self.snapshot_number)
+        excess = len(ours) - keep
         if excess <= 0:
+            SNAPS_RETAINED.set(len(ours))
             return
         victims = ours[:excess]   # list is creation-ascending
         any_deleted = False
@@ -147,6 +163,8 @@ class SnapShotter:
                 self._stuck[v.name] = self._stuck.get(v.name, 0) + 1
                 log.warning("cannot delete snapshot %s (attempt %d): %s",
                             v.full, self._stuck[v.name], e)
+        deleted = sum(1 for v in victims if v.name not in self._stuck)
+        SNAPS_RETAINED.set(len(ours) - deleted)
         if not any_deleted and victims:
             # every deletable candidate is stuck: fatal alarm path
             # (snapShotter.js:370-404)

@@ -149,6 +149,7 @@ class StorageBackend(abc.ABC):
         progress_cb: ProgressCb | None = None,
         compress: str | None = None,
         stream_id: str | None = None,
+        from_snapshot: str | None = None,
     ) -> None:
         """Stream snapshot *name* of *dataset* into *writer* (the
         sender side of lib/backupSender.js:154-242).  *compress* is a
@@ -157,7 +158,13 @@ class StorageBackend(abc.ABC):
         the per-stream header so the receiver keys off the wire.
         *stream_id* (the backup job uuid) rides the same header so the
         receiver can reject a STALE sender's dial-back — a cancelled
-        restore's job connecting to the port its successor rebound."""
+        restore's job connecting to the port its successor rebound.
+        *from_snapshot* requests an INCREMENTAL stream: only the delta
+        between that (negotiated common) base snapshot and *name* goes
+        on the wire, and the header names both ends so the receiver
+        can refuse a stream whose base it does not hold.  A backend
+        that cannot produce the requested delta raises StorageError —
+        the job fails and the restore client retries full."""
 
     @abc.abstractmethod
     async def recv(
@@ -173,6 +180,69 @@ class StorageBackend(abc.ABC):
         names a stream id different from *expect_stream_id* is
         refused BEFORE any dataset mutation (a headerless/old-sender
         stream cannot be verified and is accepted)."""
+
+    # -- incremental (delta) rebuild support --
+    #
+    # The negotiation protocol (backup/client.py POST /backup `bases`
+    # offer, backup/server.py `negotiate_base`) is backend-agnostic;
+    # these hooks are where each backend declares HOW a delta applies.
+    # Every default degrades to the full-stream path, so a backend
+    # that implements none of them keeps working exactly as before.
+
+    #: True when a delta applies onto the EXISTING dataset in place
+    #: (zfs recv -F rolls back to the common base natively); False
+    #: when the receiver builds a fresh dataset from a base snapshot
+    #: held in another dataset (dirstore clones the isolated
+    #: predecessor's base snapshot, then applies the delta onto it).
+    delta_in_place = False
+
+    def supports_delta(self) -> bool:
+        """Whether this backend can send/apply incremental streams."""
+        return False
+
+    async def list_children(self, dataset: str) -> list[str]:
+        """Direct child datasets of *dataset* (zfs list -d 1), full
+        names.  Used to find a previously-isolated dataset whose
+        snapshots can still serve as delta bases."""
+        return []
+
+    async def delta_candidates(
+            self, dataset: str,
+            fallback: str | None = None) -> tuple[list[str], str | None]:
+        """Epoch-ms snapshot names this peer can offer as delta bases,
+        plus the dataset that holds their content (*dataset* itself
+        when it exists, else *fallback* — a pre-isolated predecessor —
+        for backends that can clone a base from a foreign dataset).
+        ``([], None)`` means ineligible: the restore goes full."""
+        return [], None
+
+    async def sweep_delta_debris(self, dataset: str) -> bool:
+        """Remove the remains of a delta apply that died mid-flight
+        (crash between create and the verified install).  Returns True
+        when debris WAS swept — the caller must treat the store as
+        suspect and force a FULL restore for this attempt."""
+        return False
+
+    async def recv_delta(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        *,
+        base: str,
+        base_src: str | None = None,
+        progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
+    ) -> None:
+        """Apply an incremental stream produced by :meth:`send` with
+        ``from_snapshot=base``.  The stream header MUST name exactly
+        *base*; anything else — a full stream, a different base, an
+        unverifiable header — raises StorageError before any dataset
+        mutation, and the caller retries full.  Divergence discovered
+        DURING apply (content that fails the stream's post-apply
+        verification) destroys the partial and raises: a bad base can
+        cost a re-transfer, never a wrong dataset."""
+        raise StorageError("backend does not support incremental "
+                           "receive")
 
     # -- convenience shared across backends --
 

@@ -21,11 +21,14 @@ unmounted data really is invisible at the mountpoint, as with zfs.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import shutil
+import tempfile
 import threading
 import time
+import zlib
 from pathlib import Path
 
 from manatee_tpu import faults
@@ -35,18 +38,126 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
+    is_epoch_ms_snapshot,
     pump_child_to_socket,
     pump_socket_to_child,
     snapshot_name_now,
 )
 from manatee_tpu.utils.executil import drain_and_reap
 
-_RESERVED = {"@data", "@snapshots", "@meta.json"}
+_RESERVED = {"@data", "@snapshots", "@meta.json", "@manifests"}
 # the keys every @meta.json carries (create() writes exactly these).
 # Together with _RESERVED this IS the on-disk contract `manatee-adm
 # doctor` verifies (manatee_tpu/doctor.py imports both) — change them
 # here and the verifier follows.
 META_KEYS = ("mountpoint", "mounted", "props", "snaps")
+
+# cap on the compressed delta-detail blob (deletion list + target
+# manifest) a recv will read off the wire — a corrupt header length
+# must not make the receiver allocate unboundedly
+MAX_DELTA_DETAIL = 256 << 20
+
+
+# ---- per-snapshot content manifests (the delta plane's ground truth)
+
+def _sha256_file(p: Path) -> str:
+    h = hashlib.sha256()
+    with open(p, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def manifest_scan(root: str | Path, with_hash: bool = True) -> dict:
+    """Walk a snapshot (or @data) directory into a manifest map:
+    relpath -> entry, where entry is ``{"t": "f", "size", "mtime",
+    "m", "h"}`` for files, ``{"t": "l", "lnk"}`` for symlinks,
+    ``{"t": "d", "m"}`` for directories (``m`` = permission bits — a
+    chmod with unchanged bytes must still ship, or full and
+    incremental restores would yield different datasets).
+    ``with_hash=False`` (doctor's structural check) skips the content
+    hashes.  Pure/synchronous so it can run under
+    ``asyncio.to_thread`` and offline in the doctor alike."""
+    root = Path(root)
+    files: dict[str, dict] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dp = Path(dirpath)
+        for n in list(dirnames):
+            p = dp / n
+            if p.is_symlink():
+                files[p.relative_to(root).as_posix()] = {
+                    "t": "l", "lnk": os.readlink(p)}
+                dirnames.remove(n)    # never follow into the target
+            else:
+                files[p.relative_to(root).as_posix()] = {
+                    "t": "d", "m": p.stat().st_mode & 0o7777}
+        for n in filenames:
+            p = dp / n
+            if p.is_symlink():
+                files[p.relative_to(root).as_posix()] = {
+                    "t": "l", "lnk": os.readlink(p)}
+                continue
+            st = p.stat()
+            ent: dict = {"t": "f", "size": st.st_size,
+                         "mtime": round(st.st_mtime, 6),
+                         "m": st.st_mode & 0o7777}
+            if with_hash:
+                ent["h"] = _sha256_file(p)
+            files[p.relative_to(root).as_posix()] = ent
+    return files
+
+
+def manifest_entry_key(ent: dict | None, with_hash: bool = True):
+    """The comparable identity of one manifest entry.  mtime is
+    DELIBERATELY excluded: unchanged files keep the receiver's base
+    clone timestamps, which legitimately differ from the sender's —
+    content (type/size/mode/hash, link target) is the verdict."""
+    if not isinstance(ent, dict):
+        return None
+    t = ent.get("t")
+    if t == "f":
+        return ("f", ent.get("size"), ent.get("m"),
+                ent.get("h") if with_hash else None)
+    if t == "l":
+        return ("l", ent.get("lnk"))
+    if t == "d":
+        return ("d", ent.get("m"))
+    return ("?",)
+
+
+def manifest_delta(base_files: dict, tgt_files: dict) \
+        -> tuple[list[str], list[str]]:
+    """(changed-or-added paths, deleted paths) between two manifests —
+    what an incremental send ships and what the receiver removes."""
+    changed = sorted(
+        p for p, e in tgt_files.items()
+        if manifest_entry_key(e) != manifest_entry_key(
+            base_files.get(p)))
+    deleted = sorted(p for p in base_files if p not in tgt_files)
+    return changed, deleted
+
+
+def manifest_diff_paths(got: dict, want: dict,
+                        with_hash: bool = True) -> list[str]:
+    """Paths on which two manifests disagree (either direction) — the
+    post-apply verification and the doctor's structural check share
+    this so the two verdicts cannot drift."""
+    bad = [p for p, e in want.items()
+           if manifest_entry_key(e, with_hash)
+           != manifest_entry_key(got.get(p), with_hash)]
+    bad += [p for p in got if p not in want]
+    return sorted(set(bad))
+
+
+def _check_wire_relpath(path) -> str:
+    """A path that came off the wire (delta manifest / deletion list)
+    must be a safe relative path before it is allowed anywhere near a
+    filesystem operation."""
+    if not isinstance(path, str) or not path or path.startswith("/") \
+            or "\\" in path or "\x00" in path \
+            or any(comp in ("", ".", "..") for comp in path.split("/")):
+        raise StorageError("unsafe path in delta stream: %r" % (path,))
+    return path
 
 
 class DirBackend(StorageBackend):
@@ -58,27 +169,40 @@ class DirBackend(StorageBackend):
     # ---- internals ----
 
     def _sweep_meta_tmp(self, min_age_s: float = 60.0) -> None:
-        """Startup cleanup of ``@meta.json.tmp-<pid>-<tid>`` files a
-        crashed save never renamed into place — the same discipline
-        coordd applies to its snapshot tmp orphans.  Only files older
-        than *min_age_s* go: a sibling process (the snapshotter saving
-        this dataset's meta right now) has an in-flight tmp that is
+        """Startup cleanup of ``@meta.json.tmp-<pid>-<tid>`` files —
+        and their ``@manifests/*.json.tmp-*`` siblings — a crashed
+        save never renamed into place: the same discipline coordd
+        applies to its snapshot tmp orphans.  Only files older than
+        *min_age_s* go: a sibling process (the snapshotter saving this
+        dataset's meta right now) has an in-flight tmp that is
         milliseconds old, and unlinking it would fail that save."""
         now = time.time()
+
+        def aged_unlink(p: Path) -> None:
+            try:
+                if now - p.stat().st_mtime >= min_age_s:
+                    p.unlink()
+            except OSError:
+                pass
+
         base = self.root / "datasets"
         for dirpath, dirnames, filenames in os.walk(base):
-            # never descend into dataset content
-            dirnames[:] = [n for n in dirnames
-                           if n not in ("@data", "@snapshots")]
-            for name in filenames:
-                if not name.startswith("@meta.json.tmp"):
-                    continue
-                p = Path(dirpath) / name
+            if "@manifests" in dirnames:
+                # crashed manifest writes strand tmps too; nothing
+                # else ever visits them (the doctor only notes them)
                 try:
-                    if now - p.stat().st_mtime >= min_age_s:
-                        p.unlink()
+                    for p in (Path(dirpath) / "@manifests").iterdir():
+                        if ".json.tmp" in p.name:
+                            aged_unlink(p)
                 except OSError:
                     pass
+            # never descend into dataset content
+            dirnames[:] = [n for n in dirnames
+                           if n not in ("@data", "@snapshots",
+                                        "@manifests")]
+            for name in filenames:
+                if name.startswith("@meta.json.tmp"):
+                    aged_unlink(Path(dirpath) / name)
 
     def _dspath(self, dataset: str) -> Path:
         if not dataset or dataset.startswith("/") or ".." in dataset.split("/"):
@@ -298,6 +422,49 @@ class DirBackend(StorageBackend):
 
     # ---- snapshots ----
 
+    def _manifest_path(self, dataset: str, name: str) -> Path:
+        return self._dspath(dataset) / "@manifests" / ("%s.json" % name)
+
+    def _write_manifest(self, dataset: str, name: str,
+                        files: dict) -> None:
+        """Atomic install (tmp + rename): a torn manifest would read
+        as unparseable and be lazily recomputed, but never as a
+        half-truth the delta plane could ship."""
+        p = self._manifest_path(dataset, name)
+        p.parent.mkdir(exist_ok=True)
+        tmp = p.with_name("%s.tmp-%d-%d"
+                          % (p.name, os.getpid(),
+                             threading.get_ident()))
+        tmp.write_text(json.dumps({"snapshot": name, "files": files},
+                                  separators=(",", ":")))
+        os.replace(tmp, p)
+
+    async def snapshot_manifest(self, dataset: str, name: str) -> dict:
+        """The per-snapshot content manifest (path -> size/mtime/hash),
+        written at snapshot time and BACKFILLED LAZILY here for
+        snapshots that predate the manifest plane (or whose manifest
+        was torn by a crash): snapshot directories are immutable after
+        creation, so a recompute from the directory is always ground
+        truth."""
+        snapdir = self._dspath(dataset) / "@snapshots" / name
+        if not snapdir.is_dir():
+            raise StorageError("no such snapshot: %s@%s"
+                               % (dataset, name))
+        p = self._manifest_path(dataset, name)
+        try:
+            man = json.loads(await asyncio.to_thread(p.read_text))
+            files = man["files"]
+            if not isinstance(files, dict):
+                raise ValueError("files is not an object")
+            return files
+        except FileNotFoundError:
+            pass
+        except (ValueError, KeyError, OSError):
+            pass          # unreadable/torn: recompute from the dir
+        files = await asyncio.to_thread(manifest_scan, snapdir)
+        self._write_manifest(dataset, name, files)
+        return files
+
     async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot:
         # error:StorageError models a failed disk write at snapshot
         # time (callers like _snapshot_safe must tolerate it)
@@ -308,7 +475,36 @@ class DirBackend(StorageBackend):
             raise StorageError("snapshot exists: %s@%s" % (dataset, name))
         src = self._dspath(dataset) / "@data"
         dst = self._dspath(dataset) / "@snapshots" / name
-        await asyncio.to_thread(shutil.copytree, src, dst, symlinks=True)
+
+        def copy_and_scan():
+            # manifest written at snapshot time, describing the
+            # SNAPSHOT dir (not @data, which keeps changing under a
+            # live database): exactly what a delta sender will ship
+            # from.  Content is hashed DURING the copy — one read per
+            # file, not a second full pass, since the transition
+            # snapshot sits near the failover path.
+            hashes: dict[str, str] = {}
+
+            def copy_fn(s: str, d: str) -> None:
+                h = hashlib.sha256()
+                with open(s, "rb") as fi, open(d, "wb") as fo:
+                    for chunk in iter(lambda: fi.read(1 << 20), b""):
+                        h.update(chunk)
+                        fo.write(chunk)
+                shutil.copystat(s, d)       # copy2 parity (mtime)
+                hashes[str(Path(d))] = h.hexdigest()
+
+            shutil.copytree(src, dst, symlinks=True,
+                            copy_function=copy_fn)
+            files = manifest_scan(dst, with_hash=False)
+            for rel, ent in files.items():
+                if ent.get("t") == "f":
+                    ent["h"] = hashes.get(str(dst / rel)) \
+                        or _sha256_file(dst / rel)
+            return files
+
+        files = await asyncio.to_thread(copy_and_scan)
+        self._write_manifest(dataset, name, files)
         now = time.time()
         meta["snaps"][name] = now
         self._save_meta(dataset, meta)
@@ -344,6 +540,12 @@ class DirBackend(StorageBackend):
             raise StorageError("cannot destroy snapshot %s@%s: %s"
                                % (dataset, name, e)) from None
         try:
+            # the manifest follows its snapshot out (doctor would
+            # otherwise report it as an orphan)
+            self._manifest_path(dataset, name).unlink()
+        except OSError:
+            pass
+        try:
             meta = self._load_meta(dataset)
         except StorageError:
             return
@@ -378,10 +580,16 @@ class DirBackend(StorageBackend):
         progress_cb: ProgressCb | None = None,
         compress: str | None = None,
         stream_id: str | None = None,
+        from_snapshot: str | None = None,
     ) -> None:
         src = self._dspath(dataset) / "@snapshots" / name
         if not src.exists():
             raise StorageError("no such snapshot: %s@%s" % (dataset, name))
+        if from_snapshot:
+            await self._send_delta(dataset, name, from_snapshot, src,
+                                   writer, progress_cb, compress,
+                                   stream_id)
+            return
         await faults.point("storage.send")
         size = await self.estimate_send_size(dataset, name)
         hdr = {"snapshot": name, "size": size}
@@ -479,6 +687,95 @@ class DirBackend(StorageBackend):
                                % (dataset, name, e)) from e
         if rc != 0:
             raise StorageError("tar send failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))
+
+    async def _send_delta(self, dataset: str, name: str, base: str,
+                          src: Path, writer: asyncio.StreamWriter,
+                          progress_cb: ProgressCb | None,
+                          compress: str | None,
+                          stream_id: str | None) -> None:
+        """Incremental send: header + compressed detail blob (deletion
+        list, changed list, full target manifest) + a tar of only the
+        changed/added paths.  The manifests are the diff's ground
+        truth; both are loaded (lazily backfilled) from this dataset's
+        manifest store.  Small by construction, so the delta always
+        takes the python pipeline — the native splice pump's win is
+        full-dataset streams."""
+        await faults.point("storage.delta.send")
+        if not (self._dspath(dataset) / "@snapshots" / base).is_dir():
+            raise StorageError("delta base does not exist: %s@%s"
+                               % (dataset, base))
+        base_files = await self.snapshot_manifest(dataset, base)
+        tgt_files = await self.snapshot_manifest(dataset, name)
+        changed, deleted = manifest_delta(base_files, tgt_files)
+        for p in changed:
+            if "\n" in p:
+                # tar -T is line-framed; a newline in a path cannot be
+                # shipped safely (pg never creates one)
+                raise StorageError("cannot delta-send path with "
+                                   "newline: %r" % p)
+        size = sum(e.get("size", 0) for p in changed
+                   for e in (tgt_files[p],) if e.get("t") == "f")
+        detail = {"changed": changed, "deleted": deleted,
+                  "manifest": tgt_files}
+        blob = zlib.compress(
+            json.dumps(detail, separators=(",", ":")).encode())
+        hdr = {"snapshot": name, "base": base, "size": size,
+               "deltaLen": len(blob)}
+        if compress:
+            hdr["compression"] = compress
+        if stream_id:
+            hdr["stream"] = stream_id
+        try:
+            writer.write(json.dumps(hdr).encode() + b"\n" + blob)
+            await writer.drain()
+        except Exception as e:
+            raise StorageError("delta send of %s@%s aborted: %s"
+                               % (dataset, name, e)) from e
+
+        with tempfile.NamedTemporaryFile("w", prefix="mnt-delta-",
+                                         suffix=".list") as lf:
+            # dirs sort before their contents, so tar creates them
+            # first; --no-recursion keeps a changed dir entry from
+            # re-shipping its unchanged contents
+            for p in changed:
+                lf.write("./%s\n" % p)
+            lf.flush()
+            proc = await asyncio.create_subprocess_exec(
+                "tar", "-C", str(src), "--no-recursion",
+                "--verbatim-files-from", "-T", lf.name, "-cf", "-",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+            t_err = asyncio.create_task(proc.stderr.read())
+            try:
+                with wirestream.recorded_stage(
+                        "send", dataset, compress,
+                        basis="incremental") as st:
+                    st.raw, st.wire = await wirestream.pipeline_copy(
+                        proc.stdout.read, writer, codec=compress,
+                        progress=(lambda d: progress_cb(d, size))
+                        if progress_cb else None)
+                    # the detail blob is wire traffic too: without it
+                    # the bench's incremental-vs-full ratio would not
+                    # charge the manifest's cost
+                    st.raw += len(blob)
+                    st.wire += len(blob)
+            except asyncio.CancelledError:
+                await drain_and_reap(proc, t_err)
+                raise
+            except Exception as e:
+                await drain_and_reap(proc, t_err)
+                raise StorageError("delta send of %s@%s aborted: %s"
+                                   % (dataset, name, e)) from e
+            try:
+                err = await t_err
+                rc = await proc.wait()
+            except asyncio.CancelledError:
+                await drain_and_reap(proc, t_err)
+                raise
+        if rc != 0:
+            raise StorageError("tar delta send failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))
 
     async def recv(
@@ -583,6 +880,267 @@ class DirBackend(StorageBackend):
             # other aborted restore
             await self._destroy_quietly(dataset)
             raise
+
+    # ---- incremental rebuild (delta) ----
+
+    delta_in_place = False
+
+    def supports_delta(self) -> bool:
+        return True
+
+    async def list_children(self, dataset: str) -> list[str]:
+        p = self._dspath(dataset)
+        if not self._exists_sync(dataset):
+            return []
+        return sorted("%s/%s" % (dataset, c.name) for c in p.iterdir()
+                      if c.is_dir() and c.name not in _RESERVED
+                      and (c / "@meta.json").exists())
+
+    async def delta_candidates(
+            self, dataset: str,
+            fallback: str | None = None) -> tuple[list[str], str | None]:
+        for src in (dataset, fallback):
+            if not src or not self._exists_sync(src):
+                continue
+            names = [s.name for s in await self.list_snapshots(src)
+                     if is_epoch_ms_snapshot(s.name)]
+            if names:
+                return names, src
+        return [], None
+
+    async def sweep_delta_debris(self, dataset: str) -> bool:
+        """A dataset whose meta still carries the ``applying`` marker
+        is a delta apply that died between create and the verified
+        install: destroy it.  The caller treats a sweep as doubt and
+        forces this attempt FULL — the crash proved nothing about why
+        the apply died."""
+        if not self._exists_sync(dataset):
+            return False
+        try:
+            meta = self._load_meta(dataset)
+        except StorageError:
+            return False
+        if not meta.get("applying"):
+            return False
+        await self.destroy(dataset, recursive=True)
+        return True
+
+    async def recv_delta(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        *,
+        base: str,
+        base_src: str | None = None,
+        progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
+    ) -> None:
+        """Apply an incremental stream: clone the local copy of *base*
+        (held by *base_src* — typically the isolated predecessor
+        dataset) into a fresh dataset, extract the changed files,
+        apply the deletions, and VERIFY the result against the
+        stream's target manifest before anything is recorded.  Any
+        mismatch — a divergent base, torn transfer, anything —
+        destroys the partial and raises; the restore client then
+        retries full.  Divergence can cost a re-transfer, never a
+        wrong dataset."""
+        hdr_line = await reader.readline()
+        if not hdr_line:
+            raise StorageError("empty delta recv stream")
+        try:
+            hdr = json.loads(hdr_line)
+            snapname = hdr["snapshot"]
+            size = hdr.get("size")
+            dlen = int(hdr["deltaLen"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            raise StorageError("bad delta stream header: %r"
+                               % hdr_line) from None
+        wirestream.check_stream_id(hdr, expect_stream_id)
+        if hdr.get("base") != base:
+            # a full stream, or a delta against some other base: either
+            # way NOT what was negotiated — refuse before any mutation
+            raise StorageError(
+                "delta stream names base %r, expected %r"
+                % (hdr.get("base"), base))
+        if (not isinstance(snapname, str) or not snapname
+                or "/" in snapname or "\\" in snapname
+                or snapname in (".", "..") or snapname in _RESERVED):
+            raise StorageError("bad snapshot name in stream: %r"
+                               % (snapname,))
+        if not 0 <= dlen <= MAX_DELTA_DETAIL:
+            raise StorageError("implausible delta detail length %d"
+                               % dlen)
+        try:
+            # the blob rides the wire right behind the header; a
+            # sender that stalls inside it is a dead transfer, not a
+            # slow one
+            blob = await asyncio.wait_for(reader.readexactly(dlen),
+                                          600)
+            # the cap must bound the DECOMPRESSED size too: zlib
+            # expands up to ~1000:1, and a small wire blob of
+            # compressed zeros would otherwise allocate gigabytes
+            # before any validation ran
+            d = zlib.decompressobj()
+            raw = d.decompress(blob, MAX_DELTA_DETAIL)
+            if d.unconsumed_tail:
+                raise StorageError(
+                    "delta detail blob inflates past the %d-byte cap"
+                    % MAX_DELTA_DETAIL)
+            detail = json.loads(raw + d.flush())
+            deleted = [_check_wire_relpath(p)
+                       for p in detail["deleted"]]
+            changed = [_check_wire_relpath(p)
+                       for p in detail["changed"]]
+            manifest = detail["manifest"]
+            if not isinstance(manifest, dict):
+                raise StorageError("delta manifest is not an object")
+            for p in manifest:
+                _check_wire_relpath(p)
+        except StorageError:
+            raise
+        except (asyncio.IncompleteReadError, ValueError, KeyError,
+                TypeError, zlib.error) as e:
+            raise StorageError("bad delta detail blob: %s" % e) \
+                from None
+        codec = hdr.get("compression")
+        feed = wirestream.make_feed(reader, codec)
+
+        base_src = base_src or dataset
+        srcdir = self._dspath(base_src) / "@snapshots" / base
+        if not srcdir.is_dir():
+            raise StorageError("no local copy of delta base %s@%s"
+                               % (base_src, base))
+        if self._exists_sync(dataset):
+            raise StorageError(
+                "recv target exists: %s (isolate or destroy it first)"
+                % dataset)
+        await self.create(dataset)
+        try:
+            # the applying marker makes a half-applied dataset
+            # self-describing debris: sweep_delta_debris destroys it
+            # and the next restore attempt goes full
+            meta = self._load_meta(dataset)
+            meta["applying"] = hdr.get("stream") or snapname
+            self._save_meta(dataset, meta)
+            # error:StorageError models an apply that dies after the
+            # dataset materialized; crash here is the half-applied
+            # debris the sweep scenario proves is swept + retried full
+            await faults.point("storage.delta.apply")
+            data = self._dspath(dataset) / "@data"
+            await asyncio.to_thread(shutil.copytree, srcdir, data,
+                                    symlinks=True, dirs_exist_ok=True)
+            # paths whose TYPE flipped (file->dir, dir->symlink, ...)
+            # must be cleared before extraction: tar will not replace
+            # a directory with a file
+            await asyncio.to_thread(
+                self._clear_type_flips, data, changed, manifest)
+            proc = await asyncio.create_subprocess_exec(
+                "tar", "-C", str(data), "-xf", "-",
+                stdin=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+            t_err = asyncio.create_task(proc.stderr.read())
+            seen = {"raw": 0}
+
+            def _prog(d: int) -> None:
+                seen["raw"] = d
+                if progress_cb:
+                    progress_cb(d, size)
+
+            with wirestream.recorded_stage("recv", dataset, codec,
+                                           basis="incremental") as st:
+                err, rc = await pump_socket_to_child(
+                    proc, feed, t_err, on_progress=_prog,
+                    label="delta recv into %s" % dataset)
+                st.raw = seen["raw"] + len(blob)
+                st.wire = (feed.wire_bytes if codec else seen["raw"]) \
+                    + len(blob)
+            if rc != 0:
+                raise StorageError(
+                    "tar delta recv failed (rc=%d): %s"
+                    % (rc, err.decode("utf-8", "replace")))
+            await asyncio.to_thread(self._apply_deletions, data,
+                                    deleted, manifest)
+            got = await asyncio.to_thread(manifest_scan, data)
+            bad = manifest_diff_paths(got, manifest)
+            if bad:
+                raise StorageError(
+                    "delta apply DIVERGED from the sender's target "
+                    "manifest at %d path(s) (first: %s) — base %r is "
+                    "not the sender's base; retry full"
+                    % (len(bad), ", ".join(bad[:5]), base))
+            # success: preserve the received snapshot + its manifest,
+            # exactly like a full recv preserves the streamed snapshot
+            snapdir = self._dspath(dataset) / "@snapshots" / snapname
+            await asyncio.to_thread(shutil.copytree, data, snapdir,
+                                    symlinks=True)
+            self._write_manifest(dataset, snapname, manifest)
+            meta = self._load_meta(dataset)
+            meta["snaps"][snapname] = time.time()
+            meta["mounted"] = False
+            meta.pop("applying", None)
+            self._save_meta(dataset, meta)
+        except BaseException:
+            # any abort — divergence, dead stream, cancel, fault —
+            # removes the partial; the base content is untouched in
+            # base_src, so nothing is lost but the transfer
+            await self._destroy_quietly(dataset)
+            raise
+
+    @staticmethod
+    def _clear_type_flips(data: Path, changed: list[str],
+                          manifest: dict) -> None:
+        for p in changed:
+            tgt = data / p
+            ent = manifest.get(p)
+            if ent is None or not (tgt.is_symlink() or tgt.exists()):
+                continue
+            on_disk = ("l" if tgt.is_symlink()
+                       else "d" if tgt.is_dir() else "f")
+            if on_disk != ent.get("t") or on_disk in ("l",):
+                if tgt.is_dir() and not tgt.is_symlink():
+                    shutil.rmtree(tgt)
+                else:
+                    tgt.unlink()
+
+    @staticmethod
+    def _apply_deletions(data: Path, deleted: list[str],
+                         manifest: dict) -> None:
+        # deepest-first so directories empty before their own removal;
+        # a path already absent is fine (the delta describes the
+        # target state, and absent IS that state).
+        #
+        # A deleted path whose ANCESTOR the delta replaced with a
+        # non-directory is moot — the old descendant went with the old
+        # ancestor — and must be SKIPPED, not resolved: if the new
+        # ancestor is a symlink (a pg_tblspc-style link), resolving
+        # the old path through it would delete files OUTSIDE the
+        # dataset.  (Deletions under symlinks cannot arise any other
+        # way: manifest_scan never descends into them, so only a type
+        # flip puts a symlink above a base-manifest path.)
+        def ancestor_replaced(p: str) -> bool:
+            parts = p.split("/")
+            for i in range(1, len(parts)):
+                ent = manifest.get("/".join(parts[:i]))
+                if isinstance(ent, dict) and ent.get("t") != "d":
+                    return True
+            return False
+
+        for p in sorted(deleted, reverse=True):
+            if ancestor_replaced(p):
+                continue
+            tgt = data / p
+            try:
+                if tgt.is_dir() and not tgt.is_symlink():
+                    shutil.rmtree(tgt)
+                else:
+                    tgt.unlink()
+            except FileNotFoundError:
+                pass
+            except NotADirectoryError:
+                # some component is (already) a non-directory: the old
+                # path cannot exist under it — equally moot
+                pass
 
     async def _destroy_quietly(self, dataset: str) -> None:
         """Abort-path cleanup: the dataset vanishing concurrently (a

@@ -58,36 +58,41 @@ _PREFERENCE = ("zstd", "zlib")
 WIRE_MAGIC = b"MNTSTRM1"
 
 _REG = get_registry()
+# the basis label ("full" | "incremental") is what lets the bench and
+# the dashboards show the incremental-rebuild saving: the same rebuild
+# traffic, split by whether the whole dataset or just a delta moved
 STREAM_BYTES = _REG.counter(
     "stream_bytes_total", "raw snapshot bytes moved by bulk streams",
-    ("direction",))
+    ("direction", "basis"))
 STREAM_WIRE_BYTES = _REG.counter(
     "stream_wire_bytes_total",
-    "bulk-stream bytes on the wire (after compression)", ("direction",))
+    "bulk-stream bytes on the wire (after compression)",
+    ("direction", "basis"))
 # stream-stage latency in the sub-second-to-minutes regime (a small
 # dataset rebuild is tens of ms; a production one, minutes)
 STREAM_DUR = _REG.histogram(
     "stream_stage_duration_seconds",
-    "wall-clock of one bulk-stream stage", ("direction",),
+    "wall-clock of one bulk-stream stage", ("direction", "basis"),
     buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
              60.0, 300.0, 1800.0))
 STREAM_THROUGHPUT = _REG.histogram(
     "stream_throughput_mb_per_second",
-    "raw-byte throughput of one bulk-stream stage", ("direction",),
+    "raw-byte throughput of one bulk-stream stage",
+    ("direction", "basis"),
     buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
              2500.0))
 
 
 def record_stream(direction: str, raw: int, wire: int,
-                  duration_s: float) -> None:
+                  duration_s: float, basis: str = "full") -> None:
     """Fold one completed stream stage into the registry; returns
     nothing — callers stamp span attrs themselves."""
-    STREAM_BYTES.inc(raw, direction=direction)
-    STREAM_WIRE_BYTES.inc(wire, direction=direction)
-    STREAM_DUR.observe(duration_s, direction=direction)
+    STREAM_BYTES.inc(raw, direction=direction, basis=basis)
+    STREAM_WIRE_BYTES.inc(wire, direction=direction, basis=basis)
+    STREAM_DUR.observe(duration_s, direction=direction, basis=basis)
     if duration_s > 0:
         STREAM_THROUGHPUT.observe(raw / duration_s / 1e6,
-                                  direction=direction)
+                                  direction=direction, basis=basis)
 
 
 def throughput_mb_s(raw: int, duration_s: float) -> float | None:
@@ -103,19 +108,22 @@ class _Stage:
 
 
 @contextlib.contextmanager
-def recorded_stage(direction: str, dataset: str, codec: str | None):
+def recorded_stage(direction: str, dataset: str, codec: str | None,
+                   basis: str = "full"):
     """One bulk-stream stage's span + clock + registry fold, shared by
     every backend's send/recv (the glue existed four times before).
     The body sets ``st.raw``/``st.wire``; metrics and span attrs are
-    recorded only when the stage completes."""
+    recorded only when the stage completes.  *basis* labels whether
+    the stage moved the whole dataset or a negotiated delta, so the
+    span waterfall and the wire-byte counters show the saving."""
     from manatee_tpu.obs import span
     st = _Stage()
     with span("stream.%s" % direction, dataset=dataset,
-              codec=codec or "raw") as sp:
+              codec=codec or "raw", basis=basis) as sp:
         clock = StageClock()
         yield st
         dur = clock.elapsed()
-        record_stream(direction, st.raw, st.wire, dur)
+        record_stream(direction, st.raw, st.wire, dur, basis=basis)
         sp.attrs.update(
             bytes_total=st.raw, wire_bytes=st.wire,
             throughput_mb_s=throughput_mb_s(st.raw, dur))

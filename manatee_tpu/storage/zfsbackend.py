@@ -24,6 +24,7 @@ from manatee_tpu.storage.base import (
     Snapshot,
     StorageBackend,
     StorageError,
+    is_epoch_ms_snapshot,
     pump_child_to_socket,
     pump_socket_to_child,
 )
@@ -197,21 +198,29 @@ class ZfsBackend(StorageBackend):
         progress_cb: ProgressCb | None = None,
         compress: str | None = None,
         stream_id: str | None = None,
+        from_snapshot: str | None = None,
     ) -> None:
         from manatee_tpu import native
 
+        if from_snapshot:
+            await faults.point("storage.delta.send")
+        basis = "incremental" if from_snapshot else "full"
         # zfs streams historically go raw with no header, so the codec
         # and stream id ride a magic-prefixed wire header — written
         # ONLY when the receiver's POST proved it knows how to probe
         # for the magic (it offered codecs / declared the stream
-        # protocol; the sender gates stream_id/compress on that).  Old
-        # peers in either direction stay on the raw wire.
-        if compress or stream_id:
+        # protocol; the sender gates stream_id/compress/delta on
+        # that).  Old peers in either direction stay on the raw wire.
+        if compress or stream_id or from_snapshot:
             hdr = {"snapshot": name}
             if compress:
                 hdr["compression"] = compress
             if stream_id:
                 hdr["stream"] = stream_id
+            if from_snapshot:
+                # the receiver verifies this names the NEGOTIATED base
+                # before letting `zfs recv -F` near the dataset
+                hdr["base"] = from_snapshot
             frame = wirestream.WIRE_MAGIC + json.dumps(hdr).encode() \
                 + b"\n"
             try:
@@ -220,16 +229,28 @@ class ZfsBackend(StorageBackend):
             except Exception as e:
                 raise StorageError("zfs send of %s@%s aborted: %s"
                                    % (dataset, name, e)) from e
+        if from_snapshot == name:
+            # the receiver already holds the send target (`zfs send
+            # -i X ds@X` is an error): the header ALONE is the whole
+            # stream — base == snapshot tells the receiver to roll
+            # back to the common snapshot and stop.  ~100 bytes where
+            # the fallback would re-ship the entire dataset.
+            return
+        send_args = ["send", "-v", "-P"]
+        if from_snapshot:
+            send_args += ["-i", from_snapshot]
+        send_args.append("%s@%s" % (dataset, name))
         if not compress and native.enabled() \
                 and writer.get_extra_info("socket") is not None:
             # an UNCOMPRESSED body still rides the kernel splice pump
             # even when a stream-id header was stamped (the pump's
             # flush_transport pushes the header out first, exactly
             # like DirBackend's header + native path)
-            await self._send_native(dataset, name, writer, progress_cb)
+            await self._send_native(dataset, name, writer, progress_cb,
+                                    send_args)
             return
         proc = await asyncio.create_subprocess_exec(
-            self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name),
+            self.zfs, *send_args,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
             env={},
@@ -239,7 +260,8 @@ class ZfsBackend(StorageBackend):
 
         async def pump_stdout():
             with wirestream.recorded_stage("send", dataset,
-                                           compress) as st:
+                                           compress,
+                                           basis=basis) as st:
                 st.raw, st.wire = await wirestream.pipeline_copy(
                     proc.stdout.read, writer, codec=compress,
                     progress=(lambda d: progress_cb(d, state.size))
@@ -273,7 +295,8 @@ class ZfsBackend(StorageBackend):
 
     async def _send_native(self, dataset: str, name: str,
                            writer: asyncio.StreamWriter,
-                           progress_cb: ProgressCb | None) -> None:
+                           progress_cb: ProgressCb | None,
+                           send_args: list[str] | None = None) -> None:
         """MANATEE_NATIVE=1: `zfs send` stdout is spliced to the peer
         socket in the kernel — fd-lifetime/cancellation protocol shared
         with DirBackend in storage.base.pump_child_to_socket — while
@@ -285,7 +308,9 @@ class ZfsBackend(StorageBackend):
         err_chunks: list[bytes] = []
 
         proc, t_err = await pump_child_to_socket(
-            [self.zfs, "send", "-v", "-P", "%s@%s" % (dataset, name)],
+            [self.zfs, *(send_args
+                         or ["send", "-v", "-P",
+                             "%s@%s" % (dataset, name)])],
             writer,
             stderr_task=lambda p: _watch_send_stderr(
                 p, state, err_chunks, progress_cb),
@@ -358,4 +383,110 @@ class ZfsBackend(StorageBackend):
             st.wire = feed.wire_bytes if codec else st.raw
         if rc != 0:
             raise StorageError("zfs recv failed (rc=%d): %s"
+                               % (rc, err.decode("utf-8", "replace")))
+
+    # ---- incremental rebuild (delta) ----
+    #
+    # zfs deltas apply IN PLACE: `zfs recv -F` natively rolls the
+    # existing dataset back to the common base and verifies the
+    # incremental stream's lineage by guid/checksum — a same-named but
+    # divergent base fails the recv, the partial is discarded by zfs
+    # itself, and the restore client retries full.
+
+    delta_in_place = True
+
+    def supports_delta(self) -> bool:
+        return True
+
+    async def list_children(self, dataset: str) -> list[str]:
+        res = await self._zfs("list", "-H", "-o", "name", "-d", "1",
+                              dataset, check=False)
+        if res.returncode != 0:
+            return []
+        return sorted(n.strip() for n in res.stdout.splitlines()
+                      if n.strip() and n.strip() != dataset)
+
+    async def delta_candidates(
+            self, dataset: str,
+            fallback: str | None = None) -> tuple[list[str], str | None]:
+        # in-place apply needs the base ON the live dataset; a
+        # pre-isolated predecessor (*fallback*) cannot serve as a zfs
+        # incremental target, so it is deliberately ignored
+        if not await self.exists(dataset):
+            return [], None
+        names = [s.name for s in await self.list_snapshots(dataset)
+                 if is_epoch_ms_snapshot(s.name)]
+        return (names, dataset) if names else ([], None)
+
+    async def recv_delta(
+        self,
+        dataset: str,
+        reader: asyncio.StreamReader,
+        *,
+        base: str,
+        base_src: str | None = None,
+        progress_cb: ProgressCb | None = None,
+        expect_stream_id: str | None = None,
+    ) -> None:
+        try:
+            hdr, feed = await wirestream.probe_wire_header(reader)
+        except ValueError as e:
+            raise StorageError(str(e)) from None
+        wirestream.check_stream_id(hdr, expect_stream_id)
+        if not hdr or hdr.get("base") != base:
+            # a full/headerless stream, or a delta against some other
+            # base: refuse before `zfs recv -F` touches the dataset
+            raise StorageError(
+                "delta stream names base %r, expected %r"
+                % ((hdr or {}).get("base"), base))
+        if not await self.exists(dataset):
+            raise StorageError("delta recv target %s does not exist"
+                               % dataset)
+        if hdr.get("snapshot") == base:
+            # base == target: the receiver already holds the sender's
+            # newest snapshot; rolling back to it IS the whole apply
+            # (discarding local changes/snapshots past it, exactly as
+            # a streamed delta would)
+            await self._zfs("rollback", "-r",
+                            "%s@%s" % (dataset, base))
+            with wirestream.recorded_stage("recv", dataset, None,
+                                           basis="incremental"):
+                pass
+            return
+        codec = hdr.get("compression")
+        feed = wirestream.make_feed(feed, codec)
+        # roll the dataset back to the negotiated base FIRST: `recv -F`
+        # alone only discards data modifications since the MOST RECENT
+        # snapshot, and this dataset holds snapshots newer than the
+        # base (the post-restore initial snapshot, the snapshotter's
+        # own) — a plain -i recv against those fails with 'most recent
+        # snapshot does not match incremental source'.  rollback -r
+        # destroys the intervening (local-only, superseded) snapshots
+        # and makes the base the head; a failed rollback fails the
+        # apply before recv touches anything, and the client retries
+        # full.
+        await self._zfs("rollback", "-r", "%s@%s" % (dataset, base))
+        proc = await asyncio.create_subprocess_exec(
+            self.zfs, "recv", "-F", "-v", "-u", dataset,
+            stdin=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env={},
+        )
+        t_err = asyncio.create_task(proc.stderr.read())
+        seen = {"raw": 0}
+
+        def _prog(d: int) -> None:
+            seen["raw"] = d
+            if progress_cb:
+                progress_cb(d, None)
+
+        with wirestream.recorded_stage("recv", dataset, codec,
+                                       basis="incremental") as st:
+            err, rc = await pump_socket_to_child(
+                proc, feed, t_err, on_progress=_prog,
+                label="zfs delta recv into %s" % dataset)
+            st.raw = seen["raw"]
+            st.wire = feed.wire_bytes if codec else st.raw
+        if rc != 0:
+            raise StorageError("zfs delta recv failed (rc=%d): %s"
                                % (rc, err.decode("utf-8", "replace")))

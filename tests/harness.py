@@ -503,17 +503,34 @@ class ClusterHarness:
         if await be.exists("manatee/pg"):
             await be.destroy("manatee/pg", recursive=True)
 
+    async def isolate_dataset(self, peer: Peer) -> None:
+        """Rename a (stopped) peer's pg dataset aside exactly the way
+        `manatee-adm rebuild` does (isolated/rebuild-<ts>): the
+        isolated snapshots stay offerable as delta bases, so the next
+        boot takes the INCREMENTAL restore path — the inducement for
+        the delta-seam crash scenarios."""
+        from manatee_tpu.backup.client import RestoreClient
+        be = DirBackend(str(peer.root / "store"))
+        if await be.exists("manatee/pg"):
+            rc = RestoreClient(be, dataset="manatee/pg",
+                               mountpoint=str(peer.root / "data"))
+            await rc.isolate("rebuild")
+
     async def restart_peer(self, peer: Peer, *, wipe_data: bool = False,
+                           isolate_data: bool = False,
                            sitter_faults=(), backup_faults=()) -> None:
         """The crash sweep's recovery primitive: bring a peer back ON
         THE SAME data dir, ports, and identity — kill whatever is left
         of it first (a crashed sitter's orphaned database child
-        included), optionally wipe the dataset (restore-path
-        scenarios), optionally boot-arm fault specs on one daemon for
-        the respawn."""
+        included), optionally wipe or isolate the dataset
+        (full-restore-path / incremental-restore-path scenarios),
+        optionally boot-arm fault specs on one daemon for the
+        respawn."""
         peer.kill()
         if wipe_data:
             await self.wipe_dataset(peer)
+        if isolate_data:
+            await self.isolate_dataset(peer)
         peer.start(sitter_faults=sitter_faults,
                    backup_faults=backup_faults)
 

@@ -75,6 +75,18 @@ REPO = Path(__file__).resolve().parent.parent
 #                 upstream), wipe the async: the sender crashes
 #                 mid-backup-stream; restart it, the restore retries
 #                 to completion
+#   incr_sender   arm the sync's BACKUPSERVER, then restart the async
+#                 with its dataset ISOLATED the rebuild way (snapshots
+#                 stay offerable as delta bases): the next restore
+#                 negotiates an incremental stream, driving the
+#                 negotiation/delta-send seams in the sender process;
+#                 restart it, the restore retries to completion
+#   incr_apply    boot-arm the async's sitter and isolate its dataset:
+#                 the sitter negotiates an incremental restore and
+#                 crashes mid-APPLY, leaving a half-applied dataset;
+#                 the restarted sitter must sweep the debris and fall
+#                 back to a FULL restore (asserted via the status
+#                 server's restore job basis)
 #   coordd        arm coordd via its metrics listener; crash at the
 #                 dispatch/durability seam, restart it on the same
 #                 data dir (op-log recovery), sessions re-register
@@ -86,6 +98,7 @@ REPO = Path(__file__).resolve().parent.parent
 # variant: "exit" (default, os._exit → CRASH_EXIT_CODE) or "kill"
 # (SIGKILL-to-self → waitpid -SIGKILL); both variants are exercised.
 SCENARIOS: dict[str, dict] = {
+    "backup.negotiate_base": dict(kind="incr_sender"),
     "backup.post":          dict(kind="boot_async", wipe=True),
     "backup.recv.stream":   dict(kind="boot_async", wipe=True,
                                  variant="kill"),
@@ -102,6 +115,8 @@ SCENARIOS: dict[str, dict] = {
     "pg.repoint":           dict(kind="repoint"),
     "pg.restore":           dict(kind="boot_async", wipe=True),
     "state.write":          dict(kind="primary_write"),
+    "storage.delta.apply":  dict(kind="incr_apply"),
+    "storage.delta.send":   dict(kind="incr_sender", variant="kill"),
     "storage.recv":         dict(kind="boot_async", wipe=True),
     "storage.send":         dict(kind="sender"),
     "storage.snapshot":     dict(kind="boot_async", wipe=True),
@@ -415,6 +430,33 @@ def test_crash_at_seam(tmp_path, point):
                 sync.kill_backup_only()
                 sync.start_backup_only()
 
+            elif scn["kind"] == "incr_sender":
+                # the async's bootstrap restore came from the sync's
+                # backupserver, so the two share the streamed snapshot
+                # name — isolating (not wiping) the async's dataset
+                # makes its next restore OFFER that snapshot, driving
+                # the incremental seams in the sender process
+                await arm_crash(cluster, sp, "--url",
+                                "http://127.0.0.1:%d"
+                                % sync.backup_port)
+                await cluster.restart_peer(a, isolate_data=True)
+                status = await asyncio.to_thread(
+                    sync.wait_daemon_exit, "backup", 120)
+                assert status == want, \
+                    "backup sender did not die AT the delta seam: %r" \
+                    % status
+                sync.kill_backup_only()
+                sync.start_backup_only()
+
+            elif scn["kind"] == "incr_apply":
+                await cluster.restart_peer(a, isolate_data=True,
+                                           sitter_faults=[sp])
+                status = await asyncio.to_thread(
+                    a.wait_daemon_exit, "sitter", 120)
+                assert status == want, \
+                    "sitter did not die AT the apply seam: %r" % status
+                await cluster.restart_peer(a)
+
             elif scn["kind"] == "coordd":
                 await arm_crash(cluster, sp, "--url",
                                 cluster.coord_metrics_url(0))
@@ -446,6 +488,19 @@ def test_crash_at_seam(tmp_path, point):
                                      % scn["kind"])
 
             await verify_recovery(cluster, sampler)
+
+            if scn["kind"] == "incr_apply":
+                # the half-applied dataset must have been SWEPT and
+                # the retry must have fallen back to the full stream
+                # (a crashed apply proves nothing about why it died —
+                # doubt never rides into another incremental attempt)
+                _s, body = await http_get(
+                    "http://127.0.0.1:%d/restore" % a.status_port)
+                rjob = (body or {}).get("restore")
+                assert rjob and rjob.get("done") is True, rjob
+                assert rjob.get("basis") == "full", \
+                    "post-crash retry was not a full restore: %r" \
+                    % rjob
         finally:
             await sampler.stop()
             await cluster.stop()

@@ -210,6 +210,75 @@ def test_dirstore_orphan_snapshot_dir_is_warning(tmp_path):
     assert summarize(findings)["ok"]
 
 
+def test_dirstore_manifest_diverged_is_damage(tmp_path):
+    """The manifest is the delta plane's ground truth: a PARSEABLE
+    manifest that disagrees with its (immutable) snapshot dir could
+    ship — and verify — a wrong delta, so divergence is damage."""
+    _be, root = make_dirstore(tmp_path)
+    mpath = ds_path(root) / "@manifests" / "snap1.json"
+    man = json.loads(mpath.read_text())
+    man["files"]["wal"]["size"] = 1          # lies about the content
+    mpath.write_text(json.dumps(man))
+    findings = check_dirstore(root)
+    assert damage_checks(findings) == {"manifest-diverged"}
+
+
+def test_dirstore_manifest_extra_and_missing_paths_are_damage(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    mpath = ds_path(root) / "@manifests" / "snap2.json"
+    man = json.loads(mpath.read_text())
+    man["files"]["ghost"] = {"t": "f", "size": 3, "h": "00"}
+    mpath.write_text(json.dumps(man))
+    assert damage_checks(check_dirstore(root)) == {"manifest-diverged"}
+    del man["files"]["ghost"]
+    del man["files"]["wal"]                  # real content unaccounted
+    mpath.write_text(json.dumps(man))
+    assert damage_checks(check_dirstore(root)) == {"manifest-diverged"}
+
+
+def test_dirstore_manifest_corrupt_is_warning(tmp_path):
+    """A torn/unreadable manifest is self-healing (lazily recomputed
+    from the snapshot dir), so it is a warning, not damage."""
+    _be, root = make_dirstore(tmp_path)
+    (ds_path(root) / "@manifests" / "snap1.json").write_text("{oops")
+    findings = check_dirstore(root)
+    assert not damage_checks(findings)
+    assert ("warning", "manifest-corrupt") in levels(findings)
+
+
+def test_dirstore_manifest_orphan_and_tmp_are_notes(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    mandir = ds_path(root) / "@manifests"
+    (mandir / "gone.json").write_text('{"files": {}}')
+    (mandir / "snap1.json.tmp-1-2").write_text("{")
+    findings = check_dirstore(root)
+    assert not damage_checks(findings)
+    assert ("note", "manifest-orphan") in levels(findings)
+    assert ("note", "manifest-tmp-orphan") in levels(findings)
+    assert summarize(findings)["ok"]
+
+
+def test_dirstore_pre_manifest_dataset_is_clean(tmp_path):
+    """Datasets from before the manifest plane (no @manifests dir at
+    all, or snapshots without manifests) verify clean — manifests are
+    backfilled lazily, their absence proves nothing."""
+    import shutil
+    _be, root = make_dirstore(tmp_path)
+    shutil.rmtree(ds_path(root) / "@manifests")
+    assert check_dirstore(root) == []
+
+
+def test_dirstore_applying_marker_is_note(tmp_path):
+    _be, root = make_dirstore(tmp_path)
+    meta_path = ds_path(root) / "@meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["applying"] = "some-job"
+    meta_path.write_text(json.dumps(meta))
+    findings = check_dirstore(root)
+    assert not damage_checks(findings)
+    assert ("note", "delta-apply-in-progress") in levels(findings)
+
+
 def test_dirstore_missing_data_dir_is_damage(tmp_path):
     import shutil
     _be, root = make_dirstore(tmp_path)

@@ -1,0 +1,725 @@
+"""Incremental rebuild: common-snapshot negotiation + delta send.
+
+The negotiation matrix (common base / no common / divergent / old-peer
+fallback / --full), the dirstore per-snapshot manifest plane (round
+trip incl. deletions and the lazy backfill path), the receiver's
+divergence -> destroy-partial -> full-retry contract, the crashed-apply
+debris sweep, and the wire-byte saving the whole feature exists for
+(incremental ≪ full on a mostly-clean dataset).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from manatee_tpu.backup import (
+    BackupQueue,
+    BackupRestServer,
+    BackupSender,
+    RestoreClient,
+)
+from manatee_tpu.backup.server import negotiate_base
+from manatee_tpu.storage import DirBackend
+from manatee_tpu.storage import stream as wirestream
+from manatee_tpu.storage.base import StorageError
+from manatee_tpu.storage.dirstore import (
+    manifest_delta,
+    manifest_diff_paths,
+    manifest_scan,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def wire_recv(basis: str) -> int:
+    return int(wirestream.STREAM_WIRE_BYTES.value(direction="recv",
+                                                  basis=basis))
+
+
+async def make_src(tmp_path, *, nfiles=8, fsize=64 * 1024):
+    """Sender side: dataset with semi-compressible content + one
+    epoch-ms snapshot, behind a real REST server + sender."""
+    be = DirBackend(tmp_path / "src-store")
+    await be.create("pg", mountpoint=str(tmp_path / "src-mnt"))
+    data = tmp_path / "src-store" / "datasets" / "pg" / "@data"
+    import os
+    for i in range(nfiles):
+        # unique random half + zero half: ~2:1 compressible, but no
+        # cross-file repetition a codec could flatten to nothing
+        (data / ("blob-%d.bin" % i)).write_bytes(
+            os.urandom(fsize // 2) + b"\x00" * (fsize // 2))
+    (data / "subdir").mkdir()
+    (data / "subdir" / "nested.txt").write_text("nested-v1")
+    (data / "doomed.txt").write_text("will be deleted")
+    await be.snapshot("pg", "1700000000111")
+    queue = BackupQueue()
+    server = BackupRestServer(queue, host="127.0.0.1", port=0,
+                              storage=be, dataset="pg")
+    await server.start()
+    sender = BackupSender(queue, be, "pg")
+    sender.start()
+    return be, data, queue, server, sender
+
+
+def dirty_src(data, *, touch=1):
+    """Mutate a small fraction of the sender's live data: rewrite
+    *touch* blob(s), change a nested file, add one, delete one."""
+    import os
+    for i in range(touch):
+        (data / ("blob-%d.bin" % i)).write_bytes(os.urandom(8192))
+    (data / "subdir" / "nested.txt").write_text("nested-v2")
+    (data / "added.txt").write_text("fresh file")
+    (data / "doomed.txt").unlink()
+
+
+# ---- negotiation matrix ----
+
+def test_negotiate_base_matrix(tmp_path):
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("pg")
+        await be.snapshot("pg", "1700000000111")
+        await be.snapshot("pg", "1700000000222")
+        await be.snapshot("pg", "not-epoch")
+        # newest COMMON name wins, not the newest either side holds
+        assert await negotiate_base(
+            be, "pg", ["1700000000111", "1700000000333"]) \
+            == "1700000000111"
+        assert await negotiate_base(
+            be, "pg", ["1700000000222", "1700000000111"]) \
+            == "1700000000222"
+        # no overlap / empty / malformed offers -> full
+        assert await negotiate_base(be, "pg", ["1699999999999"]) is None
+        assert await negotiate_base(be, "pg", []) is None
+        assert await negotiate_base(be, "pg", "1700000000111") is None
+        assert await negotiate_base(be, "pg", {"x": 1}) is None
+        # non-epoch names are never negotiable, even when shared
+        assert await negotiate_base(be, "pg", ["not-epoch"]) is None
+        assert await negotiate_base(be, "pg", [17, None]) is None
+    run(go())
+
+
+def test_post_backup_negotiation_and_old_peer_shapes(tmp_path):
+    """POST /backup: a bases offer negotiates; no offer, an old
+    (proto<2) peer, or a server without storage stays full."""
+    import aiohttp
+
+    async def go():
+        be, _data, queue, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        lsrv = await asyncio.start_server(
+            lambda r, w: w.close(), "127.0.0.1", 0)
+        lport = lsrv.sockets[0].getsockname()[1]
+        body = {"host": "127.0.0.1", "port": lport, "dataset": "pg"}
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.post(url + "/backup", json=dict(
+                        body, streamProto=2,
+                        bases=["1700000000111"])) as r:
+                    assert r.status == 201
+                    rb = await r.json()
+                assert rb["basis"] == {"mode": "incremental",
+                                       "base": "1700000000111"}
+                job = queue.get(rb["jobid"])
+                assert job.base == "1700000000111"
+                assert job.to_dict()["basis"] == "incremental"
+
+                # no common base -> full
+                async with http.post(url + "/backup", json=dict(
+                        body, streamProto=2,
+                        bases=["1699999999999"])) as r:
+                    assert (await r.json())["basis"] == {"mode": "full"}
+
+                # an old peer never sends bases/proto 2 -> full, and
+                # the response shape stays consumable (extra key only)
+                async with http.post(url + "/backup", json=body) as r:
+                    rb = await r.json()
+                    assert rb["basis"] == {"mode": "full"}
+                    assert queue.get(rb["jobid"]).base is None
+
+                # proto 1 peers (stream ids, no delta) stay full even
+                # if something malformed smuggles a bases key
+                async with http.post(url + "/backup", json=dict(
+                        body, streamProto=1,
+                        bases=["1700000000111"])) as r:
+                    assert (await r.json())["basis"] == {"mode": "full"}
+        finally:
+            lsrv.close()
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_server_without_storage_never_negotiates(tmp_path):
+    import aiohttp
+
+    async def go():
+        queue = BackupQueue()
+        server = BackupRestServer(queue, host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            url = "http://127.0.0.1:%d" % server.port
+            async with aiohttp.ClientSession() as http:
+                async with http.post(url + "/backup", json={
+                        "host": "127.0.0.1", "port": 1, "dataset": "x",
+                        "streamProto": 2,
+                        "bases": ["1700000000111"]}) as r:
+                    assert (await r.json())["basis"] == {"mode": "full"}
+        finally:
+            await server.stop()
+    run(go())
+
+
+# ---- manifest plane ----
+
+def test_manifest_written_at_snapshot_time_and_diff(tmp_path):
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("pg")
+        data = tmp_path / "store" / "datasets" / "pg" / "@data"
+        (data / "a.txt").write_text("one")
+        (data / "d").mkdir()
+        (data / "d" / "b.txt").write_text("two")
+        (data / "lnk").symlink_to("a.txt")
+        await be.snapshot("pg", "1700000000111")
+        mpath = tmp_path / "store" / "datasets" / "pg" / "@manifests" \
+            / "1700000000111.json"
+        assert mpath.exists()
+        m1 = json.loads(mpath.read_text())["files"]
+        assert m1["a.txt"]["t"] == "f" and m1["a.txt"]["size"] == 3
+        assert "h" in m1["a.txt"] and "mtime" in m1["a.txt"]
+        assert isinstance(m1["a.txt"]["m"], int)   # permission bits
+        assert m1["d"]["t"] == "d" and isinstance(m1["d"]["m"], int)
+        assert m1["d/b.txt"]["t"] == "f"
+        assert m1["lnk"] == {"t": "l", "lnk": "a.txt"}
+
+        (data / "a.txt").write_text("one-changed")
+        (data / "d" / "b.txt").unlink()
+        (data / "added").write_text("x")
+        await be.snapshot("pg", "1700000000222")
+        m2 = await be.snapshot_manifest("pg", "1700000000222")
+        changed, deleted = manifest_delta(m1, m2)
+        assert changed == ["a.txt", "added"]
+        assert deleted == ["d/b.txt"]
+        # mtime is informational, never part of the change verdict
+        assert manifest_diff_paths(m2, m2) == []
+    run(go())
+
+
+def test_manifest_lazy_backfill_and_torn_recompute(tmp_path):
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("pg")
+        data = tmp_path / "store" / "datasets" / "pg" / "@data"
+        (data / "a.txt").write_text("one")
+        await be.snapshot("pg", "1700000000111")
+        mpath = tmp_path / "store" / "datasets" / "pg" / "@manifests" \
+            / "1700000000111.json"
+        want = json.loads(mpath.read_text())["files"]
+
+        # a pre-manifest-era snapshot: the file is missing entirely
+        mpath.unlink()
+        got = await be.snapshot_manifest("pg", "1700000000111")
+        assert got == want
+        assert mpath.exists()          # backfill installed it
+
+        # a torn write: unparseable -> recomputed from the dir
+        mpath.write_text("{not json")
+        got = await be.snapshot_manifest("pg", "1700000000111")
+        assert got == want
+        assert json.loads(mpath.read_text())["files"] == want
+
+        # no such snapshot stays an error
+        with pytest.raises(StorageError, match="no such snapshot"):
+            await be.snapshot_manifest("pg", "1700000000999")
+    run(go())
+
+
+# ---- end-to-end restore paths ----
+
+def test_incremental_restore_end_to_end_and_wire_bytes(tmp_path):
+    """The headline path: full bootstrap, dirty a little, rebuild —
+    the second restore negotiates the common snapshot, ships only the
+    delta (wire bytes ≪ full), applies deletions, verifies, and the
+    result matches the sender's target snapshot exactly."""
+    async def go():
+        src_be, data, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst, dataset="pg", mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            w0 = wire_recv("full")
+            await asyncio.wait_for(client.restore(url), 20)
+            full_wire = wire_recv("full") - w0
+            assert client.current_job["basis"] == "full"
+            assert full_wire > 0
+
+            dirty_src(data, touch=1)
+            await src_be.snapshot("pg", "1700000000222")
+
+            w0i = wire_recv("incremental")
+            await asyncio.wait_for(client.restore(url), 20)
+            incr_wire = wire_recv("incremental") - w0i
+            assert client.current_job["basis"] == "incremental"
+            # the wire saving IS the feature: a ~5%-dirty dataset must
+            # move well under a quarter of the full stream
+            assert 0 < incr_wire < full_wire / 4, \
+                (incr_wire, full_wire)
+
+            # content identical to the sender's target snapshot
+            want = manifest_scan(
+                tmp_path / "src-store" / "datasets" / "pg"
+                / "@snapshots" / "1700000000222")
+            got = manifest_scan(
+                tmp_path / "dst-store" / "datasets" / "pg" / "@data")
+            assert manifest_diff_paths(got, want) == []
+            assert not (mnt / "doomed.txt").exists()
+            assert (mnt / "added.txt").read_text() == "fresh file"
+            # the received target snapshot is preserved (it seeds the
+            # NEXT incremental) and the old dataset was isolated
+            snaps = [s.name for s in await dst.list_snapshots("pg")]
+            assert "1700000000222" in snaps
+            assert client.last_isolated \
+                and "autorebuild-" in client.last_isolated
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_divergent_base_destroys_partial_and_retries_full(tmp_path):
+    """Same snapshot NAME, different bytes (two peers minted the same
+    epoch-ms in the same millisecond): the delta applies onto the
+    wrong base, the post-apply manifest verification catches it, the
+    partial is destroyed, and the SAME restore call completes via the
+    full stream — never a wrong dataset."""
+    async def go():
+        src_be, data, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst, dataset="pg", mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            await asyncio.wait_for(client.restore(url), 20)
+            dirty_src(data, touch=1)
+            await src_be.snapshot("pg", "1700000000222")
+
+            # corrupt the receiver's copy of the common base: same
+            # name, different content
+            basedir = tmp_path / "dst-store" / "datasets" / "pg" \
+                / "@snapshots" / "1700000000111"
+            (basedir / "blob-3.bin").write_bytes(b"DIVERGED")
+
+            await asyncio.wait_for(client.restore(url), 30)
+            # the attempt fell back: final basis is full, and the
+            # dataset matches the sender's target exactly
+            assert client.current_job["basis"] == "full"
+            want = manifest_scan(
+                tmp_path / "src-store" / "datasets" / "pg"
+                / "@snapshots" / "1700000000222")
+            got = manifest_scan(
+                tmp_path / "dst-store" / "datasets" / "pg" / "@data")
+            assert manifest_diff_paths(got, want) == []
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_no_common_base_goes_full(tmp_path):
+    async def go():
+        _sb, _d, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            # a local dataset whose snapshots share nothing with the
+            # sender: offered, rejected, full
+            await dst.create("pg")
+            await dst.snapshot("pg", "1600000000000")
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "full"
+            assert client.last_isolated          # classic isolation
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_old_server_ignores_bases_and_streams_full(tmp_path):
+    """A new client restoring from an OLD backup server (no storage
+    wired = no negotiation, response carries no usable basis): the
+    offer is ignored and the classic full path runs unchanged."""
+    async def go():
+        src_be = DirBackend(tmp_path / "src-store")
+        await src_be.create("pg",
+                            mountpoint=str(tmp_path / "src-mnt"))
+        data = tmp_path / "src-store" / "datasets" / "pg" / "@data"
+        (data / "blob").write_bytes(b"x" * 100_000)
+        await src_be.snapshot("pg", "1700000000111")
+        queue = BackupQueue()
+        server = BackupRestServer(queue, host="127.0.0.1", port=0)
+        await server.start()
+        sender = BackupSender(queue, src_be, "pg")
+        sender.start()
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            url = "http://127.0.0.1:%d" % server.port
+            await asyncio.wait_for(client.restore(url), 20)
+            # seed a common base, restore again: still full (the old
+            # server cannot negotiate)
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "full"
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_incremental_disabled_never_offers(tmp_path):
+    async def go():
+        src_be, data, queue, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            await asyncio.wait_for(client.restore(url), 20)
+            await asyncio.wait_for(
+                client.restore(url, incremental=False), 20)
+            assert client.current_job["basis"] == "full"
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+# ---- crashed-apply debris + isolated-base sourcing ----
+
+def test_delta_debris_sweep_forces_full(tmp_path):
+    """A dataset carrying the applying marker is a crash-interrupted
+    delta apply: the next restore sweeps it and goes FULL — doubt
+    never rides into another incremental attempt."""
+    async def go():
+        _sb, _d, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            # fabricate the debris a crash at storage.delta.apply
+            # leaves: a created dataset with the marker (and even a
+            # plausible base snapshot that would otherwise be offered)
+            await dst.create("pg")
+            await dst.snapshot("pg", "1700000000111")
+            meta_p = tmp_path / "dst-store" / "datasets" / "pg" \
+                / "@meta.json"
+            meta = json.loads(meta_p.read_text())
+            meta["applying"] = "jobid-of-the-dead"
+            meta_p.write_text(json.dumps(meta))
+
+            assert await dst.sweep_delta_debris("pg") is True
+            assert not await dst.exists("pg")
+            # a clean dataset is NOT debris
+            await dst.create("pg")
+            assert await dst.sweep_delta_debris("pg") is False
+            await dst.destroy("pg", recursive=True)
+
+            # end to end: marker present -> swept -> full restore
+            await dst.create("pg")
+            await dst.snapshot("pg", "1700000000111")
+            meta = json.loads(meta_p.read_text())
+            meta["applying"] = "jobid-of-the-dead"
+            meta_p.write_text(json.dumps(meta))
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "full"
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_rebuild_isolated_dataset_serves_bases_but_full_prefix_never(
+        tmp_path):
+    """The operator-rebuild flow: `manatee-adm rebuild` isolates under
+    rebuild-<ts>, and the sitter's next restore negotiates a delta
+    from the ISOLATED dataset's snapshots.  `--full` isolates under
+    fullrebuild-<ts>, which the restore plane never offers."""
+    async def go():
+        src_be, data, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst, dataset="pg", mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            await asyncio.wait_for(client.restore(url), 20)
+            dirty_src(data, touch=1)
+            await src_be.snapshot("pg", "1700000000222")
+
+            # what the rebuild CLI does (no --full)
+            iso = await client.isolate("rebuild")
+            assert iso and iso.startswith("isolated/rebuild-")
+            bases, src = await dst.delta_candidates(
+                "pg", await client._newest_isolated())
+            assert "1700000000111" in bases and src == iso
+
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "incremental"
+            want = manifest_scan(
+                tmp_path / "src-store" / "datasets" / "pg"
+                / "@snapshots" / "1700000000222")
+            got = manifest_scan(
+                tmp_path / "dst-store" / "datasets" / "pg" / "@data")
+            assert manifest_diff_paths(got, want) == []
+
+            # --full: the isolation prefix hides the bases, and a
+            # fullrebuild NEWER than the stale rebuild- isolation
+            # suppresses that one too — the newest isolation is the
+            # operator's latest word
+            iso2 = await client.isolate("fullrebuild")
+            assert iso2 and iso2.startswith("isolated/fullrebuild-")
+            assert await client._newest_isolated() is None
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "full"
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_empty_delta_when_target_equals_base(tmp_path):
+    """The receiver already holds the sender's newest snapshot: the
+    delta is EMPTY (dirstore ships a no-op tar + manifest) — the
+    cheapest possible rebuild, still fully verified."""
+    async def go():
+        _sb, _d, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            await asyncio.wait_for(client.restore(url), 20)
+            w0 = wire_recv("incremental")
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "incremental"
+            incr_wire = wire_recv("incremental") - w0
+            # just the manifest blob, no content
+            assert 0 < incr_wire < 64 * 1024, incr_wire
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_mode_only_change_ships_and_applies(tmp_path):
+    """A chmod with unchanged bytes is still a change: the manifest
+    carries permission bits, so the file ships in the delta and the
+    receiver ends bit-for-bit AND mode-for-mode identical to a full
+    restore."""
+    import os
+
+    async def go():
+        src_be, data, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst, dataset="pg", mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            os.chmod(data / "blob-0.bin", 0o600)
+            await src_be.snapshot("pg", "1700000000200")
+            await asyncio.wait_for(client.restore(url), 20)
+            assert (mnt / "blob-0.bin").stat().st_mode & 0o7777 \
+                == 0o600
+
+            os.chmod(data / "blob-0.bin", 0o755)      # bytes unchanged
+            await src_be.snapshot("pg", "1700000000222")
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "incremental"
+            assert (mnt / "blob-0.bin").stat().st_mode & 0o7777 \
+                == 0o755
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_dead_upstream_fails_once_not_twice(tmp_path):
+    """A failure BEFORE incremental negotiation (dead upstream) must
+    not trigger the full fallback: the retry would fail identically,
+    doubling the latency and burning the rebuild CLI's failed-attempt
+    budget at twice the real rate."""
+    from manatee_tpu.backup import RestoreError
+
+    async def go():
+        dst = DirBackend(tmp_path / "dst-store")
+        await dst.create("pg")
+        await dst.snapshot("pg", "1700000000111")     # bases on offer
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1,
+                               http_connect_timeout=1.0)
+        # a port nothing listens on: the POST fails pre-negotiation
+        with pytest.raises((RestoreError, OSError,
+                            asyncio.TimeoutError, Exception)):
+            await asyncio.wait_for(
+                client.restore("http://127.0.0.1:1"), 20)
+        assert client.attempts == 1, client.attempts
+        # the dataset was never touched (no isolation happened)
+        assert await dst.exists("pg")
+    run(go())
+
+
+def test_type_flip_deletions_apply_and_never_escape(tmp_path):
+    """Ancestors replaced by the delta orphan their old descendants:
+    dir->symlink must NOT let the stale deletion resolve through the
+    new link (it would delete files OUTSIDE the dataset), and
+    dir->file must not crash the apply into a full-stream fallback —
+    both deltas apply incrementally and verify."""
+    import shutil
+
+    async def go():
+        src = DirBackend(tmp_path / "src-store")
+        await src.create("pg", mountpoint=str(tmp_path / "src-mnt"))
+        data = tmp_path / "src-store" / "datasets" / "pg" / "@data"
+        (data / "a").mkdir()
+        (data / "a" / "b").write_text("inside")
+        (data / "d").mkdir()
+        (data / "d" / "c").write_text("kid")
+        (data / "keep.txt").write_text("k")
+        await src.snapshot("pg", "1700000000111")
+        queue = BackupQueue()
+        server = BackupRestServer(queue, host="127.0.0.1", port=0,
+                                  storage=src, dataset="pg")
+        await server.start()
+        sender = BackupSender(queue, src, "pg")
+        sender.start()
+        dst = DirBackend(tmp_path / "dst-store")
+        mnt = tmp_path / "dst-mnt"
+        client = RestoreClient(dst, dataset="pg", mountpoint=str(mnt),
+                               poll_interval=0.1)
+        try:
+            url = "http://127.0.0.1:%d" % server.port
+            await asyncio.wait_for(client.restore(url), 20)
+
+            # files the symlink flip must never be able to reach
+            outside = tmp_path / "outside"
+            outside.mkdir()
+            (outside / "b").write_text("precious")
+
+            shutil.rmtree(data / "a")
+            (data / "a").symlink_to(outside)     # dir -> symlink
+            shutil.rmtree(data / "d")
+            (data / "d").write_text("now a file")  # dir -> file
+            await src.snapshot("pg", "1700000000222")
+
+            await asyncio.wait_for(client.restore(url), 20)
+            assert client.current_job["basis"] == "incremental"
+            assert (outside / "b").read_text() == "precious"
+            want = manifest_scan(
+                tmp_path / "src-store" / "datasets" / "pg"
+                / "@snapshots" / "1700000000222")
+            got = manifest_scan(
+                tmp_path / "dst-store" / "datasets" / "pg" / "@data")
+            assert manifest_diff_paths(got, want) == []
+            assert (mnt / "a").is_symlink()
+            assert (mnt / "d").is_file() \
+                and (mnt / "d").read_text() == "now a file"
+        finally:
+            await sender.stop()
+            await server.stop()
+    run(go())
+
+
+def test_delta_detail_bomb_is_refused(tmp_path, monkeypatch):
+    """The detail-blob cap bounds the DECOMPRESSED size, not just the
+    wire bytes: a small blob of compressed filler must be refused
+    before json.loads allocates its expansion."""
+    import zlib
+
+    from manatee_tpu.storage import dirstore as ds_mod
+
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        monkeypatch.setattr(ds_mod, "MAX_DELTA_DETAIL", 1 << 16)
+        blob = zlib.compress(b"[" + b"0," * 200_000 + b"0]")
+        assert len(blob) < (1 << 16)          # tiny on the wire...
+        hdr = {"snapshot": "1700000000222", "base": "1700000000111",
+               "deltaLen": len(blob)}
+        reader = asyncio.StreamReader()
+        reader.feed_data(json.dumps(hdr).encode() + b"\n" + blob)
+        reader.feed_eof()
+        with pytest.raises(StorageError, match="inflates past"):
+            await be.recv_delta("pg", reader, base="1700000000111")
+        assert not await be.exists("pg")      # refused pre-mutation
+    run(go())
+
+
+def test_manifest_tmp_orphans_swept_at_startup(tmp_path):
+    """A crashed manifest write's tmp file is removed by the same
+    aged-orphan startup sweep that handles @meta.json tmps; a fresh
+    (in-flight sibling) tmp is left alone."""
+    import os
+    import time
+
+    async def go():
+        be = DirBackend(tmp_path / "store")
+        await be.create("pg")
+        data = tmp_path / "store" / "datasets" / "pg" / "@data"
+        (data / "a.txt").write_text("one")
+        await be.snapshot("pg", "1700000000111")
+        mandir = tmp_path / "store" / "datasets" / "pg" / "@manifests"
+        aged = mandir / "1700000000111.json.tmp-1-2"
+        fresh = mandir / "1700000000111.json.tmp-3-4"
+        aged.write_text("{")
+        fresh.write_text("{")
+        old = time.time() - 3600
+        os.utime(aged, (old, old))
+
+        DirBackend(tmp_path / "store")        # startup sweep
+        assert not aged.exists()
+        assert fresh.exists()
+        assert (mandir / "1700000000111.json").exists()
+    run(go())
+
+
+def test_apply_failure_mid_stream_cleans_partial(tmp_path, monkeypatch):
+    """An error injected at the apply seam destroys the partial and
+    the restore completes full — the wedge shape (recv target exists)
+    can never follow an aborted delta."""
+    async def go():
+        from manatee_tpu import faults
+        _sb, _d, _q, server, sender = await make_src(tmp_path)
+        url = "http://127.0.0.1:%d" % server.port
+        dst = DirBackend(tmp_path / "dst-store")
+        client = RestoreClient(dst, dataset="pg",
+                               mountpoint=str(tmp_path / "mnt"),
+                               poll_interval=0.1)
+        try:
+            await asyncio.wait_for(client.restore(url), 20)
+            reg = faults.get_faults()
+            reg.arm(point="storage.delta.apply", action="error",
+                    error="StorageError", count=1)
+            await asyncio.wait_for(client.restore(url), 30)
+            assert client.current_job["basis"] == "full"
+            assert await dst.exists("pg")
+        finally:
+            faults.get_faults().clear()
+            await sender.stop()
+            await server.stop()
+    run(go())

@@ -326,8 +326,10 @@ def test_restore_end_to_end_negotiates_and_falls_back(tmp_path,
         server = BackupRestServer(queue, host="127.0.0.1", port=0)
         await server.start()
         sender.start()
-        raw0 = wirestream.STREAM_BYTES.value(direction="send")
-        wire0 = wirestream.STREAM_WIRE_BYTES.value(direction="send")
+        raw0 = wirestream.STREAM_BYTES.value(direction="send",
+                                             basis="full")
+        wire0 = wirestream.STREAM_WIRE_BYTES.value(direction="send",
+                                                   basis="full")
         try:
             rc = RestoreClient(be, dataset=dst,
                                mountpoint=str(tmp_path / ("mnt-" + dst)),
@@ -336,9 +338,11 @@ def test_restore_end_to_end_negotiates_and_falls_back(tmp_path,
         finally:
             await sender.stop()
             await server.stop()
-        return (int(wirestream.STREAM_BYTES.value(direction="send")
+        return (int(wirestream.STREAM_BYTES.value(direction="send",
+                                                  basis="full")
                     - raw0),
-                int(wirestream.STREAM_WIRE_BYTES.value(direction="send")
+                int(wirestream.STREAM_WIRE_BYTES.value(
+                    direction="send", basis="full")
                     - wire0))
 
     async def go():

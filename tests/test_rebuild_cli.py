@@ -1,7 +1,9 @@
 """Live test of the guarded `manatee-adm rebuild` flow: depose a
 primary, run rebuild on its host (dataset destroyed, deposed entry
 removed), restart the sitter, and watch it restore and rejoin —
-lib/adm.js:1319-1684 end to end."""
+lib/adm.js:1319-1684 end to end.  Plus the incremental-rebuild
+consumer wiring: a plain rebuild of a live async negotiates a delta
+from its isolated snapshots, and --full skips the negotiation."""
 
 import asyncio
 import os
@@ -59,6 +61,104 @@ def test_rebuild_deposed_peer(tmp_path):
             await cluster.wait_writable(sync, "post-rebuild")
             # and it actually has the data (restored from upstream)
             res = await primary.pg_query({"op": "select"})
+            assert "pre-rebuild" in res["rows"]
+        finally:
+            await cluster.stop()
+    asyncio.run(go())
+
+
+def test_rebuild_live_async_incremental_then_full(tmp_path):
+    """The operator flow the tentpole exists for: `manatee-adm
+    rebuild` on a live async isolates its dataset under rebuild-<ts>,
+    the sitter's restore offers the isolated snapshots as bases and
+    ships only the delta (basis=incremental on the status server's
+    restore job); `rebuild --full` isolates under fullrebuild-<ts>
+    and the SAME peer restores with the classic full stream."""
+    from tests.test_partition import http_get
+
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3)
+        try:
+            await cluster.start()
+            primary, _sync, asyncs = await converged(cluster)
+            a = asyncs[0]
+            await cluster.wait_writable(primary, "pre-rebuild")
+
+            env = dict(os.environ, PYTHONPATH=str(REPO),
+                       COORD_ADDR="127.0.0.1:%d" % cluster.coord_port,
+                       SHARD="1")
+            env.pop("MANATEE_ADM_TEST_STATE", None)
+
+            from manatee_tpu.storage import DirBackend
+            store = DirBackend(str(a.root / "store"))
+
+            async def wait_peer_settled(timeout=120.0):
+                # the async must be healthy WITH its dataset on disk
+                # before we take its sitter down: under suite load a
+                # previous recovery can still be mid-restore (dataset
+                # isolated away), and rebuilding through that window
+                # would find nothing to isolate
+                import time as _time
+                deadline = _time.monotonic() + timeout
+                while _time.monotonic() < deadline:
+                    ok = False
+                    try:
+                        s, _b = await http_get(
+                            "http://127.0.0.1:%d/ping" % a.status_port)
+                        ok = (s == 200)
+                    except (OSError, asyncio.TimeoutError):
+                        ok = False
+                    if ok and await store.exists("manatee/pg"):
+                        return
+                    await asyncio.sleep(0.5)
+                raise AssertionError("async never settled pre-rebuild")
+
+            async def rebuild(*extra):
+                # the operator way: the broken peer's sitter is down
+                # while its dataset is isolated, then restarted to
+                # restore (a HEALTHY sitter would ride its open file
+                # descriptors right through the rename)
+                await wait_peer_settled()
+                a.kill_sitter_only()
+                task = asyncio.create_task(asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, "-m", "manatee_tpu.cli",
+                     "rebuild", "-y", "-c",
+                     str(a.root / "sitter.json"),
+                     "--timeout", "120", *extra],
+                    capture_output=True, text=True, env=env,
+                    timeout=180))
+                await asyncio.sleep(2.0)     # isolation is done
+                a.start_sitter_only()
+                cp = await task
+                assert cp.returncode == 0, (cp.stdout, cp.stderr)
+                assert "Peer is healthy again." in cp.stdout
+                return cp
+
+            async def last_restore_basis():
+                _s, body = await http_get(
+                    "http://127.0.0.1:%d/restore" % a.status_port)
+                job = (body or {}).get("restore")
+                assert job and job.get("done") is True, job
+                return job.get("basis")
+
+            cp = await rebuild()
+            assert "Isolated existing dataset as" in cp.stdout
+            assert await last_restore_basis() == "incremental"
+            await cluster.wait_for(
+                lambda s: [x["id"] for x in s.get("async") or []]
+                == [a.ident], 60, "async readopted after rebuild")
+
+            cp = await rebuild("--full")
+            assert "will not be offered as incremental bases" \
+                in cp.stdout
+            assert await last_restore_basis() == "full"
+
+            # data still correct after both rebuilds
+            await cluster.wait_for(
+                lambda s: [x["id"] for x in s.get("async") or []]
+                == [a.ident], 60, "async readopted after --full")
+            res = await a.pg_query({"op": "select"})
             assert "pre-rebuild" in res["rows"]
         finally:
             await cluster.stop()

@@ -117,6 +117,53 @@ def test_cleanup_noop_within_budget(tmp_path):
     run(go())
 
 
+def test_cleanup_pins_newest_base_candidate(tmp_path):
+    """Retention pin: the newest epoch-ms snapshot is the best
+    common-base candidate a peer can offer for an incremental rebuild
+    — the cleanup pass must never destroy it, even under an absurd
+    snapshot_number, and the snapshots_retained gauge reports what
+    the pass left behind."""
+    from manatee_tpu.snapshots import SNAPS_RETAINED
+
+    async def go():
+        st = await mk_storage(tmp_path)
+        for i in range(4):
+            await st.snapshot("manatee/pg", str(1700000000000 + i))
+
+        # snapshot_number=0 would naively delete everything; the pin
+        # floors retention at the newest one
+        shot = SnapShotter(st, dataset="manatee/pg", snapshot_number=0)
+        await shot.cleanup_once()
+        names = [s.name for s in await st.list_snapshots("manatee/pg")]
+        kept = [n for n in names if is_epoch_ms_snapshot(n)]
+        assert kept == ["1700000000003"]
+        assert SNAPS_RETAINED.value() == 1
+
+        # another pass with nothing excess keeps it (and the gauge)
+        await shot.cleanup_once()
+        assert [s.name for s in await st.list_snapshots("manatee/pg")] \
+            == ["1700000000003"]
+        assert SNAPS_RETAINED.value() == 1
+    run(go())
+
+
+def test_retained_gauge_tracks_keep_n(tmp_path):
+    from manatee_tpu.snapshots import SNAPS_RETAINED
+
+    async def go():
+        st = await mk_storage(tmp_path)
+        for i in range(5):
+            await st.snapshot("manatee/pg", str(1700000000000 + i))
+        shot = SnapShotter(st, dataset="manatee/pg", snapshot_number=3)
+        await shot.cleanup_once()
+        assert SNAPS_RETAINED.value() == 3
+        # under budget: gauge still reflects the current pool
+        shot.snapshot_number = 10
+        await shot.cleanup_once()
+        assert SNAPS_RETAINED.value() == 3
+    run(go())
+
+
 def test_stuck_accounting_and_fatal_when_all_stuck(tmp_path):
     """snapShotter.js:370-404: failed destroys are counted per
     snapshot; if EVERY excess snapshot is undeletable the service

@@ -258,6 +258,134 @@ async def _sink_server():
     return server, server.sockets[0].getsockname()[1]
 
 
+def test_delta_send_recv_contract(tmp_path):
+    """Incremental send/recv argv + wire contract: `zfs send -i base`
+    on the sender, `zfs recv -F -v -u` (native rollback-to-base) on
+    the receiver, the negotiated base named in the wire header and
+    verified before the child runs, and a mismatched base refused
+    without touching the dataset."""
+    async def go():
+        cmd, root = make_zfs_shim(tmp_path)
+        be = ZfsBackend(zfs_cmd=cmd)
+        await be.create("src")
+        await be.snapshot("src", "1700000000111")
+        # mutate the fake dataset's content between the snapshots
+        st = json.loads((root / "state.json").read_text())
+        st["datasets"]["src"]["data"] = "mutated"
+        (root / "state.json").write_text(json.dumps(st))
+        await be.snapshot("src", "1700000000222")
+
+        async def xfer(recv_coro_fn):
+            done = asyncio.Event()
+            out: dict = {}
+
+            async def on_conn(reader, writer):
+                try:
+                    await recv_coro_fn(reader)
+                except StorageError as e:
+                    out["error"] = e
+                done.set()
+                writer.close()
+
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            return server, port, done, out
+
+        # seed dst with the full base stream
+        server, port, done, _ = await xfer(
+            lambda r: be.recv("dst", r))
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 30)
+        await be.send("src", "1700000000111", writer)
+        writer.close()
+        await asyncio.wait_for(done.wait(), 30)
+        server.close()
+
+        # the receiver-local snapshots a real peer accumulates after a
+        # restore (the post-restore initial snapshot): the apply must
+        # roll back PAST them — real `zfs recv -F` alone cannot, so
+        # recv_delta issues `zfs rollback -r` first (and the fake zfs
+        # models recv's most-recent-snapshot check faithfully)
+        await be.snapshot("dst", "1700000000150")
+
+        # candidates: the live dataset itself, in place
+        bases, src = await be.delta_candidates("dst")
+        assert bases == ["1700000000111", "1700000000150"] \
+            and src == "dst"
+        assert be.delta_in_place and be.supports_delta()
+
+        # the delta: only src@222-over-@111 moves; dst rolls back and
+        # applies in place
+        server, port, done, out = await xfer(
+            lambda r: be.recv_delta("dst", r, base="1700000000111"))
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 30)
+        await be.send("src", "1700000000222", writer,
+                      from_snapshot="1700000000111", stream_id="j1")
+        writer.close()
+        await asyncio.wait_for(done.wait(), 30)
+        server.close()
+        assert "error" not in out, out
+        assert [s.name for s in await be.list_snapshots("dst")] \
+            == ["1700000000111", "1700000000222"]
+        stf = json.loads((root / "state.json").read_text())
+        assert stf["datasets"]["dst"]["data"] == "mutated"
+
+        log = argv_log(root)
+        assert ["send", "-v", "-P", "-i", "1700000000111",
+                "src@1700000000222"] in log
+        assert ["rollback", "-r", "dst@1700000000111"] in log
+        assert ["recv", "-F", "-v", "-u", "dst"] in log
+
+        # a stream against a DIFFERENT base is refused before zfs recv
+        # ever runs
+        n_recv = sum(1 for a in log if a and a[0] == "recv")
+        server, port, done, out = await xfer(
+            lambda r: be.recv_delta("dst", r, base="1700000000333"))
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 30)
+        await be.send("src", "1700000000222", writer,
+                      from_snapshot="1700000000111", stream_id="j2")
+        writer.close()
+        await asyncio.wait_for(done.wait(), 30)
+        server.close()
+        assert "error" in out and "expected" in str(out["error"])
+        log = argv_log(root)
+        assert sum(1 for a in log if a and a[0] == "recv") == n_recv
+
+        # base == target (the receiver already holds the sender's
+        # newest snapshot): the header alone is the stream — the
+        # receiver rolls back to the common snapshot and stops, a
+        # ~100-byte no-op where the fallback would re-ship everything
+        st2 = json.loads((root / "state.json").read_text())
+        st2["datasets"]["dst"]["data"] = "locally-dirtied"
+        (root / "state.json").write_text(json.dumps(st2))
+        await be.snapshot("dst", "1700000000250")   # local-only
+        log = argv_log(root)
+        n_recv = sum(1 for a in log if a and a[0] == "recv")
+        server, port, done, out = await xfer(
+            lambda r: be.recv_delta("dst", r, base="1700000000222"))
+        _r, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), 30)
+        await be.send("src", "1700000000222", writer,
+                      from_snapshot="1700000000222", stream_id="j3")
+        writer.close()
+        await asyncio.wait_for(done.wait(), 30)
+        server.close()
+        assert "error" not in out, out
+        stf = json.loads((root / "state.json").read_text())
+        assert stf["datasets"]["dst"]["data"] == "mutated"
+        assert [s.name for s in await be.list_snapshots("dst")] \
+            == ["1700000000111", "1700000000222"]
+        log = argv_log(root)
+        assert ["rollback", "-r", "dst@1700000000222"] in log
+        assert sum(1 for a in log if a and a[0] == "recv") == n_recv
+        assert not any(a[:4] == ["send", "-v", "-P", "-i"]
+                       and a[4] == a[5].partition("@")[2]
+                       for a in log if len(a) > 5)
+    run(go())
+
+
 def test_full_restore_orchestration_over_zfs(tmp_path):
     """backup/client.py's isolate -> receive -> mount -> snapshot flow
     (lib/zfsClient.js:115-207) executed over the zfs backend."""
