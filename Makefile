@@ -82,13 +82,17 @@ bench:
 
 # roff man pages generated from the markdown source (reference:
 # Makefile:68-79)
-man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1
+man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1 \
+		man/man1/manatee-sitter.1
 man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
 man/man1/manatee-adm-trace.1: docs/man/manatee-adm-trace.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm-trace.md > $@
+man/man1/manatee-sitter.1: docs/man/manatee-sitter.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-sitter.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

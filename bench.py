@@ -45,6 +45,20 @@ Plus two data-plane legs:
                              AND wire bytes for full vs incremental
                              (docs/performance.md).
 
+And one fleet-scale control-plane leg:
+
+  - control_plane_scale:     MANATEE_SCALE_SHARDS (default 32) shards
+                             on one coordd: a measured 3-peer shard
+                             plus N-1 singleton neighbors hosted by a
+                             single `manatee-sitter --fleet` process
+                             over ONE multiplexed coordination
+                             connection.  Reports session/connection
+                             amortization, watch-delivery p50/p99
+                             through the coalesced fan-out, coordd CPU
+                             per shard, and the measured shard's
+                             failover with every neighbor churning —
+                             per_shard breakdown in the JSON.
+
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
 every perf PR's effect is attributable stage by stage; the breakdown
@@ -90,7 +104,10 @@ DISCONNECT_GRACE = 0.35
 
 ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
-               "incremental_rebuild")
+               "incremental_rebuild", "control_plane_scale")
+# total shards in the control_plane_scale leg: one measured 3-peer
+# shard + (N-1) singleton neighbors in ONE fleet sitter process
+SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
 # raw payload of the restore_throughput leg: large enough that stream
 # setup (REST round trip, listener, tar spawn) is not the whole
 # number, small enough for a CI smoke lane
@@ -344,6 +361,291 @@ async def bench_incremental_rebuild() -> dict:
         return out
 
 
+def _percentile(samples: list[float], pct: float) -> float:
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    idx = min(len(xs) - 1, max(0, int(round(pct / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def _proc_cpu_seconds(pid: int) -> float:
+    """utime+stime of one process from /proc (coordd CPU accounting)."""
+    with open("/proc/%d/stat" % pid) as fh:
+        fields = fh.read().rsplit(")", 1)[1].split()
+    return (int(fields[11]) + int(fields[12])) \
+        / os.sysconf("SC_CLK_TCK")
+
+
+def _metric_value(text: str, name: str) -> float | None:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return None
+
+
+async def bench_control_plane_scale() -> dict:
+    """Fleet-scale control-plane leg: one coordd, one measured 3-peer
+    shard (full harness), and SCALE_SHARDS-1 singleton neighbor shards
+    hosted by ONE `manatee-sitter --fleet` process over a single
+    multiplexed coordination connection.  Reports steady-state
+    session/connection counts, watch-delivery p50/p99 through the
+    coalesced fan-out + mux demux path, coordd CPU per shard, and the
+    measured shard's failover_to_writable while every neighbor churns
+    — with a per_shard breakdown for the scaling curve."""
+    from manatee_tpu.coord.client import NetCoord, mux_handle
+    from manatee_tpu.storage import DirBackend
+    from tests.harness import (
+        alloc_port_block,
+        kill_fleet_sitter,
+        spawn_fleet_sitter,
+    )
+    from tests.test_partition import http_get
+
+    n_shards = max(2, SCALE_SHARDS)
+    n_neighbors = n_shards - 1
+    churn_rounds = int(os.environ.get("MANATEE_SCALE_ROUNDS", "10"))
+
+    with tempfile.TemporaryDirectory(prefix="manatee-bench-cp-") as d:
+        tmp = Path(d)
+        (tmp / "measured").mkdir()
+        cluster = ClusterHarness(tmp / "measured", n_peers=3,
+                                 n_coord=1,
+                                 session_timeout=SESSION_TIMEOUT,
+                                 disconnect_grace=DISCONNECT_GRACE)
+        fleet_proc = None
+        handles: list = []
+        writer = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-scale", timeout=60)
+
+            # ---- the neighbor fleet: N-1 singleton shards, 1 process
+            base_port = alloc_port_block(4 * n_neighbors + 1)
+            status_port = base_port + 4 * n_neighbors
+            froot = tmp / "fleet"
+            froot.mkdir()
+            names = ["s%02d" % k for k in range(n_neighbors)]
+            shard_entries = []
+            for k, name in enumerate(names):
+                b = base_port + 4 * k
+                sroot = froot / name
+                store = str(sroot / "store")
+                be = DirBackend(store)
+                if not await be.exists("manatee"):
+                    await be.create("manatee")
+                shard_entries.append({
+                    "name": name,
+                    "shardPath": "/manatee/%s" % name,
+                    "postgresPort": b,
+                    "backupPort": b + 2,
+                    "zfsPort": b + 3,
+                    "dataDir": str(sroot / "data"),
+                    "storageRoot": store,
+                })
+            fleet_cfg = {
+                "ip": "127.0.0.1",
+                "dataset": "manatee/pg",
+                "storageBackend": "dir",
+                "pgEngine": "sim",
+                "oneNodeWriteMode": True,
+                "statusPort": status_port,
+                "healthChkInterval": 0.5,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": SESSION_TIMEOUT,
+                             "disconnectGrace": DISCONNECT_GRACE},
+                "shards": shard_entries,
+            }
+            fleet_proc = await asyncio.to_thread(
+                spawn_fleet_sitter, fleet_cfg, froot)
+
+            # every neighbor writable (singleton primary, gen >= 0)
+            writer = NetCoord(cluster.coord_connstr,
+                              session_timeout=30)
+            await writer.connect()
+            deadline = time.monotonic() + 120
+            pending = set(names)
+            while pending and time.monotonic() < deadline:
+                for name in list(pending):
+                    try:
+                        data, _v = await writer.get(
+                            "/manatee/%s/state" % name)
+                        if (json.loads(data.decode()).get("primary")
+                                or {}).get("id"):
+                            pending.discard(name)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        pass     # shard not bootstrapped yet
+                await asyncio.sleep(0.2)
+            if pending:
+                raise RuntimeError("fleet shards never wrote state: %s"
+                                   % sorted(pending))
+
+            # one bench mux connection carries one handle per neighbor
+            for name in names:
+                handles.append(await mux_handle(
+                    cluster.coord_connstr, session_timeout=30,
+                    name="bench-" + name))
+            churn_paths = []
+            for name in names:
+                path = "/manatee/%s/churn" % name
+                await writer.create(path, b"0")
+                churn_paths.append(path)
+
+            async def churn_round() -> list[tuple[str, float]]:
+                """Arm one watch per neighbor shard through the mux,
+                mutate each churn node, return per-shard delivery
+                latencies (set-send -> demuxed fire)."""
+                loop = asyncio.get_running_loop()
+                futs = []
+                for h, path in zip(handles, churn_paths):
+                    fut = loop.create_future()
+
+                    def cb(_event, fut=fut):
+                        if not fut.done():
+                            fut.set_result(time.monotonic())
+                    await h.get(path, watch=cb)
+                    futs.append(fut)
+                t0s = []
+                for path in churn_paths:
+                    t0s.append(time.monotonic())
+                    await writer.set(path, b"x")
+                out = []
+                for name, t0, fut in zip(names, t0s, futs):
+                    t_fire = await asyncio.wait_for(fut, 30)
+                    out.append((name, t_fire - t0))
+                return out
+
+            # ---- steady-state window: watch latency + coordd CPU
+            coordd_pid = cluster.coord_procs[0].pid
+            cpu0 = _proc_cpu_seconds(coordd_pid)
+            w0 = time.monotonic()
+            per_shard_lat: dict[str, list[float]] = {n: [] for n in names}
+            for _ in range(churn_rounds):
+                for name, lat in await churn_round():
+                    per_shard_lat[name].append(lat)
+            window = time.monotonic() - w0
+            cpu = _proc_cpu_seconds(coordd_pid) - cpu0
+            all_lat = [v for vs in per_shard_lat.values() for v in vs]
+
+            _s, coordd_metrics = await http_get(
+                cluster.coord_metrics_url(0) + "/metrics")
+            _s, fleet_metrics = await http_get(
+                "http://127.0.0.1:%d/metrics" % status_port)
+
+            # ---- failover of the measured shard under neighbor churn
+            stop_churn = asyncio.Event()
+
+            churned = [0]
+
+            async def churn_forever():
+                # keep the neighbors churning THROUGH transient errors:
+                # a single lost watch while coordd absorbs the takeover
+                # must not silently turn the "under churn" measurement
+                # into an unchurned one.  Rounds completed are reported
+                # (failover_churn_rounds) so a quiet window is visible.
+                while not stop_churn.is_set():
+                    try:
+                        await churn_round()
+                        churned[0] += 1
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        if stop_churn.is_set():
+                            return
+                        print("control_plane_scale: churn error "
+                              "during failover (continuing): %r" % e,
+                              file=sys.stderr)
+                        await asyncio.sleep(0.2)
+
+            churn_task = asyncio.create_task(churn_forever())
+            try:
+                t0 = time.monotonic()
+                p1.kill()
+                await cluster.wait_topology(primary=p2, timeout=60)
+                await cluster.wait_writable(p2, "post-scale-failover",
+                                            timeout=60)
+                failover_s = time.monotonic() - t0
+            finally:
+                stop_churn.set()
+                churn_task.cancel()
+                try:
+                    await churn_task
+                except asyncio.CancelledError:
+                    pass       # the cancel we just requested
+                except Exception:
+                    pass       # a mid-round error the cancel cut short
+
+            per_shard = {}
+            for name in names:
+                data, _v = await writer.get("/manatee/%s/state" % name)
+                st = json.loads(data.decode())
+                lats = per_shard_lat[name]
+                per_shard[name] = {
+                    "generation": st.get("generation"),
+                    "watch_events": len(lats),
+                    "watch_p50_ms": round(
+                        _percentile(lats, 50) * 1e3, 2),
+                    "watch_p99_ms": round(
+                        _percentile(lats, 99) * 1e3, 2),
+                }
+
+            out = {
+                "shards": n_shards,
+                "neighbors": n_neighbors,
+                "coordd_sessions": _metric_value(
+                    coordd_metrics, "coordd_sessions"),
+                "coordd_connections": _metric_value(
+                    coordd_metrics, "coordd_connections"),
+                "fleet_coord_connections": _metric_value(
+                    fleet_metrics, "manatee_coord_connections"),
+                "fleet_coord_sessions": _metric_value(
+                    fleet_metrics, "manatee_coord_sessions"),
+                "fleet_mux_handles": _metric_value(
+                    fleet_metrics, "manatee_coord_mux_handles"),
+                "coordd_cpu_core_per_shard": round(
+                    cpu / window / n_shards, 5) if window else None,
+                "watch_p50_ms": round(_percentile(all_lat, 50) * 1e3, 2),
+                "watch_p99_ms": round(_percentile(all_lat, 99) * 1e3, 2),
+                "failover_s": round(failover_s, 3),
+                "failover_churn_rounds": churned[0],
+                "per_shard": per_shard,
+            }
+            print("control_plane_scale: %d shards, fleet process "
+                  "coord connections=%s sessions=%s (mux handles=%s); "
+                  "watch p50=%.2fms p99=%.2fms; coordd cpu/shard=%s "
+                  "core; failover with %d churning neighbors %.2fs"
+                  % (n_shards, out["fleet_coord_connections"],
+                     out["fleet_coord_sessions"],
+                     out["fleet_mux_handles"], out["watch_p50_ms"],
+                     out["watch_p99_ms"],
+                     out["coordd_cpu_core_per_shard"], n_neighbors,
+                     failover_s), file=sys.stderr)
+            return out
+        finally:
+            for h in handles:
+                try:
+                    await h.close()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+            if writer is not None:
+                try:
+                    await writer.close()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+            if fleet_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, fleet_proc)
+            await cluster.stop()
+
+
 async def main() -> None:
     picked = selected_configs()
     results: dict[str, float] = {}
@@ -356,7 +658,8 @@ async def main() -> None:
                               "grab_trace": True},
     }
     for name in picked:
-        if name in ("restore_throughput", "incremental_rebuild"):
+        if name in ("restore_throughput", "incremental_rebuild",
+                    "control_plane_scale"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -367,6 +670,14 @@ async def main() -> None:
     incremental = None
     if "incremental_rebuild" in picked:
         incremental = await bench_incremental_rebuild()
+    scale = None
+    if "control_plane_scale" in picked:
+        scale = await bench_control_plane_scale()
+        if results.get("single"):
+            # the acceptance ratio: one shard's failover with N-1
+            # churning neighbors vs the quiet single-coordd leg
+            scale["failover_vs_single"] = round(
+                scale["failover_s"] / results["single"], 2)
 
     # the deployed configuration is the one reported; CI smoke lanes
     # that skip it fall back to whatever failover leg ran
@@ -384,6 +695,8 @@ async def main() -> None:
         out["restore_throughput_mb_s"] = round(throughput, 1)
     if incremental is not None:
         out["incremental_rebuild"] = incremental
+    if scale is not None:
+        out["control_plane_scale"] = scale
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

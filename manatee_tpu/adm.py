@@ -24,9 +24,9 @@ import json
 import os
 from dataclasses import dataclass
 
-from manatee_tpu.coord.api import BadVersionError, NoNodeError, \
-    cluster_state_txn
-from manatee_tpu.coord.client import NetCoord
+from manatee_tpu.coord.api import BadVersionError, CoordClient, \
+    NoNodeError, cluster_state_txn
+from manatee_tpu.coord.client import mux_handle
 from manatee_tpu.pg.engine import PgError, parse_pg_url
 from manatee_tpu.state.types import role_of
 from manatee_tpu.utils import iso_ms as _now_iso
@@ -330,7 +330,7 @@ class AdmClient:
         'h1:p1,h2:p2' (zkCfg.connStr parity)."""
         self.coord_addr = coord_addr
         self.base_path = base_path
-        self._client: NetCoord | None = None
+        self._client: CoordClient | None = None
 
     async def __aenter__(self):
         await self.connect()
@@ -340,8 +340,14 @@ class AdmClient:
         await self.close()
 
     async def connect(self) -> None:
-        self._client = NetCoord(self.coord_addr, session_timeout=30)
-        await asyncio.wait_for(self._client.connect(), 10)
+        # the process-wide mux pool: concurrent AdmClients in one
+        # process (topology fan-outs, harness probes) share one
+        # connection and one session.  NOTE the pool keys on (connstr,
+        # session params), so an embedding process only shares ITS
+        # connection with adm when its session_timeout is also 30 —
+        # otherwise adm dials its own, exactly as requested.
+        self._client = await asyncio.wait_for(
+            mux_handle(self.coord_addr, session_timeout=30), 10)
 
     async def close(self) -> None:
         if self._client:

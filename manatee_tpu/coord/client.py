@@ -59,6 +59,19 @@ _SESSION_EVENTS = _REG.counter(
     "coord_session_events_total",
     "coordination session lifecycle events "
     "(connected/disconnected/expired)", ("event",))
+# Amortization gauges: with the mux pool, N shards in one process show
+# coord_connections/coord_sessions of 1 and coord_mux_handles of N —
+# the before/after of fleet mode in one scrape (docs/performance.md).
+_CONNECTIONS = _REG.gauge(
+    "coord_connections",
+    "open coordination TCP connections from this process")
+_SESSIONS = _REG.gauge(
+    "coord_sessions",
+    "live coordination sessions owned by this process")
+_MUX_HANDLES = _REG.gauge(
+    "coord_mux_handles",
+    "logical coordination handles multiplexed over this process's "
+    "pooled connections")
 
 _ERRS = {
     "NoNodeError": NoNodeError,
@@ -168,6 +181,10 @@ class NetCoord(CoordClient):
         self._closed = False
         self._expired = False
         self._connected = asyncio.Event()
+        # gauge bookkeeping (inc exactly once per live connection /
+        # session, dec exactly once however it ends)
+        self._conn_counted = False
+        self._sess_counted = False
 
     # ---- lifecycle ----
 
@@ -281,6 +298,13 @@ class NetCoord(CoordClient):
         if res.get("disconnect_grace") is not None:
             self._disconnect_grace = float(res["disconnect_grace"])
         self._connected.set()
+        if not self._conn_counted:
+            _CONNECTIONS.inc()
+            self._conn_counted = True
+        if not self._sess_counted:
+            # a resume keeps the same session; only count it once
+            _SESSIONS.inc()
+            self._sess_counted = True
         if self._ping_task is None or self._ping_task.done():
             self._ping_task = asyncio.create_task(self._ping_loop())
         self._notify("connected")
@@ -313,6 +337,13 @@ class NetCoord(CoordClient):
                 await self._writer.wait_closed()
             except (ConnectionError, RuntimeError):
                 pass
+        if self._conn_counted:
+            _CONNECTIONS.dec()
+            self._conn_counted = False
+        if self._sess_counted:
+            # a clean close ends the session (goodbye above)
+            _SESSIONS.dec()
+            self._sess_counted = False
         self._fail_pending(ConnectionLossError("closed"))
 
     @property
@@ -366,6 +397,9 @@ class NetCoord(CoordClient):
 
     def _on_disconnect(self) -> None:
         self._connected.clear()
+        if self._conn_counted:
+            _CONNECTIONS.dec()
+            self._conn_counted = False
         self._fail_pending(ConnectionLossError("connection lost"))
         if self._expired or self._closed:
             return
@@ -399,6 +433,9 @@ class NetCoord(CoordClient):
         if self._expired:
             return
         self._expired = True
+        if self._sess_counted:
+            _SESSIONS.dec()
+            self._sess_counted = False
         self._watches.clear()
         self._fail_pending(SessionExpiredError(self._session_id or "?"))
         self._notify("expired")
@@ -626,3 +663,426 @@ class NetCoord(CoordClient):
                 "sequential": op.sequential,
             })
         return await self._request({"op": "multi", "ops": wire_ops})
+
+
+# ---- session multiplexing (fleet mode) ----
+#
+# One process running N shards used to open N coordination connections,
+# N sessions, and N ping loops against coordd.  CoordMux owns ONE
+# NetCoord and hands out refcounted logical handles: every handle is a
+# full CoordClient (same reply-deadline, backoff, and reconnect
+# semantics — they are the shared NetCoord's), watch delivery is
+# demultiplexed back to the arming handle, and session lifecycle
+# events fan out to every handle, so each shard's ConsensusMgr reacts
+# to an expiry exactly as it would on a private client (it rebuilds
+# via its factory, which lands back on the pooled mux — the pool dials
+# one fresh connection however many shards rebuild).
+#
+# The deliberate semantic shift: all handles share one SESSION, so
+# every shard's election ephemerals live and die with the process —
+# the process is the failure domain, which is exactly what fleet mode
+# means (a SIGKILLed fleet sitter fails over all of its shards via one
+# FIN + disconnect-grace expiry instead of N session timeouts).
+
+
+class _HandleWatch:
+    """A one-shot watch armed by a handle on the shared client.  When
+    the shared read loop fires it, the event is queued to the mux's
+    demux pump, which re-attributes it to the arming handle."""
+
+    __slots__ = ("handle", "kind", "path", "cb", "client")
+
+    def __init__(self, handle: "MuxHandle", kind: str, path: str,
+                 cb: WatchCb, client: NetCoord):
+        self.handle = handle
+        self.kind = kind
+        self.path = path
+        self.cb = cb
+        self.client = client      # the generation it was armed on
+
+    def __call__(self, event: WatchEvent) -> None:
+        h = self.handle
+        h._armed.discard(self)    # consumed (one-shot)
+        h._mux._enqueue(h, self.cb, event)
+
+
+class MuxHandle(CoordClient):
+    """One logical coordination client multiplexed over a shared
+    connection (see :class:`CoordMux`).  Obtain via
+    :meth:`CoordMux.handle` or the process-wide :func:`mux_handle`."""
+
+    def __init__(self, mux: "CoordMux", name: str | None):
+        self._mux = mux
+        self.name = name
+        self._closed = False
+        self._session_cbs: list[Callable[[str], None]] = []
+        self._armed: set[_HandleWatch] = set()
+
+    def __repr__(self) -> str:
+        return "<MuxHandle %s of %r>" % (self.name or "?", self._mux)
+
+    def _client(self) -> NetCoord:
+        if self._closed:
+            raise ConnectionLossError("mux handle closed")
+        c = self._mux._client
+        if c is None:
+            raise ConnectionLossError("mux not connected")
+        return c
+
+    # -- lifecycle --
+
+    async def connect(self) -> None:
+        if self._closed:
+            raise ConnectionLossError("mux handle closed")
+        await self._mux._ensure_client()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        await self._mux._release(self)
+
+    @property
+    def session_id(self) -> str | None:
+        if self._closed:
+            return None
+        c = self._mux._client
+        return None if c is None else c.session_id
+
+    def on_session_event(self, cb: Callable[[str], None]) -> None:
+        self._session_cbs.append(cb)
+
+    def _fire_session(self, event: str) -> None:
+        for cb in list(self._session_cbs):
+            try:
+                cb(event)
+            except Exception:
+                log.exception("mux session callback failed")
+
+    # -- watch plumbing --
+
+    def _wrap(self, kind: str, path: str, cb: WatchCb | None,
+              client: NetCoord) -> _HandleWatch | None:
+        if cb is None:
+            return None
+        w = _HandleWatch(self, kind, path, cb, client)
+        self._armed.add(w)
+        return w
+
+    # -- ops (delegated; the shared client's semantics apply) --
+
+    async def create(self, path: str, data: bytes = b"", *,
+                     ephemeral: bool = False,
+                     sequential: bool = False) -> str:
+        return await self._client().create(
+            path, data, ephemeral=ephemeral, sequential=sequential)
+
+    async def get(self, path: str, watch: WatchCb | None = None
+                  ) -> tuple[bytes, int]:
+        data, version, _ctime = await self.get_full(path, watch)
+        return data, version
+
+    async def get_full(self, path: str, watch: WatchCb | None = None
+                       ) -> tuple[bytes, int, float]:
+        c = self._client()
+        w = self._wrap("data", path, watch, c)
+        try:
+            return await c.get_full(path, watch=w)
+        except CoordError:
+            # the shared client disarmed the wrapper from its own
+            # table; drop our tracking entry too
+            if w is not None:
+                self._armed.discard(w)
+            raise
+
+    async def set(self, path: str, data: bytes, version: int = -1) -> int:
+        return await self._client().set(path, data, version)
+
+    async def delete(self, path: str, version: int = -1) -> None:
+        await self._client().delete(path, version)
+
+    async def exists(self, path: str, watch: WatchCb | None = None
+                     ) -> Stat | None:
+        c = self._client()
+        w = self._wrap("data", path, watch, c)
+        try:
+            return await c.exists(path, watch=w)
+        except CoordError:
+            if w is not None:
+                self._armed.discard(w)
+            raise
+
+    async def get_children(self, path: str, watch: WatchCb | None = None
+                           ) -> list[str]:
+        c = self._client()
+        w = self._wrap("children", path, watch, c)
+        try:
+            return await c.get_children(path, watch=w)
+        except CoordError:
+            if w is not None:
+                self._armed.discard(w)
+            raise
+
+    async def multi(self, ops: list[Op]) -> list:
+        return await self._client().multi(ops)
+
+
+class CoordMux:
+    """Owns one :class:`NetCoord` (connection + session + ping loop)
+    and hands out refcounted :class:`MuxHandle` logical clients.
+
+    Watch demultiplexing runs through a single pump task so delivery
+    order is preserved across handles and the ``coord.mux.demux``
+    failpoint covers the seam.  When the shared session expires, every
+    handle observes ``expired``; the next :meth:`handle` (or
+    ``connect``) call rebuilds ONE fresh underlying client for all of
+    them.  When the last handle is released the connection is closed
+    and the mux (if pooled) leaves the pool."""
+
+    def __init__(self, connstr: str, *, session_timeout: float = 60.0,
+                 disconnect_grace: float | None = None,
+                 pool_key: tuple | None = None):
+        self._connstr = connstr
+        self._session_timeout = session_timeout
+        self._disconnect_grace = disconnect_grace
+        self._pool_key = pool_key
+        self._client: NetCoord | None = None
+        self._handles: set[MuxHandle] = set()
+        self._lock = asyncio.Lock()
+        self._queue: asyncio.Queue | None = None
+        self._demux_task: asyncio.Task | None = None
+        self._closed = False
+        # the loop this mux's primitives belong to: a pooled mux is
+        # unusable from any OTHER loop (mux_handle evicts it there)
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._loop = None
+
+    def __repr__(self) -> str:
+        return "<CoordMux %s handles=%d>" % (self._connstr,
+                                             len(self._handles))
+
+    @property
+    def handle_count(self) -> int:
+        return len(self._handles)
+
+    async def handle(self, name: str | None = None) -> MuxHandle:
+        """Acquire a connected logical handle (dials the shared
+        connection first if needed — raises like NetCoord.connect)."""
+        await self._ensure_client()
+        if self._closed:
+            # lost a race with the last release closing the mux
+            raise ConnectionLossError("mux closed")
+        h = MuxHandle(self, name)
+        self._handles.add(h)
+        _MUX_HANDLES.inc()
+        return h
+
+    async def _ensure_client(self) -> None:
+        async with self._lock:
+            if self._closed:
+                raise ConnectionLossError("mux closed")
+            c = self._client
+            if c is not None and not c._expired and not c._closed:
+                return
+            client = NetCoord(self._connstr,
+                              session_timeout=self._session_timeout,
+                              disconnect_grace=self._disconnect_grace)
+            await client.connect()
+            if self._closed:
+                # the mux retired (last release / expiry) while we
+                # dialed: don't strand a connected client nobody owns
+                try:
+                    await client.close()
+                except (CoordError, OSError):
+                    pass
+                raise ConnectionLossError("mux closed")
+            client.on_session_event(self._on_session)
+            self._client = client
+            if self._queue is None:
+                self._queue = asyncio.Queue()
+            if self._demux_task is None or self._demux_task.done():
+                self._demux_task = asyncio.create_task(
+                    self._demux_loop())
+
+    def _on_session(self, event: str) -> None:
+        # fan the shared session's lifecycle out to every logical
+        # handle: each shard's ConsensusMgr sees the same 'expired' it
+        # would on a private client and rebuilds through its factory
+        for h in list(self._handles):
+            h._fire_session(event)
+        if event == "expired":
+            self._retire()
+
+    def _retire(self) -> None:
+        """Session expiry is terminal for a NetCoord, so it is terminal
+        for the mux built on it: every handle is dead (the layer above
+        each one rebuilds through its factory, which lands on a FRESH
+        pooled mux — one dial however many shards rebuild).  Retiring
+        here is also what keeps refcounts honest: nothing above ever
+        close()es an expired client, so dead handles must not hold the
+        pool slot open forever."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._pool_key is not None \
+                and _MUX_POOL.get(self._pool_key) is self:
+            del _MUX_POOL[self._pool_key]
+        handles = list(self._handles)
+        self._handles.clear()
+        for h in handles:
+            h._closed = True
+            h._armed.clear()     # the expired client dropped its table
+        if handles:
+            _MUX_HANDLES.dec(len(handles))
+        # wake the demux pump so it drains and EXITS on its own (we are
+        # in a sync callback and cannot await a cancelled task here);
+        # the expired client's own tasks self-terminate on its flags
+        if self._queue is not None:
+            self._queue.put_nowait(None)
+        self._client = None
+
+    def _enqueue(self, handle: MuxHandle, cb: WatchCb,
+                 event: WatchEvent) -> None:
+        q = self._queue
+        if q is None or handle._closed:
+            return
+        q.put_nowait((handle, cb, event))
+
+    async def _demux_loop(self) -> None:
+        try:
+            while True:
+                item = await self._queue.get()
+                if item is None:
+                    if self._closed:
+                        return     # retire sentinel: drain and exit
+                    continue
+                handle, cb, event = item
+                # THE demux seam: one shared connection's watch stream
+                # fanning back out to per-shard logical handles.  drop
+                # = a lost watch (the anti-entropy pass is the
+                # insurance); crash = the sweep's process death here.
+                if await faults.point("coord.mux.demux") == "drop":
+                    continue
+                if handle._closed:
+                    continue
+                try:
+                    cb(event)
+                except Exception:
+                    log.exception("mux watch callback failed")
+        except asyncio.CancelledError:
+            raise
+
+    async def _release(self, handle: MuxHandle) -> None:
+        if handle not in self._handles:
+            return
+        self._handles.discard(handle)
+        _MUX_HANDLES.dec()
+        for w in list(handle._armed):
+            # disarm from the client GENERATION each watch was armed
+            # on (an expired predecessor already cleared its table;
+            # _disarm is tolerant of that)
+            w.client._disarm(w.kind, w.path, w)
+        handle._armed.clear()
+        if not self._handles:
+            await self._close_now()
+
+    async def _close_now(self) -> None:
+        self._closed = True
+        if self._pool_key is not None \
+                and _MUX_POOL.get(self._pool_key) is self:
+            del _MUX_POOL[self._pool_key]
+        t = self._demux_task
+        if t is not None:
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._demux_task = None
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                await client.close()
+            except (CoordError, OSError):
+                pass
+
+
+# key -> live mux.  Keyed on the full session parameters, not just the
+# connstr: two callers asking for different timeouts must not silently
+# share a session whose timeout only matches one of them.
+_MUX_POOL: dict[tuple, CoordMux] = {}
+
+
+async def mux_handle(connstr: str, *, session_timeout: float = 60.0,
+                     disconnect_grace: float | None = None,
+                     name: str | None = None) -> MuxHandle:
+    """The process-wide mux pool: every caller asking for the same
+    coordd (connstr + session parameters) — fleet-mode shards, a
+    single sitter, adm, the test harness — rides ONE TCP connection
+    and ONE session.  Returns a connected logical handle; closing the
+    last handle closes the connection and empties the pool slot."""
+    key = (connstr, float(session_timeout),
+           None if disconnect_grace is None else float(disconnect_grace))
+    loop = asyncio.get_running_loop()
+    while True:
+        mux = _MUX_POOL.get(key)
+        if mux is not None and mux._loop is not None \
+                and mux._loop is not loop:
+            if not mux._loop.is_closed():
+                # a LIVE loop (another thread) owns the slot: its mux
+                # cannot serve this loop, and mutating it cross-thread
+                # would tear down shards it is actively running.  This
+                # caller rides a private, unpooled mux instead.
+                private = CoordMux(connstr,
+                                   session_timeout=session_timeout,
+                                   disconnect_grace=disconnect_grace)
+                try:
+                    return await private.handle(name=name)
+                except BaseException:
+                    await private._close_now()
+                    raise
+            # a DEAD loop's mux, kept alive by handles its loop died
+            # still holding (a leak in that loop's owner): its
+            # lock/queue/tasks are bound to the dead loop, so it
+            # cannot serve this one.  Drop the slot and settle the
+            # gauges the dead loop never will.
+            mux._closed = True
+            if mux._handles:
+                _MUX_HANDLES.dec(len(mux._handles))
+                for h in mux._handles:
+                    h._closed = True
+                mux._handles.clear()
+            c, mux._client = mux._client, None
+            if c is not None:
+                if c._conn_counted:
+                    _CONNECTIONS.dec()
+                    c._conn_counted = False
+                if c._sess_counted:
+                    _SESSIONS.dec()
+                    c._sess_counted = False
+            del _MUX_POOL[key]
+            mux = None
+        if mux is None or mux._closed:
+            mux = CoordMux(connstr, session_timeout=session_timeout,
+                           disconnect_grace=disconnect_grace,
+                           pool_key=key)
+            _MUX_POOL[key] = mux
+        try:
+            return await mux.handle(name=name)
+        except ConnectionLossError:
+            if mux._closed:
+                continue    # raced the last release; retry on a fresh mux
+            if not mux._handles and mux._client is None:
+                await mux._close_now()
+            raise
+        except BaseException:
+            # a failed FIRST dial must not leave a dead zero-handle
+            # entry squatting the pool slot (its lock is bound to THIS
+            # event loop; a later loop reusing the connstr would trip
+            # over it).  A mux with live handles stays: the failure
+            # belongs to this caller, not to them.
+            if not mux._handles and mux._client is None:
+                await mux._close_now()
+            raise

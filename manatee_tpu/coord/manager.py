@@ -103,6 +103,10 @@ class ConsensusMgr:
 
         self._client: CoordClient | None = None
         self._inited = False
+        # full path of OUR current election ephemeral (create returns
+        # the sequenced name); deleted explicitly on close() because a
+        # pooled mux handle's close cannot end the shared session
+        self._my_election_node: str | None = None
         self._ready = False    # current client fully set up (joined)
         self._closed = False
         self._active: list[dict] = []
@@ -255,6 +259,19 @@ class ConsensusMgr:
                 t.cancel()
             await asyncio.gather(*rearms, return_exceptions=True)
         if self._client:
+            if self._my_election_node is not None:
+                # prompt departure: a private client's close() ends its
+                # session and drops this ephemeral implicitly, but a
+                # pooled mux handle's close() leaves the SHARED session
+                # (and everything it owns) alive for the sibling
+                # shards — delete our election entry explicitly so
+                # peers see this shard leave NOW, not when the last
+                # sibling drains
+                try:
+                    await self._client.delete(self._my_election_node)
+                except (CoordError, OSError):
+                    pass
+                self._my_election_node = None
             try:
                 await self._client.close()
             except (CoordError, OSError):
@@ -356,7 +373,30 @@ class ConsensusMgr:
         await client.mkdirp(self._election_path)
         await client.mkdirp(self._history_path)
         await self._read_state_and_watch(client)
-        await client.create(
+        # sweep our OWN ghosts before (re)joining: election entries
+        # with our ident owned by OUR CURRENT session.  A private
+        # client's ghosts (a failed prior setup attempt) die when
+        # close() ends its session — but a pooled mux handle shares
+        # its session with every other shard in the process, so
+        # close() cannot end it and the ghost would outlive every
+        # retry.  Scoped to our session id on purpose: a fast-restart
+        # predecessor's stale entry rides a DIFFERENT (dying) session
+        # and must be left to expire — membership dedupes it
+        # (parse_and_unique_actives, MANATEE_206) and tests pin the
+        # overlap window.
+        sid = getattr(client, "session_id", None)
+        if sid is not None:
+            for n in await client.get_children(self._election_path):
+                if n[:n.rfind("-")] != self._ident:
+                    continue
+                st = await client.exists(self._election_path + "/" + n)
+                if st is None or st.ephemeral_owner != sid:
+                    continue
+                try:
+                    await client.delete(self._election_path + "/" + n)
+                except NoNodeError:
+                    pass
+        self._my_election_node = await client.create(
             self._election_path + "/" + self._ident + "-",
             json.dumps(self._data).encode(),
             ephemeral=True, sequential=True)

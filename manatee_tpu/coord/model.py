@@ -78,6 +78,10 @@ class ZNodeTree:
         self.sessions: dict[str, Session] = {}
         self._session_counter = 0
         self.on_mutate: Callable[[], None] | None = None
+        # node count (incl. root), maintained incrementally on
+        # create/delete so a /metrics scrape never walks the tree —
+        # scrape cost must not scale with tree size
+        self.node_count = 1
 
     def _mutated(self) -> None:
         if self.on_mutate is not None:
@@ -124,6 +128,13 @@ class ZNodeTree:
         tree = cls()
         if snap.get("v") == 1 and "root" in snap:
             tree._root = build(snap["root"])
+
+            def count(node: _Node) -> int:
+                return 1 + sum(count(c) for c in node.children.values())
+
+            # one load-time walk seeds the incremental counter; every
+            # later mutation maintains it in O(1)
+            tree.node_count = count(tree._root)
         return tree
 
     # ---- sessions ----
@@ -239,6 +250,7 @@ class ZNodeTree:
             raise NodeExistsError(path)
         parent.children[name] = _Node(
             data=bytes(data), ephemeral_owner=ephemeral_owner)
+        self.node_count += 1
         self._mutated()
         self._fire(DATA, path, WatchEvent(EventType.CREATED, path))
         self._fire(CHILDREN, parent_path,
@@ -276,6 +288,7 @@ class ZNodeTree:
             # ephemeral nodes cannot have children in ZK; defensive only
             raise NotEmptyError(path)
         del parent.children[name]
+        self.node_count -= 1
         self._mutated()
         parent_path = path.rpartition("/")[0] or "/"
         self._fire(DATA, path, WatchEvent(EventType.DELETED, path))

@@ -166,6 +166,14 @@ def snapshot_shape_ok(snap) -> bool:
             and "root" in snap and "seq" in snap and "epoch" in snap)
 
 
+def encode_frame(msg: dict) -> bytes:
+    """One wire frame (newline-delimited JSON).  The hot fan-out paths
+    (watch fires, replication ships, leader pings) encode a message
+    ONCE with this and hand the same bytes to every subscriber
+    connection instead of re-serializing per connection."""
+    return (json.dumps(msg) + "\n").encode()
+
+
 def _b64(data: bytes) -> str:
     return base64.b64encode(data).decode()
 
@@ -263,23 +271,62 @@ class _Conn:
         # pair captured after a concurrent op landed carries that op's
         # seq), and a dict of bare futures would drop the first
         self.ack_waiters: dict[int, list[asyncio.Future]] = {}
+        # Coalesced outbound path: frames queue here and ONE flush per
+        # event-loop tick writes them with a single writer.write — a
+        # mutation that fires K watches on this connection (or a burst
+        # of replies) costs one syscall, not K.  The slow-subscriber
+        # sever is keyed on the PRE-EXISTING backlog (what the peer has
+        # failed to drain), never on the frame being pushed — a single
+        # frame larger than the bound (an attach snapshot for a big
+        # tree) on a healthy connection must always be deliverable, as
+        # it was on the uncoalesced path.
+        self._outq: list[bytes] = []
+        self._outq_bytes = 0
+        self._flush_scheduled = False
 
     def push(self, msg: dict) -> None:
+        self.push_bytes(encode_frame(msg))
+
+    def push_bytes(self, data: bytes) -> None:
+        """Queue one pre-encoded frame; fan-out callers encode once and
+        pass the same bytes to every subscriber's push_bytes."""
         if not self.alive:
+            return
+        if self._outq_bytes > self.server.max_buffered:
+            # frames already queued this tick exceed the bound without
+            # being drained: don't let the in-process queue grow
+            # unboundedly either (the new frame is NOT counted — it
+            # must be allowed to be the one oversized frame)
+            self.sever()
+            return
+        self._outq.append(data)
+        self._outq_bytes += len(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        if not self.alive or not self._outq:
+            self._outq.clear()
+            self._outq_bytes = 0
             return
         try:
             buffered = self.writer.transport.get_write_buffer_size()
         except (AttributeError, RuntimeError):
             buffered = 0
         if buffered > self.server.max_buffered:
-            # slow/stalled subscriber: watch pushes would otherwise
-            # buffer unboundedly inside coordd.  Sever it, as ZooKeeper
-            # does with slow clients; its session lives on until the
-            # timeout, so a healthy client reconnects.
+            # slow/stalled subscriber: the transport still holds more
+            # than the bound from PREVIOUS ticks.  Sever it, as
+            # ZooKeeper does with slow clients; its session lives on
+            # until the timeout, so a healthy client reconnects.
             self.sever()
             return
+        data = b"".join(self._outq)
+        self._outq.clear()
+        self._outq_bytes = 0
         try:
-            self.writer.write((json.dumps(msg) + "\n").encode())
+            self.writer.write(data)
         except (ConnectionError, RuntimeError):
             self.alive = False
 
@@ -293,8 +340,9 @@ class _Conn:
 
     def watch_sink(self, kind: str):
         def sink(event):
-            self.push({"watch": {"kind": kind, "type": event.type.value,
-                                 "path": event.path}})
+            # the frame is encoded ONCE per (event, kind) no matter how
+            # many connections subscribed — see CoordServer._watch_frame
+            self.push_bytes(self.server._watch_frame(kind, event))
         sink.__owner__ = self
         return sink
 
@@ -390,7 +438,29 @@ class CoordServer:
         self.metrics_port = metrics_port
         self._metrics_runner = None
         self._mutations = 0
+        # serialize-once watch fan-out: one-entry memo keyed on the
+        # identity of the WatchEvent the tree is currently firing (all
+        # K subscriber sinks for one mutation run consecutively), plus
+        # a counter tests/operators can pin the guarantee on
+        self._watch_memo: tuple | None = None
+        self._watch_encodes = 0
         self._wire_tree(self.tree)
+
+    def _watch_frame(self, kind: str, event) -> bytes:
+        """The wire frame for one watch fire, encoded exactly once per
+        (event, kind) and shared by every subscribed connection.  The
+        memo keys on the event OBJECT: ZNodeTree._fire builds one event
+        and calls all sinks for it synchronously, so a single entry is
+        exact — a mutation touching K watchers serializes once."""
+        memo = self._watch_memo
+        if memo is not None and memo[0] is event and memo[1] == kind:
+            return memo[2]
+        data = encode_frame({"watch": {"kind": kind,
+                                       "type": event.type.value,
+                                       "path": event.path}})
+        self._watch_memo = (event, kind, data)
+        self._watch_encodes += 1
+        return data
 
     def _wire_tree(self, tree: model.ZNodeTree) -> None:
         """One on_mutate hook per tree: count mutations (for /metrics).
@@ -976,13 +1046,17 @@ class CoordServer:
             b.metric("ensemble_size", "gauge",
                      "configured member count", len(self.ensemble))
 
-        def count_nodes(node) -> int:
-            return 1 + sum(count_nodes(c) for c in node.children.values())
-
+        # incremental gauge maintained by ZNodeTree on mutate: scrape
+        # cost must not scale with tree size (the old implementation
+        # walked the whole tree here, per scrape)
         b.metric("znodes", "gauge", "nodes in the tree (incl. root)",
-                 count_nodes(self.tree._root))
+                 self.tree.node_count)
         b.metric("watches", "gauge", "registered one-shot watches",
                  sum(len(v) for v in self.tree._watches.values()))
+        b.metric("watch_serializations_total", "counter",
+                 "watch events serialized for fan-out (one per fired "
+                 "event, however many connections subscribe)",
+                 self._watch_encodes)
         b.histogram(_RPC_HANDLE.name, _RPC_HANDLE.help,
                     _RPC_HANDLE.buckets, _RPC_HANDLE.series())
         return b.render()
@@ -1428,6 +1502,9 @@ class CoordServer:
         self._shipped_seq = max(self._shipped_seq, seq)
         if not self._follower_conns:
             return 0
+        # one serialization for the whole follower set (a 5-member
+        # ensemble used to pay 4 json.dumps of the same ship)
+        frame = encode_frame(msg)
         loop = asyncio.get_running_loop()
         waiters: list[tuple[_Conn, asyncio.Future]] = []
         acks = 0
@@ -1456,7 +1533,7 @@ class CoordServer:
                 continue
             fut = loop.create_future()
             f.ack_waiters.setdefault(seq, []).append(fut)
-            f.push(msg)
+            f.push_bytes(frame)
             waiters.append((f, fut))
         need = self._quorum_needed()
         # followers needed beyond ourselves; no-quorum ensembles (2
@@ -1524,11 +1601,13 @@ class CoordServer:
         interval = max(self.tick * 2, 0.5)
         while not self._stopping and self.role == "leader":
             await asyncio.sleep(interval)
-            for f in list(self._follower_conns):
+            ping = encode_frame(
                 # advertise the last SHIPPED seq: self._seq may be
                 # ahead of the stream while a mutation awaits its log
                 # fsync, and an unshipped seq would read as drift
-                f.push({"sync_ping": {"seq": self._shipped_seq}})
+                {"sync_ping": {"seq": self._shipped_seq}})
+            for f in list(self._follower_conns):
+                f.push_bytes(ping)
             # probe the other members CONCURRENTLY: sequential 0.5s
             # probe timeouts against unreachable members would stretch
             # the gap between sync_pings past the followers' idle

@@ -9,25 +9,63 @@ import signal
 import sys
 
 from manatee_tpu.utils.logutil import setup_logging
-from manatee_tpu.utils.validation import ConfigError, load_json_config
+from manatee_tpu.utils.validation import (
+    ConfigError,
+    load_json_config,
+    validate_config,
+)
 
 
-def parse_daemon_args(description: str, argv=None) -> argparse.Namespace:
+def parse_daemon_args(description: str, argv=None, *,
+                      fleet: bool = False) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=description)
-    p.add_argument("-f", "--config", required=True,
+    p.add_argument("-f", "--config", required=not fleet,
                    help="JSON config file path")
+    if fleet:
+        p.add_argument("--fleet", metavar="SHARDS_JSON", default=None,
+                       help="fleet mode: JSON config with a `shards` "
+                            "list — run every shard's state machine "
+                            "in this one process over one multiplexed "
+                            "coordination connection")
     p.add_argument("-v", "--verbose", action="count", default=0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if fleet:
+        if args.config and args.fleet:
+            p.error("-f/--config and --fleet are mutually exclusive")
+        if not args.config and not args.fleet:
+            p.error("one of -f/--config or --fleet is required")
+    return args
 
 
 def daemon_main(name: str, description: str, schema: dict | None,
-                run_coro_factory, argv=None) -> None:
+                run_coro_factory, argv=None, *,
+                fleet_schema: dict | None = None) -> None:
     """Parse args, load config, set up logging, run until SIGINT/SIGTERM.
-    *run_coro_factory(cfg)* returns (start_coro, stop_coro_factory)."""
-    args = parse_daemon_args(description, argv)
+    *run_coro_factory(cfg)* returns (start_coro, stop_coro_factory).
+
+    *fleet_schema*: enables the ``--fleet`` flag (and the ``shards``
+    config key) for this daemon.  A config carrying a ``shards`` list —
+    whether it arrived via ``--fleet`` or plain ``-f`` — is validated
+    against *fleet_schema* instead of *schema*; the daemon validates
+    each merged per-shard config itself (sitter.start_fleet)."""
+    fleet = fleet_schema is not None
+    args = parse_daemon_args(description, argv, fleet=fleet)
     setup_logging(name, args.verbose)
+    path = args.fleet if fleet and args.fleet else args.config
     try:
-        cfg = load_json_config(args.config, schema, name=name)
+        # load WITHOUT a schema first: which schema applies depends on
+        # whether the config is a fleet config (`shards` key)
+        cfg = load_json_config(path, None, name=name)
+        if not isinstance(cfg, dict):
+            raise ConfigError("%s: config must be a JSON object, "
+                              "not %s" % (path, type(cfg).__name__))
+        is_fleet_cfg = fleet and isinstance(cfg.get("shards"), list)
+        if fleet and args.fleet and not is_fleet_cfg:
+            raise ConfigError(
+                "--fleet config %s has no `shards` list" % path)
+        use_schema = fleet_schema if is_fleet_cfg else schema
+        if use_schema is not None:
+            validate_config(cfg, use_schema, name=name)
     except ConfigError as e:
         sys.stderr.write("%s: %s\n" % (name, e))
         sys.exit(2)
@@ -41,4 +79,11 @@ def daemon_main(name: str, description: str, schema: dict | None,
         await stop_evt.wait()
         await stopper()
 
-    asyncio.run(run())
+    try:
+        asyncio.run(run())
+    except ConfigError as e:
+        # config errors the daemon itself raises at startup (the fleet
+        # path validates each merged per-shard config in start_fleet)
+        # exit like any other config error, not as a crash
+        sys.stderr.write("%s: %s\n" % (name, e))
+        sys.exit(2)

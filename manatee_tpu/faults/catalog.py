@@ -83,6 +83,15 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/coord/client.py",),
         ("error", "delay", "stall", "drop", "crash"),
     ),
+    "coord.mux.demux": (
+        "mux watch demultiplexer: where one shared coordd "
+        "connection's watch stream fans back out to per-shard logical "
+        "handles (fleet mode); drop = a lost watch the anti-entropy "
+        "pass must heal, stall = the whole mux's watch plane wedges "
+        "until cleared",
+        ("manatee_tpu/coord/client.py",),
+        ("delay", "stall", "drop", "crash"),
+    ),
     "coord.put_state": (
         "consensus manager's durable cluster-state transaction "
         "(state + history, one multi)",

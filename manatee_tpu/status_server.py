@@ -23,6 +23,15 @@ scrape bunyan logs):
 - ``GET/POST/DELETE /faults`` the sitter process's live fault-injection
   surface (`manatee_tpu.faults`): list armed rules + the failpoint
   catalog, arm by spec, disarm — what `manatee-adm fault` talks to.
+
+Fleet mode (``manatee-sitter --fleet``, docs/user-guide.md): ONE
+status server fronts every shard the process runs.  Per-shard routes
+live under ``/shards/<name>/...`` (``ping``/``state``/``restore``),
+``GET /shards`` lists them, the legacy single-shard paths keep working
+(bound to the first shard, so probes written for one-shard sitters
+stay valid), and ``/metrics`` carries a ``shard`` label on every
+state-derived gauge.  ``/events``/``/spans``/``/faults`` stay
+process-wide — journal, spans, and fault registry are per process.
 """
 
 from __future__ import annotations
@@ -39,14 +48,42 @@ from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
 log = logging.getLogger("manatee.status")
 
 
-class StatusServer:
-    def __init__(self, *, host: str = "0.0.0.0", port: int,
-                 pg_mgr=None, state_machine=None, restore_client=None):
-        self.host = host
-        self.port = port
+class _ShardEntry:
+    """One shard's introspection surfaces (fleet mode runs several)."""
+
+    __slots__ = ("name", "pg_mgr", "state_machine", "restore_client")
+
+    def __init__(self, name, pg_mgr, state_machine, restore_client):
+        self.name = name
         self.pg_mgr = pg_mgr
         self.state_machine = state_machine
         self.restore_client = restore_client
+
+
+class StatusServer:
+    def __init__(self, *, host: str = "0.0.0.0", port: int,
+                 pg_mgr=None, state_machine=None, restore_client=None,
+                 shards: list[tuple] | None = None):
+        """Single-shard form: pass *pg_mgr*/*state_machine*/
+        *restore_client*.  Fleet form: pass *shards* as an ordered list
+        of ``(name, pg_mgr, state_machine, restore_client)`` tuples —
+        the first entry also answers the legacy single-shard routes."""
+        self.host = host
+        self.port = port
+        if shards is not None:
+            if not shards:
+                raise ValueError("fleet status server needs >= 1 shard")
+            self._entries = [_ShardEntry(*s) for s in shards]
+            self._fleet = True
+        else:
+            self._entries = [_ShardEntry(None, pg_mgr, state_machine,
+                                         restore_client)]
+            self._fleet = False
+        first = self._entries[0]
+        # legacy accessors (tests and embedders read these)
+        self.pg_mgr = first.pg_mgr
+        self.state_machine = first.state_machine
+        self.restore_client = first.restore_client
         self._runner: web.AppRunner | None = None
         app = web.Application()
         app.router.add_get("/", self._routes)
@@ -56,6 +93,10 @@ class StatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/events", self._events)
         app.router.add_get("/spans", self._spans)
+        app.router.add_get("/shards", self._shards)
+        app.router.add_get("/shards/{shard}/ping", self._ping)
+        app.router.add_get("/shards/{shard}/state", self._state)
+        app.router.add_get("/shards/{shard}/restore", self._restore)
         faults.attach_http(app)
         self._app = app
 
@@ -66,41 +107,86 @@ class StatusServer:
         await site.start()
         if self.port == 0:
             self.port = self._runner.addresses[0][1]
-        log.info("status server on %s:%d", self.host, self.port)
+        log.info("status server on %s:%d%s", self.host, self.port,
+                 " (%d shards)" % len(self._entries)
+                 if self._fleet else "")
 
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
 
-    async def _routes(self, _req: web.Request) -> web.Response:
-        return web.json_response(["/ping", "/state", "/restore",
-                                  "/metrics", "/events", "/spans",
-                                  "/faults"])
+    def _entry(self, req: web.Request) -> _ShardEntry | None:
+        """The shard a request addresses: ``/shards/<name>/...`` routes
+        name one explicitly; the legacy paths mean the first (in
+        single-shard mode: only) entry.  None = unknown shard name."""
+        name = req.match_info.get("shard")
+        if name is None:
+            return self._entries[0]
+        for e in self._entries:
+            if e.name == name:
+                return e
+        return None
 
-    async def _ping(self, _req: web.Request) -> web.Response:
-        healthy = bool(self.pg_mgr and self.pg_mgr.online)
+    async def _routes(self, _req: web.Request) -> web.Response:
+        routes = ["/ping", "/state", "/restore", "/metrics", "/events",
+                  "/spans", "/faults", "/shards"]
+        if self._fleet:
+            routes += ["/shards/%s/%s" % (e.name, leaf)
+                       for e in self._entries
+                       for leaf in ("ping", "state", "restore")]
+        return web.json_response(routes)
+
+    async def _shards(self, _req: web.Request) -> web.Response:
+        # a single-shard sitter's entry is unnamed (no /shards/<name>/
+        # routes resolve): report an empty list, not [null] — callers
+        # fall back to the legacy routes on fleet=false
+        return web.json_response({
+            "fleet": self._fleet,
+            "shards": [e.name for e in self._entries
+                       if e.name is not None],
+        })
+
+    async def _ping(self, req: web.Request) -> web.Response:
+        e = self._entry(req)
+        if e is None:
+            return web.json_response({"error": "no such shard"},
+                                     status=404)
+        healthy = bool(e.pg_mgr and e.pg_mgr.online)
         body = {"healthy": healthy,
-                "pg": self.pg_mgr.status() if self.pg_mgr else None}
+                "pg": e.pg_mgr.status() if e.pg_mgr else None}
+        if e.name is not None:
+            body["shard"] = e.name
         return web.json_response(body, status=200 if healthy else 503)
 
-    async def _state(self, _req: web.Request) -> web.Response:
-        if self.state_machine is None:
+    async def _state(self, req: web.Request) -> web.Response:
+        e = self._entry(req)
+        if e is None:
+            return web.json_response({"error": "no such shard"},
+                                     status=404)
+        if e.state_machine is None:
             return web.json_response({"error": "no state machine"},
                                      status=503)
-        body = self.state_machine.debug_state()
-        if self.pg_mgr is not None:
+        body = e.state_machine.debug_state()
+        if e.pg_mgr is not None:
             # failure-prediction surface (health/telemetry.py): operators
             # and adm warnings read the early-warning score from here
-            body["healthScore"] = self.pg_mgr.health_score
-            body["healthTelemetry"] = self.pg_mgr.telemetry.last_tick()
+            body["healthScore"] = e.pg_mgr.health_score
+            body["healthTelemetry"] = e.pg_mgr.telemetry.last_tick()
+        if e.name is not None:
+            body["shard"] = e.name
         return web.json_response(body)
 
-    async def _restore(self, _req: web.Request) -> web.Response:
-        job = (self.restore_client.current_job
-               if self.restore_client else None)
-        if job is None:
-            return web.json_response({"restore": None})
-        return web.json_response({"restore": job})
+    async def _restore(self, req: web.Request) -> web.Response:
+        e = self._entry(req)
+        if e is None:
+            return web.json_response({"error": "no such shard"},
+                                     status=404)
+        job = (e.restore_client.current_job
+               if e.restore_client else None)
+        body = {"restore": job}
+        if e.name is not None:
+            body["shard"] = e.name
+        return web.json_response(body)
 
     async def _events(self, req: web.Request) -> web.Response:
         """The peer's event journal, oldest first.  ?since=SEQ returns
@@ -127,69 +213,88 @@ class StatusServer:
                                  content_type="application/json")
 
     async def _metrics(self, _req: web.Request) -> web.Response:
-        """Prometheus text exposition: state-derived gauges + the whole
-        process-wide obs registry."""
-        from manatee_tpu.utils.prom import MetricsBuilder
+        """Prometheus text exposition: state-derived gauges (labeled
+        per shard in fleet mode) + the whole process-wide obs
+        registry."""
+        from manatee_tpu.utils.prom import MetricsBuilder, label_str
 
         b = MetricsBuilder("manatee")
-        metric = b.metric
-        pg = self.pg_mgr
-        if pg is not None:
-            metric("pg_online", "gauge",
-                   "1 when the local database answers health probes",
-                   1 if pg.online else 0)
-            if pg.health_score is not None:
-                metric("health_score", "gauge",
-                       "learned failure-probability score in [0,1]",
-                       "%.4f" % pg.health_score)
-            tick = pg.telemetry.last_tick()
-            if tick:
-                # normalized feature vector of the last probe
-                # (telemetry.normalize_tick order)
-                names = ("latency", "timed_out", "lag", "wal_stall",
-                         "reconnects")
-                from manatee_tpu.utils.prom import label_str
-                metric("probe_feature", "gauge",
-                       "normalized health-probe features, last tick",
-                       [(label_str(feature=n), "%.4f" % v)
-                        for n, v in zip(names, tick)])
-        sm = self.state_machine
-        if sm is not None:
-            dbg = sm.debug_state()
-            st = dbg.get("clusterState") or {}
-            if "generation" in st:
-                metric("generation", "gauge",
-                       "durable cluster-state generation",
-                       st["generation"])
-            role = dbg.get("role") or "none"
-            metric("role", "gauge", "current durable role",
-                   [('{role="%s"}' % r, 1 if r == role else 0)
-                    for r in ("primary", "sync", "async", "deposed",
-                              "none")])
-            metric("frozen", "gauge",
-                   "1 when the cluster is frozen (no automatic "
-                   "transitions)", 1 if st.get("freeze") else 0)
-            metric("cluster_peers", "gauge",
-                   "peers in the durable topology incl. deposed",
-                   (1 if st.get("primary") else 0)
-                   + (1 if st.get("sync") else 0)
-                   + len(st.get("async") or [])
-                   + len(st.get("deposed") or []))
-        job = (self.restore_client.current_job
-               if self.restore_client else None)
-        if job is not None:
-            metric("restore_size_bytes", "gauge",
-                   "size of the in-flight restore stream",
-                   int(job.get("size") or 0))
-            metric("restore_done_bytes", "gauge",
-                   "bytes received by the in-flight restore",
-                   int(job.get("completed") or 0))
-        metric("journal_events", "gauge",
-               "events buffered in the in-memory journal ring",
-               len(get_journal()))
+        # family name -> (type, help, [(labelstr, value), ...]) —
+        # collected across shards so each family is emitted once
+        fams: dict[str, tuple[str, str, list]] = {}
+
+        def metric(name, mtype, help_, value, **labels):
+            fam = fams.setdefault(name, (mtype, help_, []))
+            fam[2].append((label_str(**labels), value))
+
+        for e in self._entries:
+            lb = {} if e.name is None else {"shard": e.name}
+            pg = e.pg_mgr
+            if pg is not None:
+                metric("pg_online", "gauge",
+                       "1 when the local database answers health probes",
+                       1 if pg.online else 0, **lb)
+                if pg.health_score is not None:
+                    metric("health_score", "gauge",
+                           "learned failure-probability score in [0,1]",
+                           "%.4f" % pg.health_score, **lb)
+                tick = pg.telemetry.last_tick()
+                if tick:
+                    # normalized feature vector of the last probe
+                    # (telemetry.normalize_tick order)
+                    names = ("latency", "timed_out", "lag", "wal_stall",
+                             "reconnects")
+                    for n, v in zip(names, tick):
+                        metric("probe_feature", "gauge",
+                               "normalized health-probe features, "
+                               "last tick", "%.4f" % v,
+                               feature=n, **lb)
+            sm = e.state_machine
+            if sm is not None:
+                dbg = sm.debug_state()
+                st = dbg.get("clusterState") or {}
+                if "generation" in st:
+                    metric("generation", "gauge",
+                           "durable cluster-state generation",
+                           st["generation"], **lb)
+                role = dbg.get("role") or "none"
+                for r in ("primary", "sync", "async", "deposed",
+                          "none"):
+                    metric("role", "gauge", "current durable role",
+                           1 if r == role else 0, role=r, **lb)
+                metric("frozen", "gauge",
+                       "1 when the cluster is frozen (no automatic "
+                       "transitions)", 1 if st.get("freeze") else 0,
+                       **lb)
+                metric("cluster_peers", "gauge",
+                       "peers in the durable topology incl. deposed",
+                       (1 if st.get("primary") else 0)
+                       + (1 if st.get("sync") else 0)
+                       + len(st.get("async") or [])
+                       + len(st.get("deposed") or []), **lb)
+            job = (e.restore_client.current_job
+                   if e.restore_client else None)
+            if job is not None:
+                metric("restore_size_bytes", "gauge",
+                       "size of the in-flight restore stream",
+                       int(job.get("size") or 0), **lb)
+                metric("restore_done_bytes", "gauge",
+                       "bytes received by the in-flight restore",
+                       int(job.get("completed") or 0), **lb)
+        for name, (mtype, help_, samples) in fams.items():
+            b.metric(name, mtype, help_, samples)
+        if self._fleet:
+            b.metric("fleet_shards", "gauge",
+                     "shards this fleet sitter process runs",
+                     len(self._entries))
+        b.metric("journal_events", "gauge",
+                 "events buffered in the in-memory journal ring",
+                 len(get_journal()))
         # the process-wide registry: state_transitions_total, the
-        # failover/reconfigure/probe/RPC histograms, restore counters —
-        # everything components registered via manatee_tpu.obs
+        # failover/reconfigure/probe/RPC histograms, restore counters,
+        # the coord_connections/coord_sessions/coord_mux_handles
+        # amortization gauges — everything components registered via
+        # manatee_tpu.obs
         get_registry().render_into(b)
         return web.Response(text=b.render(),
                             content_type="text/plain")

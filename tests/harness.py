@@ -22,10 +22,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-from manatee_tpu.coord.client import (                  # noqa: E402
-    NetCoord,
-    sync_status,
-)
+from manatee_tpu.coord.client import sync_status        # noqa: E402
 from manatee_tpu.pg.engine import SimPgEngine           # noqa: E402
 from manatee_tpu.pg.postgres import PostgresEngine      # noqa: E402
 from manatee_tpu.storage import DirBackend              # noqa: E402
@@ -127,6 +124,40 @@ def alloc_port_block(n: int) -> int:
         if len(socks) == n:
             return base
     raise RuntimeError("no free port block of %d found" % n)
+
+
+def spawn_fleet_sitter(cfg: dict, root) -> subprocess.Popen:
+    """Spawn ``manatee-sitter --fleet`` as a child process: write *cfg*
+    to ``root/fleet.json``, append its output to ``root/fleet.log``,
+    start it in its own process group (tear down with
+    :func:`kill_fleet_sitter`).  Shared by tests and bench.py's
+    control_plane_scale leg; call via ``asyncio.to_thread`` from a
+    coroutine."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "fleet.json").write_text(json.dumps(cfg, indent=2))
+    with open(root / "fleet.log", "ab") as logf:
+        # the child inherits a dup of the fd; the parent's copy can
+        # close right away (no handle leak across a long bench)
+        return subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.daemons.sitter",
+             "--fleet", str(root / "fleet.json")],
+            stdout=logf, stderr=logf,
+            env=dict(os.environ, PYTHONPATH=str(REPO)),
+            start_new_session=True)
+
+
+def kill_fleet_sitter(proc: subprocess.Popen) -> None:
+    """SIGKILL a :func:`spawn_fleet_sitter` process group and reap it
+    (fleet shards' sim databases are children in the same group)."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        pass
 
 
 class Peer:
@@ -597,10 +628,12 @@ class ClusterHarness:
 
     # -- cluster state inspection --
 
-    async def coord_client(self) -> NetCoord:
-        c = NetCoord(self.coord_connstr, session_timeout=30)
-        await c.connect()
-        return c
+    async def coord_client(self):
+        # the process-wide mux pool: concurrent harness probes (state
+        # polls, samplers) share one connection to the coordination
+        # service instead of dialing one each
+        from manatee_tpu.coord.client import mux_handle
+        return await mux_handle(self.coord_connstr, session_timeout=30)
 
     async def cluster_state(self) -> dict | None:
         # tolerate mid-election windows (ensemble leader just died):

@@ -107,6 +107,10 @@ SCENARIOS: dict[str, dict] = {
     "coord.client.connect": dict(kind="boot_async"),
     "coord.client.recv":    dict(kind="boot_async"),
     "coord.client.send":    dict(kind="boot_async", variant="kill"),
+    # the rejoining async's mux demuxes the state-watch push fired by
+    # the primary's topology write that adds it — the demux pump dies
+    # exactly at the fan-back-out seam
+    "coord.mux.demux":      dict(kind="boot_async"),
     "coord.put_state":      dict(kind="primary_write", variant="kill"),
     "coordd.dispatch":      dict(kind="coordd", variant="kill"),
     "coordd.oplog.append":  dict(kind="coordd", induce="freeze"),

@@ -1,13 +1,19 @@
 """Multiple shards on one coordination service: isolation of state,
 election, and adm's shard listing (the reference's /manatee/<shard>
-namespace, lib/adm.js:107-122)."""
+namespace, lib/adm.js:107-122) — plus the fleet-scale stack: N shards
+over ONE CoordMux'd TCP connection, serialize-once watch fan-out, and
+the `manatee-sitter --fleet` daemon."""
 
 import asyncio
+import json
+import time
 
 from manatee_tpu.adm import AdmClient
-from manatee_tpu.coord import CoordSpace
+from manatee_tpu.coord import ConsensusMgr, CoordSpace
+from manatee_tpu.coord.client import NetCoord, _MUX_POOL, mux_handle
 from manatee_tpu.coord.server import CoordServer
-from tests.test_state_machine import SimPeer, wait_for
+from manatee_tpu.state.machine import PeerStateMachine
+from tests.test_state_machine import SimPeer, SimPg, wait_for
 
 
 def test_two_shards_isolated():
@@ -74,5 +80,586 @@ def test_adm_lists_shards_over_tcp():
             await adm.close()
             await w.close()
         finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+# ---- fleet scale: the real TCP stack over one mux'd connection ----
+
+
+class TcpPeer:
+    """SimPeer's real-TCP twin: ConsensusMgr + PeerStateMachine whose
+    coordination client comes from *factory_fn* — a private NetCoord
+    (killable: its session dies with it) or a pooled mux handle (fleet
+    mode: N peers in one process over one socket)."""
+
+    def __init__(self, name: str, shard_path: str, factory_fn, *,
+                 takeover_grace: float = 0.0):
+        self.ident = "%s:5432:12345" % name
+        self.info = {
+            "id": self.ident, "zoneId": name, "ip": name,
+            "pgUrl": "tcp://postgres@%s:5432/postgres" % name,
+            "backupUrl": "http://%s:12345" % name,
+        }
+        self.pg = SimPg()
+        self._client = None
+
+        async def factory():
+            c = await factory_fn()
+            self._client = c
+            return c
+
+        data = {k: v for k, v in self.info.items() if k != "id"}
+        self.zk = ConsensusMgr(client_factory=factory, path=shard_path,
+                               ident=self.ident, data=data,
+                               anti_entropy_interval=2.0)
+        self.sm = PeerStateMachine(zk=self.zk, pg=self.pg,
+                                   self_info=self.info,
+                                   takeover_grace=takeover_grace)
+
+    async def start(self):
+        self.sm.start()
+        await self.zk.start()
+        self.sm.pg_init()
+
+    async def kill(self):
+        """Peer death over TCP: stop deciding, end the session (the
+        goodbye drops our ephemerals at once — the FIN-fast-path
+        equivalent for an in-process peer)."""
+        self.sm._closed = True
+        self.zk._closed = True
+        await self.sm.close()
+        if self._client is not None:
+            await self._client.close()
+
+    async def close(self):
+        await self.sm.close()
+        await self.zk.close()
+
+
+def _private_factory(port: int):
+    async def factory():
+        c = NetCoord("127.0.0.1", port, session_timeout=2.0)
+        await c.connect()
+        return c
+    return factory
+
+
+def _mux_factory(connstr: str, name: str):
+    async def factory():
+        return await mux_handle(connstr, session_timeout=2.0,
+                                name=name)
+    return factory
+
+
+async def _shard_state(client, path: str) -> dict | None:
+    from manatee_tpu.coord.api import CoordError
+    try:
+        data, _v = await client.get(path + "/state")
+        return json.loads(data.decode())
+    except CoordError:
+        return None
+
+
+async def _watch_latency(handle, writer, path: str) -> float:
+    """Seconds from a mutation to its demuxed watch delivery through
+    the shared mux connection."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    def cb(_event):
+        if not fut.done():
+            fut.set_result(time.monotonic())
+    await handle.get(path, watch=cb)
+    t0 = time.monotonic()
+    await writer.set(path, b"tick")
+    t_fire = await asyncio.wait_for(fut, 10)
+    return t_fire - t0
+
+
+def test_fleet_shards_one_mux_connection_tcp(tmp_path):
+    """N shards on the real TCP stack whose standby peers all ride ONE
+    CoordMux'd connection: killing shard k's primary moves only shard
+    k's generation, watch latency through the mux stays bounded, and
+    the mux survives a coordd restart — every logical handle's owner
+    rebuilds onto one fresh pooled connection."""
+    async def go():
+        N = 3
+        server = CoordServer(tick=0.05,
+                             data_dir=str(tmp_path / "coordd"))
+        await server.start()
+        port = server.port
+        connstr = "127.0.0.1:%d" % port
+        paths = ["/manatee/m%d" % k for k in range(N)]
+        prims = [TcpPeer("P%d" % k, paths[k], _private_factory(port))
+                 for k in range(N)]
+        syncs = [TcpPeer("S%d" % k, paths[k],
+                         _mux_factory(connstr, "m%d-sync" % k),
+                         takeover_grace=0.0)
+                 for k in range(N)]
+        observer = NetCoord("127.0.0.1", port, session_timeout=30)
+        await observer.connect()
+        try:
+            for p in prims:
+                await p.start()
+            for s in syncs:
+                await s.start()
+            for k in range(N):
+                await wait_for(
+                    lambda k=k: (syncs[k].sm._state or {}).get("sync"),
+                    15, "shard %d converged" % k)
+                st = syncs[k].sm._state
+                assert st["primary"]["id"] == prims[k].ident
+                assert st["sync"]["id"] == syncs[k].ident
+
+            # ---- the amortization claim, observed server-side: N
+            # standbys share ONE connection and ONE session
+            assert len(_MUX_POOL) == 1
+            mux = next(iter(_MUX_POOL.values()))
+            assert mux.handle_count == N
+            sids = {s.zk._client.session_id for s in syncs}
+            assert len(sids) == 1 and None not in sids
+            # sessions: N private primaries + 1 mux + 1 observer
+            live = sum(1 for s in server.tree.sessions.values()
+                       if not s.expired)
+            assert live == N + 2, live
+
+            # ---- watch delivery through the mux demux stays bounded
+            await observer.create("/scratch", b"0")
+            probe = await mux_handle(connstr, session_timeout=2.0,
+                                     name="probe")
+            lat = await _watch_latency(probe, observer, "/scratch")
+            assert lat < 2.0, "mux watch delivery took %.3fs" % lat
+
+            # ---- kill shard 0's primary: nobody else moves
+            gens = [(syncs[k].sm._state or {}).get("generation")
+                    for k in range(N)]
+            await prims[0].kill()
+            await wait_for(
+                lambda: (syncs[0].sm._state or {}).get("generation")
+                == gens[0] + 1, 15, "shard 0 takeover")
+            assert syncs[0].sm._state["primary"]["id"] \
+                == syncs[0].ident
+            lat = await _watch_latency(probe, observer, "/scratch")
+            assert lat < 2.0, \
+                "watch delivery degraded to %.3fs during takeover" % lat
+            for k in range(1, N):
+                assert (syncs[k].sm._state or {}).get("generation") \
+                    == gens[k], "shard %d generation moved" % k
+            await probe.close()
+
+            # ---- coordd restart: the shared session dies; every
+            # handle's owner observes expiry and rebuilds through the
+            # pool onto ONE fresh connection, state intact (data_dir)
+            await observer.close()
+            await server.stop()
+            server = CoordServer(port=port, tick=0.05,
+                                 data_dir=str(tmp_path / "coordd"))
+            await server.start()
+
+            def resumed():
+                if not mux._closed:
+                    return False     # old generation must retire
+                if len(_MUX_POOL) != 1:
+                    return False
+                m = next(iter(_MUX_POOL.values()))
+                if m is mux or m.handle_count != N:
+                    return False
+                for k in range(N):
+                    # every shard rebuilt onto the fresh pooled
+                    # connection and re-read its durable state
+                    if syncs[k].zk.status != "CONNECTED" \
+                            or not syncs[k].zk._ready:
+                        return False
+                    st = syncs[k].sm._state
+                    if not st or not st.get("primary"):
+                        return False
+                    if st["generation"] < gens[k]:
+                        return False
+                return True
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not resumed():
+                await asyncio.sleep(0.1)
+            assert resumed(), \
+                "mux/fleet never resumed after coordd restart " \
+                "(pool=%r)" % _MUX_POOL
+            new_mux = next(iter(_MUX_POOL.values()))
+            sids = {s.zk._client.session_id for s in syncs}
+            assert len(sids) == 1 and None not in sids
+            assert new_mux.handle_count == N
+        finally:
+            for p in syncs + prims:
+                try:
+                    await p.close()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_mux_pool_evicts_on_failed_dial():
+    """A failed FIRST dial must not leave a dead zero-handle mux
+    squatting the pool slot: its lock is bound to the dialing event
+    loop, and a later asyncio.run reusing the connstr would trip over
+    it instead of just reconnecting."""
+    async def go():
+        from tests.harness import alloc_port_block
+        connstr = "127.0.0.1:%d" % alloc_port_block(1)   # nobody listens
+        try:
+            await mux_handle(connstr, session_timeout=2.0)
+            raise AssertionError("dial to a dead port succeeded")
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+        assert not any(k[0] == connstr for k in _MUX_POOL), _MUX_POOL
+    asyncio.run(go())
+
+
+def test_mux_ghost_election_entry_swept():
+    """Closing a pooled handle cannot end the SHARED session, so a
+    failed setup attempt's election ephemeral outlives the handle —
+    the consensus manager sweeps its own stale entries before
+    rejoining (a private client's close() used to do this by killing
+    the whole session)."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        connstr = "127.0.0.1:%d" % server.port
+        path = "/manatee/g"
+        ident = "1.2.3.4:5432:12345"
+        # a second handle keeps the shared session alive across the
+        # ghost-maker's close, exactly as sibling fleet shards would
+        keeper = await mux_handle(connstr, session_timeout=5.0,
+                                  name="keeper")
+        zk = None
+        try:
+            ghost_maker = await mux_handle(connstr, session_timeout=5.0,
+                                           name="ghost")
+            await ghost_maker.mkdirp(path + "/election")
+            ghost = await ghost_maker.create(
+                path + "/election/" + ident + "-", b"{}",
+                ephemeral=True, sequential=True)
+            await ghost_maker.close()
+            names = await keeper.get_children(path + "/election")
+            assert len(names) == 1     # the ghost outlived its handle
+            zk = ConsensusMgr(
+                client_factory=lambda: mux_handle(
+                    connstr, session_timeout=5.0, name="g"),
+                path=path, ident=ident, data={"zoneId": "g"})
+            await zk.start()
+            await wait_for(lambda: zk._ready, 10, "manager ready")
+            names = await keeper.get_children(path + "/election")
+            mine = [n for n in names
+                    if n[:n.rfind("-")] == ident]
+            assert len(mine) == 1, names
+            assert ghost.rsplit("/", 1)[1] not in mine, \
+                "stale election entry survived the rejoin sweep"
+        finally:
+            if zk is not None:
+                await zk.close()
+            await keeper.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_watch_fanout_serializes_once_per_event():
+    """A mutation with K subscribed connections serializes its watch
+    frame exactly once (the acceptance pin for the coalesced fan-out
+    path) — and every subscriber still receives it."""
+    async def go():
+        server = CoordServer()
+        await server.start()
+        K = 5
+        clients, events = [], []
+        try:
+            writer = NetCoord("127.0.0.1", server.port,
+                              session_timeout=10)
+            await writer.connect()
+            clients.append(writer)
+            await writer.create("/hot", b"0")
+            for _ in range(K):
+                c = NetCoord("127.0.0.1", server.port,
+                             session_timeout=10)
+                await c.connect()
+                clients.append(c)
+                ev = asyncio.Event()
+                events.append(ev)
+                await c.get("/hot", watch=lambda _e, ev=ev: ev.set())
+            enc0 = server._watch_encodes
+            await writer.set("/hot", b"1")
+            for ev in events:
+                await asyncio.wait_for(ev.wait(), 5)
+            assert server._watch_encodes - enc0 == 1, \
+                "watch frame encoded %d times for one mutation" \
+                % (server._watch_encodes - enc0)
+        finally:
+            for c in clients:
+                await c.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_mux_handle_close_departs_election_promptly():
+    """A cleanly closed shard must leave the election NOW: a private
+    client's close() ended its session (dropping the ephemeral), but a
+    pooled handle's close cannot end the SHARED session — the manager
+    deletes its own election node explicitly on close()."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        connstr = "127.0.0.1:%d" % server.port
+        keeper = await mux_handle(connstr, session_timeout=30,
+                                  name="keeper")
+        try:
+            zk = ConsensusMgr(
+                client_factory=lambda: mux_handle(
+                    connstr, session_timeout=30, name="d"),
+                path="/manatee/d", ident="9.9.9.9:5432:1",
+                data={"zoneId": "d"})
+            await zk.start()
+            await wait_for(lambda: zk._ready, 10, "manager ready")
+            names = await keeper.get_children("/manatee/d/election")
+            assert len(names) == 1
+            await zk.close()
+            # the keeper still holds the shared session open, so only
+            # an explicit delete can have removed the entry
+            names = await keeper.get_children("/manatee/d/election")
+            assert names == [], \
+                "election entry outlived its shard's clean close"
+        finally:
+            await keeper.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_mux_pool_cross_loop_eviction():
+    """A mux kept alive by a handle leaked in a PREVIOUS event loop is
+    bound to that loop's primitives; a later loop reusing the connstr
+    must get a fresh dial, not a cross-loop RuntimeError."""
+    from tests.harness import alloc_port_block
+    port = alloc_port_block(1)
+    connstr = "127.0.0.1:%d" % port
+
+    async def loop_one():
+        server = CoordServer(port=port, tick=0.05)
+        await server.start()
+        try:
+            h = await mux_handle(connstr, session_timeout=5.0,
+                                 name="leaked")
+            await h.create("/x", b"1")
+            # h deliberately leaked: its loop dies while it is open
+        finally:
+            await server.stop()
+    asyncio.run(loop_one())
+    assert any(k[0] == connstr for k in _MUX_POOL)
+
+    async def loop_two():
+        server = CoordServer(port=port, tick=0.05)
+        await server.start()
+        try:
+            h = await mux_handle(connstr, session_timeout=5.0,
+                                 name="fresh")
+            await h.create("/y", b"2")
+            data, _v = await h.get("/y")
+            assert data == b"2"
+            muxes = [m for k, m in _MUX_POOL.items()
+                     if k[0] == connstr]
+            assert len(muxes) == 1 and muxes[0].handle_count == 1
+            await h.close()
+        finally:
+            await server.stop()
+    asyncio.run(loop_two())
+
+
+def test_single_oversized_frame_still_delivered():
+    """A lone frame larger than max_buffered on a healthy connection is
+    delivered, not severed: the coalesced path's sever keys on the
+    backlog the peer failed to drain, never on the frame being pushed
+    (a follower attach snapshot of a big tree must always ship, as it
+    did on the uncoalesced path)."""
+    async def go():
+        server = CoordServer(tick=0.05)
+        await server.start()
+        try:
+            c = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            w = NetCoord("127.0.0.1", server.port, session_timeout=5)
+            await c.connect()
+            await w.connect()
+            big = b"x" * 4096
+            await w.create("/big", big)
+            server.max_buffered = 256      # far below one reply frame
+            data, _v = await c.get("/big")
+            assert data == big
+            ev = asyncio.Event()
+            await c.get("/big", watch=lambda _e: ev.set())
+            await w.set("/big", big + b"y")
+            await asyncio.wait_for(ev.wait(), 5)
+            await c.close()
+            await w.close()
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_znode_count_gauge_incremental(tmp_path):
+    """The /metrics znode gauge is maintained on mutate, never by
+    walking the tree at scrape time (scrape cost must not scale with
+    tree size)."""
+    from manatee_tpu.coord.model import ZNodeTree
+
+    def recount(tree):
+        def walk(n):
+            return 1 + sum(walk(c) for c in n.children.values())
+        return walk(tree._root)
+
+    tree = ZNodeTree()
+    tree.create("/a")
+    tree.create("/a/b", b"x")
+    s = tree.create_session(60)
+    tree.create("/a/e", ephemeral_owner=s.id)
+    for _ in range(3):
+        tree.create("/a/q-", sequential=True)
+    assert tree.node_count == recount(tree) == 7
+    tree.delete("/a/b")
+    assert tree.node_count == recount(tree) == 6
+    tree.expire_session(s.id)       # drops /a/e
+    assert tree.node_count == recount(tree) == 5
+    # snapshot round trip re-seeds the counter (ephemerals dropped)
+    clone = ZNodeTree.from_snapshot(tree.to_snapshot())
+    assert clone.node_count == recount(clone) == 5
+
+    async def go():
+        server = CoordServer()
+        await server.start()
+        try:
+            server.tree.create("/x")
+            assert "coordd_znodes 2" in server._render_metrics()
+            # the scrape reads the incremental gauge, not a walk: a
+            # forged counter must show up verbatim
+            server.tree.node_count = 12345
+            assert "coordd_znodes 12345" in server._render_metrics()
+        finally:
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_status_server_single_shard_shards_route():
+    """GET /shards on a plain single-shard sitter reports fleet=false
+    with an EMPTY list (the lone entry is unnamed; no /shards/<name>/
+    routes resolve) — callers fall back to the legacy routes."""
+    async def go():
+        from manatee_tpu.status_server import StatusServer
+        from tests.test_partition import http_get
+        s = StatusServer(host="127.0.0.1", port=0)
+        await s.start()
+        try:
+            _st, body = await http_get(
+                "http://127.0.0.1:%d/shards" % s.port)
+            assert body == {"fleet": False, "shards": []}
+        finally:
+            await s.stop()
+    asyncio.run(go())
+
+
+def test_fleet_sitter_daemon_end_to_end(tmp_path):
+    """`manatee-sitter --fleet`: one process runs N singleton shards
+    over one mux'd connection — per-shard status routes, shard-labeled
+    metrics, the coord_connections==1 amortization gauge, and every
+    shard independently writable."""
+    from tests.harness import (
+        alloc_port_block,
+        kill_fleet_sitter,
+        spawn_fleet_sitter,
+    )
+
+    async def go():
+        from manatee_tpu.pg.engine import SimPgEngine
+        from manatee_tpu.storage import DirBackend
+        n = 2
+        base = alloc_port_block(4 * n + 1)
+        status_port = base + 4 * n
+        server = CoordServer(tick=0.1)
+        await server.start()
+        shards = []
+        for k in range(n):
+            b = base + 4 * k
+            sroot = tmp_path / ("s%d" % k)
+            be = DirBackend(str(sroot / "store"))
+            await be.create("manatee")
+            shards.append({
+                "name": "s%d" % k,
+                "shardPath": "/manatee/s%d" % k,
+                "postgresPort": b, "backupPort": b + 2,
+                "zfsPort": b + 3,
+                "dataDir": str(sroot / "data"),
+                "storageRoot": str(sroot / "store"),
+            })
+        cfg = {
+            "ip": "127.0.0.1", "dataset": "manatee/pg",
+            "storageBackend": "dir", "pgEngine": "sim",
+            "oneNodeWriteMode": True, "statusPort": status_port,
+            "healthChkInterval": 0.3,
+            "coordCfg": {"connStr": "127.0.0.1:%d" % server.port,
+                         "sessionTimeout": 5,
+                         "disconnectGrace": 0.4},
+            "shards": shards,
+        }
+        proc = await asyncio.to_thread(spawn_fleet_sitter, cfg,
+                                       tmp_path)
+        try:
+            from tests.test_partition import http_get
+            url = "http://127.0.0.1:%d" % status_port
+            deadline = time.monotonic() + 60
+            names = None
+            while time.monotonic() < deadline:
+                try:
+                    _s, body = await http_get(url + "/shards")
+                    names = body["shards"]
+                    break
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    await asyncio.sleep(0.3)
+            assert names == ["s0", "s1"], names
+
+            # every singleton shard becomes writable independently
+            engine = SimPgEngine()
+            for k in range(n):
+                ok = False
+                while time.monotonic() < deadline and not ok:
+                    try:
+                        res = await engine.query(
+                            "127.0.0.1", base + 4 * k,
+                            {"op": "insert",
+                             "value": "w%d" % k, "timeout": 2.0}, 3.0)
+                        ok = bool(res.get("ok"))
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        pass
+                    if not ok:
+                        await asyncio.sleep(0.2)
+                assert ok, "fleet shard s%d never writable" % k
+
+            # per-shard routes + process-wide amortization gauges
+            _s, st0 = await http_get(url + "/shards/s0/state")
+            _s, st1 = await http_get(url + "/shards/s1/state")
+            assert st0["shard"] == "s0" and st1["shard"] == "s1"
+            assert st0["clusterState"]["primary"]["id"] \
+                != st1["clusterState"]["primary"]["id"]
+            status, _b = await http_get(url + "/shards/nope/state")
+            assert status == 404
+            _s, text = await http_get(url + "/metrics")
+            assert "manatee_coord_connections 1\n" in text
+            assert "manatee_coord_sessions 1\n" in text
+            assert "manatee_coord_mux_handles %d\n" % n in text
+            assert 'manatee_generation{shard="s0"}' in text
+            assert "manatee_fleet_shards %d\n" % n in text
+        finally:
+            await asyncio.to_thread(kill_fleet_sitter, proc)
             await server.stop()
     asyncio.run(go())
