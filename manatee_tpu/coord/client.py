@@ -271,6 +271,14 @@ class NetCoord(CoordClient):
             writer.close()
             self._rotate()
             raise ConnectionLossError("handshake: %s" % e) from None
+        except BaseException:
+            # a cancellation (session teardown racing the dial) landing
+            # on the drain/readline awaits above must not strand the
+            # half-handshaken socket: nothing else holds a reference to
+            # it yet, so an unclosed leave here leaks the fd forever
+            # (mnt-lint: cancel-unsafe-acquire)
+            writer.close()
+            raise
         if not line:
             writer.close()
             self._rotate()
@@ -993,15 +1001,23 @@ class CoordMux:
         if self._pool_key is not None \
                 and _MUX_POOL.get(self._pool_key) is self:
             del _MUX_POOL[self._pool_key]
-        t = self._demux_task
-        if t is not None:
-            t.cancel()
-            try:
-                await t
-            except asyncio.CancelledError:
-                pass
-        self._demux_task = None
-        client, self._client = self._client, None
+        # under the mux lock like the spawn site in _ensure_client: the
+        # _closed flag above already keeps a racing _ensure_client from
+        # respawning the pump, but holding the lock across this
+        # load->await->store window makes the discipline checkable
+        # (mnt-lint: lockset-inconsistent) instead of an argument in a
+        # comment.  No caller holds the lock here: _release comes from
+        # handle.close(), the private-mux unwind from mux_handle().
+        async with self._lock:
+            t = self._demux_task
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+            self._demux_task = None
+            client, self._client = self._client, None
         if client is not None:
             try:
                 await client.close()

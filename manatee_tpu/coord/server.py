@@ -892,10 +892,13 @@ class CoordServer:
         if not self.data_dir:
             # no persistence configured: the consistent pair is still
             # what replication callers need (no await between the two
-            # reads, so they are atomic in the event loop)
+            # reads, so they are atomic in the event loop — the
+            # atomic-section annotation makes mnt-lint enforce that)
+            # mnt-lint: atomic-section=seq-snapshot-pair
             snap = self.tree.to_snapshot()
             snap["seq"] = self._seq
             return (self._seq, snap)
+            # mnt-lint: end-atomic-section
         async with self._persist_lock, self._log_lock:
             # BOTH locks for the whole prep→write→install span: the
             # epoch has been bumped but the new-epoch snapshot is not

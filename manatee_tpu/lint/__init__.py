@@ -2,10 +2,13 @@
 
 The engine (rule registry, suppression handling, output formats) lives
 in :mod:`manatee_tpu.lint.engine`; the rules themselves in
-:mod:`manatee_tpu.lint.rules_style` (the original six checks) and
+:mod:`manatee_tpu.lint.rules_style` (the original six checks),
 :mod:`manatee_tpu.lint.rules_async` (async-concurrency discipline:
 orphaned tasks, blocking calls, swallowed cancellation, unreaped
-cancels, lock hygiene, unbounded network waits).
+cancels, lock hygiene, unbounded network waits) and
+:mod:`manatee_tpu.lint.rules_flow` (flow-sensitive rules over the
+per-function CFGs built by :mod:`manatee_tpu.lint.cfg`: broken atomic
+sections, inconsistent locksets, cancellation-unsafe acquisitions).
 
 ``tools/lint`` is a thin shim over :func:`main`; ``python -m
 manatee_tpu.lint`` works too.  See docs/lint.md for the rule catalog.
@@ -25,6 +28,7 @@ from manatee_tpu.lint.engine import (
 from manatee_tpu.lint import rules_style  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_async  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_faults  # noqa: F401  (registration)
+from manatee_tpu.lint import rules_flow  # noqa: F401  (registration)
 
 __all__ = [
     "RULES",
@@ -37,4 +41,5 @@ __all__ = [
     "rules_style",
     "rules_async",
     "rules_faults",
+    "rules_flow",
 ]

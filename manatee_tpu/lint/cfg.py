@@ -1,0 +1,527 @@
+"""Per-function control-flow graphs for flow-sensitive lint rules.
+
+:func:`build_cfg` turns one function def into a graph of basic blocks.
+Each block carries an ordered *event* stream — await points, calls,
+attribute/name loads and stores — plus the set of lock names held
+throughout the block (every ``with``/``async with`` over a plain dotted
+expression is treated as a lock scope; ``async with self._lock:`` is
+the canonical form).  The flow-sensitive rules in
+:mod:`manatee_tpu.lint.rules_flow` consume the graph through
+:func:`scan_paths`, a forward reachability walk that tracks whether an
+await point was crossed.
+
+Deliberate approximations (documented so rule authors can reason about
+false-negative surface):
+
+- exception edges: every block created inside a ``try`` body gets an
+  edge to each handler entry (an exception can arise anywhere in the
+  body);
+- ``finally`` bodies are wired on the normal path only; ``return``/
+  ``raise`` shortcuts do not route through them (rules that care about
+  finally-based cleanup inspect the AST lexically instead);
+- nested ``def``/``lambda`` bodies are opaque: they execute in another
+  context, so none of their events belong to this function's flow;
+- generator expressions evaluate lazily but are treated as inline
+  (their first iterable genuinely evaluates at the definition site);
+- a ``yield`` inside an ``async def`` (async generator) counts as an
+  await point: the consumer can interleave arbitrary work between
+  items.  Sync-generator yields are not awaits.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+# engine does not import cfg at module level (FileContext builds CFGs
+# through a lazy import), so sharing its dotted() here is cycle-free
+from manatee_tpu.lint.engine import dotted
+
+# event kinds
+AWAIT = "await"          # await expr / async for step / async with enter-exit
+CALL = "call"            # any Call; name = dotted callee when resolvable
+LOAD = "load"            # dotted attribute read (name = "self.x", "mod.Y")
+STORE = "store"          # dotted attribute write
+LOAD_NAME = "load_name"  # bare name read
+STORE_NAME = "store_name"  # bare name write (assignment, for-target, ...)
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str
+    node: ast.AST
+    name: str | None = None
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+
+class Block:
+    __slots__ = ("bid", "events", "succs", "except_succs", "locks")
+
+    def __init__(self, bid: int, locks: frozenset):
+        self.bid = bid
+        self.events: list[Event] = []
+        self.succs: list[Block] = []
+        # edges taken only when an exception unwinds out of this block
+        # (try body -> handler entry).  Kept separate: cancellation
+        # lands at await points on the NORMAL path, so rules about
+        # cancel windows must not ride exception edges into handlers.
+        self.except_succs: list[Block] = []
+        self.locks = locks
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "Block(%d, %d events, ->%s, locks=%s)" % (
+            self.bid, len(self.events),
+            [s.bid for s in self.succs + self.except_succs],
+            sorted(self.locks) or "")
+
+
+class FuncCFG:
+    """CFG of one function def; ``entry`` is always ``blocks[0]``."""
+
+    def __init__(self, func):
+        self.func = func
+        self.blocks: list[Block] = []
+        self.entry = self._new(frozenset())
+        self._index: dict[int, tuple] | None = None
+
+    def _new(self, locks: frozenset) -> Block:
+        b = Block(len(self.blocks), locks)
+        self.blocks.append(b)
+        return b
+
+    def events(self):
+        """Yield (block, idx, event) over every block in creation order."""
+        for b in self.blocks:
+            for i, e in enumerate(b.events):
+                yield b, i, e
+
+    def position_of(self, node) -> tuple | None:
+        """(block, idx) of the event anchored on *node* (by identity)."""
+        if self._index is None:
+            self._index = {}
+            for b, i, e in self.events():
+                self._index.setdefault(id(e.node), (b, i))
+        return self._index.get(id(node))
+
+
+_SCOPE_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class _Builder:
+    def __init__(self, func):
+        self.cfg = FuncCFG(func)
+        self.cur = self.cfg.entry
+        self.loops: list[tuple] = []     # (head block, exit block)
+        self.is_async = isinstance(func, ast.AsyncFunctionDef)
+
+    # -- plumbing --
+
+    def _new(self, locks: frozenset | None = None) -> Block:
+        return self.cfg._new(self.cur.locks if locks is None else locks)
+
+    def _edge(self, a: Block, b: Block):
+        if b not in a.succs:
+            a.succs.append(b)
+
+    def emit(self, kind: str, node, name: str | None = None):
+        self.cur.events.append(Event(kind, node, name))
+
+    def build(self) -> FuncCFG:
+        self.seq(self.cfg.func.body)
+        return self.cfg
+
+    # -- statements --
+
+    def seq(self, stmts):
+        for s in stmts:
+            self.stmt(s)
+
+    def stmt(self, s):
+        m = getattr(self, "stmt_" + type(s).__name__, None)
+        if m is not None:
+            m(s)
+        else:
+            self.generic_stmt(s)
+
+    def generic_stmt(self, s):
+        # Expr, Assert, Delete, Import, Global, Nonlocal, Pass, ...
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self.expr(child)
+
+    def stmt_FunctionDef(self, s):
+        # nested scope: opaque (its body runs in another context); its
+        # own CFG is built separately by the rules
+        for dec in s.decorator_list:
+            self.expr(dec)
+
+    stmt_AsyncFunctionDef = stmt_FunctionDef
+    stmt_ClassDef = stmt_FunctionDef
+
+    def stmt_Assign(self, s):
+        self.expr(s.value)
+        for t in s.targets:
+            self.target(t)
+
+    def stmt_AnnAssign(self, s):
+        if s.value is not None:
+            self.expr(s.value)
+            self.target(s.target)
+
+    def stmt_AugAssign(self, s):
+        self.target_load(s.target)
+        self.expr(s.value)
+        self.target(s.target)
+
+    def stmt_Return(self, s):
+        self.expr(s.value)
+        self.cur = self._new()       # unreachable continuation
+
+    def stmt_Raise(self, s):
+        self.expr(s.exc)
+        self.expr(s.cause)
+        self.cur = self._new()       # handlers are wired by stmt_Try
+
+    def stmt_Break(self, s):
+        if self.loops:
+            self._edge(self.cur, self.loops[-1][1])
+        self.cur = self._new()
+
+    def stmt_Continue(self, s):
+        if self.loops:
+            self._edge(self.cur, self.loops[-1][0])
+        self.cur = self._new()
+
+    def stmt_If(self, s):
+        self.expr(s.test)
+        src = self.cur
+        join = self._new()
+        body = self._new()
+        self._edge(src, body)
+        self.cur = body
+        self.seq(s.body)
+        self._edge(self.cur, join)
+        if s.orelse:
+            els = self._new()
+            self._edge(src, els)
+            self.cur = els
+            self.seq(s.orelse)
+            self._edge(self.cur, join)
+        else:
+            self._edge(src, join)
+        self.cur = join
+
+    def stmt_While(self, s):
+        head = self._new()
+        self._edge(self.cur, head)
+        self.cur = head
+        self.expr(s.test)
+        exit_ = self._new()
+        body = self._new()
+        self._edge(head, body)
+        self.loops.append((head, exit_))
+        self.cur = body
+        self.seq(s.body)
+        self._edge(self.cur, head)
+        self.loops.pop()
+        if s.orelse:
+            els = self._new()
+            self._edge(head, els)
+            self.cur = els
+            self.seq(s.orelse)
+            self._edge(self.cur, exit_)
+        else:
+            self._edge(head, exit_)
+        self.cur = exit_
+
+    def stmt_For(self, s):
+        self.expr(s.iter)
+        head = self._new()
+        self._edge(self.cur, head)
+        self.cur = head
+        if isinstance(s, ast.AsyncFor):
+            self.emit(AWAIT, s)      # each __anext__ is an await point
+        self.target(s.target)
+        exit_ = self._new()
+        body = self._new()
+        self._edge(head, body)
+        self.loops.append((head, exit_))
+        self.cur = body
+        self.seq(s.body)
+        self._edge(self.cur, head)
+        self.loops.pop()
+        if s.orelse:
+            els = self._new()
+            self._edge(head, els)
+            self.cur = els
+            self.seq(s.orelse)
+            self._edge(self.cur, exit_)
+        else:
+            self._edge(head, exit_)
+        self.cur = exit_
+
+    stmt_AsyncFor = stmt_For
+
+    def stmt_With(self, s):
+        entry_locks = self.cur.locks
+        locknames = set()
+        for item in s.items:
+            self.expr(item.context_expr)
+            d = dotted(item.context_expr)
+            if d:
+                locknames.add(d)
+            if isinstance(s, ast.AsyncWith):
+                self.emit(AWAIT, s)  # __aenter__
+            if item.optional_vars is not None:
+                self.target(item.optional_vars)
+        body = self._new(entry_locks | frozenset(locknames))
+        self._edge(self.cur, body)
+        self.cur = body
+        self.seq(s.body)
+        after = self._new(entry_locks)
+        self._edge(self.cur, after)
+        self.cur = after
+        if isinstance(s, ast.AsyncWith):
+            # __aexit__ awaits; a lock is released by then, so the
+            # event lands in the after-block (outside the held scope)
+            self.emit(AWAIT, s)
+
+    stmt_AsyncWith = stmt_With
+
+    def stmt_Try(self, s):
+        body_start = len(self.cfg.blocks)
+        body_first = self._new()
+        self._edge(self.cur, body_first)
+        self.cur = body_first
+        self.seq(s.body)
+        # snapshot BEFORE the orelse, and give the orelse its own
+        # block: an exception in the else clause is NOT caught by this
+        # try's handlers, so else code must not grow exception edges
+        body_blocks = self.cfg.blocks[body_start:]
+        if s.orelse:
+            els = self._new()
+            self._edge(self.cur, els)
+            self.cur = els
+            self.seq(s.orelse)
+        body_end = self.cur
+        handler_exits = [body_end]
+        handler_entries = []
+        for h in s.handlers:
+            he = self._new()
+            handler_entries.append(he)
+            self.cur = he
+            if h.name:
+                self.emit(STORE_NAME, h, h.name)
+            self.seq(h.body)
+            handler_exits.append(self.cur)
+        # an exception can arise anywhere in the body: every body block
+        # reaches every handler entry (via exception edges)
+        for b in body_blocks:
+            for he in handler_entries:
+                if he not in b.except_succs:
+                    b.except_succs.append(he)
+        fin = self._new()
+        for x in handler_exits:
+            self._edge(x, fin)
+        self.cur = fin
+        if s.finalbody:
+            self.seq(s.finalbody)
+
+    if hasattr(ast, "TryStar"):      # pragma: no branch
+        stmt_TryStar = stmt_Try
+
+    def stmt_Match(self, s):
+        self.expr(s.subject)
+        src = self.cur
+        join = self._new()
+        for case in s.cases:
+            cb = self._new()
+            self._edge(src, cb)
+            self.cur = cb
+            if case.guard is not None:
+                self.expr(case.guard)
+            self.seq(case.body)
+            self._edge(self.cur, join)
+        self._edge(src, join)        # no case matched
+        self.cur = join
+
+    # -- assignment targets --
+
+    def target(self, t):
+        if isinstance(t, ast.Name):
+            self.emit(STORE_NAME, t, t.id)
+        elif isinstance(t, ast.Attribute):
+            self.expr(t.value)       # receiver loads (`self.a` in self.a.b=)
+            d = dotted(t)
+            if d:
+                self.emit(STORE, t, d)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self.target(e)
+        elif isinstance(t, ast.Starred):
+            self.target(t.value)
+        elif isinstance(t, ast.Subscript):
+            self.expr(t.value)
+            self.expr(t.slice)
+
+    def target_load(self, t):
+        """The read half of an AugAssign target."""
+        if isinstance(t, ast.Name):
+            self.emit(LOAD_NAME, t, t.id)
+        elif isinstance(t, ast.Attribute):
+            self.expr(t.value)
+            d = dotted(t)
+            if d:
+                self.emit(LOAD, t, d)
+        elif isinstance(t, ast.Subscript):
+            self.expr(t.value)
+            self.expr(t.slice)
+
+    # -- expressions (events in evaluation order) --
+
+    def expr(self, e):
+        if e is None:
+            return
+        m = getattr(self, "expr_" + type(e).__name__, None)
+        if m is not None:
+            m(e)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self.expr(child)
+
+    def expr_Await(self, e):
+        self.expr(e.value)
+        self.emit(AWAIT, e)          # the operand is computed, THEN awaited
+
+    def expr_Call(self, e):
+        self.expr(e.func)
+        for a in e.args:
+            self.expr(a)
+        for kw in e.keywords:
+            self.expr(kw.value)
+        self.emit(CALL, e, dotted(e.func))
+
+    def expr_Attribute(self, e):
+        d = dotted(e)
+        if d is not None:
+            self.emit(LOAD, e, d)
+        else:
+            # receiver is a call/subscript/...: recurse into it
+            self.expr(e.value)
+
+    def expr_Name(self, e):
+        self.emit(LOAD_NAME, e, e.id)
+
+    def expr_Lambda(self, e):
+        for d in e.args.defaults + [d for d in e.args.kw_defaults
+                                    if d is not None]:
+            self.expr(d)             # defaults evaluate here; body is opaque
+
+    def expr_NamedExpr(self, e):
+        self.expr(e.value)
+        self.emit(STORE_NAME, e.target, e.target.id)
+
+    def expr_Yield(self, e):
+        self.expr(e.value)
+        if self.is_async:
+            self.emit(AWAIT, e)      # async generator: consumer interleaves
+
+    def expr_YieldFrom(self, e):
+        self.expr(e.value)
+
+    def _comp(self, e):
+        for gen in e.generators:
+            self.expr(gen.iter)
+            if gen.is_async:
+                self.emit(AWAIT, e)
+            for cond in gen.ifs:
+                self.expr(cond)
+        if isinstance(e, ast.DictComp):
+            self.expr(e.key)
+            self.expr(e.value)
+        else:
+            self.expr(e.elt)
+
+    expr_ListComp = _comp
+    expr_SetComp = _comp
+    expr_DictComp = _comp
+    expr_GeneratorExp = _comp
+
+
+def build_cfg(func) -> FuncCFG:
+    """CFG for one ``FunctionDef``/``AsyncFunctionDef``."""
+    return _Builder(func).build()
+
+
+def iter_function_defs(tree):
+    """Every function def in *tree*, including nested ones (each gets
+    its own CFG; a nested def's events never leak into its parent's)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- path queries --
+
+KEEP = None
+STOP = "stop"
+HIT = "hit"
+
+
+def scan_paths(cfg: FuncCFG, start: tuple, classify,
+               follow_exceptions: bool = True) -> list:
+    """Forward reachability from *start* = (block, idx), exclusive.
+
+    ``classify(event, awaited)`` is called for every event reachable
+    strictly after the start position; ``awaited`` is True when some
+    await point lies on the path taken so far.  It returns:
+
+    - ``KEEP`` (None): continue through this event;
+    - ``STOP``: this path is resolved (e.g. the handle was protected);
+    - ``HIT``: record ``(event, awaited)`` and stop this path.
+
+    Await events flip ``awaited`` for everything downstream of them.
+    Each (position, awaited) state is visited once, so loops terminate;
+    returns the list of hits.  With ``follow_exceptions=False`` the
+    walk sticks to normal-flow edges (cancellation-window rules: a
+    cancel lands at an await on the normal path, never "inside" an
+    exception edge).
+    """
+    hits = []
+    hit_keys = set()
+    seen = set()
+    b, i = start
+    stack = [(b, i + 1, False)]
+    while stack:
+        blk, idx, awaited = stack.pop()
+        if idx >= len(blk.events):
+            succs = blk.succs + (blk.except_succs if follow_exceptions
+                                 else [])
+            for succ in succs:
+                key = ("b", succ.bid, awaited)
+                if key not in seen:
+                    seen.add(key)
+                    stack.append((succ, 0, awaited))
+            continue
+        key = (blk.bid, idx, awaited)
+        if key in seen:
+            continue
+        seen.add(key)
+        e = blk.events[idx]
+        verdict = classify(e, awaited)
+        if verdict == STOP:
+            continue
+        if verdict == HIT:
+            hkey = (id(e.node), awaited)
+            if hkey not in hit_keys:
+                hit_keys.add(hkey)
+                hits.append((e, awaited))
+            continue
+        if e.kind == AWAIT:
+            awaited = True
+        stack.append((blk, idx + 1, awaited))
+    return hits

@@ -14,7 +14,19 @@ Suppressions are per line::
 A suppression matches findings whose reported line is the line the
 comment sits on (for multi-line statements that is the first line).
 Suppressed findings are kept separately in :class:`LintResult` so the
-JSON output — and the test suite — can account for them.
+JSON output — and the test suite — can account for them.  A disable
+that suppresses nothing is itself reported (``unused-suppression``):
+stale suppressions are debt that must not outlive the finding.
+
+Checked *annotations* ride the same comment namespace: a
+``# mnt-lint: atomic-section`` marker line (optionally ``=<label>``)
+opens a region that a matching end marker (the same comment prefix
+followed by ``end-atomic-section``) closes.  Both markers must end the
+line — the ``$``-anchored regexes below keep prose mentions (like this
+docstring) from registering.  The region is an assertion the
+``atomic-section-broken`` rule verifies (an await inside it is a
+finding); the engine accounts for the markers themselves — unmatched
+or dead regions are reported like unused disables.
 
 Configuration comes from defaults < a JSON config file
 (``--config``, or ``.mnt-lint.json`` in the working directory when
@@ -27,8 +39,10 @@ import argparse
 import ast
 import dataclasses
 import fnmatch
+import hashlib
 import json
 import re
+import subprocess
 import sys
 from pathlib import Path
 from typing import Callable, Iterator
@@ -39,7 +53,12 @@ DEFAULT_PATHS = ["manatee_tpu", "tests", "tools", "bench.py",
 # the fixture suite under tests/data/lint depends on that)
 DEFAULT_EXCLUDE = ["tests/data"]
 
+DEFAULT_CACHE = ".mnt-lint-cache.json"
+
 _SUPPRESS_RE = re.compile(r"#\s*mnt-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_ATOMIC_BEGIN_RE = re.compile(
+    r"#\s*mnt-lint:\s*atomic-section(?:=([A-Za-z0-9_.\-]+))?\s*$")
+_ATOMIC_END_RE = re.compile(r"#\s*mnt-lint:\s*end-atomic-section\s*$")
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -78,6 +97,39 @@ class Config:
     # bench code drops e.g. the sync-file-I/O rule (tiny fixture writes
     # in a test do not need a worker thread).
     path_disable: tuple = ()
+    # atomic-section-broken: method-name globs for the load/save halves
+    # of a load-modify-save pair routed through calls (dirstore's
+    # `_load_meta`/`_save_meta`).  The glob's literal core is stripped
+    # to pair them ("_load_meta" <-> "_save_meta" share the "_·_meta"
+    # stem).
+    atomic_load_calls: frozenset = frozenset({"*load*"})
+    atomic_save_calls: frozenset = frozenset({"*save*"})
+    # cancel-unsafe-acquire: handle-yielding acquires — the bound
+    # result is the resource.  An entry with a dot matches the dotted
+    # callee exactly; a bare entry matches the last component (so
+    # "open" covers the builtin and `path.open`).
+    acquire_calls: frozenset = frozenset({
+        "open", "os.fdopen", "socket.socket",
+        "open_connection", "open_unix_connection",
+        "start_server", "start_unix_server",
+        "create_server", "create_unix_server",
+        "create_subprocess_exec", "create_subprocess_shell",
+    })
+    # side-effect acquires: the resource exists but no handle comes
+    # back (dataset `create` — the cancel window that stranded
+    # meta-less debris in PR 8), checked in discarded form: execution
+    # must enter a cleanup-capable try before the next await.  A
+    # znode-style create whose bound result is just a PATH string is
+    # deliberately not in acquire_calls.
+    acquire_discard_calls: frozenset = frozenset({"create"})
+    # "<path-glob>::<function-glob>" entries where an unguarded
+    # side-effect acquire is deliberate — test/bench setup whose
+    # cleanup is directory teardown rather than a try block
+    acquire_discard_allow: frozenset = frozenset()
+    # lockset-inconsistent: how many lock-guarded access sites establish
+    # an attribute's lock discipline (below this, a lock seen once is
+    # just coincidence, not a contract)
+    lockset_min_guarded: int = 2
 
     _KEYS = {
         "max-line": "max_line",
@@ -88,6 +140,13 @@ class Config:
         "unbounded-allow": "unbounded_allow",
         "blocking-extra": "blocking_extra",
         "path-disable": "path_disable",
+        "atomic-load-calls": "atomic_load_calls",
+        "atomic-save-calls": "atomic_save_calls",
+        "acquire-calls": "acquire_calls",
+        "acquire-discard-calls": "acquire_discard_calls",
+        "acquire-discard-allow": "acquire_discard_allow",
+        "lockset-min-guarded": "lockset_min_guarded",
+        "notes": None,       # free-form justifications, ignored here
     }
 
     @classmethod
@@ -96,10 +155,12 @@ class Config:
         cfg = base or cls()
         kw = {}
         for key, val in data.items():
-            field = cls._KEYS.get(key)
-            if field is None:
+            if key not in cls._KEYS:
                 raise ValueError("unknown mnt-lint config key: %r" % key)
-            if field == "max_line":
+            field = cls._KEYS[key]
+            if field is None:
+                continue
+            if field in ("max_line", "lockset_min_guarded"):
                 kw[field] = int(val)
             elif field == "exclude":
                 kw[field] = tuple(val)
@@ -165,6 +226,14 @@ def _syntax_rule(ctx):
     return iter(())
 
 
+# engine-level too: computed in check_source after suppression matching
+# (a rule generator cannot see which suppressions ended up unused)
+@rule("unused-suppression",
+      "disable comment or annotation that suppresses/verifies nothing")
+def _unused_suppression_rule(ctx):
+    return iter(())
+
+
 # ---- AST helpers shared by rules ----
 
 def dotted(node) -> str | None:
@@ -216,6 +285,9 @@ class FileContext:
         self.lines = text.splitlines()
         self._parents: dict | None = None
         self._owners: dict | None = None
+        self._cfgs: dict | None = None
+        self._annotations: list | None = None
+        self._module_globals: frozenset | None = None
 
     def finding(self, line: int, rule_name: str, msg: str) -> Finding:
         return Finding(self.path, line, rule_name, msg)
@@ -254,6 +326,42 @@ class FileContext:
         owner = self.owners.get(node)
         return owner if isinstance(owner, ast.AsyncFunctionDef) else None
 
+    @property
+    def cfgs(self) -> dict:
+        """function def node -> FuncCFG, for every def in the file
+        (built once, shared by all flow-sensitive rules)."""
+        if self._cfgs is None:
+            from manatee_tpu.lint import cfg as cfgmod
+            self._cfgs = {fn: cfgmod.build_cfg(fn)
+                          for fn in cfgmod.iter_function_defs(self.tree)}
+        return self._cfgs
+
+    @property
+    def annotations(self) -> list:
+        """Well-formed atomic-section regions: [(begin, end, label)].
+        Malformed markers are accounted for by the engine itself."""
+        if self._annotations is None:
+            self._annotations, _ = parse_annotations(self.text)
+        return self._annotations
+
+    @property
+    def module_globals(self) -> frozenset:
+        """Names bound by module-level statements (assignment targets;
+        imports and defs are not *mutable* state and stay out)."""
+        if self._module_globals is None:
+            names: set[str] = set()
+            for node in self.tree.body:
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets \
+                        if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name):
+                                names.add(sub.id)
+            self._module_globals = frozenset(names)
+        return self._module_globals
+
 
 # ---- suppression handling ----
 
@@ -267,6 +375,68 @@ def parse_suppressions(text: str) -> dict:
             if names:
                 out[i] = names
     return out
+
+
+def parse_annotations(text: str) -> tuple[list, list]:
+    """Atomic-section markers -> (regions, problems).
+
+    ``regions`` is ``[(begin_line, end_line, label)]`` for matched
+    begin/end pairs; ``problems`` is ``[(line, msg)]`` for unmatched or
+    nested markers.  Regions do not nest (an atomic claim inside an
+    atomic claim adds nothing and usually means a stray marker).
+    """
+    regions, problems = [], []
+    open_at: tuple | None = None
+    for i, line in enumerate(text.splitlines(), 1):
+        if _ATOMIC_END_RE.search(line):
+            if open_at is None:
+                problems.append(
+                    (i, "end-atomic-section without a matching "
+                        "atomic-section begin"))
+            else:
+                regions.append((open_at[0], i, open_at[1]))
+                open_at = None
+            continue
+        m = _ATOMIC_BEGIN_RE.search(line)
+        if m:
+            if open_at is not None:
+                problems.append(
+                    (i, "atomic-section opened at line %d is still "
+                        "open (sections do not nest)" % open_at[0]))
+            else:
+                open_at = (i, m.group(1))
+    if open_at is not None:
+        problems.append(
+            (open_at[0], "atomic-section is never closed (add a "
+                         "'# mnt-lint: end-atomic-section' marker)"))
+    return regions, problems
+
+
+def _annotation_accounting(ctx: FileContext) -> Iterator[Finding]:
+    """Unmatched markers, plus regions that cannot verify anything: a
+    section outside any async execution context has no await points to
+    forbid, so the claim is dead weight (reported like an unused
+    disable)."""
+    _, problems = parse_annotations(ctx.text)
+    for line, msg in problems:
+        yield ctx.finding(line, "unused-suppression", msg)
+    for begin, end, label in ctx.annotations:
+        # live = some statement in range runs in an async function that
+        # ENCLOSES the region (a def nested inside the region executes
+        # later, not while the section does — its awaits don't count,
+        # so it can't make the claim checkable either)
+        live = any(
+            begin <= getattr(node, "lineno", 0) <= end
+            and (fn := ctx.async_owner(node)) is not None
+            and fn.lineno <= begin
+            for node in ast.walk(ctx.tree) if isinstance(node, ast.stmt))
+        if not live:
+            yield ctx.finding(
+                begin, "unused-suppression",
+                "atomic-section%s covers no statement in an async "
+                "function: nothing here can await, so the annotation "
+                "verifies nothing"
+                % (" %r" % label if label else ""))
 
 
 # ---- core per-file run ----
@@ -291,12 +461,32 @@ def check_source(text: str, path: str = "<string>",
         findings.extend(r.fn(ctx))
     supp = parse_suppressions(text)
     kept, suppressed = [], []
+    used: dict[int, set] = {}
     for f in sorted(findings):
         names = supp.get(f.line, ())
         if "all" in names or f.rule in names:
             suppressed.append(f)
+            used.setdefault(f.line, set()).add(
+                f.rule if f.rule in names else "all")
         else:
             kept.append(f)
+    if "unused-suppression" not in disabled:
+        # a disable that silenced nothing is stale debt; reported
+        # OUTSIDE the suppression match so it cannot silence itself.
+        # Names for rules disabled by config are skipped: the comment
+        # documents intent for profiles where the rule IS on, and a
+        # path-disable must not turn it into a finding.
+        for line, names in sorted(supp.items()):
+            for name in sorted(names - used.get(line, set()) - disabled):
+                what = "disable=all" if name == "all" \
+                    else "suppression for %r" % name
+                kept.append(ctx.finding(
+                    line, "unused-suppression",
+                    "%s matches no finding on this line — remove it "
+                    "(stale suppressions hide future regressions)"
+                    % what))
+        kept.extend(_annotation_accounting(ctx))
+        kept.sort()
     return LintResult(path, kept, suppressed)
 
 
@@ -347,7 +537,8 @@ def iter_files(paths, config: Config) -> Iterator[Path]:
             yield p
 
 
-def check_paths(paths, config: Config | None = None
+def check_paths(paths, config: Config | None = None,
+                cache: "ResultCache | None" = None
                 ) -> tuple[int, list, list]:
     """(files checked, findings, suppressed findings) over *paths*."""
     config = config or Config()
@@ -356,10 +547,164 @@ def check_paths(paths, config: Config | None = None
     suppressed: list[Finding] = []
     for f in iter_files(paths, config):
         n += 1
-        res = check_file(f, config)
+        res = cache.lookup(f) if cache is not None else None
+        if res is None:
+            res = check_file(f, config)
+            if cache is not None:
+                cache.store(f, res)
         findings.extend(res.findings)
         suppressed.extend(res.suppressed)
     return n, findings, suppressed
+
+
+# ---- content-hash result cache (--cache) ----
+
+class ResultCache:
+    """Per-path lint results keyed on a content hash.
+
+    The key folds in the file bytes, the effective config, and a digest
+    of the lint package's own sources — editing a rule invalidates
+    everything, editing one file invalidates that file.  Stored as JSON,
+    one entry per path; entries for files that no longer exist are
+    pruned at save() time.
+    """
+
+    def __init__(self, path: str | Path, config: Config):
+        self.path = Path(path)
+        self.salt = hashlib.sha256(
+            (_tool_digest() + _config_digest(config)).encode()).hexdigest()
+        self.entries: dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        try:
+            data = json.loads(self.path.read_text())
+            if isinstance(data, dict) and data.get("salt") == self.salt:
+                self.entries = data.get("entries", {})
+        except (OSError, ValueError):
+            pass
+
+    def _key(self, path: Path) -> str | None:
+        try:
+            blob = path.read_bytes()
+        except OSError:
+            return None
+        return hashlib.sha256(self.salt.encode() + blob).hexdigest()
+
+    def lookup(self, path: Path) -> LintResult | None:
+        ent = self.entries.get(str(path))
+        if not ent or ent.get("key") != self._key(path):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return LintResult(
+            str(path),
+            [Finding(**d) for d in ent["findings"]],
+            [Finding(**d) for d in ent["suppressed"]])
+
+    def store(self, path: Path, res: LintResult):
+        key = self._key(path)
+        if key is None:
+            return
+        self.entries[str(path)] = {
+            "key": key,
+            "findings": [f.as_dict() for f in res.findings],
+            "suppressed": [f.as_dict() for f in res.suppressed],
+        }
+
+    def save(self):
+        # entries whose file is gone (renames, deletions) are dropped
+        # here, so the cache tracks the live tree instead of growing
+        # with every path that ever existed
+        self.entries = {p: ent for p, ent in self.entries.items()
+                        if Path(p).is_file()}
+        try:
+            self.path.write_text(json.dumps(
+                {"salt": self.salt, "entries": self.entries},
+                sort_keys=True))
+        except OSError as e:
+            print("mnt-lint: cannot write cache %s: %s"
+                  % (self.path, e), file=sys.stderr)
+
+
+def _tool_digest() -> str:
+    """Digest of the lint package sources: any rule/engine edit must
+    invalidate every cached result."""
+    h = hashlib.sha256()
+    pkg = Path(__file__).parent
+    for f in sorted(pkg.glob("*.py")):
+        h.update(f.name.encode())
+        try:
+            h.update(f.read_bytes())
+        except OSError:
+            pass
+    return h.hexdigest()
+
+
+def _config_digest(config: Config) -> str:
+    def enc(v):
+        if isinstance(v, frozenset):
+            return sorted(enc(x) for x in v)
+        if isinstance(v, tuple):
+            return [enc(x) for x in v]
+        return v
+    return json.dumps(
+        {f.name: enc(getattr(config, f.name))
+         for f in dataclasses.fields(config)}, sort_keys=True)
+
+
+# ---- --changed: lint only files git considers modified ----
+
+def changed_files(base: str | None = None) -> list[str]:
+    """Paths changed vs *base* (default: the working tree + index vs
+    HEAD) plus untracked files, repo-relative."""
+    cmds = [
+        ["git", "diff", "--name-only", base or "HEAD", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ]
+    out: set[str] = set()
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except OSError as e:
+            raise SystemExit("mnt-lint: cannot run git: %s" % e)
+        if proc.returncode != 0:
+            raise SystemExit("mnt-lint: %s failed: %s"
+                             % (" ".join(cmd), proc.stderr.strip()))
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return sorted(out)
+
+
+def _within(path: str, roots) -> bool:
+    p = Path(path)
+    for root in roots:
+        r = Path(root)
+        if p == r:
+            return True
+        try:
+            p.relative_to(r)
+            return True
+        except ValueError:
+            continue
+    return False
+
+
+def select_changed(roots, config: Config, base: str | None = None
+                   ) -> list[Path]:
+    """The lintable subset of git-changed files under *roots*: same
+    .py/shebang gating and exclude list as a directory walk."""
+    picked = []
+    for rel in changed_files(base):
+        p = Path(rel)
+        if not p.is_file():
+            continue             # deleted/renamed-away
+        if not _within(rel, roots):
+            continue
+        if _excluded(p, config):
+            continue
+        if p.suffix == ".py" or _is_python_script(p):
+            picked.append(p)
+    return picked
 
 
 # ---- allowlist matching (used by unbounded-wait) ----
@@ -378,6 +723,53 @@ def allow_matches(entries, path: str, funcname: str) -> bool:
                 or fnmatch.fnmatch(path, "*" + pat_path.lstrip("*")):
             return True
     return False
+
+
+# ---- SARIF output (--format sarif) ----
+
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def to_sarif(findings, suppressed) -> dict:
+    """One SARIF 2.1.0 run: kept findings as plain results, suppressed
+    ones carried with an ``inSource`` suppression record so code
+    scanning shows the debt without gating on it."""
+    def result(f: Finding, suppressed_in_source: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        if suppressed_in_source:
+            out["suppressions"] = [{"kind": "inSource"}]
+        return out
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mnt-lint",
+                "informationUri":
+                    "https://github.com/TritonDataCenter/manatee",
+                "rules": [
+                    {"id": name,
+                     "shortDescription": {"text": RULES[name].summary}}
+                    for name in sorted(RULES)],
+            }},
+            "results": [result(f, False) for f in findings]
+                       + [result(f, True) for f in suppressed],
+        }],
+    }
 
 
 # ---- CLI ----
@@ -414,7 +806,7 @@ def main(argv=None) -> int:
                     "(docs/lint.md)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to check (default: the repo tree)")
-    ap.add_argument("--format", choices=("human", "json"),
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
                     default="human")
     ap.add_argument("--disable", action="append", default=[],
                     metavar="RULE[,RULE...]",
@@ -426,6 +818,20 @@ def main(argv=None) -> int:
     ap.add_argument("--unbounded-allow", action="append", default=[],
                     metavar="PATH::FUNC",
                     help="allowlist entry for the unbounded-wait rule")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only files git reports changed vs BASE "
+                         "(default HEAD) plus untracked files, within "
+                         "the given paths")
+    ap.add_argument("--cache", nargs="?", const=DEFAULT_CACHE,
+                    default=None, metavar="FILE",
+                    help="reuse results for unchanged file content "
+                         "(key: file bytes + config + lint sources; "
+                         "default file %s)" % DEFAULT_CACHE)
+    ap.add_argument("--suppression-baseline", metavar="FILE",
+                    help="JSON {\"suppressed\": N}: fail when the "
+                         "suppressed-finding count exceeds N (zero "
+                         "NEW suppressions vs the committed baseline)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -436,8 +842,35 @@ def main(argv=None) -> int:
         return 0
 
     config = _build_config(args)
-    n, findings, suppressed = check_paths(args.paths or DEFAULT_PATHS,
-                                          config)
+    roots = args.paths or DEFAULT_PATHS
+    cache = ResultCache(args.cache, config) if args.cache else None
+    if args.changed is not None:
+        targets = select_changed(roots, config, args.changed)
+        if not targets:
+            print("mnt-lint: no changed files under %s"
+                  % ", ".join(map(str, roots)), file=sys.stderr)
+    else:
+        targets = roots
+    n, findings, suppressed = check_paths(targets, config, cache)
+    if cache is not None:
+        cache.save()
+    rc = 1 if findings else 0
+    if args.suppression_baseline:
+        try:
+            baseline = json.loads(Path(
+                args.suppression_baseline).read_text())
+            allowed = int(baseline["suppressed"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            raise SystemExit("mnt-lint: bad suppression baseline %s: %s"
+                             % (args.suppression_baseline, e))
+        if len(suppressed) > allowed:
+            print("mnt-lint: %d suppressions exceed the committed "
+                  "baseline of %d (%s) — fix the findings instead of "
+                  "suppressing them, or justify a baseline bump in "
+                  "review" % (len(suppressed), allowed,
+                              args.suppression_baseline),
+                  file=sys.stderr)
+            rc = 1
     if args.format == "json":
         print(json.dumps({
             "files": n,
@@ -445,9 +878,26 @@ def main(argv=None) -> int:
             "findings": [f.as_dict() for f in findings],
             "suppressed": [f.as_dict() for f in suppressed],
         }, indent=2, sort_keys=True))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings, suppressed), indent=2,
+                         sort_keys=True))
+        # stdout is usually redirected into the upload file; keep the
+        # job log actionable by rendering the findings on stderr too
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        summary = "mnt-lint: %d files, %d problems (%d suppressed)" \
+            % (n, len(findings), len(suppressed))
+        if cache is not None:
+            summary += " [cache: %d hits, %d misses]" % (cache.hits,
+                                                         cache.misses)
+        print(summary, file=sys.stderr)
     else:
         for f in findings:
             print(f.render())
-        print("mnt-lint: %d files, %d problems (%d suppressed)"
-              % (n, len(findings), len(suppressed)), file=sys.stderr)
-    return 1 if findings else 0
+        summary = "mnt-lint: %d files, %d problems (%d suppressed)" \
+            % (n, len(findings), len(suppressed))
+        if cache is not None:
+            summary += " [cache: %d hits, %d misses]" % (cache.hits,
+                                                         cache.misses)
+        print(summary, file=sys.stderr)
+    return rc

@@ -1,0 +1,586 @@
+"""Flow-sensitive async rules over per-function CFGs (lint/cfg.py).
+
+The event-loop concurrency model gives every coroutine a free mutual
+exclusion guarantee: between two await points nobody else runs.  All
+three rules here police the places where that guarantee silently ends
+— an ``await`` inside a window that looked atomic:
+
+- ``atomic-section-broken``: a load-modify-save of shared state with an
+  await between the load and the save (the torn-meta bug class: a
+  concurrent writer's save lands during the await and this save then
+  reinstates stale state).  Declared ``# mnt-lint: atomic-section``
+  regions are verified await-free; load/save pairs are also inferred
+  from data flow.
+- ``lockset-inconsistent``: Eraser-style lockset inference per
+  attribute — an attribute the class guards with ``async with
+  self._lock`` at several sites, written elsewhere across an await
+  without it, breaks the very interleavings the lock exists to stop.
+- ``cancel-unsafe-acquire``: a resource-acquiring call whose handle is
+  still unprotected (no context manager, no try/finally, no ownership
+  transfer) at the next await point — a cancellation landing there
+  leaks the handle forever (the PR 8 listening-socket leak class).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from manatee_tpu.lint.cfg import (
+    AWAIT,
+    CALL,
+    HIT,
+    KEEP,
+    LOAD,
+    LOAD_NAME,
+    STOP,
+    STORE,
+    STORE_NAME,
+    scan_paths,
+)
+from manatee_tpu.lint.engine import (
+    FileContext,
+    allow_matches,
+    dotted,
+    rule,
+    walk_no_defs,
+)
+
+RULE_ATOMIC = "atomic-section-broken"
+RULE_LOCKSET = "lockset-inconsistent"
+RULE_CANCEL = "cancel-unsafe-acquire"
+
+_AWAIT_NODES = (ast.Await, ast.AsyncFor, ast.AsyncWith)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+# ---------------------------------------------------------------- helpers
+
+def _lock_withs(ctx: FileContext, node) -> list:
+    """(with-stmt, lock names) for every enclosing ``with``/``async
+    with`` over plain dotted expressions, innermost first."""
+    out = []
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(cur, _FUNC_NODES):
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            names = frozenset(
+                d for item in cur.items
+                if (d := dotted(item.context_expr)) is not None)
+            if names:
+                out.append((cur, names))
+        cur = ctx.parents.get(cur)
+    return out
+
+
+def _shares_lock_stmt(ctx: FileContext, a, b) -> bool:
+    """True when one dotted-CM with statement lexically encloses both
+    *a* and *b* — a lock provably held across the whole window."""
+    held = {id(w) for w, _ in _lock_withs(ctx, a)}
+    return any(id(w) in held for w, _ in _lock_withs(ctx, b))
+
+
+def _mentions(node, names: set) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(node))
+
+
+def _glob_stem(name: str, globs) -> str | None:
+    """Strip a matching glob's literal core out of *name*, pairing
+    '_load_meta' (via '*load*') with '_save_meta' (via '*save*') on
+    the shared '__meta' stem."""
+    for g in globs:
+        if fnmatch.fnmatch(name, g):
+            core = g.replace("*", "")
+            if core and core in name:
+                return name.replace(core, "", 1)
+            return name
+    return None
+
+
+# ----------------------------------------------------- atomic-section-broken
+
+@rule(RULE_ATOMIC,
+      "load-modify-save of shared state spans an await point")
+def atomic_section_broken(ctx: FileContext):
+    """Two halves.  Declared: a ``# mnt-lint: atomic-section`` region
+    asserts no await point inside — the machine-checked form of the
+    prose invariants dirstore._save_meta and coordd's snapshot pairing
+    used to carry as comments.  Inferred: a local loaded from
+    ``self.X``/module state (or a ``*load*`` method call) that flows
+    into a save of the same state with an await on some path between
+    them — unless one dotted ``with``/``async with`` (a lock) spans the
+    whole window, or the local is re-loaded after the await."""
+    yield from _atomic_declared(ctx)
+    yield from _atomic_inferred(ctx)
+
+
+def _atomic_declared(ctx: FileContext):
+    for begin, end, label in ctx.annotations:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, _AWAIT_NODES):
+                continue
+            line = getattr(node, "lineno", 0)
+            if begin <= line <= end:
+                owner = ctx.owners.get(node)
+                if owner is not None and owner.lineno > begin:
+                    # the await belongs to a def nested INSIDE the
+                    # region: it runs when that function is later
+                    # called, not while the section executes (the CFG
+                    # layer treats nested defs as opaque for the same
+                    # reason)
+                    continue
+                what = {ast.Await: "await",
+                        ast.AsyncFor: "async for",
+                        ast.AsyncWith: "async with"}[type(node)]
+                yield ctx.finding(
+                    line, RULE_ATOMIC,
+                    "atomic section%s declared at line %d is broken by "
+                    "this %s: another task can interleave here and the "
+                    "section's load-to-save window is no longer atomic"
+                    % (" %r" % label if label else "", begin, what))
+
+
+def _state_of(ctx: FileContext, value, local_names: set,
+              declared_globals: set):
+    """What shared state an assignment's RHS reads, if any."""
+    if isinstance(value, ast.Attribute):
+        d = dotted(value)
+        if d and d.startswith("self."):
+            return ("attr", d)
+        return None
+    if isinstance(value, ast.Name):
+        # module state: a `global`-declared name, or a module-level
+        # binding the function never shadows with a local store
+        if value.id in ctx.module_globals \
+                and (value.id in declared_globals
+                     or value.id not in local_names):
+            return ("global", value.id)
+        return None
+    call = value.value if isinstance(value, ast.Await) else value
+    if isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value)
+        stem = _glob_stem(call.func.attr, ctx.config.atomic_load_calls)
+        if recv is not None and stem is not None:
+            arg0 = ast.dump(call.args[0]) if call.args else None
+            return ("loadcall", recv, stem, arg0)
+    return None
+
+
+def _save_anchors(ctx: FileContext, fn, state, local: str) -> dict:
+    """id(event-anchor-node) -> (line, description) for statements in
+    *fn* that save *state* using the loaded value *local*."""
+    out: dict[int, tuple] = {}
+    owners = ctx.owners
+    for node in walk_no_defs(fn):
+        if owners.get(node) is not fn:
+            continue
+        if state[0] in ("attr", "global"):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not _mentions(value, {local}):
+                continue
+            for t in targets:
+                if state[0] == "attr" and isinstance(t, ast.Attribute) \
+                        and dotted(t) == state[1]:
+                    out[id(t)] = (t.lineno, state[1])
+                elif state[0] == "global" and isinstance(t, ast.Name) \
+                        and t.id == state[1]:
+                    out[id(t)] = (t.lineno, state[1])
+        else:                    # loadcall
+            _, recv, stem, arg0 = state
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            if dotted(node.func.value) != recv:
+                continue
+            save_stem = _glob_stem(node.func.attr,
+                                   ctx.config.atomic_save_calls)
+            if save_stem is None or save_stem != stem:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if not any(_mentions(a, {local}) for a in args):
+                continue
+            if arg0 is not None and node.args \
+                    and ast.dump(node.args[0]) != arg0:
+                continue         # a different dataset/key: not this pair
+            out[id(node)] = (node.lineno,
+                             "%s.%s(...)" % (recv, node.func.attr))
+    return out
+
+
+def _atomic_inferred(ctx: FileContext):
+    owners = ctx.owners
+    for fn, cfg in ctx.cfgs.items():
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        local_names = {e.name for _, _, e in cfg.events()
+                       if e.kind == STORE_NAME}
+        declared_globals = {n for node in walk_no_defs(fn)
+                            if isinstance(node, ast.Global)
+                            for n in node.names}
+        for node in walk_no_defs(fn):
+            if owners.get(node) is not fn \
+                    or not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            local = node.targets[0].id
+            state = _state_of(ctx, node.value, local_names,
+                              declared_globals)
+            if state is None:
+                continue
+            anchors = _save_anchors(ctx, fn, state, local)
+            if not anchors:
+                continue
+            start = cfg.position_of(node.targets[0])
+            if start is None:
+                continue
+
+            def classify(e, awaited, *, _local=local, _anchors=anchors):
+                if id(e.node) in _anchors:
+                    # an unawaited save does NOT resolve the window: a
+                    # save/await/save sequence still reinstates
+                    # pre-await state at the second save, so keep
+                    # walking (only a re-load of the local ends it)
+                    return HIT if awaited else KEEP
+                if e.kind == STORE_NAME and e.name == _local:
+                    return STOP   # re-loaded/rebound: a fresh window
+                return KEEP
+
+            for e2, _ in scan_paths(cfg, start, classify):
+                if _shares_lock_stmt(ctx, node, e2.node):
+                    continue      # one lock spans load and save
+                line, desc = anchors[id(e2.node)]
+                yield ctx.finding(
+                    line, RULE_ATOMIC,
+                    "load-modify-save of %s spans an await: %r was "
+                    "loaded at line %d and an interleaved writer can "
+                    "land before this save reinstates the stale value "
+                    "— re-load after the await, or hold one lock "
+                    "across the whole window"
+                    % (desc, local, node.lineno))
+
+
+# ---------------------------------------------------- lockset-inconsistent
+
+def _first_level(name: str) -> str | None:
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[0] == "self":
+        return "self." + parts[1]
+    return None
+
+
+@rule(RULE_LOCKSET,
+      "attribute lock-guarded at some sites, written across an await "
+      "without it elsewhere")
+def lockset_inconsistent(ctx: FileContext):
+    """Eraser's lockset discipline, adapted to the event loop: single
+    reads/writes are already atomic here, so only *windows* race — a
+    read or write of ``self.X`` followed on some path by a write of
+    ``self.X`` with an await between them.  When the class guards X
+    with ``async with self.<lock>`` at ``lockset-min-guarded``+ sites,
+    any such window not spanned by that lock is exactly the
+    interleaving the guarded sites were protecting against."""
+    min_guarded = ctx.config.lockset_min_guarded
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        accesses = []            # (key, event, block, idx, cfg)
+        lock_attrs: set[str] = set()
+        for m in methods:
+            cfg = ctx.cfgs.get(m)
+            if cfg is None:
+                continue
+            for b in cfg.blocks:
+                lock_attrs.update(
+                    name for name in b.locks if name.startswith("self."))
+            for b, i, e in cfg.events():
+                if e.kind in (LOAD, STORE) and e.name:
+                    key = _first_level(e.name)
+                    if key is not None:
+                        accesses.append((key, e, b, i, cfg))
+        guard_sites: dict[tuple, set] = {}
+        for key, e, b, i, cfg in accesses:
+            if key in lock_attrs:
+                continue
+            for lock in b.locks:
+                if lock.startswith("self."):
+                    guard_sites.setdefault((key, lock), set()).add(e.line)
+        guarding: dict[str, set] = {}
+        for (key, lock), lines in guard_sites.items():
+            if len(lines) >= min_guarded:
+                guarding.setdefault(key, set()).add(lock)
+        reported: set[tuple] = set()
+        for key, e1, b1, i1, cfg in accesses:
+            locks = guarding.get(key)
+            if not locks:
+                continue
+
+            def classify(e, awaited, *, _key=key, _e1=e1):
+                if e.kind == STORE and e.name \
+                        and _first_level(e.name) == _key \
+                        and e.node is not _e1.node:
+                    return HIT if awaited else STOP
+                return KEEP
+
+            for e2, _ in scan_paths(cfg, (b1, i1), classify):
+                pos2 = cfg.position_of(e2.node)
+                locks2 = pos2[0].locks if pos2 else frozenset()
+                if locks & b1.locks & locks2 \
+                        and _shares_lock_stmt(ctx, e1.node, e2.node):
+                    continue     # guarded continuously across the window
+                mark = (key, e2.line)
+                if mark in reported:
+                    continue
+                reported.add(mark)
+                lockname = sorted(locks)[0]
+                yield ctx.finding(
+                    e2.line, RULE_LOCKSET,
+                    "%s is guarded by 'async with %s' at %d other "
+                    "site(s), but this write ends a window (opened at "
+                    "line %d) that crosses an await without it — take "
+                    "the lock across the window or document why this "
+                    "site cannot race"
+                    % (key, lockname,
+                       len(guard_sites.get((key, lockname), ())),
+                       e1.line))
+
+
+# --------------------------------------------------- cancel-unsafe-acquire
+
+_ACQ_WRAPPERS = {"wait_for", "shield"}
+_CLOSE_METHODS = {
+    "close", "aclose", "terminate", "kill", "release", "cancel",
+    "unlink", "wait_closed", "shutdown", "stop", "abort", "detach",
+}
+
+
+def _qualname(ctx: FileContext, node) -> str:
+    owner = ctx.owners.get(node)
+    return owner.name if owner is not None else "<module>"
+
+
+def _name_match(entries, name: str | None) -> bool:
+    if not name:
+        return False
+    for entry in entries:
+        if "." in entry:
+            if name == entry:
+                return True
+        elif name == entry or name.endswith("." + entry):
+            return True
+    return False
+
+
+def _binding_of(ctx: FileContext, call) -> tuple:
+    """('with'|'discard'|'handles'|'escape', data) — how the acquire's
+    result is bound.  Climbs through await and wait_for/shield
+    wrappers to the binding statement."""
+    cur = call
+    parent = ctx.parents.get(cur)
+    while True:
+        if isinstance(parent, ast.Await):
+            cur, parent = parent, ctx.parents.get(parent)
+            continue
+        if isinstance(parent, ast.Call):
+            pname = dotted(parent.func)
+            if pname and pname.rsplit(".", 1)[-1] in _ACQ_WRAPPERS \
+                    and cur in parent.args:
+                cur, parent = parent, ctx.parents.get(parent)
+                continue
+        break
+    if isinstance(parent, ast.withitem):
+        return ("with", None)
+    if isinstance(parent, ast.Expr):
+        return ("discard", cur)
+    if isinstance(parent, ast.Assign) and parent.value is cur \
+            and len(parent.targets) == 1:
+        t = parent.targets[0]
+        if isinstance(t, ast.Name):
+            return ("handles", (parent, [t]))
+        if isinstance(t, ast.Tuple) and all(
+                isinstance(e, ast.Name) for e in t.elts):
+            return ("handles", (parent, list(t.elts)))
+    # attribute/subscript targets, return values, nested expressions:
+    # ownership moves somewhere this local analysis cannot follow
+    return ("escape", None)
+
+
+def _cleanup_try(ctx: FileContext, node, handles: set | None) -> bool:
+    """Is *node* inside a try statement whose finally (or a
+    BaseException/CancelledError/bare handler) can clean up?  With
+    *handles*, the cleanup must actually mention one of them."""
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(cur, _FUNC_NODES):
+        if isinstance(cur, ast.Try):
+            bodies = list(cur.finalbody)
+            for h in cur.handlers:
+                names = set()
+                if h.type is not None:
+                    for n in (h.type.elts if isinstance(h.type, ast.Tuple)
+                              else [h.type]):
+                        d = dotted(n)
+                        if d:
+                            names.add(d.rsplit(".", 1)[-1])
+                if h.type is None or names & {"BaseException",
+                                              "CancelledError"}:
+                    bodies.extend(h.body)
+            if bodies:
+                if handles is None:
+                    return True
+                if any(_mentions(s, handles) for s in bodies):
+                    return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def _idempotent_ensure(ctx: FileContext, node) -> bool:
+    """A discarded create that is guarded by an existence check
+    (``if not await x.exists(...):``) or sits in a try tolerating an
+    *ExistsError is an idempotent *ensure*: a cancellation leaves
+    convergent state a retry walks straight past, not stranded debris
+    (coord mkdirp, the isolate-parent create, the dataset ensure)."""
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(cur, _FUNC_NODES):
+        if isinstance(cur, ast.If) and any(
+                isinstance(sub, ast.Call)
+                and (d := dotted(sub.func)) is not None
+                and d.rsplit(".", 1)[-1] == "exists"
+                for sub in ast.walk(cur.test)):
+            return True
+        if isinstance(cur, ast.Try):
+            for h in cur.handlers:
+                if h.type is None:
+                    continue
+                for n in (h.type.elts if isinstance(h.type, ast.Tuple)
+                          else [h.type]):
+                    d = dotted(n)
+                    if d and d.rsplit(".", 1)[-1].endswith("ExistsError"):
+                        return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def _protecting_use(ctx: FileContext, name_node) -> bool:
+    """A bare-name use of a handle that transfers or guards ownership:
+    with-item, return/yield, call argument, stored into an object, or
+    aliased to another name."""
+    cur, parent = name_node, ctx.parents.get(name_node)
+    while parent is not None and not isinstance(parent, ast.stmt):
+        if isinstance(parent, ast.withitem):
+            return True
+        if isinstance(parent, ast.Call) and cur is not parent.func:
+            return True          # passed as an argument: ownership moves
+        if isinstance(parent, (ast.Return, ast.Yield)):
+            return True
+        cur, parent = parent, ctx.parents.get(parent)
+    if isinstance(parent, (ast.Return, ast.With, ast.AsyncWith)):
+        return True
+    if isinstance(parent, ast.Assign) and _mentions(parent.value,
+                                                    {name_node.id}):
+        return True              # stored/aliased: the new owner cleans up
+    return False
+
+
+@rule(RULE_CANCEL,
+      "acquired resource unprotected at the next await point")
+def cancel_unsafe_acquire(ctx: FileContext):
+    """Between acquiring a resource and wrapping it in a context
+    manager / try-finally, a cancellation landing on any await leaks
+    the handle: the CancelledError propagates and nothing ever closes
+    it (PR 8: a listening socket leaked forever by a cancel between
+    create_server and its guard; a dataset stranded between create and
+    the tar spawn).  Flagged when a path from the acquisition reaches
+    an await before the handle is protected or ownership moves."""
+    config = ctx.config
+    for fn, cfg in ctx.cfgs.items():
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for b, i, e in list(cfg.events()):
+            if e.kind != CALL:
+                continue
+            handleish = _name_match(config.acquire_calls, e.name)
+            discardish = _name_match(config.acquire_discard_calls,
+                                     e.name)
+            if not handleish and not discardish:
+                continue
+            kind, data = _binding_of(ctx, e.node)
+            if kind in ("with", "escape"):
+                continue
+            if kind == "discard":
+                # no handle to track (dataset create): safe only once
+                # execution is inside a try that can clean up on cancel
+                if not discardish:
+                    continue     # a discarded handle-yielder: not ours
+                if allow_matches(config.acquire_discard_allow, ctx.path,
+                                 _qualname(ctx, e.node)):
+                    continue
+                if _idempotent_ensure(ctx, e.node):
+                    continue
+
+                def classify_discard(ev, awaited):
+                    if ev.kind == AWAIT:
+                        return STOP if _cleanup_try(ctx, ev.node, None) \
+                            else HIT
+                    return KEEP
+
+                # scan from the acquire's own await (or the call when
+                # not awaited): its own completion is not the window
+                start = cfg.position_of(data) or cfg.position_of(e.node)
+                hits = scan_paths(cfg, start, classify_discard,
+                                  follow_exceptions=False) \
+                    if start else []
+                if hits:
+                    yield ctx.finding(
+                        e.line, RULE_CANCEL,
+                        "%s(...) acquires a resource with no handle "
+                        "bound, and an await is reached at line %d "
+                        "before any try that could clean it up on "
+                        "cancellation — enter the guarding try/except "
+                        "before the next await point"
+                        % (e.name, hits[0][0].line))
+                continue
+            if not handleish:
+                continue         # a bound side-effect acquire (znode
+                                 # create returning a path): no handle
+            assign, name_nodes = data
+            handles = {t.id for t in name_nodes}
+            start = cfg.position_of(name_nodes[-1])
+            if start is None:
+                continue
+
+            def classify(ev, awaited, *, _handles=handles):
+                if ev.kind == STORE_NAME and ev.name in _handles:
+                    return STOP   # rebound: this window is over
+                if ev.kind == LOAD and ev.name:
+                    parts = ev.name.split(".")
+                    if parts[0] in _handles and len(parts) == 2 \
+                            and parts[1] in _CLOSE_METHODS:
+                        return STOP   # direct close/transfer call
+                    return KEEP
+                if ev.kind == LOAD_NAME and ev.name in _handles:
+                    return STOP if _protecting_use(ctx, ev.node) else KEEP
+                if ev.kind == AWAIT:
+                    return STOP if _cleanup_try(ctx, ev.node, _handles) \
+                        else HIT
+                return KEEP
+
+            hits = scan_paths(cfg, start, classify,
+                              follow_exceptions=False)
+            if hits:
+                names = ", ".join(sorted(handles))
+                yield ctx.finding(
+                    e.line, RULE_CANCEL,
+                    "handle(s) %s from %s(...) are unprotected at the "
+                    "await on line %d: a cancellation landing there "
+                    "leaks the resource — use 'async with'/'with', or "
+                    "enter a try/finally that closes them before the "
+                    "next await point"
+                    % (names, e.name or "acquire", hits[0][0].line))

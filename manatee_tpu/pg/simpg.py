@@ -268,6 +268,7 @@ class SimPgServer:
     async def _stream_from_upstream(self) -> None:
         conninfo = self.conf["primary_conninfo"]
         while not self._stopping:
+            writer = None
             try:
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(
@@ -306,6 +307,13 @@ class SimPgServer:
                     asyncio.TimeoutError):
                 pass
             finally:
+                # every exit — refused hello, broken stream, cancel —
+                # must close the socket: before this finally each
+                # reconnect iteration (and a live re-point's cancel)
+                # leaked the previous connection's fd (mnt-lint:
+                # cancel-unsafe-acquire)
+                if writer is not None:
+                    writer.close()
                 # a cancelled ex-streamer (live upstream re-point) must
                 # not clobber the link state its replacement owns
                 if self._upstream_task is asyncio.current_task():

@@ -235,6 +235,11 @@ class DirBackend(StorageBackend):
         # a successor's, reinstating stale meta.  Meta is tiny and
         # saves are rare (snapshots, mounts, transitions), so the
         # bounded fsync stall is the cheaper side of the trade.
+        # That invariant is MACHINE-CHECKED now: mnt-lint's
+        # atomic-section-broken rule pairs _load_meta with _save_meta
+        # through the loaded value and fires on any await between
+        # them, and the callers below carry explicit
+        # `atomic-section` annotations the same rule verifies.
         # The tmp name is per-writer-unique: the sitter AND the
         # snapshotter both save this dataset's meta, and a SHARED tmp
         # path lets one writer truncate the file another is about to
@@ -355,17 +360,21 @@ class DirBackend(StorageBackend):
         return meta.get("props", {}).get(prop)
 
     async def set_prop(self, dataset: str, prop: str, value: str) -> None:
+        # mnt-lint: atomic-section=set-prop
         meta = self._load_meta(dataset)
         if prop == "mountpoint":
             meta["mountpoint"] = value
         else:
             meta.setdefault("props", {})[prop] = value
         self._save_meta(dataset, meta)
+        # mnt-lint: end-atomic-section
 
     async def inherit_prop(self, dataset: str, prop: str) -> None:
+        # mnt-lint: atomic-section=inherit-prop
         meta = self._load_meta(dataset)
         meta.get("props", {}).pop(prop, None)
         self._save_meta(dataset, meta)
+        # mnt-lint: end-atomic-section
 
     async def set_mountpoint(self, dataset: str, mountpoint: str) -> None:
         was_mounted = await self.is_mounted(dataset)
@@ -379,6 +388,7 @@ class DirBackend(StorageBackend):
         return (await self.get_prop(dataset, "mountpoint"))
 
     async def mount(self, dataset: str) -> None:
+        # mnt-lint: atomic-section=mount
         meta = self._load_meta(dataset)
         mp = meta.get("mountpoint")
         if not mp:
@@ -398,8 +408,10 @@ class DirBackend(StorageBackend):
         os.symlink(target.resolve(), link)
         meta["mounted"] = True
         self._save_meta(dataset, meta)
+        # mnt-lint: end-atomic-section
 
     async def unmount(self, dataset: str) -> None:
+        # mnt-lint: atomic-section=unmount
         meta = self._load_meta(dataset)
         mp = meta.get("mountpoint")
         if mp and Path(mp).is_symlink():
@@ -410,6 +422,7 @@ class DirBackend(StorageBackend):
                 os.unlink(mp)
         meta["mounted"] = False
         self._save_meta(dataset, meta)
+        # mnt-lint: end-atomic-section
 
     async def is_mounted(self, dataset: str) -> bool:
         # ground truth = the symlink, not the meta flag (mnttab-verify
@@ -506,8 +519,20 @@ class DirBackend(StorageBackend):
         files = await asyncio.to_thread(copy_and_scan)
         self._write_manifest(dataset, name, files)
         now = time.time()
+        # mnt-lint: atomic-section=snapshot-record
+        # RE-load: the copy ran in a worker thread while the loop kept
+        # serving, so a concurrent load-modify-save (set_prop, mount,
+        # another snapshot) may have installed fresh meta — saving the
+        # copy we loaded before the await would silently reinstate the
+        # stale value (exactly the torn-meta class mnt-lint's
+        # atomic-section-broken rule exists to catch; it flagged this
+        # site on its first tree-wide run)
+        meta = self._load_meta(dataset)
+        if name in meta["snaps"]:
+            raise StorageError("snapshot exists: %s@%s" % (dataset, name))
         meta["snaps"][name] = now
         self._save_meta(dataset, meta)
+        # mnt-lint: end-atomic-section
         return Snapshot(dataset, name, now)
 
     async def list_snapshots(self, dataset: str) -> list[Snapshot]:

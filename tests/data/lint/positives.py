@@ -1,4 +1,5 @@
-# mnt-lint fixture: one violation per rule, NO suppressions.  The
+# mnt-lint fixture: one violation per rule, no USED suppressions (the
+# one disable below silences nothing — that is its violation).  The
 # engine walk excludes tests/data, so this file is only ever linted by
 # tests/test_lint.py passing it explicitly.
 import asyncio
@@ -53,6 +54,39 @@ async def faulty(faults, pick):
     await faults.point("no.such.point")    # faultpoint-unregistered
     await faults.point("pg.restore")
     await faults.point("pg.restore")       # faultpoint-unregistered
+
+
+class Torn:
+    async def bump(self):
+        cur = self.counter
+        await work()
+        self.counter = cur + 1             # atomic-section-broken
+
+
+class Lockset:
+    async def locked_add(self, item):
+        async with self._lock:
+            self.items = self.items + [item]
+
+    async def locked_clear(self):
+        async with self._lock:
+            self.items = []
+
+    async def racy(self):
+        n = self.items
+        await work()
+        self.items = n + [1]               # lockset-inconsistent (+atomic)
+
+
+async def cancel_leak(host):
+    r, w = await asyncio.open_connection(host, 1)  # cancel-unsafe-acquire
+    await w.drain()                        # (the unprotected await)
+    w.close()
+    return r
+
+
+def stale():                               # mnt-lint: disable=style
+    return None                            # ^ unused-suppression
 
 
 def shadowed():

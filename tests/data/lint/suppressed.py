@@ -36,3 +36,35 @@ async def undisciplined(lock):
 
 async def unbounded():
     await asyncio.open_connection("h", 1)  # mnt-lint: disable=all
+
+
+class TornQuiet:
+    async def bump(self):
+        cur = self.counter
+        await work()
+        self.counter = cur + 1  # mnt-lint: disable=atomic-section-broken
+
+
+class LocksetQuiet:
+    async def locked_add(self, item):
+        async with self._lock:
+            self.items = self.items + [item]
+
+    async def locked_clear(self):
+        async with self._lock:
+            self.items = []
+
+    async def racy(self):
+        n = self.items
+        await work()
+        self.items = n + [1]  # mnt-lint: disable=lockset-inconsistent,atomic-section-broken
+
+
+async def cancel_leak(host):
+    # the disable names both rules that fire on the acquire line: the
+    # unbounded direct await and the cancel-window leak
+    r, w = await asyncio.open_connection(  # mnt-lint: disable=cancel-unsafe-acquire,unbounded-wait
+        host, 1)
+    await w.drain()
+    w.close()
+    return r

@@ -22,8 +22,13 @@ async def adm(port, *args, stdin: str | None = None):
         stdin=asyncio.subprocess.PIPE if stdin is not None else None,
         stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
         env=cli_env("127.0.0.1:%d" % port))
-    out, err = await proc.communicate(
-        stdin.encode() if stdin is not None else None)
+    try:
+        out, err = await proc.communicate(
+            stdin.encode() if stdin is not None else None)
+    finally:
+        # a cancel landing in communicate() must not orphan the child
+        if proc.returncode is None:
+            proc.kill()
     return proc.returncode, out.decode(), err.decode()
 
 

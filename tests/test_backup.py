@@ -156,22 +156,24 @@ def test_backup_job_rest_api(tmp_path):
                     done.set()
 
                 lsrv = await asyncio.start_server(drain, "127.0.0.1", 0)
-                lport = lsrv.sockets[0].getsockname()[1]
-                async with http.post(url + "/backup", json={
-                        "host": "127.0.0.1", "port": lport,
-                        "dataset": "pg"}) as r:
-                    assert r.status == 201
-                    job_path = (await r.json())["jobPath"]
-                await asyncio.wait_for(done.wait(), 10)
-                for _ in range(50):
-                    async with http.get(url + job_path) as r:
-                        body = await r.json()
-                    if body["done"] is True:
-                        break
-                    await asyncio.sleep(0.1)
-                assert body["done"] is True
-                assert body["completed"] > 0
-                lsrv.close()
+                try:
+                    lport = lsrv.sockets[0].getsockname()[1]
+                    async with http.post(url + "/backup", json={
+                            "host": "127.0.0.1", "port": lport,
+                            "dataset": "pg"}) as r:
+                        assert r.status == 201
+                        job_path = (await r.json())["jobPath"]
+                    await asyncio.wait_for(done.wait(), 10)
+                    for _ in range(50):
+                        async with http.get(url + job_path) as r:
+                            body = await r.json()
+                        if body["done"] is True:
+                            break
+                        await asyncio.sleep(0.1)
+                    assert body["done"] is True
+                    assert body["completed"] > 0
+                finally:
+                    lsrv.close()
         finally:
             await sender.stop()
             await server.stop()

@@ -124,13 +124,15 @@ def test_follower_redirects_client_to_leader():
             # direct hello at a follower is refused with the hint
             r, w = await asyncio.wait_for(
                 asyncio.open_connection(*members[1]), 5.0)
-            w.write(b'{"op":"hello","xid":1,"session_timeout":5}\n')
-            await w.drain()
-            import json
-            msg = json.loads(await r.readline())
-            assert msg["error"] == "NotLeaderError"
-            assert msg["leader"] == "%s:%d" % members[0]
-            w.close()
+            try:
+                w.write(b'{"op":"hello","xid":1,"session_timeout":5}\n')
+                await w.drain()
+                import json
+                msg = json.loads(await r.readline())
+                assert msg["error"] == "NotLeaderError"
+                assert msg["leader"] == "%s:%d" % members[0]
+            finally:
+                w.close()
         finally:
             for s in servers:
                 await s.stop()
@@ -285,7 +287,12 @@ def test_coord_status_cli(tmp_path):
             _sys.executable, "-m", "manatee_tpu.cli", "coord-status",
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE, env=env)
-        out, err = await proc.communicate()
+        try:
+            out, err = await proc.communicate()
+        finally:
+            # a cancel in communicate() must not orphan the child
+            if proc.returncode is None:
+                proc.kill()
         return proc.returncode, out.decode(), err.decode()
 
     async def go():

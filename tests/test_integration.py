@@ -424,7 +424,12 @@ def test_stale_ephemeral_from_fast_restart_is_deduped(tmp_path):
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE,
                 env=cli_env(cluster.coord_connstr))
-            out, _err = await proc.communicate()
+            try:
+                out, _err = await proc.communicate()
+            finally:
+                # a cancel in communicate() must not orphan the child
+                if proc.returncode is None:
+                    proc.kill()
             active = json.loads(out)
             assert [a["id"] for a in active].count(a1.ident) == 1
             assert len(active) == 3

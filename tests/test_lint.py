@@ -26,6 +26,10 @@ NEW_RULES = {
     "swallowed-cancellation", "cancel-without-await", "lock-discipline",
     "unbounded-wait", "span-not-closed", "faultpoint-unregistered",
     "write-without-drain",
+    # flow-sensitive rules (v3: CFG-based) + the engine's suppression
+    # accounting
+    "atomic-section-broken", "lockset-inconsistent",
+    "cancel-unsafe-acquire", "unused-suppression",
 }
 PORTED_RULES = {
     "syntax", "unused-import", "shadowed-def", "bare-except",
@@ -633,10 +637,12 @@ def test_suppression_roundtrip():
     res2 = check_source("\n".join(lines) + "\n", "snippet.py")
     assert res2.findings == []
     assert [f.rule for f in res2.suppressed] == ["orphan-task"]
-    # a suppression for a DIFFERENT rule must not silence it
+    # a suppression for a DIFFERENT rule must not silence it — and the
+    # now-stale disable is itself reported as debt
     lines[line - 1] = lines[line - 1].replace("orphan-task", "style")
     res3 = check_source("\n".join(lines) + "\n", "snippet.py")
-    assert [f.rule for f in res3.findings] == ["orphan-task"]
+    assert [f.rule for f in res3.findings] == ["orphan-task",
+                                              "unused-suppression"]
 
 
 # ---- fixture files + outputs ----
@@ -656,7 +662,9 @@ def test_suppressed_fixture_is_clean():
     assert {f.rule for f in suppressed} >= {
         "unused-import", "orphan-task", "blocking-call-in-async",
         "blocking-io-in-async", "swallowed-cancellation",
-        "cancel-without-await", "lock-discipline", "unbounded-wait"}
+        "cancel-without-await", "lock-discipline", "unbounded-wait",
+        "atomic-section-broken", "lockset-inconsistent",
+        "cancel-unsafe-acquire"}
 
 
 def test_fixture_dir_excluded_from_tree_walk():

@@ -1,0 +1,899 @@
+"""mnt-lint v3: CFG construction + the flow-sensitive rules + the new
+CLI modes (--changed, --cache, --format sarif, suppression baseline).
+
+The CFG tests pin the graph shapes the rules depend on (awaits behind
+branches/loops/try-finally, lock scopes, exception edges); each rule
+gets positives plus the near-miss negatives its precision rests on
+(lock-exempt atomic section, re-load after the await, finally-guarded
+acquire, context-manager acquire, continuous-lock window).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.lint import Config, check_source, main
+from manatee_tpu.lint.cfg import (
+    AWAIT,
+    HIT,
+    KEEP,
+    STORE,
+    build_cfg,
+    scan_paths,
+)
+
+REPO = Path(__file__).parent.parent
+
+
+def lint(src: str, config: Config | None = None, path: str = "snippet.py"):
+    return check_source(textwrap.dedent(src), path, config)
+
+
+def rules_of(src: str, config: Config | None = None) -> set:
+    return {f.rule for f in lint(src, config).findings}
+
+
+def cfg_of(src: str, name: str | None = None):
+    tree = ast.parse(textwrap.dedent(src))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and (name is None or node.name == name):
+            return build_cfg(node)
+    raise AssertionError("no function %r in snippet" % name)
+
+
+def awaits_reachable_from_entry(cfg) -> bool:
+    """Does some path from the function entry cross an await?"""
+    hits = scan_paths(cfg, (cfg.entry, -1),
+                      lambda e, aw: HIT if e.kind == AWAIT else KEEP)
+    return bool(hits)
+
+
+# ---- CFG construction ----
+
+def test_cfg_straight_line_event_order():
+    cfg = cfg_of("""\
+        async def f(self):
+            x = self.a
+            await g()
+            self.a = x
+    """)
+    kinds = [e.kind for b in cfg.blocks for e in b.events]
+    # load of self.a, store to x, call+await, load x, store self.a
+    assert kinds.index("load") < kinds.index("await") < \
+        len(kinds) - 1 - kinds[::-1].index("store")
+
+
+def test_cfg_branch_join():
+    cfg = cfg_of("""\
+        async def f(p):
+            if p:
+                await g()
+            done()
+    """)
+    # the await sits on only ONE path; both reach the join
+    joins = [b for b in cfg.blocks
+             if any(e.kind == "call" and e.name == "done"
+                    for e in b.events)]
+    assert len(joins) == 1
+    hits = scan_paths(cfg, (cfg.entry, -1),
+                      lambda e, aw: HIT if e.kind == "call"
+                      and e.name == "done" else KEEP)
+    # reached both with and without an await crossed
+    assert sorted(aw for _, aw in hits) == [False, True]
+
+
+def test_cfg_loop_back_edge():
+    # a store inside a loop is reachable from its own load via the
+    # back edge, with the await in between
+    cfg = cfg_of("""\
+        async def f(self):
+            while True:
+                x = self.n
+                await g()
+                self.n = x + 1
+    """)
+    start = next((b, i) for b, ib, e in _positions(cfg)
+                 for i in [ib]
+                 if e.kind == "store_name" and e.name == "x")
+    hits = scan_paths(cfg, start,
+                      lambda e, aw: HIT if e.kind == STORE
+                      and e.name == "self.n" else KEEP)
+    assert hits and all(aw for _, aw in hits)
+
+
+def _positions(cfg):
+    for b in cfg.blocks:
+        for i, e in enumerate(b.events):
+            yield b, i, e
+
+
+def test_cfg_try_finally_normal_path():
+    cfg = cfg_of("""\
+        async def f():
+            try:
+                await g()
+            finally:
+                cleanup()
+    """)
+    # the finally's call is reachable (normal path), with await crossed
+    hits = scan_paths(cfg, (cfg.entry, -1),
+                      lambda e, aw: HIT if e.kind == "call"
+                      and e.name == "cleanup" else KEEP)
+    assert hits and any(aw for _, aw in hits)
+
+
+def test_cfg_exception_edges_separable():
+    cfg = cfg_of("""\
+        async def f():
+            risky()
+            try:
+                step()
+            except ValueError:
+                await fallback()
+            done()
+    """)
+    def classify(e, aw):
+        return HIT if e.kind == AWAIT else KEEP
+
+    with_exc = scan_paths(cfg, (cfg.entry, -1), classify)
+    without = scan_paths(cfg, (cfg.entry, -1), classify,
+                         follow_exceptions=False)
+    assert with_exc and not without
+
+
+def test_cfg_lock_scopes():
+    cfg = cfg_of("""\
+        async def f(self):
+            self.a = 1
+            async with self._lock:
+                self.b = 2
+            self.c = 3
+    """)
+    locks_at = {e.name: b.locks for b, i, e in _positions(cfg)
+                if e.kind == STORE}
+    assert locks_at["self.a"] == frozenset()
+    assert locks_at["self.b"] == frozenset({"self._lock"})
+    assert locks_at["self.c"] == frozenset()
+
+
+def test_cfg_async_for_and_with_are_awaits():
+    assert awaits_reachable_from_entry(cfg_of("""\
+        async def f(it):
+            async for x in it:
+                use(x)
+    """))
+    assert awaits_reachable_from_entry(cfg_of("""\
+        async def f(cm):
+            async with cm():
+                pass
+    """))
+    assert not awaits_reachable_from_entry(cfg_of("""\
+        def f(xs):
+            for x in xs:
+                use(x)
+    """))
+
+
+def test_cfg_nested_defs_opaque():
+    # the nested worker's await is NOT an await of f's flow
+    assert not awaits_reachable_from_entry(cfg_of("""\
+        def f():
+            async def worker():
+                await g()
+            return worker
+    """, name="f"))
+
+
+# ---- atomic-section-broken: inference ----
+
+def test_atomic_attr_load_await_store():
+    assert "atomic-section-broken" in rules_of("""\
+        class C:
+            async def bump(self):
+                cur = self.counter
+                await g()
+                self.counter = cur + 1
+    """)
+
+
+def test_atomic_no_await_is_clean():
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def bump(self):
+                cur = self.counter
+                self.counter = cur + 1
+                await g()
+    """)
+
+
+def test_atomic_lock_spanning_window_exempt():
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def bump(self):
+                async with self._lock:
+                    cur = self.counter
+                    await g()
+                    self.counter = cur + 1
+    """)
+    # a lock over only ONE half does not span the window
+    assert "atomic-section-broken" in rules_of("""\
+        class C:
+            async def bump(self):
+                async with self._lock:
+                    cur = self.counter
+                await g()
+                self.counter = cur + 1
+    """)
+
+
+def test_atomic_save_await_save_still_flagged():
+    # an unawaited save must not resolve the window: the second save
+    # still reinstates pre-await state (review-pinned regression)
+    res = lint("""\
+        class C:
+            async def f(self, ds, v):
+                meta = self._load_meta(ds)
+                self._save_meta(ds, meta)
+                await g()
+                self._save_meta(ds, meta)
+    """)
+    hits = [f for f in res.findings if f.rule == "atomic-section-broken"]
+    assert [f.line for f in hits] == [6]
+
+
+def test_atomic_reload_after_await_is_clean():
+    # the dirstore destroy_snapshot discipline: re-load after the await
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def bump(self):
+                cur = self.counter
+                await g()
+                cur = self.counter
+                self.counter = cur + 1
+    """)
+
+
+def test_atomic_loadcall_savecall_pair():
+    src = """\
+        class C:
+            async def set_prop(self, ds, k, v):
+                meta = self._load_meta(ds)
+                %s
+                meta[k] = v
+                self._save_meta(ds, meta)
+    """
+    assert "atomic-section-broken" in rules_of(src % "await g()")
+    assert "atomic-section-broken" not in rules_of(src % "pass")
+    # a DIFFERENT dataset's save is not this load's pair
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def touch(self, a, b, v):
+                meta = self._load_meta(a)
+                await g()
+                self._save_meta(b, v)
+    """)
+
+
+def test_atomic_module_global():
+    assert "atomic-section-broken" in rules_of("""\
+        COUNT = 0
+        async def bump():
+            global COUNT
+            cur = COUNT
+            await g()
+            COUNT = cur + 1
+    """)
+
+
+def test_atomic_store_not_derived_from_load_is_clean():
+    # storing an unrelated value is not a load-modify-save
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def swap(self):
+                old = self.task
+                await old
+                self.task = None
+    """)
+
+
+def test_atomic_branch_only_await_path_flagged():
+    assert "atomic-section-broken" in rules_of("""\
+        class C:
+            async def bump(self, slow):
+                cur = self.counter
+                if slow:
+                    await g()
+                self.counter = cur + 1
+    """)
+
+
+# ---- atomic-section-broken: declared regions + accounting ----
+
+BEGIN = "# mnt-lint: " + "atomic-section"
+END = "# mnt-lint: " + "end-atomic-section"
+
+
+def test_annotation_region_verified():
+    src = textwrap.dedent("""\
+        class C:
+            async def f(self):
+                %s=window
+                a = self.x
+                await g()
+                self.y = a
+                %s
+    """) % (BEGIN, END)
+    res = check_source(src, "snippet.py")
+    hits = [f for f in res.findings if f.rule == "atomic-section-broken"
+            and "window" in f.msg]
+    assert hits and hits[0].line == 5
+
+
+def test_annotation_clean_region_quiet():
+    src = textwrap.dedent("""\
+        class C:
+            async def f(self):
+                %s
+                a = self.x
+                self.y = a
+                %s
+                await g()
+    """) % (BEGIN, END)
+    res = check_source(src, "snippet.py")
+    assert res.findings == []
+
+
+def test_annotation_unmatched_markers_reported():
+    res = check_source(textwrap.dedent("""\
+        async def f():
+            %s
+            await g()
+    """) % BEGIN, "snippet.py")
+    assert any(f.rule == "unused-suppression"
+               and "never closed" in f.msg for f in res.findings)
+    res2 = check_source(textwrap.dedent("""\
+        async def f():
+            %s
+            await g()
+    """) % END, "snippet.py")
+    assert any(f.rule == "unused-suppression"
+               and "without a matching" in f.msg for f in res2.findings)
+
+
+def test_annotation_dead_region_reported():
+    # a region in a sync function cannot contain awaits: dead claim
+    res = check_source(textwrap.dedent("""\
+        def f():
+            %s
+            x = 1
+            %s
+    """) % (BEGIN, END), "snippet.py")
+    assert any(f.rule == "unused-suppression"
+               and "verifies nothing" in f.msg for f in res.findings)
+
+
+def test_unused_disable_reported_and_not_self_silencing():
+    mark = "# mnt-lint: " + "disable=style"
+    res = check_source("x = 1  %s\n" % mark, "snippet.py")
+    assert [f.rule for f in res.findings] == ["unused-suppression"]
+    # an unused disable=all is reported the same way
+    mark_all = "# mnt-lint: " + "disable=all"
+    res2 = check_source("x = 1  %s\n" % mark_all, "snippet.py")
+    assert [f.rule for f in res2.findings] == ["unused-suppression"]
+
+
+def test_unused_disable_skips_config_disabled_rules():
+    # a comment for a rule this path's profile turns OFF documents
+    # intent for profiles where it is on — not stale debt
+    mark = "# mnt-lint: " + "disable=style"
+    cfg = Config.from_dict({"path-disable": {"tests/*": ["style"]}})
+    res = check_source("x = 1  %s\n" % mark, "tests/t.py", cfg)
+    assert res.findings == []
+    # the same comment elsewhere (rule on, nothing to silence) reports
+    res2 = check_source("x = 1  %s\n" % mark, "manatee_tpu/x.py", cfg)
+    assert [f.rule for f in res2.findings] == ["unused-suppression"]
+
+
+def test_annotation_nested_def_await_not_a_break():
+    # an await inside a def nested in the region runs LATER, when the
+    # helper is called — the section itself never yields the loop
+    src = textwrap.dedent("""\
+        class C:
+            async def f(self):
+                %s=window
+                a = self.x
+                async def helper():
+                    await g()
+                self.y = (a, helper)
+                %s
+    """) % (BEGIN, END)
+    res = check_source(src, "snippet.py")
+    assert res.findings == []
+
+
+def test_try_else_has_no_exception_edges():
+    # an exception in the else clause is NOT caught by this try's
+    # handlers: a handler-store must not look reachable from an
+    # else-clause await (atomic false positive pinned by review)
+    assert "atomic-section-broken" not in rules_of("""\
+        class C:
+            async def f(self):
+                meta = self.meta
+                try:
+                    x = 1
+                except Exception:
+                    self.meta = meta
+                else:
+                    await work()
+    """)
+
+
+# ---- lockset-inconsistent ----
+
+LOCKSET_SRC = """\
+    class C:
+        async def locked_add(self, item):
+            async with self._lock:
+                self.items = self.items + [item]
+
+        async def locked_clear(self):
+            async with self._lock:
+                self.items = []
+
+        async def racy(self):
+            n = self.items
+            await g()
+            self.items = n + [1]
+"""
+
+
+def test_lockset_unguarded_window_flagged():
+    res = lint(LOCKSET_SRC)
+    hits = [f for f in res.findings if f.rule == "lockset-inconsistent"]
+    assert hits and "self.items" in hits[0].msg \
+        and "self._lock" in hits[0].msg
+
+
+def test_lockset_guarded_window_exempt():
+    assert "lockset-inconsistent" not in rules_of(
+        LOCKSET_SRC.replace(
+            """\
+        async def racy(self):
+            n = self.items
+            await g()
+            self.items = n + [1]""",
+            """\
+        async def racy(self):
+            async with self._lock:
+                n = self.items
+                await g()
+                self.items = n + [1]"""))
+
+
+def test_lockset_two_lock_stints_not_continuous():
+    # the lock is held at BOTH ends but released across the await:
+    # that is two stints, not a spanned window
+    assert "lockset-inconsistent" in rules_of("""\
+        class C:
+            async def locked_add(self, item):
+                async with self._lock:
+                    self.items = self.items + [item]
+
+            async def locked_clear(self):
+                async with self._lock:
+                    self.items = []
+
+            async def racy(self):
+                async with self._lock:
+                    n = self.items
+                await g()
+                async with self._lock:
+                    self.items = n + [1]
+    """)
+
+
+def test_lockset_below_threshold_quiet():
+    # one guarded site is coincidence, not a contract (min-guarded=2)
+    assert "lockset-inconsistent" not in rules_of("""\
+        class C:
+            async def locked_once(self):
+                async with self._lock:
+                    self.items = []
+
+            async def racy(self):
+                n = self.items
+                await g()
+                self.items = n + [1]
+    """)
+
+
+def test_lockset_no_await_window_quiet():
+    assert "lockset-inconsistent" not in rules_of("""\
+        class C:
+            async def locked_add(self, item):
+                async with self._lock:
+                    self.items = self.items + [item]
+
+            async def locked_clear(self):
+                async with self._lock:
+                    self.items = []
+
+            async def fine(self):
+                n = self.items
+                self.items = n + [1]
+                await g()
+    """)
+
+
+def test_lockset_lock_attr_itself_exempt():
+    # accesses to self._lock (the lock object) are not tracked state
+    assert "lockset-inconsistent" not in rules_of("""\
+        class C:
+            async def a(self):
+                async with self._lock:
+                    self.x = 1
+
+            async def b(self):
+                async with self._lock:
+                    self.x = 2
+
+            async def c(self):
+                lk = self._lock
+                await g()
+                self._lock = lk
+    """)
+
+
+# ---- cancel-unsafe-acquire ----
+
+def test_cancel_acquire_then_await_flagged():
+    res = lint("""\
+        async def f(host):
+            r, w = await asyncio.open_connection(host, 1)
+            await w.drain()
+            w.close()
+    """)
+    hits = [f for f in res.findings if f.rule == "cancel-unsafe-acquire"]
+    assert hits and hits[0].line == 2 and "w" in hits[0].msg
+
+
+def test_cancel_try_finally_guard_clean():
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(host):
+            r, w = await asyncio.open_connection(host, 1)
+            try:
+                await w.drain()
+            finally:
+                w.close()
+    """)
+
+
+def test_cancel_baseexception_cleanup_clean():
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(host):
+            r, w = await asyncio.open_connection(host, 1)
+            try:
+                await w.drain()
+            except BaseException:
+                w.close()
+                raise
+    """)
+
+
+def test_cancel_context_manager_acquire_clean():
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(path):
+            with open(path) as fh:
+                data = fh.read()
+            await g(data)
+    """)
+
+
+def test_cancel_close_before_await_clean():
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(host):
+            r, w = await asyncio.open_connection(host, 1)
+            w.close()
+            await g()
+    """)
+
+
+def test_cancel_ownership_transfer_clean():
+    # stored on self: the owner's teardown closes it
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        class C:
+            async def f(self, host):
+                r, w = await asyncio.open_connection(host, 1)
+                self._writer = w
+                self._reader = r
+                await g()
+    """)
+    # passed into a call: ownership moves with it
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(host):
+            r, w = await asyncio.open_connection(host, 1)
+            await pump(r, w)
+    """)
+
+
+def test_cancel_wait_for_wrapped_acquire_still_tracked():
+    assert "cancel-unsafe-acquire" in rules_of("""\
+        async def f(host):
+            r, w = await asyncio.wait_for(
+                asyncio.open_connection(host, 1), 5.0)
+            await w.drain()
+            w.close()
+    """)
+
+
+def test_cancel_subprocess_communicate_flagged_and_guarded():
+    src = """\
+        async def f(argv):
+            proc = await asyncio.create_subprocess_exec(*argv)
+            %s
+    """
+    assert "cancel-unsafe-acquire" in rules_of(src % "await proc.communicate()")
+    assert "cancel-unsafe-acquire" not in rules_of(src % textwrap.dedent("""\
+        try:
+                await proc.communicate()
+            finally:
+                if proc.returncode is None:
+                    proc.kill()"""))
+
+
+def test_cancel_discarded_acquire_needs_cleanup_try():
+    # the dataset-create shape: no handle, so safety = being inside a
+    # try that can clean up before the next await
+    assert "cancel-unsafe-acquire" in rules_of("""\
+        async def f(storage, ds):
+            await storage.create(ds)
+            await g()
+    """)
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(storage, ds):
+            await storage.create(ds)
+            try:
+                await g()
+            except BaseException:
+                await storage.destroy(ds)
+                raise
+    """)
+    # no await after the create: nothing can cancel-strand it
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(storage, ds):
+            await storage.create(ds)
+            record(ds)
+    """)
+
+
+def test_cancel_idempotent_ensure_exempt():
+    # `if not await exists(): create()` is an ensure: a cancel leaves
+    # convergent state, the retry walks past the exists check
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def f(storage, ds):
+            if not await storage.exists(ds):
+                await storage.create(ds)
+            await storage.mount(ds)
+    """)
+    # so is the mkdirp shape: a try tolerating NodeExistsError
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        async def mkdirp(self, path):
+            try:
+                await self.create(path)
+            except NodeExistsError:
+                pass
+            await self.get(path)
+    """)
+
+
+def test_cancel_discard_allow_scoping():
+    cfg = Config(acquire_discard_allow=frozenset({"tests/*"}))
+    src = """\
+        async def f(storage, ds):
+            await storage.create(ds)
+            await g()
+    """
+    assert "cancel-unsafe-acquire" in {
+        f.rule for f in lint(src, cfg, path="manatee_tpu/x.py").findings}
+    assert "cancel-unsafe-acquire" not in {
+        f.rule for f in lint(src, cfg, path="tests/test_x.py").findings}
+
+
+def test_cancel_sync_function_out_of_scope():
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        def f(path):
+            fh = open(path)
+            return fh
+    """)
+
+
+def test_cancel_acquire_calls_configurable():
+    cfg = Config(acquire_calls=frozenset({"lease"}))
+    src = """\
+        async def f(pool):
+            h = await pool.lease()
+            await g()
+            h.release()
+    """
+    assert "cancel-unsafe-acquire" not in rules_of(src)
+    assert "cancel-unsafe-acquire" in rules_of(src, cfg)
+
+
+# ---- suppression round trips for the flow rules ----
+
+def test_flow_rule_suppression_roundtrip():
+    mark = "# mnt-lint: " + "disable=atomic-section-broken"
+    src = textwrap.dedent("""\
+        class C:
+            async def bump(self):
+                cur = self.counter
+                await g()
+                self.counter = cur + 1  %s
+    """) % mark
+    res = check_source(src, "snippet.py")
+    assert [f.rule for f in res.findings] == []
+    assert [f.rule for f in res.suppressed] == ["atomic-section-broken"]
+
+
+# ---- --changed mode + result cache (subprocess, real git repo) ----
+
+BAD_SRC = "async def f():\n    asyncio.create_task(g())\n"
+GOOD_SRC = "async def f():\n    t = asyncio.create_task(g())\n    await t\n"
+
+
+def run_lint(tmp_repo, *args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint"), *args],
+        cwd=tmp_repo, capture_output=True, text=True)
+
+
+@pytest.fixture
+def tmp_repo(tmp_path):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    (tmp_path / "dirty.py").write_text("x = 2\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    return tmp_path
+
+
+def test_changed_mode_lints_only_changed_files(tmp_repo):
+    # an unmodified tree: nothing to lint (paths precede the flag:
+    # a bare `--changed <path>` would read the path as its BASE)
+    r = run_lint(tmp_repo, ".", "--changed")
+    assert r.returncode == 0 and "no changed files" in r.stderr
+    # modify one file to contain a finding; the clean one stays out
+    (tmp_repo / "dirty.py").write_text(BAD_SRC)
+    r = run_lint(tmp_repo, ".", "--changed")
+    assert r.returncode == 1
+    assert "dirty.py" in r.stdout and "clean.py" not in r.stdout
+    assert "1 files" in r.stderr
+    # untracked files are picked up too
+    (tmp_repo / "fresh.py").write_text(BAD_SRC)
+    r = run_lint(tmp_repo, ".", "--changed")
+    assert "fresh.py" in r.stdout and "2 files" in r.stderr
+
+
+def test_changed_mode_explicit_base(tmp_repo):
+    (tmp_repo / "dirty.py").write_text(BAD_SRC)
+    subprocess.run(["git", "commit", "-aqm", "break"], cwd=tmp_repo,
+                   check=True, capture_output=True)
+    # vs HEAD: committed, so nothing changed
+    r = run_lint(tmp_repo, ".", "--changed")
+    assert r.returncode == 0
+    # vs HEAD~1 the breakage is visible
+    r = run_lint(tmp_repo, ".", "--changed", "HEAD~1")
+    assert r.returncode == 1 and "dirty.py" in r.stdout
+
+
+def _cache_stats(stderr: str) -> tuple:
+    part = stderr.split("cache: ")[1]
+    return (int(part.split(" hits")[0]),
+            int(part.split(", ")[1].split(" misses")[0]))
+
+
+def test_cache_roundtrip_and_invalidation(tmp_repo):
+    (tmp_repo / "dirty.py").write_text(BAD_SRC)
+    r1 = run_lint(tmp_repo, ".", "--cache")
+    assert r1.returncode == 1
+    assert _cache_stats(r1.stderr) == (0, 2)   # cold: both files miss
+    assert (tmp_repo / ".mnt-lint-cache.json").is_file()
+    # second run: every file served from cache, same verdict
+    r2 = run_lint(tmp_repo, ".", "--cache")
+    assert r2.returncode == 1
+    assert _cache_stats(r2.stderr) == (2, 0)
+    # editing a file invalidates just that entry — and fixes the verdict
+    (tmp_repo / "dirty.py").write_text(GOOD_SRC)
+    r3 = run_lint(tmp_repo, ".", "--cache")
+    assert r3.returncode == 0
+    assert _cache_stats(r3.stderr) == (1, 1)
+
+
+def test_cache_findings_identical(tmp_repo):
+    (tmp_repo / "dirty.py").write_text(BAD_SRC)
+    r1 = run_lint(tmp_repo, ".", "--cache", "--format", "json")
+    r2 = run_lint(tmp_repo, ".", "--cache", "--format", "json")
+    d1, d2 = json.loads(r1.stdout), json.loads(r2.stdout)
+    assert d1["findings"] == d2["findings"]
+    assert d1["problems"] == d2["problems"] == 1
+
+
+def test_cache_prunes_deleted_files(tmp_repo):
+    (tmp_repo / "doomed.py").write_text("x = 3\n")
+    run_lint(tmp_repo, ".", "--cache")
+    cache = json.loads((tmp_repo / ".mnt-lint-cache.json").read_text())
+    assert "doomed.py" in cache["entries"]
+    (tmp_repo / "doomed.py").unlink()
+    run_lint(tmp_repo, ".", "--cache")
+    cache = json.loads((tmp_repo / ".mnt-lint-cache.json").read_text())
+    assert "doomed.py" not in cache["entries"]
+
+
+# ---- SARIF output + suppression baseline ----
+
+def test_sarif_output_shape(capsys):
+    data = Path(__file__).parent / "data" / "lint"
+    rc = main(["--format", "sarif", str(data / "positives.py"),
+               str(data / "suppressed.py")])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == "2.1.0"
+    run = out["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mnt-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    assert results
+    for res in results:
+        assert res["ruleId"] in rule_ids
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+    # suppressed findings ride along, marked inSource, and are the
+    # only suppressed ones
+    supp = [r for r in results if r.get("suppressions")]
+    assert supp and all(s["suppressions"][0]["kind"] == "inSource"
+                        for s in supp)
+    assert all("suppressed.py" in s["locations"][0]["physicalLocation"]
+               ["artifactLocation"]["uri"] for s in supp)
+
+
+def test_suppression_baseline_gate(tmp_path, capsys):
+    data = Path(__file__).parent / "data" / "lint"
+    base = tmp_path / "baseline.json"
+    # a default config, not the repo's .mnt-lint.json: the repo's
+    # tests/* path-disables would turn some fixture suppressions into
+    # unused-suppression findings
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text("{}")
+    # suppressed.py has suppressions but no findings; a zero baseline
+    # fails the run even though nothing is broken
+    base.write_text(json.dumps({"suppressed": 0}))
+    rc = main([str(data / "suppressed.py"), "--config", str(cfg),
+               "--suppression-baseline", str(base)])
+    capsys.readouterr()
+    assert rc == 1
+    # a generous baseline passes
+    base.write_text(json.dumps({"suppressed": 100}))
+    rc = main([str(data / "suppressed.py"), "--config", str(cfg),
+               "--suppression-baseline", str(base)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_repo_baseline_is_zero():
+    # the committed baseline pins ZERO suppressions outside fixtures
+    base = json.loads((REPO / ".mnt-lint-baseline.json").read_text())
+    assert base["suppressed"] == 0

@@ -425,11 +425,11 @@ def test_backpressure_bounds_sender_readahead(tmp_path):
             writer.close()
 
         server = await asyncio.start_server(serve, "127.0.0.1", 0)
-        port = server.sockets[0].getsockname()[1]
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection("127.0.0.1", port), 5.0)
-        writer.transport.set_write_buffer_limits(high=CHUNK)
         try:
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 5.0)
+            writer.transport.set_write_buffer_limits(high=CHUNK)
             copy = asyncio.create_task(wirestream.pipeline_copy(
                 read_fn, writer, chunk_size=CHUNK,
                 readahead=READAHEAD))

@@ -244,7 +244,12 @@ def test_rebuild_aborts_after_repeated_restore_failures(tmp_path):
                 "-y", "-c", str(cfgpath), "--timeout", "120",
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE, env=env)
-            out, err = await asyncio.wait_for(proc.communicate(), 60)
+            try:
+                out, err = await asyncio.wait_for(proc.communicate(), 60)
+            finally:
+                # a timeout/cancel must not orphan the rebuild child
+                if proc.returncode is None:
+                    proc.kill()
             out, err = out.decode(), err.decode()
             assert proc.returncode != 0
             # escalating warnings, then the abort with a diagnosis

@@ -151,16 +151,18 @@ def test_send_recv_roundtrip_over_tcp(be, tmp_path):
             recv_done.set()
 
         server = await asyncio.start_server(handle, "127.0.0.1", 0)
-        port = server.sockets[0].getsockname()[1]
+        try:
+            port = server.sockets[0].getsockname()[1]
 
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection("127.0.0.1", port), 10)
-        await be.send("pg", snap.name, writer)
-        writer.close()
-        await writer.wait_closed()
-        await asyncio.wait_for(recv_done.wait(), 10)
-        server.close()
-        await server.wait_closed()
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 10)
+            await be.send("pg", snap.name, writer)
+            writer.close()
+            await writer.wait_closed()
+            await asyncio.wait_for(recv_done.wait(), 10)
+        finally:
+            server.close()
+            await server.wait_closed()
 
         # received unmounted (zfs recv -u), then mount and verify content
         assert not await be2.is_mounted("pg")
@@ -239,15 +241,18 @@ def test_send_receiver_disconnect_raises_storage_error(be, tmp_path):
             writer.transport.abort()
 
         server = await asyncio.start_server(handler, "127.0.0.1", 0)
-        port = server.sockets[0].getsockname()[1]
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection("127.0.0.1", port), 10)
-        with pytest.raises(StorageError):
-            # generous bound: subprocess spawn latency spikes when the
-            # whole suite's process churn is high
-            await asyncio.wait_for(be.send("pg", snap.name, writer), 30)
-        server.close()
-        await server.wait_closed()
+        try:
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 10)
+            with pytest.raises(StorageError):
+                # generous bound: subprocess spawn latency spikes when
+                # the whole suite's process churn is high
+                await asyncio.wait_for(be.send("pg", snap.name, writer),
+                                       30)
+        finally:
+            server.close()
+            await server.wait_closed()
     run(go())
 
 

@@ -206,17 +206,19 @@ def test_send_recv_roundtrip_with_progress(tmp_path, monkeypatch,
             writer.close()
 
         server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
-        port = server.sockets[0].getsockname()[1]
-        _r, writer = await asyncio.wait_for(
-            asyncio.open_connection("127.0.0.1", port), 10)
-        ticks = []
-        await be.send("src", "1700000000111", writer,
-                      progress_cb=lambda done, total: ticks.append(
-                          (done, total)))
-        writer.close()
-        await asyncio.wait_for(received.wait(), 10)
-        server.close()
-        await server.wait_closed()
+        try:
+            port = server.sockets[0].getsockname()[1]
+            _r, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), 10)
+            ticks = []
+            await be.send("src", "1700000000111", writer,
+                          progress_cb=lambda done, total: ticks.append(
+                              (done, total)))
+            writer.close()
+            await asyncio.wait_for(received.wait(), 10)
+        finally:
+            server.close()
+            await server.wait_closed()
 
         # the size line was parsed and progress was reported against it
         assert ticks and ticks[-1][1] == size
