@@ -9,8 +9,8 @@ PYTHON ?= python3
 
 .PHONY: all test test-unit test-integ test-integ-postgres lint \
     lint-fast bench \
-    devcluster native clean modelcheck chaos chaos-postgres \
-    chaos-partition man \
+    devcluster native clean modelcheck modelcheck-jax chaos \
+    chaos-postgres chaos-partition man \
     train-health eval-recorded
 
 all: lint test
@@ -48,6 +48,13 @@ lint-fast:
 # (deeper than the bounded sweep `make test` runs)
 modelcheck:
 	$(PYTHON) -m manatee_tpu.state.modelcheck --config all --depth 6
+
+# the same sweep two plies deeper on the JAX array engine
+# (docs/modelcheck.md); exact agreement with the python oracle is
+# enforced by tests/test_mc_array.py
+modelcheck-jax:
+	JAX_PLATFORMS=cpu $(PYTHON) -m manatee_tpu.state.modelcheck \
+	    --config all --depth 8 --engine jax --progress
 
 # unscripted randomized storm against real processes + the real CLI
 # (MANATEE_CHAOS_SECONDS / MANATEE_CHAOS_SEED to vary)

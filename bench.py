@@ -79,6 +79,7 @@ postgres-engine leg recorded alongside in "configs":
 import asyncio
 import json
 import os
+import re
 import signal
 import statistics
 import subprocess
@@ -104,7 +105,8 @@ DISCONNECT_GRACE = 0.35
 
 ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
-               "incremental_rebuild", "control_plane_scale")
+               "incremental_rebuild", "control_plane_scale",
+               "modelcheck_throughput")
 # total shards in the control_plane_scale leg: one measured 3-peer
 # shard + (N-1) singleton neighbors in ONE fleet sitter process
 SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
@@ -112,6 +114,17 @@ SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
 # setup (REST round trip, listener, tar spawn) is not the whole
 # number, small enough for a CI smoke lane
 RESTORE_MB = int(os.environ.get("MANATEE_BENCH_RESTORE_MB", "32"))
+
+# modelcheck_throughput leg: python-oracle vs jax-engine states/sec on
+# one exhaustive configuration, plus the jax engine's device-count
+# sweep on the host-platform mesh.  "promote" has the largest state
+# space of the shipped configs, so it is the one worth measuring.
+MODELCHECK_CONFIG = os.environ.get("MANATEE_MODELCHECK_CONFIG",
+                                   "promote")
+MODELCHECK_DEPTH = int(os.environ.get("MANATEE_MODELCHECK_DEPTH", "5"))
+MODELCHECK_DEVICES = (1, 2, 4, 8)
+MODELCHECK_ARTIFACT = os.environ.get("MANATEE_MODELCHECK_ARTIFACT",
+                                     "MULTICHIP_modelcheck.json")
 
 
 def selected_configs() -> list[str]:
@@ -646,6 +659,106 @@ async def bench_control_plane_scale() -> dict:
             await cluster.stop()
 
 
+def _mesh_env(n_devices: int) -> dict:
+    """Subprocess env forcing an n-device virtual CPU mesh.  The flag
+    must be final before jax initializes, hence subprocess-per-count
+    (same discipline as __graft_entry__.dryrun_multichip)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=%d" % n_devices
+    ).strip()
+    return env
+
+
+def _probe_json(args: list[str], env: dict) -> dict:
+    cp = subprocess.run([sys.executable, *args], capture_output=True,
+                        text=True, env=env, timeout=900)
+    if cp.returncode != 0:
+        raise RuntimeError("probe %s failed rc=%d:\n%s"
+                           % (args, cp.returncode, cp.stderr[-2000:]))
+    return json.loads(cp.stdout.strip().splitlines()[-1])
+
+
+async def bench_modelcheck_throughput() -> dict:
+    """states/sec for the python oracle vs the jax array engine, the
+    jax device-count sweep, and the deeper-sweep dividend (how many
+    extra plies the jax engine buys inside the python wall-clock).
+
+    Every leg runs in its own subprocess: the python leg stays
+    jax-free, and each jax leg needs its device count pinned in
+    XLA_FLAGS before jax initializes.  jax legs are warm-measured (the
+    probe compiles first, then times — bench measures throughput, not
+    jit latency)."""
+    py = await asyncio.to_thread(
+        _probe_json,
+        ["-m", "manatee_tpu.state.modelcheck", "--config",
+         MODELCHECK_CONFIG, "--depth", str(MODELCHECK_DEPTH), "--json"],
+        dict(os.environ))
+    devices = {}
+    deeper = None
+    for n in MODELCHECK_DEVICES:
+        args = ["-m", "manatee_tpu.state.mc_array", "--config",
+                MODELCHECK_CONFIG, "--depth", str(MODELCHECK_DEPTH)]
+        if n == MODELCHECK_DEVICES[-1]:
+            args += ["--deeper", "2"]
+        leg = await asyncio.to_thread(_probe_json, args, _mesh_env(n))
+        if leg["states"] != py["states"]:
+            raise RuntimeError(
+                "engines disagree on reachable states (%d devices): "
+                "python=%d jax=%d — run the differential tests"
+                % (n, py["states"], leg["states"]))
+        devices[str(n)] = {"states_per_sec": leg["states_per_sec"],
+                           "seconds": leg["seconds"]}
+        deeper = leg.get("deeper", deeper)
+    n8 = devices[str(MODELCHECK_DEVICES[-1])]
+    out = {
+        "config": MODELCHECK_CONFIG,
+        "depth": MODELCHECK_DEPTH,
+        "states": py["states"],
+        "python_states_per_sec": py["states_per_sec"],
+        "python_seconds": py["seconds"],
+        "jax_devices": devices,
+        "speedup_vs_python": round(
+            n8["states_per_sec"] / py["states_per_sec"], 1)
+        if py["states_per_sec"] else None,
+        # single-core containers share one core across all virtual
+        # devices; record the core count so flat scaling reads
+        # correctly
+        "cpu_count": os.cpu_count(),
+    }
+    if deeper is not None:
+        out["deeper_sweep"] = {
+            **deeper,
+            "python_wall_budget_s": py["seconds"],
+            "within_python_budget":
+                deeper["seconds"] <= py["seconds"],
+        }
+    tail = ("modelcheck_throughput: %s depth=%d python %.0f st/s, "
+            "jax(8dev) %.0f st/s (%.1fx)"
+            % (MODELCHECK_CONFIG, MODELCHECK_DEPTH,
+               py["states_per_sec"], n8["states_per_sec"],
+               out["speedup_vs_python"] or 0.0))
+    if deeper is not None:
+        tail += (", depth %d in %.2fs (python d%d budget %.2fs)"
+                 % (deeper["depth"], deeper["seconds"],
+                    MODELCHECK_DEPTH, py["seconds"]))
+    await asyncio.to_thread(
+        Path(MODELCHECK_ARTIFACT).write_text, json.dumps({
+            "n_devices": MODELCHECK_DEVICES[-1],
+            "rc": 0,
+            "ok": bool(py["ok"] and deeper is not None
+                       and deeper["ok"] and deeper["complete"]),
+            "skipped": False,
+            "tail": tail + "\n",
+            "modelcheck_throughput": out,
+        }, indent=2) + "\n")
+    print(tail, file=sys.stderr)
+    return out
+
+
 async def main() -> None:
     picked = selected_configs()
     results: dict[str, float] = {}
@@ -659,7 +772,7 @@ async def main() -> None:
     }
     for name in picked:
         if name in ("restore_throughput", "incremental_rebuild",
-                    "control_plane_scale"):
+                    "control_plane_scale", "modelcheck_throughput"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -670,6 +783,9 @@ async def main() -> None:
     incremental = None
     if "incremental_rebuild" in picked:
         incremental = await bench_incremental_rebuild()
+    modelcheck = None
+    if "modelcheck_throughput" in picked:
+        modelcheck = await bench_modelcheck_throughput()
     scale = None
     if "control_plane_scale" in picked:
         scale = await bench_control_plane_scale()
@@ -697,6 +813,8 @@ async def main() -> None:
         out["incremental_rebuild"] = incremental
     if scale is not None:
         out["control_plane_scale"] = scale
+    if modelcheck is not None:
+        out["modelcheck_throughput"] = modelcheck
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

@@ -1,0 +1,1707 @@
+"""JAX-accelerated model checking: vectorized frontier exploration.
+
+The Python explorer (modelcheck.py) rebuilds every BFS child by
+replaying its whole action sequence through the real async
+``PeerStateMachine`` — one state per Python iteration, so its depth
+bound is CPU wall clock.  This module encodes the checker world as a
+fixed-shape int32 vector and evaluates transitions + safety invariants
+for the *whole frontier* in one vmapped/shard_map'd device step across
+the host-platform mesh (ROADMAP item 4).
+
+The encoding is **bijective with the semantic-state quotient** shared
+with the Python engine (canon.py): every field of the canonical digest
+dict — and nothing else — has a slot in the vector, so deduplicating on
+raw vector bytes is exactly deduplicating on the canonical digest.
+That bijection is what makes the differential-oracle contract
+checkable: matched-depth runs of the two engines must agree exactly on
+the reachable semantic-state set and on every violation verdict
+(tests/test_mc_array.py), and any divergence replays the offending
+action sequence through the Python world for a minimized trace.
+
+Why exact agreement is even possible: in the checker harness
+specifically (takeover_grace=0, the worker task never started, MCPg
+reconfigures never fail, no one-node-write-mode, fixed promote-expiry
+constants, digests taken only at action boundaries after tasks settle)
+the machine's observable semantics reduce to a finite pure function
+over this fixed-shape state.  Every branch of that function is mirrored
+here as a pure jnp kernel; docs/modelcheck.md walks the encoding.
+
+Engine shape:
+
+* per-action **transition kernels** (peer evaluation incl. the full
+  primary/sync duty ladder, view refresh, crash, rejoin, xlog catch-up,
+  operator promote/freeze, partition/heal) — pure jnp, int32 in/out;
+* vectorized **safety predicates** (generation monotonicity,
+  single-writable-primary, sync-only takeover, xlog gate) as a bitmask
+  over canon.CATEGORIES;
+* a **liveness kernel** mirroring check_liveness: catch-up, the fair
+  schedule run to fixpoint with a lax.while_loop, then the convergence
+  predicates (dead-primary-replaced, sync-appointed, role/chain
+  consistency);
+* a **frontier driver**: vmap over a fixed-size chunk, shard_map across
+  the device mesh, device-side dedup via sorted semantic-hash keys,
+  host-side exact refill from the seen-set (hash collisions therefore
+  cannot drop states — the device pass only *reduces* host work).
+
+Deliberate-weakening flags (:class:`Mutations`) mirror the mutation
+self-tests of tests/test_model_check.py in both engines, pinning that
+vectorization never trades away detection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import sys
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from manatee_tpu.state import canon
+from manatee_tpu.state.modelcheck import (
+    CONFIGS,
+    FUTURE_EXPIRY,
+    PAST_EXPIRY,
+    MCConfig,
+    MCResult,
+    _fast_sleep,
+    _replay,
+)
+
+# ---------------------------------------------------------------------------
+# role / field codes
+
+NONE = -1
+
+# role_note / role_of codes
+R_NONE, R_PRIM, R_SYNC, R_ASYNC, R_DEPOSED = 0, 1, 2, 3, 4
+_NOTE_STR = {R_NONE: None, R_PRIM: "primary", R_SYNC: "sync",
+             R_ASYNC: "async", R_DEPOSED: "deposed"}
+
+# pg-target role codes
+T_NONE, T_PRIM, T_SYNC, T_ASYNC = 0, 1, 2, 3
+_T_STR = {T_NONE: "none", T_PRIM: "primary", T_SYNC: "sync",
+          T_ASYNC: "async"}
+
+# promote-request role codes
+PR_SYNC, PR_ASYNC = 0, 1
+
+# the freeze payload the explorer's freeze action writes (modelcheck.py)
+FREEZE_DICT = {"date": "2026-01-01T00:00:00Z", "reason": "modelcheck"}
+
+_BIT = canon.CATEGORY_BIT
+
+
+class EncodingError(Exception):
+    """A world outside the fixed-shape encoding's domain — by
+    construction unreachable from the explorer's configs; raised loudly
+    rather than silently mis-encoded."""
+
+
+# ---------------------------------------------------------------------------
+# layout
+
+
+class Layout:
+    """Offsets of the fixed-shape int32 encoding for P peers.
+
+    state block (SB, one for the durable store + one per-peer view):
+      gen, initWal, primary, sync, async[P]+n, deposed[P]+n, frozen,
+      promote{has, role, id, asyncIndex, gen, expired}
+    globals: kills, rejoins, store actives[P]+n, store SB
+    per peer: alive, part, xlog, ver_current, evaled, role_note,
+      target{has, role, up, down, deposed}, view actives[P]+n, view SB
+    """
+
+    def __init__(self, P: int):
+        self.P = P
+        # -- state block (relative offsets) --
+        self.SB_GEN = 0
+        self.SB_IW = 1
+        self.SB_PRIM = 2
+        self.SB_SYNC = 3
+        self.SB_ASY = 4
+        self.SB_ASY_N = 4 + P
+        self.SB_DEP = 5 + P
+        self.SB_DEP_N = 5 + 2 * P
+        self.SB_FROZEN = 6 + 2 * P
+        self.SB_P_HAS = 7 + 2 * P
+        self.SB_P_ROLE = 8 + 2 * P
+        self.SB_P_ID = 9 + 2 * P
+        self.SB_P_IDX = 10 + 2 * P
+        self.SB_P_GEN = 11 + 2 * P
+        self.SB_P_EXP = 12 + 2 * P
+        self.SB_SIZE = 13 + 2 * P
+        # -- globals --
+        self.G_KILLS = 0
+        self.G_REJOINS = 1
+        self.G_ACT = 2
+        self.G_ACT_N = 2 + P
+        self.G_SB = 3 + P
+        self.GLOB = 3 + P + self.SB_SIZE
+        # -- per-peer block --
+        self.PB_ALIVE = 0
+        self.PB_PART = 1
+        self.PB_X = 2
+        self.PB_VERCUR = 3
+        self.PB_EVALED = 4
+        self.PB_NOTE = 5
+        self.PB_T_HAS = 6
+        self.PB_T_ROLE = 7
+        self.PB_T_UP = 8
+        self.PB_T_DOWN = 9
+        self.PB_T_DEP = 10
+        self.PB_VACT = 11
+        self.PB_VACT_N = 11 + P
+        self.PB_VSB = 12 + P
+        self.PB_SIZE = 12 + P + self.SB_SIZE
+        self.SIZE = self.GLOB + P * self.PB_SIZE
+
+    def pbase(self, i: int) -> int:
+        return self.GLOB + i * self.PB_SIZE
+
+
+# ---------------------------------------------------------------------------
+# identity helpers (must match MCPeer exactly)
+
+
+def _ident(name: str) -> str:
+    return "%s:5432:12345" % name
+
+
+def _info(name: str) -> dict:
+    return {
+        "id": _ident(name), "zoneId": name, "ip": name,
+        "pgUrl": "tcp://postgres@%s:5432/postgres" % name,
+        "backupUrl": "http://%s:12345" % name,
+    }
+
+
+def _lsn_int(lsn: str) -> int:
+    hi, lo = lsn.strip().split("/")
+    if int(hi, 16) != 0:
+        raise EncodingError("lsn high word nonzero: %r" % lsn)
+    return int(lo, 16)
+
+
+def _lsn_str(v: int) -> str:
+    return "0/%07X" % v
+
+
+_STATE_KEYS = {"generation", "initWal", "primary", "sync", "async",
+               "deposed", "freeze", "promote", "trace", "span"}
+_PROMOTE_KEYS = {"id", "role", "asyncIndex", "generation", "expireTime"}
+
+
+# ---------------------------------------------------------------------------
+# encode
+
+
+def _idx_of_info(info, idx_map, what: str) -> int:
+    if info is None:
+        return NONE
+    if not isinstance(info, dict) or "id" not in info:
+        raise EncodingError("%s is not a PeerInfo: %r" % (what, info))
+    if info["id"] not in idx_map:
+        raise EncodingError("%s unknown peer %r" % (what, info["id"]))
+    i = idx_map[info["id"]]
+    return i
+
+
+def _check_info(info, names, what: str) -> None:
+    """The encoding regenerates PeerInfo dicts from the peer index, so
+    any non-canonical info dict would silently decode differently."""
+    name = names[_idx_of_info(info, {_ident(n): i for i, n
+                                     in enumerate(names)}, what)]
+    if info != _info(name):
+        raise EncodingError("%s non-canonical PeerInfo: %r" % (what, info))
+
+
+def _encode_sb(st: dict, names, out, base: int) -> None:
+    idx_map = {_ident(n): i for i, n in enumerate(names)}
+    P = len(names)
+    if st is None:
+        raise EncodingError("state block is None (pre-bootstrap world)")
+    extra = set(st) - _STATE_KEYS
+    if extra:
+        raise EncodingError("unsupported state keys: %r" % extra)
+    for k in ("generation", "initWal", "primary", "sync", "async",
+              "deposed"):
+        if k not in st:
+            raise EncodingError("state missing %r" % k)
+    out[base + 0] = st["generation"]
+    out[base + 1] = _lsn_int(st["initWal"])
+    _check_info(st["primary"], names, "primary")
+    out[base + 2] = idx_map[st["primary"]["id"]]
+    if st["sync"] is not None:
+        _check_info(st["sync"], names, "sync")
+        out[base + 3] = idx_map[st["sync"]["id"]]
+    else:
+        out[base + 3] = NONE
+    L = Layout(P)
+    asy = st["async"] or []
+    dep = st["deposed"] or []
+    if len(asy) > P or len(dep) > P:
+        raise EncodingError("async/deposed list longer than P")
+    for k, a in enumerate(asy):
+        _check_info(a, names, "async[%d]" % k)
+        out[base + L.SB_ASY + k] = idx_map[a["id"]]
+    for k in range(len(asy), P):
+        out[base + L.SB_ASY + k] = NONE
+    out[base + L.SB_ASY_N] = len(asy)
+    for k, d in enumerate(dep):
+        _check_info(d, names, "deposed[%d]" % k)
+        out[base + L.SB_DEP + k] = idx_map[d["id"]]
+    for k in range(len(dep), P):
+        out[base + L.SB_DEP + k] = NONE
+    out[base + L.SB_DEP_N] = len(dep)
+    if "freeze" in st:
+        if st["freeze"] != FREEZE_DICT:
+            raise EncodingError("non-canonical freeze: %r" % st["freeze"])
+        out[base + L.SB_FROZEN] = 1
+    else:
+        out[base + L.SB_FROZEN] = 0
+    pr = st.get("promote")
+    if "promote" in st:
+        if pr is None or set(pr) - _PROMOTE_KEYS:
+            raise EncodingError("non-canonical promote: %r" % pr)
+        out[base + L.SB_P_HAS] = 1
+        if pr["role"] == "sync":
+            out[base + L.SB_P_ROLE] = PR_SYNC
+        elif pr["role"] == "async":
+            out[base + L.SB_P_ROLE] = PR_ASYNC
+        else:
+            raise EncodingError("promote role %r" % pr["role"])
+        if pr["id"] not in idx_map:
+            raise EncodingError("promote id %r" % pr["id"])
+        out[base + L.SB_P_ID] = idx_map[pr["id"]]
+        out[base + L.SB_P_IDX] = pr.get("asyncIndex", NONE)
+        out[base + L.SB_P_GEN] = pr["generation"]
+        if pr["expireTime"] == FUTURE_EXPIRY:
+            out[base + L.SB_P_EXP] = 0
+        elif pr["expireTime"] == PAST_EXPIRY:
+            out[base + L.SB_P_EXP] = 1
+        else:
+            raise EncodingError("promote expiry %r" % pr["expireTime"])
+    else:
+        out[base + L.SB_P_HAS] = 0
+        out[base + L.SB_P_ROLE] = NONE
+        out[base + L.SB_P_ID] = NONE
+        out[base + L.SB_P_IDX] = NONE
+        out[base + L.SB_P_GEN] = 0
+        out[base + L.SB_P_EXP] = 0
+
+
+def _decode_sb(vec, names, base: int) -> dict:
+    P = len(names)
+    L = Layout(P)
+    st = {
+        "generation": int(vec[base + L.SB_GEN]),
+        "initWal": _lsn_str(int(vec[base + L.SB_IW])),
+        "primary": _info(names[int(vec[base + L.SB_PRIM])]),
+        "sync": (None if vec[base + L.SB_SYNC] == NONE
+                 else _info(names[int(vec[base + L.SB_SYNC])])),
+        "async": [_info(names[int(vec[base + L.SB_ASY + k])])
+                  for k in range(int(vec[base + L.SB_ASY_N]))],
+        "deposed": [_info(names[int(vec[base + L.SB_DEP + k])])
+                    for k in range(int(vec[base + L.SB_DEP_N]))],
+    }
+    if vec[base + L.SB_FROZEN]:
+        st["freeze"] = dict(FREEZE_DICT)
+    if vec[base + L.SB_P_HAS]:
+        pr = {
+            "id": _ident(names[int(vec[base + L.SB_P_ID])]),
+            "role": ("sync" if vec[base + L.SB_P_ROLE] == PR_SYNC
+                     else "async"),
+            "generation": int(vec[base + L.SB_P_GEN]),
+            "expireTime": (PAST_EXPIRY if vec[base + L.SB_P_EXP]
+                           else FUTURE_EXPIRY),
+        }
+        if vec[base + L.SB_P_IDX] != NONE:
+            pr["asyncIndex"] = int(vec[base + L.SB_P_IDX])
+        st["promote"] = pr
+    return st
+
+
+def _encode_cfg(cfg, idx_map, out, pbase: int, L: Layout) -> None:
+    """Encode a stripped pg-target dict into the 5 target slots."""
+    b = pbase
+    if cfg is None:
+        out[b + L.PB_T_HAS] = 0
+        out[b + L.PB_T_ROLE] = T_NONE
+        out[b + L.PB_T_UP] = NONE
+        out[b + L.PB_T_DOWN] = NONE
+        out[b + L.PB_T_DEP] = 0
+        return
+    role = cfg.get("role")
+    out[b + L.PB_T_HAS] = 1
+    if role == "none":
+        extra = set(cfg) - {"role", "deposed"}
+        if extra:
+            raise EncodingError("target extra keys %r" % extra)
+        out[b + L.PB_T_ROLE] = T_NONE
+        out[b + L.PB_T_UP] = NONE
+        out[b + L.PB_T_DOWN] = NONE
+        out[b + L.PB_T_DEP] = 1 if cfg.get("deposed") else 0
+        if "deposed" in cfg and cfg["deposed"] is not True:
+            raise EncodingError("target deposed %r" % cfg["deposed"])
+        return
+    if role not in ("primary", "sync", "async"):
+        raise EncodingError("target role %r" % role)
+    extra = set(cfg) - {"role", "upstream", "downstream"}
+    if extra:
+        raise EncodingError("target extra keys %r" % extra)
+    if "upstream" not in cfg or "downstream" not in cfg:
+        raise EncodingError("target missing upstream/downstream")
+    out[b + L.PB_T_ROLE] = {"primary": T_PRIM, "sync": T_SYNC,
+                            "async": T_ASYNC}[role]
+    up, down = cfg["upstream"], cfg["downstream"]
+    out[b + L.PB_T_UP] = (NONE if up is None else idx_map[up["id"]])
+    out[b + L.PB_T_DOWN] = (NONE if down is None else idx_map[down["id"]])
+    out[b + L.PB_T_DEP] = 0
+
+
+def _decode_cfg(vec, names, pbase: int, L: Layout):
+    b = pbase
+    if not vec[b + L.PB_T_HAS]:
+        return None
+    role = int(vec[b + L.PB_T_ROLE])
+    if role == T_NONE:
+        cfg = {"role": "none"}
+        if vec[b + L.PB_T_DEP]:
+            cfg["deposed"] = True
+        return cfg
+    up = int(vec[b + L.PB_T_UP])
+    down = int(vec[b + L.PB_T_DOWN])
+    return {
+        "role": _T_STR[role],
+        "upstream": None if up == NONE else _info(names[up]),
+        "downstream": None if down == NONE else _info(names[down]),
+    }
+
+
+def encode_world(world, config: MCConfig) -> np.ndarray:
+    """Encode a (booted, settled) Python checker World.  Raises
+    EncodingError on anything outside the encoding's domain — including
+    a pg target/applied mismatch, which the settle discipline makes
+    impossible at action boundaries (the invariant the single target
+    slot relies on)."""
+    names = list(config.peers)
+    idx_map = {_ident(n): i for i, n in enumerate(names)}
+    P = len(names)
+    L = Layout(P)
+    out = np.zeros(L.SIZE, dtype=np.int32)
+    out[L.G_KILLS] = world.kills
+    out[L.G_REJOINS] = world.rejoins
+    acts = world.store.actives
+    if len(acts) > P:
+        raise EncodingError("store actives longer than P")
+    for k, a in enumerate(acts):
+        if a["id"] not in idx_map:
+            raise EncodingError("unknown active %r" % a["id"])
+        out[L.G_ACT + k] = idx_map[a["id"]]
+    for k in range(len(acts), P):
+        out[L.G_ACT + k] = NONE
+    out[L.G_ACT_N] = len(acts)
+    _encode_sb(world.store.state, names, out, L.G_SB)
+
+    if set(world.peers) != set(names):
+        raise EncodingError("peer set mismatch")
+    for i, name in enumerate(names):
+        p = world.peers[name]
+        b = L.pbase(i)
+        out[b + L.PB_ALIVE] = 1 if p.alive else 0
+        out[b + L.PB_PART] = 1 if p.partitioned else 0
+        out[b + L.PB_X] = _lsn_int(p.pg.xlog)
+        out[b + L.PB_VERCUR] = (
+            1 if p.zk.cluster_state_version == world.store.version else 0)
+        out[b + L.PB_EVALED] = 1 if p.eval_epoch >= p.view_epoch else 0
+        note = p.sm._notified_role
+        for code, s in _NOTE_STR.items():
+            if s == note:
+                out[b + L.PB_NOTE] = code
+                break
+        else:
+            raise EncodingError("role_note %r" % note)
+        tgt = p.sm._strip_cfg(p.sm._pg_target)
+        app = p.sm._strip_cfg(p.sm._pg_applied)
+        if tgt != app:
+            raise EncodingError(
+                "pg target %r != applied %r on %s" % (tgt, app, name))
+        _encode_cfg(tgt, idx_map, out, b, L)
+        va = p.zk.active
+        if len(va) > P:
+            raise EncodingError("view actives longer than P")
+        for k, a in enumerate(va):
+            if a["id"] not in idx_map:
+                raise EncodingError("unknown view active %r" % a["id"])
+            out[b + L.PB_VACT + k] = idx_map[a["id"]]
+        for k in range(len(va), P):
+            out[b + L.PB_VACT + k] = NONE
+        out[b + L.PB_VACT_N] = len(va)
+        if p.zk.cluster_state is None:
+            raise EncodingError("peer %s view is None" % name)
+        _encode_sb(p.zk.cluster_state, names, out, b + L.PB_VSB)
+    return out
+
+
+def decode_canon(vec, config: MCConfig) -> dict:
+    """Decode a state vector back into the exact canonical dict
+    canon.world_canon builds for the equivalent Python world — the
+    other half of the bijectivity contract."""
+    names = list(config.peers)
+    P = len(names)
+    L = Layout(P)
+    s_act = [_ident(names[int(vec[L.G_ACT + k])])
+             for k in range(int(vec[L.G_ACT_N]))]
+    peers = {}
+    for name in sorted(names):
+        i = names.index(name)
+        b = L.pbase(i)
+        v_act = [_ident(names[int(vec[b + L.PB_VACT + k])])
+                 for k in range(int(vec[b + L.PB_VACT_N]))]
+        cfg = _decode_cfg(vec, names, b, L)
+        peers[name] = {
+            "alive": bool(vec[b + L.PB_ALIVE]),
+            "part": bool(vec[b + L.PB_PART]),
+            "xlog": _lsn_str(int(vec[b + L.PB_X])),
+            "ver_current": bool(vec[b + L.PB_VERCUR]),
+            "actives_current": v_act == s_act,
+            "evaled_current": bool(vec[b + L.PB_EVALED]),
+            "view": _decode_sb(vec, names, b + L.PB_VSB),
+            "view_actives": v_act,
+            "target": cfg,
+            "applied": cfg,
+            "role_note": _NOTE_STR[int(vec[b + L.PB_NOTE])],
+        }
+    return {
+        "state": _decode_sb(vec, names, L.G_SB),
+        "actives": s_act,
+        "kills": int(vec[L.G_KILLS]),
+        "rejoins": int(vec[L.G_REJOINS]),
+        "peers": peers,
+    }
+
+
+def digest_vec(vec, config: MCConfig) -> str:
+    return canon.digest_of(decode_canon(vec, config))
+
+
+# ---------------------------------------------------------------------------
+# jnp kernels
+#
+# All kernels take and return a single (SIZE,) int32 vector; the driver
+# vmaps them over the frontier.  Peer/slot indices are Python ints
+# (static), so all addressing is static slices; only content-dependent
+# gathers (e.g. async[promote.asyncIndex]) are dynamic.  Config budgets
+# and mutation flags arrive as one traced knobs array so the compiled
+# step is shared across configs of the same peer count.
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+from jax import lax                                         # noqa: E402
+
+# knobs array layout (traced scalars)
+K_MAX_KILLS, K_MAX_REJOINS, K_PROMOTE, K_FREEZE, K_PARTITION, \
+    K_MUT_XLOG, K_MUT_FREEZE, K_MUT_GENBUMP, K_MUT_DEPOSED = range(9)
+KNOBS = 9
+
+
+def make_knobs(config: MCConfig, mutations=None) -> np.ndarray:
+    m = mutations or Mutations()
+    return np.array([
+        config.max_kills, config.max_rejoins,
+        int(config.allow_promote), int(config.allow_freeze),
+        int(config.allow_partition),
+        int(m.disable_xlog_guard), int(m.ignore_freeze),
+        int(m.skip_gen_bump), int(m.deposed_keeps_primary),
+    ], dtype=np.int32)
+
+
+@dataclass(frozen=True)
+class Mutations:
+    """Deliberate rule-weakenings, mirrored in both engines.
+
+    Each flag corresponds to one monkeypatch of the Python machine (see
+    mutation_patches) and one traced branch in the kernels, so the
+    regression corpus can pin that BOTH engines flag the same seeded
+    bug with the same category."""
+    disable_xlog_guard: bool = False    # sync takeover skips the lsn gate
+    ignore_freeze: bool = False         # duties act on a frozen cluster
+    skip_gen_bump: bool = False         # takeover keeps the generation
+    deposed_keeps_primary: bool = False  # deposed peer ignores deposition
+
+    def any(self) -> bool:
+        return (self.disable_xlog_guard or self.ignore_freeze
+                or self.skip_gen_bump or self.deposed_keeps_primary)
+
+
+def _mask_tail(arr, n):
+    P = arr.shape[0]
+    return jnp.where(jnp.arange(P) < n, arr, NONE)
+
+
+def _compact(vals, keep):
+    """Stable-compact: kept entries first in original order, tail NONE;
+    returns (vals', n)."""
+    P = vals.shape[0]
+    pos = jnp.arange(P)
+    order = jnp.argsort(jnp.where(keep, pos, P + pos))
+    n = keep.sum()
+    out = jnp.where(pos < n, vals[order], NONE)
+    return out, n
+
+
+def _members(ids, n):
+    """(P,) bool: peer j appears in ids[:n]."""
+    P = ids.shape[0]
+    pos = jnp.arange(P)
+    valid = pos < n
+    return ((ids[None, :] == pos[:, None]) & valid[None, :]).any(axis=1)
+
+
+def _index_of(ids, n, j):
+    """Position of peer j in ids[:n], or NONE."""
+    eq = (ids == j) & (jnp.arange(ids.shape[0]) < n)
+    return jnp.where(eq.any(), jnp.argmax(eq), NONE)
+
+
+def _rd_sb(L, v, base):
+    P = L.P
+    return {
+        "gen": v[base + L.SB_GEN], "iw": v[base + L.SB_IW],
+        "prim": v[base + L.SB_PRIM], "sync": v[base + L.SB_SYNC],
+        "asy": v[base + L.SB_ASY:base + L.SB_ASY + P],
+        "asy_n": v[base + L.SB_ASY_N],
+        "dep": v[base + L.SB_DEP:base + L.SB_DEP + P],
+        "dep_n": v[base + L.SB_DEP_N],
+        "frozen": v[base + L.SB_FROZEN],
+        "p_has": v[base + L.SB_P_HAS], "p_role": v[base + L.SB_P_ROLE],
+        "p_id": v[base + L.SB_P_ID], "p_idx": v[base + L.SB_P_IDX],
+        "p_gen": v[base + L.SB_P_GEN], "p_exp": v[base + L.SB_P_EXP],
+    }
+
+
+def _pack_sb(L, d):
+    """Pack a state-block dict, enforcing the canonical encoding
+    (NONE-padded tails, zeroed promote fields when absent) so that
+    equal semantic states are equal byte-for-byte."""
+    has = d["p_has"]
+    one = lambda x: jnp.asarray(x, jnp.int32).reshape(1)  # noqa: E731
+    return jnp.concatenate([
+        one(d["gen"]), one(d["iw"]), one(d["prim"]), one(d["sync"]),
+        _mask_tail(d["asy"], d["asy_n"]), one(d["asy_n"]),
+        _mask_tail(d["dep"], d["dep_n"]), one(d["dep_n"]),
+        one(d["frozen"]),
+        one(has), one(jnp.where(has, d["p_role"], NONE)),
+        one(jnp.where(has, d["p_id"], NONE)),
+        one(jnp.where(has, d["p_idx"], NONE)),
+        one(jnp.where(has, d["p_gen"], 0)),
+        one(jnp.where(has, d["p_exp"], 0)),
+    ]).astype(jnp.int32)
+
+
+def _wr_sb(L, v, base, d):
+    return v.at[base:base + L.SB_SIZE].set(_pack_sb(L, d))
+
+
+def _sb_no_promote(d):
+    d = dict(d)
+    d["p_has"] = jnp.int32(0)
+    return d
+
+
+def _peer(L, v, i, off):
+    return v[L.pbase(i) + off]
+
+
+def _set_peer(L, v, i, off, val):
+    return v.at[L.pbase(i) + off].set(jnp.asarray(val, jnp.int32))
+
+
+def _sact(L, v):
+    return v[L.G_ACT:L.G_ACT + L.P], v[L.G_ACT_N]
+
+
+def _vact(L, v, i):
+    b = L.pbase(i)
+    return v[b + L.PB_VACT:b + L.PB_VACT + L.P], v[b + L.PB_VACT_N]
+
+
+def _view_sync(L, v, i):
+    """view := store, ver_current := 1, view actives := store actives,
+    evaled := 0 (MCZk.sync_view / refresh_cluster_state)."""
+    b = L.pbase(i)
+    v = v.at[b + L.PB_VSB:b + L.PB_VSB + L.SB_SIZE].set(
+        v[L.G_SB:L.G_SB + L.SB_SIZE])
+    v = v.at[b + L.PB_VACT:b + L.PB_VACT + L.P].set(
+        v[L.G_ACT:L.G_ACT + L.P])
+    v = _set_peer(L, v, i, L.PB_VACT_N, v[L.G_ACT_N])
+    v = _set_peer(L, v, i, L.PB_VERCUR, 1)
+    v = _set_peer(L, v, i, L.PB_EVALED, 0)
+    return v
+
+
+def _all_stale(L, v):
+    """A store version bump: every peer's cached version goes stale —
+    dead peers' frozen caches included (currency is derived live)."""
+    for i in range(L.P):
+        v = _set_peer(L, v, i, L.PB_VERCUR, 0)
+    return v
+
+
+def _act_remove(L, v, i):
+    ids, n = _sact(L, v)
+    out, nn = _compact(ids, (jnp.arange(L.P) < n) & (ids != i))
+    v = v.at[L.G_ACT:L.G_ACT + L.P].set(out)
+    return v.at[L.G_ACT_N].set(nn)
+
+
+def _act_append(L, v, i):
+    ids, n = _sact(L, v)
+    v = v.at[L.G_ACT:L.G_ACT + L.P].set(ids.at[n].set(i))
+    return v.at[L.G_ACT_N].set(n + 1)
+
+
+# -- non-eval action kernels ------------------------------------------------
+
+
+def _k_refresh(L, v, i):
+    return _view_sync(L, v, i)
+
+
+def _k_catchup(L, v, i):
+    return _set_peer(L, v, i, L.PB_X, v[L.G_SB + L.SB_IW])
+
+
+def _k_kill(L, v, i):
+    v = _set_peer(L, v, i, L.PB_ALIVE, 0)
+    v = v.at[L.G_KILLS].add(1)
+    return _act_remove(L, v, i)
+
+
+def _k_rejoin(L, v, i):
+    """Crashed peer returns REBUILT: operator reap of its deposed entry
+    (a version-bumping store edit) + fresh machine at the current
+    initWal (World._rejoin)."""
+    st = _rd_sb(L, v, L.G_SB)
+    pos = jnp.arange(L.P)
+    in_dep = ((st["dep"] == i) & (pos < st["dep_n"])).any()
+    dep2, dep2_n = _compact(st["dep"],
+                            (pos < st["dep_n"]) & (st["dep"] != i))
+    st2 = dict(st)
+    st2["dep"] = jnp.where(in_dep, dep2, st["dep"])
+    st2["dep_n"] = jnp.where(in_dep, dep2_n, st["dep_n"])
+    v = _wr_sb(L, v, L.G_SB, st2)
+    v = jnp.where(in_dep, _all_stale(L, v), v)      # reap bumps version
+    v = v.at[L.G_REJOINS].add(1)
+    v = _act_append(L, v, i)
+    v = _set_peer(L, v, i, L.PB_ALIVE, 1)
+    v = _set_peer(L, v, i, L.PB_PART, 0)
+    v = _set_peer(L, v, i, L.PB_X, st["iw"])
+    v = _set_peer(L, v, i, L.PB_NOTE, R_NONE)
+    b = L.pbase(i)
+    v = v.at[b + L.PB_T_HAS:b + L.PB_T_DEP + 1].set(
+        jnp.array([0, T_NONE, NONE, NONE, 0], jnp.int32))
+    return _view_sync(L, v, i)
+
+
+def _k_partition(L, v, i):
+    v = _set_peer(L, v, i, L.PB_PART, 1)
+    return _act_remove(L, v, i)                     # session expires
+
+
+def _k_heal(L, v, i):
+    v = _set_peer(L, v, i, L.PB_PART, 0)
+    v = _act_append(L, v, i)                        # new session
+    return _view_sync(L, v, i)
+
+
+def _k_promote(L, v, role, idx, expired):
+    """Operator promote request (a version-bumping store edit).  role /
+    idx / expired are static per slot."""
+    st = _rd_sb(L, v, L.G_SB)
+    st2 = dict(st)
+    st2["p_has"] = jnp.int32(1)
+    st2["p_role"] = jnp.int32(role)
+    st2["p_id"] = (st["sync"] if role == PR_SYNC
+                   else st["asy"][idx])
+    st2["p_idx"] = jnp.int32(NONE if role == PR_SYNC else idx)
+    st2["p_gen"] = st["gen"]
+    st2["p_exp"] = jnp.int32(1 if expired else 0)
+    v = _wr_sb(L, v, L.G_SB, st2)
+    return _all_stale(L, v)
+
+
+def _k_freeze(L, v, on):
+    st = _rd_sb(L, v, L.G_SB)
+    st2 = dict(st)
+    st2["frozen"] = jnp.int32(1 if on else 0)
+    v = _wr_sb(L, v, L.G_SB, st2)
+    return _all_stale(L, v)
+
+
+# -- slot enumeration -------------------------------------------------------
+#
+# Slot order REPLICATES World.enabled()'s list order exactly.  That
+# matters because the Python explorer memoizes on digest and keeps the
+# FIRST-discovered trace's verdict for each state; matching discovery
+# order is part of the differential contract, not just cosmetics.
+
+
+def slot_table(P: int) -> list[tuple]:
+    slots: list[tuple] = []
+    for i in range(P):
+        slots += [("eval", i), ("refresh", i), ("catchup", i)]
+    slots += [("kill", i) for i in range(P)]
+    slots += [("rejoin", i) for i in range(P)]
+    for i in range(P):
+        slots += [("partition", i), ("heal", i)]
+    slots += [("promote_sync",), ("promote_expired",),
+              ("promote_async", 0), ("promote_async", 1),
+              ("freeze",), ("unfreeze",)]
+    return slots
+
+
+def enabled_mask(L, v, knobs):
+    """(S,) bool in slot order, mirroring World.enabled()."""
+    st = _rd_sb(L, v, L.G_SB)
+    sact, sact_n = _sact(L, v)
+    n_alive = sum(_peer(L, v, i, L.PB_ALIVE) for i in range(L.P))
+    bits = []
+    for i in range(L.P):
+        alive = _peer(L, v, i, L.PB_ALIVE) == 1
+        part = _peer(L, v, i, L.PB_PART) == 1
+        vact, vact_n = _vact(L, v, i)
+        cur = ((_peer(L, v, i, L.PB_VERCUR) == 1)
+               & (vact == sact).all() & (vact_n == sact_n))
+        bits += [alive,
+                 alive & ~part & ~cur,
+                 alive & ~part & (_peer(L, v, i, L.PB_X) < st["iw"])]
+    for i in range(L.P):
+        bits.append((v[L.G_KILLS] < knobs[K_MAX_KILLS]) & (n_alive > 1)
+                    & (_peer(L, v, i, L.PB_ALIVE) == 1)
+                    & (_peer(L, v, i, L.PB_PART) == 0))
+    for i in range(L.P):
+        bits.append((v[L.G_REJOINS] < knobs[K_MAX_REJOINS])
+                    & (_peer(L, v, i, L.PB_ALIVE) == 0))
+    for i in range(L.P):
+        alive = _peer(L, v, i, L.PB_ALIVE) == 1
+        part = _peer(L, v, i, L.PB_PART) == 1
+        allow = knobs[K_PARTITION] == 1
+        bits += [allow & alive & ~part, allow & alive & part]
+    can_pr = (knobs[K_PROMOTE] == 1) & (st["p_has"] == 0)
+    bits += [can_pr & (st["sync"] != NONE), can_pr & (st["sync"] != NONE),
+             can_pr & (st["asy_n"] >= 1), can_pr & (st["asy_n"] >= 2)]
+    allow_f = knobs[K_FREEZE] == 1
+    bits += [allow_f & (st["frozen"] == 0), allow_f & (st["frozen"] == 1)]
+    return jnp.stack(bits)
+
+
+# -- safety predicates (World._check_safety, run after every action) --------
+
+
+def safety_mask(L, v):
+    st = _rd_sb(L, v, L.G_SB)
+    viol = jnp.int32(0)
+    for j in range(L.P):
+        prim_t = ((_peer(L, v, j, L.PB_ALIVE) == 1)
+                  & (_peer(L, v, j, L.PB_PART) == 0)
+                  & (_peer(L, v, j, L.PB_T_HAS) == 1)
+                  & (_peer(L, v, j, L.PB_T_ROLE) == T_PRIM))
+        named = st["prim"] == j
+        xlog_bad = (prim_t & named
+                    & (_peer(L, v, j, L.PB_X) < st["iw"]))
+        view_gen = v[L.pbase(j) + L.PB_VSB + L.SB_GEN]
+        split = (prim_t & ~named & (view_gen >= st["gen"])
+                 & (_peer(L, v, j, L.PB_EVALED) == 1))
+        viol = viol | jnp.where(xlog_bad,
+                                _BIT["xlog_behind"], 0).astype(jnp.int32)
+        viol = viol | jnp.where(split,
+                                _BIT["split_brain"], 0).astype(jnp.int32)
+    return viol
+
+
+# -- peer evaluation --------------------------------------------------------
+
+
+def _member_at(member, x):
+    """member[x] for a possibly-NONE peer index."""
+    return jnp.where(x >= 0,
+                     member[jnp.clip(x, 0, member.shape[0] - 1)], False)
+
+
+def _at(arr, idx):
+    return arr[jnp.clip(idx, 0, arr.shape[0] - 1)]
+
+
+def _set_target(L, v, i, has, role, up, down, dep):
+    b = L.pbase(i)
+    return v.at[b + L.PB_T_HAS:b + L.PB_T_DEP + 1].set(
+        jnp.stack([has, role, up, down, dep]).astype(jnp.int32))
+
+
+def eval_kernel(L, v, i, knobs):
+    """One PeerStateMachine._evaluate of peer *i* (static), tasks
+    settled: role notification, pg-target selection, the primary/sync
+    duty ladder, the CAS write with conflict/partition outcomes, and
+    the write-legality bits.  Returns (v', violation_bits).
+
+    Mirrors machine.py branch for branch under the checker-harness
+    reductions (takeover_grace=0, reconfigures never fail, no ONWM);
+    docs/modelcheck.md has the correspondence table."""
+    b = L.pbase(i)
+    part = _peer(L, v, i, L.PB_PART) == 1
+    ver_cur = _peer(L, v, i, L.PB_VERCUR) == 1
+    x_i = _peer(L, v, i, L.PB_X)
+    vw = _rd_sb(L, v, b + L.PB_VSB)           # the decision snapshot
+    vact, vact_n = _vact(L, v, i)
+    member = _members(vact, vact_n)           # liveness *by this view*
+    pos = jnp.arange(L.P)
+
+    # role_of(view, self) — primary > sync > async > deposed > None
+    in_asy = ((vw["asy"] == i) & (pos < vw["asy_n"])).any()
+    in_dep = ((vw["dep"] == i) & (pos < vw["dep_n"])).any()
+    role = jnp.where(
+        vw["prim"] == i, R_PRIM,
+        jnp.where(vw["sync"] == i, R_SYNC,
+                  jnp.where(in_asy, R_ASYNC,
+                            jnp.where(in_dep, R_DEPOSED, R_NONE))))
+    is_prim, is_sync = role == R_PRIM, role == R_SYNC
+
+    frozen_eff = (vw["frozen"] == 1) & ~(knobs[K_MUT_FREEZE] == 1)
+
+    # alive asyncs / unassigned actives, both in view order
+    alive_of = jax.vmap(lambda x: _member_at(member, x))(vw["asy"])
+    aasy, aasy_n = _compact(vw["asy"], (pos < vw["asy_n"]) & alive_of)
+    asy_has = jax.vmap(
+        lambda j: ((vw["asy"] == j) & (pos < vw["asy_n"])).any()
+    )(pos)
+    dep_has = jax.vmap(
+        lambda j: ((vw["dep"] == j) & (pos < vw["dep_n"])).any()
+    )(pos)
+    role_none = ~((vw["prim"][None] == pos) | (vw["sync"][None] == pos)
+                  | asy_has | dep_has)
+    unass_of = jax.vmap(lambda x: _member_at(role_none, x))(vact)
+    unass, unass_n = _compact(vact, (pos < vact_n) & unass_of)
+
+    # ---- primary duty ladder (machine._primary_duties) ----
+    pr_live = ((vw["p_has"] == 1) & (vw["p_role"] == PR_ASYNC)
+               & (vw["p_gen"] == vw["gen"]) & (vw["p_exp"] == 0))
+    p_idx = vw["p_idx"]
+    ph_valid = (pr_live & (p_idx >= 0) & (p_idx < vw["asy_n"])
+                & (_at(vw["asy"], p_idx) == vw["p_id"])
+                & _member_at(member, vw["p_id"]))
+    ph0_go = ph_valid & (p_idx == 0) & (vw["sync"] != NONE)
+    ph_swap = ph_valid & (p_idx > 0)
+    ph_act = ph0_go | ph_swap
+    sync_bad = ((vw["sync"] == NONE) | ~_member_at(member, vw["sync"]))
+    normal = is_prim & ~frozen_eff & ~ph_act
+    w_appoint = normal & sync_bad & ((aasy_n > 0) | (unass_n > 0))
+    w_prune = normal & ~sync_bad & (aasy_n != vw["asy_n"])
+    w_adopt = (normal & ~sync_bad & (aasy_n == vw["asy_n"])
+               & (unass_n > 0))
+    prim_w = (is_prim & ~frozen_eff & ph_act) | w_appoint | w_prune \
+        | w_adopt
+
+    # the candidate sync and each branch's async list
+    cand = jnp.where(aasy_n > 0, aasy[0], unass[0])
+    app_asy = jnp.where(aasy_n > 0,
+                        _mask_tail(jnp.roll(aasy, -1), aasy_n - 1), aasy)
+    app_n = jnp.where(aasy_n > 0, aasy_n - 1, aasy_n)
+    ph0_asy = _mask_tail(
+        jnp.concatenate([vw["sync"].reshape(1), vw["asy"][1:]]),
+        vw["asy_n"])
+    swp = vw["asy"]
+    i1, i2 = jnp.clip(p_idx - 1, 0, L.P - 1), jnp.clip(p_idx, 0, L.P - 1)
+    swp = swp.at[i1].set(vw["asy"][i2]).at[i2].set(vw["asy"][i1])
+    adopt_asy = jnp.where(pos < vw["asy_n"], vw["asy"],
+                          _at(unass, pos - vw["asy_n"]))
+
+    pick = lambda m, a, b_: jnp.where(m, a, b_)  # noqa: E731
+    prim_new = dict(vw)
+    prim_new["gen"] = vw["gen"] + jnp.where(ph0_go | w_appoint, 1, 0)
+    prim_new["iw"] = pick(ph0_go | w_appoint, x_i, vw["iw"])
+    prim_new["sync"] = pick(ph0_go, vw["asy"][0],
+                            pick(w_appoint, cand, vw["sync"]))
+    prim_new["asy"] = pick(ph0_go, ph0_asy,
+                           pick(ph_swap, swp,
+                                pick(w_appoint, app_asy,
+                                     pick(w_prune, aasy,
+                                          pick(w_adopt, adopt_asy,
+                                               vw["asy"])))))
+    prim_new["asy_n"] = pick(w_appoint, app_n,
+                             pick(w_prune, aasy_n,
+                                  pick(w_adopt,
+                                       vw["asy_n"] + unass_n,
+                                       vw["asy_n"])))
+    prim_new["p_has"] = pick(ph_act, 0, vw["p_has"])
+
+    # ---- sync duty ladder (machine._sync_duties) ----
+    primary_alive = _member_at(member, vw["prim"])
+    promote_me = ((vw["p_has"] == 1) & (vw["p_role"] == PR_SYNC)
+                  & (vw["p_id"] == i) & (vw["p_gen"] == vw["gen"])
+                  & (vw["p_exp"] == 0))
+    xlog_ok = (x_i >= vw["iw"]) | (knobs[K_MUT_XLOG] == 1)
+    w_take = (is_sync & ~frozen_eff & (promote_me | ~primary_alive)
+              & xlog_ok)
+    new_sync = jnp.where(aasy_n > 0, aasy[0], NONE)
+    tasy, tasy_n = _compact(
+        vw["asy"], (pos < vw["asy_n"])
+        & ((new_sync == NONE) | (vw["asy"] != new_sync)))
+    take_new = {
+        # the seeded-bug mutation strips the takeover's gen bump
+        "gen": vw["gen"] + jnp.where(knobs[K_MUT_GENBUMP] == 1, 0, 1),
+        "iw": x_i, "prim": vw["sync"], "sync": new_sync,
+        "asy": tasy, "asy_n": tasy_n,
+        "dep": vw["dep"].at[jnp.clip(vw["dep_n"], 0, L.P - 1)].set(
+            vw["prim"]),
+        "dep_n": vw["dep_n"] + 1,
+        "frozen": jnp.int32(0),               # a takeover is a fresh dict
+        "p_has": jnp.int32(0), "p_role": jnp.int32(NONE),
+        "p_id": jnp.int32(NONE), "p_idx": jnp.int32(NONE),
+        "p_gen": jnp.int32(0), "p_exp": jnp.int32(0),
+    }
+
+    # ---- the CAS write and its outcome ----
+    want_write = prim_w | w_take
+    succ = want_write & ~part & ver_cur
+    conflict = want_write & ~part & ~ver_cur
+    new_sb = {k: pick(is_sync, take_new[k], prim_new[k])
+              for k in take_new}
+    viol = _write_viol(vw, new_sb, succ)
+
+    out = v
+    out = out.at[L.G_SB:L.G_SB + L.SB_SIZE].set(
+        jnp.where(succ, _pack_sb(L, new_sb),
+                  v[L.G_SB:L.G_SB + L.SB_SIZE]))
+    for j in range(L.P):
+        if j == i:
+            continue
+        out = out.at[L.pbase(j) + L.PB_VERCUR].set(
+            jnp.where(succ, 0, _peer(L, v, j, L.PB_VERCUR)))
+    out = out.at[b + L.PB_VERCUR].set(
+        jnp.where(succ | conflict, 1, _peer(L, v, i, L.PB_VERCUR)))
+    # writer's view: success caches the written state; a conflict does
+    # an explicit refresh_cluster_state (view only — NOT the actives,
+    # unlike sync_view)
+    out = out.at[b + L.PB_VSB:b + L.PB_VSB + L.SB_SIZE].set(
+        jnp.where(succ, _pack_sb(L, new_sb),
+                  jnp.where(conflict, v[L.G_SB:L.G_SB + L.SB_SIZE],
+                            v[b + L.PB_VSB:b + L.PB_VSB + L.SB_SIZE])))
+    out = out.at[b + L.PB_EVALED].set(jnp.where(conflict, 0, 1))
+    out = out.at[b + L.PB_NOTE].set(role)
+
+    # ---- pg target (machine._react / _pg_config_for) ----
+    aidx = _index_of(vw["asy"], vw["asy_n"], i)
+    async_up = jnp.where(
+        aidx == 0,
+        jnp.where(vw["sync"] != NONE, vw["sync"], vw["prim"]),
+        _at(vw["asy"], aidx - 1))
+    async_down = jnp.where(aidx + 1 < vw["asy_n"],
+                           _at(vw["asy"], aidx + 1), NONE)
+    take_eff = w_take & ~conflict          # success or partition-abort
+    t_role = jnp.where(is_prim, T_PRIM,
+                       jnp.where(is_sync,
+                                 jnp.where(take_eff, T_PRIM, T_SYNC),
+                                 jnp.where(role == R_ASYNC, T_ASYNC,
+                                           T_NONE)))
+    t_up = jnp.where(is_prim | (is_sync & take_eff), NONE,
+                     jnp.where(is_sync, vw["prim"],
+                               jnp.where(role == R_ASYNC, async_up,
+                                         NONE)))
+    t_down = jnp.where(is_prim, vw["sync"],
+                       jnp.where(is_sync & take_eff, new_sync,
+                                 jnp.where(is_sync,
+                                           jnp.where(vw["asy_n"] > 0,
+                                                     vw["asy"][0], NONE),
+                                           jnp.where(role == R_ASYNC,
+                                                     async_down, NONE))))
+    t_dep = jnp.where(role == R_DEPOSED, 1, 0)
+    out = _set_target(L, out, i, jnp.int32(1), t_role, t_up, t_down,
+                      t_dep)
+
+    # the deposed_keeps_primary mutation returns from _evaluate before
+    # _react: no notify, no target change, no duties — only the
+    # explorer's eval-epoch bookkeeping advances
+    mut_dep = (knobs[K_MUT_DEPOSED] == 1) & (role == R_DEPOSED)
+    noop = v.at[b + L.PB_EVALED].set(1)
+    return (jnp.where(mut_dep, noop, out),
+            jnp.where(mut_dep, 0, viol).astype(jnp.int32),
+            jnp.where(mut_dep, False, succ))
+
+
+def _write_viol(old, new, succ):
+    """validate_transition + MCStore.apply legality bits for a
+    successful CAS write by a peer (operator edits are exempt)."""
+    gen_back = new["gen"] < old["gen"]
+    iw_back = new["iw"] < old["iw"]
+    prim_changed = new["prim"] != old["prim"]
+    same_gen = new["gen"] == old["gen"]
+    npsg = prim_changed & same_gen
+    pnps = prim_changed & ((old["sync"] == NONE)
+                           | (new["prim"] != old["sync"]))
+    bump_nc = (~prim_changed & (new["gen"] > old["gen"])
+               & (old["sync"] != NONE) & (new["sync"] != NONE)
+               & (old["sync"] == new["sync"]))
+    sync_nb = (~prim_changed & same_gen
+               & (((old["sync"] == NONE) != (new["sync"] == NONE))
+                  | ((old["sync"] != NONE) & (new["sync"] != NONE)
+                     & (old["sync"] != new["sync"]))))
+    frozen_w = old["frozen"] == 1
+    bits = [(gen_back, "gen_backwards"), (iw_back, "iw_backwards"),
+            (npsg, "newprim_samegen"), (pnps, "prim_not_prev_sync"),
+            (bump_nc, "bump_nochange"), (sync_nb, "sync_nobump"),
+            (frozen_w, "frozen_write")]
+    viol = jnp.int32(0)
+    for cond, name in bits:
+        viol = viol | jnp.where(succ & cond, _BIT[name],
+                                0).astype(jnp.int32)
+    return viol
+
+
+# -- liveness (World.check_liveness) ----------------------------------------
+
+
+def liveness_kernel(L, v, knobs):
+    """Catch-up + fair schedule to fixpoint + convergence predicates.
+    Returns the liveness violation bits (plus any write-legality bits
+    the settle evaluations tripped)."""
+    st0 = _rd_sb(L, v, L.G_SB)
+    # replication always catches up eventually under a fair schedule:
+    # every ALIVE peer (partitioned included) reaches the store initWal
+    for i in range(L.P):
+        alive = _peer(L, v, i, L.PB_ALIVE) == 1
+        x = _peer(L, v, i, L.PB_X)
+        v = v.at[L.pbase(i) + L.PB_X].set(
+            jnp.where(alive & (x < st0["iw"]), st0["iw"], x))
+
+    def anp(vv, i):
+        return ((_peer(L, vv, i, L.PB_ALIVE) == 1)
+                & (_peer(L, vv, i, L.PB_PART) == 0))
+
+    def views_current(vv):
+        sact, sact_n = _sact(L, vv)
+        ok = jnp.bool_(True)
+        for i in range(L.P):
+            vact, vact_n = _vact(L, vv, i)
+            cur = ((_peer(L, vv, i, L.PB_VERCUR) == 1)
+                   & (vact == sact).all() & (vact_n == sact_n))
+            ok = ok & (~anp(vv, i) | cur)
+        return ok
+
+    def round_body(carry):
+        vv, viol, r, done = carry
+        for i in range(L.P):
+            vv = jnp.where(anp(vv, i), _view_sync(L, vv, i), vv)
+        wrote_any = jnp.bool_(False)
+        for i in range(L.P):
+            go = anp(vv, i)
+            v2, viol_i, wrote = eval_kernel(L, vv, i, knobs)
+            vv = jnp.where(go, v2, vv)
+            viol = viol | jnp.where(go, viol_i, 0).astype(jnp.int32)
+            wrote_any = wrote_any | (go & wrote)
+        return vv, viol, r + 1, ~wrote_any & views_current(vv)
+
+    def cond(carry):
+        _, _, r, done = carry
+        return (r < 30) & ~done
+
+    v, viol, _, done = lax.while_loop(
+        cond, round_body,
+        (v, jnp.int32(0), jnp.int32(0), jnp.bool_(False)))
+    viol = viol | jnp.where(done, 0,
+                            _BIT["no_fixpoint"]).astype(jnp.int32)
+
+    # ---- convergence predicates (only meaningful at a fixpoint) ----
+    st = _rd_sb(L, v, L.G_SB)
+    pos = jnp.arange(L.P)
+    anp_arr = jnp.stack([anp(v, i) for i in range(L.P)])
+    in_asy = jax.vmap(
+        lambda j: ((st["asy"] == j) & (pos < st["asy_n"])).any())(pos)
+    in_dep = jax.vmap(
+        lambda j: ((st["dep"] == j) & (pos < st["dep_n"])).any())(pos)
+    role_deposed = (in_dep & ~in_asy & (st["prim"] != pos)
+                    & (st["sync"] != pos))
+    prim_alive = _member_at(anp_arr, st["prim"])
+    sync_set = st["sync"] != NONE
+    sync_alive = _member_at(anp_arr, st["sync"])
+    not_frozen = st["frozen"] == 0
+    dead_prim = not_frozen & ~prim_alive & sync_set & sync_alive
+    cand_any = (anp_arr & (pos != st["prim"]) & ~role_deposed).any()
+    no_sync = (not_frozen & prim_alive & (~sync_set | ~sync_alive)
+               & cand_any)
+
+    mism = jnp.bool_(False)
+    t_has = jnp.stack([_peer(L, v, j, L.PB_T_HAS) == 1
+                       for j in range(L.P)])
+    t_role = jnp.stack([_peer(L, v, j, L.PB_T_ROLE)
+                        for j in range(L.P)])
+    t_up = jnp.stack([_peer(L, v, j, L.PB_T_UP) for j in range(L.P)])
+    t_down = jnp.stack([_peer(L, v, j, L.PB_T_DOWN)
+                        for j in range(L.P)])
+    for j in range(L.P):
+        want = jnp.where(
+            st["prim"] == j, T_PRIM,
+            jnp.where(st["sync"] == j, T_SYNC,
+                      jnp.where(in_asy[j], T_ASYNC, T_NONE)))
+        mism = mism | (anp_arr[j] & (~t_has[j] | (t_role[j] != want)))
+
+    def up_of(j):
+        return jnp.where(_member_at(t_has, j), _at(t_up, j), NONE)
+
+    def down_of(j):
+        return jnp.where(_member_at(t_has, j), _at(t_down, j), NONE)
+
+    chain = (prim_alive & sync_set
+             & (down_of(st["prim"]) != st["sync"]))
+    chain = chain | (sync_set & sync_alive
+                     & (up_of(st["sync"]) != st["prim"]))
+    for k in range(L.P):
+        a_k = st["asy"][k]
+        live = (k < st["asy_n"]) & _member_at(anp_arr, a_k)
+        want_up = jnp.where(k == 0, st["sync"],
+                            st["asy"][max(k - 1, 0)])
+        applicable = live & ((k > 0) | sync_set)
+        chain = chain | (applicable & (up_of(a_k) != want_up))
+
+    pred = (jnp.where(dead_prim,
+                      _BIT["dead_primary_not_replaced"], 0)
+            | jnp.where(no_sync, _BIT["no_sync_appointed"], 0)
+            | jnp.where(mism, _BIT["role_mismatch"], 0)
+            | jnp.where(chain, _BIT["chain"], 0)).astype(jnp.int32)
+    return viol | jnp.where(done, pred, 0).astype(jnp.int32)
+
+
+# -- one frontier step ------------------------------------------------------
+
+
+def _apply_slot(L, v, slot, knobs):
+    kind = slot[0]
+    if kind == "eval":
+        v2, viol, _ = eval_kernel(L, v, slot[1], knobs)
+        return v2, viol
+    z = jnp.int32(0)
+    if kind == "refresh":
+        return _k_refresh(L, v, slot[1]), z
+    if kind == "catchup":
+        return _k_catchup(L, v, slot[1]), z
+    if kind == "kill":
+        return _k_kill(L, v, slot[1]), z
+    if kind == "rejoin":
+        return _k_rejoin(L, v, slot[1]), z
+    if kind == "partition":
+        return _k_partition(L, v, slot[1]), z
+    if kind == "heal":
+        return _k_heal(L, v, slot[1]), z
+    if kind == "promote_sync":
+        return _k_promote(L, v, PR_SYNC, 0, False), z
+    if kind == "promote_expired":
+        return _k_promote(L, v, PR_SYNC, 0, True), z
+    if kind == "promote_async":
+        return _k_promote(L, v, PR_ASYNC, slot[1], False), z
+    if kind == "freeze":
+        return _k_freeze(L, v, True), z
+    if kind == "unfreeze":
+        return _k_freeze(L, v, False), z
+    raise ValueError("unknown slot %r" % (kind,))
+
+
+def _step_one(L, v, knobs):
+    """Expand one state across the whole action alphabet: children in
+    slot order (disabled slots return the parent, which dedups away),
+    action+safety violation bits, and the enabled mask."""
+    en = enabled_mask(L, v, knobs)
+    outs, viols = [], []
+    for s, slot in enumerate(slot_table(L.P)):
+        v2, viol = _apply_slot(L, v, slot, knobs)
+        viol = (viol | safety_mask(L, v2)).astype(jnp.int32)
+        outs.append(jnp.where(en[s], v2, v))
+        viols.append(jnp.where(en[s], viol, 0))
+    return (jnp.stack(outs), jnp.stack(viols).astype(jnp.int32), en)
+
+
+def build_step(P: int):
+    """The jitted batched step for a peer count: (B,SIZE) -> children
+    (B,S,SIZE), violations (B,S), enabled (B,S).  Config budgets and
+    mutation flags are traced, so all same-P configs share one
+    compilation."""
+    L = Layout(P)
+
+    def step(vs, knobs):
+        return jax.vmap(lambda v: _step_one(L, v, knobs))(vs)
+
+    return jax.jit(step)
+
+
+def build_liveness(P: int):
+    L = Layout(P)
+
+    def live(vs, knobs):
+        return jax.vmap(lambda v: liveness_kernel(L, v, knobs))(vs)
+
+    return jax.jit(live)
+
+
+# ---------------------------------------------------------------------------
+# mutation patches (Python-side mirror of the knob flags)
+
+
+@contextlib.contextmanager
+def mutation_patches(mutations=None):
+    """Apply the deliberate rule-weakenings to the *Python* machine —
+    the exact monkeypatches of the mutation self-tests — so the oracle
+    and the array engine explore the same weakened semantics and the
+    regression corpus can require both to flag the same seeded bug."""
+    m = mutations or Mutations()
+    from manatee_tpu.state import machine as _machine
+    from manatee_tpu.state.types import role_of as _role_of
+    saved = {}
+    try:
+        if m.disable_xlog_guard:
+            saved["compare_lsn"] = _machine.compare_lsn
+            _machine.compare_lsn = lambda a, b: 0
+        if m.ignore_freeze:
+            saved["frozen"] = _machine.frozen
+            _machine.frozen = lambda st: False
+        if m.deposed_keeps_primary:
+            orig_eval = _machine.PeerStateMachine._evaluate
+            saved["_evaluate"] = orig_eval
+
+            async def bad_evaluate(self):
+                st = self.zk.cluster_state
+                if (st is not None
+                        and _role_of(st, self.self_id) == "deposed"):
+                    return    # ignore the deposition; keep old pg config
+                return await orig_eval(self)
+
+            _machine.PeerStateMachine._evaluate = bad_evaluate
+        if m.skip_gen_bump:
+            orig_write = _machine.PeerStateMachine._write_state
+            saved["_write_state"] = orig_write
+
+            async def bad_write(self, state, why, ver, **kw):
+                if "takeover" in why and state.get("generation", 0) > 0:
+                    state = dict(state)
+                    state["generation"] -= 1
+                return await orig_write(self, state, why, ver, **kw)
+
+            _machine.PeerStateMachine._write_state = bad_write
+        yield
+    finally:
+        if "compare_lsn" in saved:
+            _machine.compare_lsn = saved["compare_lsn"]
+        if "frozen" in saved:
+            _machine.frozen = saved["frozen"]
+        if "_evaluate" in saved:
+            _machine.PeerStateMachine._evaluate = saved["_evaluate"]
+        if "_write_state" in saved:
+            _machine.PeerStateMachine._write_state = saved["_write_state"]
+
+
+# ---------------------------------------------------------------------------
+# frontier driver
+
+
+def _slot_action(config: MCConfig, slot: tuple) -> tuple:
+    """Map a slot-table entry back to the Python explorer's action
+    tuple (for counterexample traces and the differential replay)."""
+    kind = slot[0]
+    if kind in ("eval", "refresh", "catchup", "kill", "rejoin",
+                "partition", "heal"):
+        return (kind, config.peers[slot[1]])
+    if kind == "promote_async":
+        return (kind, slot[1])
+    return (kind,)
+
+
+def _build_dedup():
+    """Device-side dedup over a flattened child batch.
+
+    Rows are reduced to a 32-bit semantic-hash key (the encoding is
+    bijective with the canonical digest, so hashing the vector IS
+    hashing the semantic state), stably sorted with invalid rows
+    (disabled slots, padding) pushed to the back, and neighbor-compared
+    on the full vector.  Stability guarantees the *minimum-linear-index*
+    occurrence of every distinct state survives, which is what preserves
+    the Python explorer's first-discovery order; hash collisions merely
+    split a run and leave an extra survivor for the host's exact
+    seen-set to absorb — the device pass only reduces host work, it can
+    never drop a state."""
+
+    def dedup(flat, valid):
+        w = flat.shape[1]
+        weights = (jnp.arange(1, w + 1, dtype=jnp.uint32)
+                   * jnp.uint32(2654435761)) | jnp.uint32(1)
+        key = (flat.astype(jnp.uint32) * weights[None, :]).sum(axis=1)
+        o1 = jnp.argsort(key, stable=True)
+        o2 = jnp.argsort(~valid[o1], stable=True)   # valid first
+        order = o1[o2]
+        srt = flat[order]
+        dup = jnp.concatenate(
+            [jnp.zeros((1,), bool), (srt[1:] == srt[:-1]).all(axis=1)])
+        keep = valid[order] & ~dup
+        return keep, order
+
+    return jax.jit(dedup)
+
+
+_ENGINES: dict = {}
+
+
+def _engine(P: int, chunk: int):
+    """Compiled (step, liveness, dedup) for a peer count and chunk
+    size.  With more than one device the step and liveness kernels are
+    shard_map'd across the host-platform mesh (chunk rows split on the
+    ``data`` axis, knobs replicated); dedup runs over the gathered
+    batch.  Cached so repeated explorations share compilations."""
+    n_dev = len(jax.devices())
+    key = (P, chunk, n_dev)
+    eng = _ENGINES.get(key)
+    if eng is not None:
+        return eng
+    L = Layout(P)
+    if n_dev > 1:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as PSpec
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+        dp, rep = PSpec("data"), PSpec()
+
+        def _step(vs, knobs):
+            return jax.vmap(lambda v: _step_one(L, v, knobs))(vs)
+
+        def _live(vs, knobs):
+            return jax.vmap(lambda v: liveness_kernel(L, v, knobs))(vs)
+
+        # check_rep=False: the liveness fair schedule is a
+        # lax.while_loop, which shard_map's replication checker does
+        # not support; nothing here relies on replication inference
+        # (inputs are either sharded on data or fully replicated)
+        step = jax.jit(shard_map(_step, mesh=mesh, in_specs=(dp, rep),
+                                 out_specs=(dp, dp, dp),
+                                 check_rep=False))
+        live = jax.jit(shard_map(_live, mesh=mesh, in_specs=(dp, rep),
+                                 out_specs=dp, check_rep=False))
+    else:
+        step = build_step(P)
+        live = build_liveness(P)
+    eng = (step, live, _build_dedup())
+    _ENGINES[key] = eng
+    return eng
+
+
+def explore_jax(config: MCConfig, depth: int | None = None,
+                max_nodes: int = 200_000, progress: bool = False,
+                mutations=None, collect=None,
+                chunk: int = 256) -> MCResult:
+    """Level-synchronized BFS with the whole frontier expanded on
+    device.
+
+    Exactly mirrors ``modelcheck.explore``: the slot table enumerates
+    actions in ``World.enabled()`` order, chunks are consecutive
+    frontier slices, the device dedup keeps minimum-linear-index
+    occurrences, and the host seen-set admits candidates in ascending
+    linear order — so states are discovered in the Python explorer's
+    exact BFS order and first-trace verdicts coincide.  Matched-depth
+    runs must agree with the oracle on states, nodes, transitions and
+    every verdict (see :func:`differential`).
+
+    *collect*, when given, is called as ``collect(digest, trace,
+    categories)`` per discovered state (digests require decoding, so
+    only pass it when comparing).  Violation records carry category
+    names (canon.CATEGORIES) as their problems."""
+    depth = config.depth if depth is None else depth
+    m = mutations or Mutations()
+    P = len(config.peers)
+    L = Layout(P)
+    table = slot_table(P)
+    S = len(table)
+    n_dev = len(jax.devices())
+    chunk = max(1, chunk // n_dev) * n_dev
+    res = MCResult(config=config.name, engine="jax")
+    t0 = time.monotonic()
+    last_report = t0
+    logging.getLogger("manatee.state").setLevel(logging.CRITICAL)
+
+    # boot through the real machine (under the same mutations): the
+    # root state and its boot-time violations come from the oracle
+    from manatee_tpu.state import machine as _machine
+    patched, _machine._sleep = _machine._sleep, _fast_sleep
+    try:
+        loop = asyncio.new_event_loop()
+        try:
+            with mutation_patches(m):
+                root_w = loop.run_until_complete(_replay(config, ()))
+        finally:
+            loop.close()
+    finally:
+        _machine._sleep = patched
+    root_vec = np.asarray(encode_world(root_w, config), np.int32)
+    boot_bad = canon.classify_all(root_w.violations
+                                  + root_w.store.violations)
+
+    knobs = jnp.asarray(make_knobs(config, m))
+    step, live, dedup = _engine(P, chunk)
+
+    vecs: list[np.ndarray] = [root_vec]
+    index: dict[bytes, int] = {root_vec.tobytes(): 0}
+    parents: list[int] = [-1]
+    pslots: list[int] = [-1]
+
+    def lv_bits(arr: np.ndarray) -> np.ndarray:
+        out = []
+        for off in range(0, len(arr), chunk):
+            part = arr[off:off + chunk]
+            if len(part) < chunk:
+                part = np.concatenate(
+                    [part, np.repeat(part[:1], chunk - len(part), 0)])
+            out.append(np.asarray(live(jnp.asarray(part), knobs)))
+        return np.concatenate(out)[:len(arr)]
+
+    def trace_of(i: int) -> list:
+        rev = []
+        while parents[i] >= 0:
+            rev.append(pslots[i])
+            i = parents[i]
+        return [_slot_action(config, table[s]) for s in reversed(rev)]
+
+    root_cats = boot_bad | canon.mask_to_categories(
+        int(lv_bits(root_vec[None, :])[0]))
+    if collect is not None:
+        collect(digest_vec(root_vec, config), (), root_cats)
+    frontier: list[int] = []
+    if root_cats:
+        res.violations.append({"trace": [],
+                               "problems": sorted(root_cats)})
+    elif depth > 0:
+        frontier.append(0)
+
+    level = 0
+    truncated = False
+    while frontier and level < depth and not truncated:
+        level += 1
+        budget = max_nodes - res.nodes
+        if budget <= 0:
+            truncated = True
+            break
+        expand = frontier
+        if len(expand) > budget:
+            expand = expand[:budget]
+            truncated = True
+        new_ids: list[int] = []
+        new_avi: list[int] = []
+        for off in range(0, len(expand), chunk):
+            part = expand[off:off + chunk]
+            n_real = len(part)
+            vs = np.stack([vecs[i] for i in part])
+            if n_real < chunk:
+                vs = np.concatenate(
+                    [vs, np.repeat(vs[:1], chunk - n_real, 0)])
+            ch, vi, en = step(jnp.asarray(vs), knobs)
+            en = np.asarray(en)
+            vi = np.asarray(vi)
+            flat = np.asarray(ch).reshape(chunk * S, L.SIZE)
+            valid = np.zeros(chunk * S, bool)
+            valid[:n_real * S] = en[:n_real].reshape(-1)
+            keep, order = dedup(jnp.asarray(flat), jnp.asarray(valid))
+            kept = np.sort(np.asarray(order)[np.asarray(keep)])
+            for lin in kept:                # ascending == BFS order
+                b, s = divmod(int(lin), S)
+                vb = flat[lin].tobytes()
+                if vb in index:
+                    continue
+                nid = len(vecs)
+                index[vb] = nid
+                vecs.append(flat[lin].copy())
+                parents.append(part[b])
+                pslots.append(s)
+                new_ids.append(nid)
+                new_avi.append(int(vi[b, s]))
+            res.nodes += n_real
+            res.transitions += int(en[:n_real].sum())
+            if progress and time.monotonic() - last_report >= 2.0:
+                last_report = time.monotonic()
+                print("[modelcheck %s/jax] states=%d frontier=%d "
+                      "depth<=%d %.0f states/s"
+                      % (config.name, len(vecs), len(frontier),
+                         res.depth_reached,
+                         len(vecs) / (last_report - t0)),
+                      file=sys.stderr, flush=True)
+        if not new_ids:
+            frontier = []
+            break
+        res.depth_reached = level
+        lv = lv_bits(np.stack([vecs[i] for i in new_ids]))
+        nxt: list[int] = []
+        for nid, avi, lbits in zip(new_ids, new_avi, lv):
+            cats = canon.mask_to_categories(avi | int(lbits))
+            if collect is not None:
+                collect(digest_vec(vecs[nid], config),
+                        tuple(trace_of(nid)), cats)
+            if cats:
+                res.violations.append({"trace": trace_of(nid),
+                                       "problems": sorted(cats)})
+            else:
+                nxt.append(nid)
+        frontier = nxt
+    if truncated:
+        res.complete = False
+    res.states = len(vecs)
+    res.seconds = time.monotonic() - t0
+    return res
+
+
+# ---------------------------------------------------------------------------
+# differential oracle
+
+
+class DifferentialError(AssertionError):
+    """The engines disagreed — always a bug, never tolerable noise."""
+
+    def __init__(self, msg: str, trace=None):
+        super().__init__(msg)
+        self.trace = trace
+
+
+def _replay_report(config: MCConfig, mutations, trace) -> str:
+    """Replay the offending action sequence through the Python world,
+    reporting the verdict after every prefix — the minimized trace a
+    divergence report ships."""
+    from manatee_tpu.state import machine as _machine
+    from manatee_tpu.state.modelcheck import _check_world
+    lines = []
+    patched, _machine._sleep = _machine._sleep, _fast_sleep
+    try:
+        loop = asyncio.new_event_loop()
+        try:
+            with mutation_patches(mutations):
+                for k in range(len(trace) + 1):
+                    w = loop.run_until_complete(
+                        _replay(config, tuple(trace[:k])))
+                    bad = _check_world(loop, w)
+                    cats = sorted(canon.classify_all(bad))
+                    lines.append("  after %-60r %s"
+                                 % (list(trace[:k]), cats or "clean"))
+        finally:
+            loop.close()
+    finally:
+        _machine._sleep = patched
+    return "\n".join(lines)
+
+
+def differential(config: MCConfig, depth: int | None = None,
+                 max_nodes: int = 200_000, mutations=None):
+    """Run both engines at matched depth and require exact agreement on
+    the reachable semantic-state set and every violation verdict.
+
+    Divergence is a hard failure (:class:`DifferentialError`): the
+    offending action sequence is replayed through the Python world and
+    the per-prefix verdicts attached as a minimized trace.  Returns
+    ``(python_result, jax_result)`` on agreement."""
+    from manatee_tpu.state.modelcheck import explore
+    m = mutations or Mutations()
+    py: dict = {}
+    jx: dict = {}
+
+    def py_collect(d, seq, bad):
+        if d not in py:
+            py[d] = (seq, canon.classify_all(bad))
+
+    def jx_collect(d, seq, cats):
+        if d not in jx:
+            jx[d] = (seq, cats)
+
+    with mutation_patches(m):
+        pres = explore(config, depth=depth, max_nodes=max_nodes,
+                       collect=py_collect)
+    jres = explore_jax(config, depth=depth, max_nodes=max_nodes,
+                       mutations=m, collect=jx_collect)
+
+    def fail(msg, trace):
+        raise DifferentialError(
+            "%s [config=%s depth=%r mutations=%r]\nminimized trace:\n%s"
+            % (msg, config.name, depth, m,
+               _replay_report(config, m, trace)), trace=trace)
+
+    for d in sorted(jx.keys() - py.keys()):
+        fail("state %s reached only by the jax engine" % d, jx[d][0])
+    for d in sorted(py.keys() - jx.keys()):
+        fail("state %s reached only by the python engine" % d,
+             py[d][0])
+    for d in sorted(py):
+        if py[d][1] != jx[d][1]:
+            fail("verdict mismatch on %s: python=%s jax=%s"
+                 % (d, sorted(py[d][1]), sorted(jx[d][1])), jx[d][0])
+    if pres.complete and jres.complete:
+        pc = (pres.states, pres.nodes, pres.transitions,
+              pres.depth_reached)
+        jc = (jres.states, jres.nodes, jres.transitions,
+              jres.depth_reached)
+        if pc != jc:
+            raise DifferentialError(
+                "counter mismatch on %s: python"
+                "(states,nodes,transitions,depth)=%r jax=%r"
+                % (config.name, pc, jc))
+    return pres, jres
+
+
+# ---------------------------------------------------------------------------
+# throughput probe (the bench.py modelcheck_throughput leg)
+
+
+def main(argv=None) -> int:
+    """One warm-measured jax sweep, JSON on stdout.
+
+    Runs in a subprocess per device count (XLA_FLAGS must be set before
+    jax initializes, so the caller — bench.py — sets the env and execs
+    this module).  A short cold run pays the jit compile first; the
+    timed runs therefore measure steady-state states/sec, which is the
+    number that matters for sweep planning."""
+    import argparse
+    import json as _json
+    import os
+
+    ap = argparse.ArgumentParser(
+        description="jax model-check engine throughput probe")
+    ap.add_argument("--config", default="promote",
+                    choices=sorted(CONFIGS))
+    ap.add_argument("--depth", type=int, default=5)
+    ap.add_argument("--deeper", type=int, default=0,
+                    help="extra plies for a second, deeper timed sweep")
+    ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--max-nodes", type=int, default=500_000)
+    args = ap.parse_args(argv)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the image's pinned accelerator plugin ignores the env var;
+        # jax.config is the mechanism it honors (tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    cfg = CONFIGS[args.config]
+    explore_jax(cfg, depth=min(2, args.depth), chunk=args.chunk)
+    res = explore_jax(cfg, depth=args.depth, chunk=args.chunk,
+                      max_nodes=args.max_nodes)
+    out = {
+        "engine": "jax", "config": args.config,
+        "n_devices": len(jax.devices()),
+        "depth": args.depth, "states": res.states,
+        "nodes": res.nodes, "ok": res.ok, "complete": res.complete,
+        "seconds": round(res.seconds, 3),
+        "states_per_sec": round(res.states_per_sec, 1),
+    }
+    if args.deeper > 0:
+        d2 = explore_jax(cfg, depth=args.depth + args.deeper,
+                         chunk=args.chunk, max_nodes=args.max_nodes)
+        out["deeper"] = {
+            "depth": args.depth + args.deeper, "states": d2.states,
+            "ok": d2.ok, "complete": d2.complete,
+            "seconds": round(d2.seconds, 3),
+            "states_per_sec": round(d2.states_per_sec, 1),
+        }
+    print(_json.dumps(out))
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,9 +62,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import hashlib
 import json
 import logging
+import sys
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -74,6 +74,7 @@ from manatee_tpu.coord.api import (
     ConnectionLossError,
     NodeExistsError,
 )
+from manatee_tpu.state import canon
 from manatee_tpu.state.machine import PeerStateMachine
 from manatee_tpu.state.types import (
     INITIAL_WAL,
@@ -644,57 +645,22 @@ class World:
 
     # -- canonical hash --
 
-    _OBS_KEYS = frozenset(("trace", "span"))
+    # the semantic-state quotient lives in canon.py, shared with the
+    # JAX array engine (mc_array.py) so the two engines cannot silently
+    # disagree on what "same state" means
+    _OBS_KEYS = canon.OBS_KEYS
 
     @staticmethod
     def _sem(state):
-        """Semantic projection of a cluster state for hashing: the
-        per-transition trace AND span ids (obs metadata, unique on
-        every durable write) are quotiented out — hashing either would
-        make every logically-identical state look fresh and defeat
-        memoization (an exponential blowup of the sweep)."""
-        if not isinstance(state, dict) \
-                or not (World._OBS_KEYS & state.keys()):
-            return state
-        return {k: v for k, v in state.items()
-                if k not in World._OBS_KEYS}
+        """Semantic projection of a cluster state for hashing (see
+        canon.sem_state; kept as a method for back-compat)."""
+        return canon.sem_state(state)
+
+    def canon(self) -> dict:
+        return canon.world_canon(self)
 
     def digest(self) -> str:
-        peers = {}
-        for name in sorted(self.peers):
-            p = self.peers[name]
-            peers[name] = {
-                "alive": p.alive,
-                "part": p.partitioned,
-                "xlog": p.pg.xlog,
-                # version staleness and actives staleness diverge (a
-                # kill changes actives without bumping the state
-                # version), and CAS outcomes depend on the version bit
-                # alone — hash them separately
-                "ver_current": (p.zk.cluster_state_version
-                                == self.store.version),
-                "actives_current": ([a["id"] for a in p.zk.active]
-                                    == [a["id"] for a in
-                                        self.store.actives]),
-                "evaled_current": p.eval_epoch >= p.view_epoch,
-                "view": self._sem(p.zk.cluster_state),
-                "view_actives": [a["id"] for a in p.zk.active],
-                # strip the overlapped-takeover commit gate: an Event
-                # is not JSON, and its identity is fresh per attempt —
-                # hashing it would defeat memoization exactly like the
-                # trace/span ids quotiented above
-                "target": p.sm._strip_cfg(p.sm._pg_target),
-                "applied": p.sm._strip_cfg(p.sm._pg_applied),
-                "role_note": p.sm._notified_role,
-            }
-        blob = json.dumps({
-            "state": self._sem(self.store.state),
-            "actives": [a["id"] for a in self.store.actives],
-            "kills": self.kills,
-            "rejoins": self.rejoins,
-            "peers": peers,
-        }, sort_keys=True)
-        return hashlib.md5(blob.encode()).hexdigest()
+        return canon.digest_of(canon.world_canon(self))
 
 
 # ---------------------------------------------------------------------------
@@ -704,16 +670,22 @@ class World:
 @dataclass
 class MCResult:
     config: str
-    nodes: int = 0
+    nodes: int = 0            # states EXPANDED (popped from the queue)
     transitions: int = 0
     depth_reached: int = 0
     seconds: float = 0.0
     complete: bool = True     # False when max_nodes truncated the search
     violations: list = field(default_factory=list)
+    states: int = 0           # distinct semantic states DISCOVERED
+    engine: str = "python"
 
     @property
     def ok(self) -> bool:
         return not self.violations
+
+    @property
+    def states_per_sec(self) -> float:
+        return self.states / self.seconds if self.seconds > 0 else 0.0
 
 
 async def _replay(config: MCConfig, seq: tuple) -> World:
@@ -735,15 +707,23 @@ def _check_world(loop, w: World) -> list[str]:
 
 
 def explore(config: MCConfig, depth: int | None = None,
-            max_nodes: int = 200_000) -> MCResult:
+            max_nodes: int = 200_000, collect=None,
+            progress: bool = False) -> MCResult:
     """BFS over action interleavings with memoization on the canonical
     world digest.  Worlds are rebuilt by replaying the action sequence
     (the machine is deterministic), so counterexamples come out as
     minimal-length traces.  Each discovered state is checked exactly
-    once, at discovery; the pop replays it only to expand children."""
+    once, at discovery; the pop replays it only to expand children.
+
+    *collect*, when given, is called as ``collect(digest, seq, bad)``
+    for every discovered semantic state (root included) — the hook the
+    differential oracle uses to compare reachable-state sets and
+    violation verdicts against the JAX array engine.  *progress* emits
+    periodic states/sec + frontier-size lines to stderr."""
     depth = config.depth if depth is None else depth
     res = MCResult(config=config.name)
     t0 = time.monotonic()
+    last_report = t0
     logging.getLogger("manatee.state").setLevel(logging.CRITICAL)
     from manatee_tpu.state import machine as _machine
     patched, _machine._sleep = _machine._sleep, _fast_sleep
@@ -756,9 +736,13 @@ def explore(config: MCConfig, depth: int | None = None,
             # world), so a pop never needs to re-replay its own node
             queue: deque[tuple] = deque()
             root = loop.run_until_complete(_replay(config, ()))
-            seen.add(root.digest())
+            root_digest = root.digest()
+            seen.add(root_digest)
             root_actions = root.enabled()
-            if _record(res, (), _check_world(loop, root)) and depth > 0:
+            root_bad = _check_world(loop, root)
+            if collect is not None:
+                collect(root_digest, (), root_bad)
+            if _record(res, (), root_bad) and depth > 0:
                 queue.append(((), root_actions))
             while queue:
                 if res.nodes >= max_nodes:
@@ -766,6 +750,14 @@ def explore(config: MCConfig, depth: int | None = None,
                     break
                 seq, actions = queue.popleft()
                 res.nodes += 1
+                if progress and time.monotonic() - last_report >= 2.0:
+                    last_report = time.monotonic()
+                    el = last_report - t0
+                    print("[modelcheck %s/python] states=%d frontier=%d "
+                          "depth<=%d %.0f states/s"
+                          % (config.name, len(seen), len(queue),
+                             res.depth_reached, len(seen) / el),
+                          file=sys.stderr, flush=True)
                 for action in actions:
                     res.transitions += 1
                     child_seq = seq + (action,)
@@ -778,10 +770,13 @@ def explore(config: MCConfig, depth: int | None = None,
                     res.depth_reached = max(res.depth_reached,
                                             len(child_seq))
                     child_actions = child.enabled()
-                    ok = _record(res, child_seq,
-                                 _check_world(loop, child))
+                    bad = _check_world(loop, child)
+                    if collect is not None:
+                        collect(d, child_seq, bad)
+                    ok = _record(res, child_seq, bad)
                     if ok and len(child_seq) < depth:
                         queue.append((child_seq, child_actions))
+            res.states = len(seen)
         finally:
             loop.close()
     finally:
@@ -807,22 +802,53 @@ def main(argv=None) -> int:
     ap.add_argument("--depth", type=int, default=None,
                     help="override the per-config interleaving depth")
     ap.add_argument("--max-nodes", type=int, default=200_000)
+    ap.add_argument("--engine", default="python",
+                    choices=("python", "jax"),
+                    help="python: replay-based BFS (the oracle); jax: "
+                         "vectorized frontier exploration on the device "
+                         "mesh (docs/modelcheck.md)")
+    ap.add_argument("--progress", action="store_true",
+                    help="periodic states/sec + frontier-size lines on "
+                         "stderr")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON result line per config (the "
+                         "CI artifact format)")
     args = ap.parse_args(argv)
 
     names = sorted(CONFIGS) if args.config == "all" else [args.config]
     rc = 0
     for name in names:
         cfg = CONFIGS[name]
-        res = explore(cfg, depth=args.depth, max_nodes=args.max_nodes)
+        if args.engine == "jax":
+            from manatee_tpu.state import mc_array
+            res = mc_array.explore_jax(cfg, depth=args.depth,
+                                       max_nodes=args.max_nodes,
+                                       progress=args.progress)
+        else:
+            res = explore(cfg, depth=args.depth,
+                          max_nodes=args.max_nodes,
+                          progress=args.progress)
         status = "ok" if res.ok else "VIOLATIONS"
         if not res.complete:
             # an incomplete sweep must not read as a pass: the whole
             # point of the tool is exhaustiveness within the bound
             status += "/TRUNCATED"
             rc = 1
-        print("%-10s %-10s nodes=%-6d transitions=%-7d depth=%d  %.1fs  (%s)"
-              % (name, status, res.nodes, res.transitions,
-                 res.depth_reached, res.seconds, cfg.description))
+        if args.as_json:
+            print(json.dumps({
+                "config": name, "engine": res.engine, "ok": res.ok,
+                "complete": res.complete, "nodes": res.nodes,
+                "states": res.states, "transitions": res.transitions,
+                "depth": res.depth_reached,
+                "seconds": round(res.seconds, 3),
+                "states_per_sec": round(res.states_per_sec, 1),
+                "violations": len(res.violations),
+            }))
+        else:
+            print("%-10s %-10s nodes=%-6d transitions=%-7d depth=%d  "
+                  "%.1fs  (%s)"
+                  % (name, status, res.nodes, res.transitions,
+                     res.depth_reached, res.seconds, cfg.description))
         for v in res.violations[:5]:
             rc = 1
             print("  trace: %s" % (v["trace"],))

@@ -105,3 +105,67 @@ def test_mutation_missing_generation_bump_is_caught():
     finally:
         machine.PeerStateMachine._write_state = orig
     assert "new primary but same generation" in _first_problem(res)
+
+
+# ---------------------------------------------------------------------------
+# fixed regression corpus: known-bad action sequences
+
+
+# One entry per vectorized safety invariant, seeded by deliberately
+# weakening the matching transition rule (mc_array.Mutations — the same
+# knob reaches both engines).  Each trace is the minimal counterexample
+# the checker first produced; BOTH engines must keep flagging it with
+# the same stable category (canon.CATEGORIES).  If a refactor ever
+# changes one of these verdicts, that is a detection regression, not a
+# corpus update.
+CORPUS = [
+    # xlog gate: a behind sync seizes primary, initWal regresses
+    ("behind", dict(disable_xlog_guard=True),
+     (("kill", "A"), ("refresh", "C"), ("eval", "C")), "iw_backwards"),
+    # freeze discipline: automatic write on a frozen cluster
+    ("freeze", dict(ignore_freeze=True),
+     (("kill", "A"), ("freeze",)), "frozen_write"),
+    # single-writable-primary: a deposed peer keeps its writable config
+    ("promote", dict(deposed_keeps_primary=True),
+     (("promote_sync",),), "role_mismatch"),
+    # generation monotonicity: takeover without the generation bump
+    ("deaths3", dict(skip_gen_bump=True),
+     (("kill", "A"),), "newprim_samegen"),
+]
+
+
+@pytest.mark.parametrize("name,mut,trace,category", CORPUS,
+                         ids=[c[3] for c in CORPUS])
+def test_corpus_python_engine_flags(name, mut, trace, category):
+    """The Python oracle flags every corpus sequence."""
+    import asyncio
+
+    from manatee_tpu.state import canon, mc_array
+    with mc_array.mutation_patches(mc_array.Mutations(**mut)):
+        orig, machine._sleep = machine._sleep, modelcheck._fast_sleep
+        loop = asyncio.new_event_loop()
+        try:
+            w = loop.run_until_complete(
+                modelcheck._replay(modelcheck.CONFIGS[name], trace))
+            bad = modelcheck._check_world(loop, w)
+        finally:
+            loop.close()
+            machine._sleep = orig
+    assert category in canon.classify_all(bad), bad
+
+
+@pytest.mark.parametrize("name,mut,trace,category", CORPUS,
+                         ids=[c[3] for c in CORPUS])
+def test_corpus_jax_engine_flags(name, mut, trace, category):
+    """The array engine flags every corpus sequence — with the exact
+    corpus trace as its counterexample, because its BFS mirrors the
+    oracle's discovery order."""
+    from manatee_tpu.state import mc_array
+    res = mc_array.explore_jax(modelcheck.CONFIGS[name],
+                               depth=len(trace),
+                               mutations=mc_array.Mutations(**mut))
+    assert res.engine == "jax"
+    hits = [v for v in res.violations if category in v["problems"]]
+    assert hits, res.violations[:3]
+    assert any(v["trace"] == list(trace) for v in hits), \
+        [v["trace"] for v in hits[:5]]
