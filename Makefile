@@ -59,7 +59,8 @@ modelcheck-jax:
 # unscripted randomized storm against real processes + the real CLI
 # (MANATEE_CHAOS_SECONDS / MANATEE_CHAOS_SEED to vary)
 chaos:
-	MANATEE_CHAOS=1 $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
+	MANATEE_CHAOS=1 $(PYTHON) -m pytest tests/test_chaos.py \
+	    tests/test_slo_live.py -x -q -s
 
 chaos-postgres:
 	MANATEE_CHAOS=1 MANATEE_ENGINE=postgres \
@@ -70,7 +71,8 @@ chaos-postgres:
 # split-brain probe
 chaos-partition:
 	MANATEE_CHAOS=1 MANATEE_CHAOS_PARTITION=1 \
-	    $(PYTHON) -m pytest tests/test_chaos.py -x -q -s
+	    $(PYTHON) -m pytest tests/test_chaos.py \
+	    tests/test_slo_live.py -x -q -s
 
 # reproduces the packaged weights: synthetic degradation batches plus
 # healthy-stretch negatives from three recorded chaos runs (seeds 1-3;
@@ -96,7 +98,8 @@ bench:
 # roff man pages generated from the markdown source (reference:
 # Makefile:68-79)
 man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1 \
-		man/man1/manatee-sitter.1
+		man/man1/manatee-sitter.1 man/man1/manatee-prober.1 \
+		man/man1/manatee-adm-slo.1
 man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
@@ -106,6 +109,12 @@ man/man1/manatee-adm-trace.1: docs/man/manatee-adm-trace.md tools/md2man
 man/man1/manatee-sitter.1: docs/man/manatee-sitter.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-sitter.md > $@
+man/man1/manatee-prober.1: docs/man/manatee-prober.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-prober.md > $@
+man/man1/manatee-adm-slo.1: docs/man/manatee-adm-slo.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-adm-slo.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

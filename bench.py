@@ -59,6 +59,25 @@ And one fleet-scale control-plane leg:
                              failover with every neighbor churning —
                              per_shard breakdown in the JSON.
 
+And the black-box measurement-plane leg:
+
+  - slo_probe:               the measurement plane measured: one
+                             `manatee-prober` fronts the
+                             ensemble_postgres shard plus
+                             MANATEE_SCALE_SHARDS-1 sim singleton
+                             neighbors over ONE multiplexed
+                             coordination connection at the default
+                             cadence, yielding steady-state prober CPU
+                             per shard; then a fresh fast-cadence
+                             prober watches a kill of the measured
+                             primary, and its client-observed error
+                             window is compared against the
+                             span-derived failover_duration_seconds
+                             sample — the outside view judged against
+                             the control plane's own account
+                             (within_15pct in the JSON), plus how many
+                             burn-rate alerts the outage fired.
+
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
 every perf PR's effect is attributable stage by stage; the breakdown
@@ -106,7 +125,7 @@ DISCONNECT_GRACE = 0.35
 ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
                "incremental_rebuild", "control_plane_scale",
-               "modelcheck_throughput")
+               "modelcheck_throughput", "slo_probe")
 # total shards in the control_plane_scale leg: one measured 3-peer
 # shard + (N-1) singleton neighbors in ONE fleet sitter process
 SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
@@ -659,6 +678,232 @@ async def bench_control_plane_scale() -> dict:
             await cluster.stop()
 
 
+async def bench_slo_probe() -> dict:
+    """The measurement plane measured: one `manatee-prober` process
+    fronts the ensemble_postgres shard plus N-1 sim singleton
+    neighbors over one multiplexed coordination connection.
+
+    Two numbers come out, each measured in its own regime.  First,
+    steady-state prober CPU per fronted shard (/proc utime+stime over
+    a quiet window) with every shard at the default 1 s cadence the
+    0.01 core/shard budget is defined at.  Second, agreement: a fresh
+    prober probes just the measured shard fast enough to resolve a
+    sub-second outage, the primary is killed, and the client-observed
+    error window (first failed write -> first succeeding write, the
+    prober's own account) is compared with the control plane's
+    span-derived failover_duration_seconds sample.
+    The SLI's clock starts when the sync DETECTS primary loss, after
+    the coordination layer's disconnect grace; a client's outage
+    includes that detection window, so the within-15% verdict judges
+    window vs (sample + grace) and the raw ratio rides alongside."""
+    from manatee_tpu.storage import DirBackend
+    from tests.harness import (
+        alloc_port_block,
+        kill_fleet_sitter,
+        spawn_fleet_sitter,
+        spawn_prober,
+    )
+    from tests.test_partition import http_get
+
+    n_shards = max(2, SCALE_SHARDS)
+    n_neighbors = n_shards - 1
+    cpu_window_s = float(os.environ.get("MANATEE_SLO_WINDOW", "10"))
+    # the measured shard probes fast so the error window's resolution
+    # is small next to a sub-second failover; neighbors pay the
+    # default cadence the overhead budget is defined at
+    probe_interval = float(os.environ.get("MANATEE_SLO_PROBE_INTERVAL",
+                                          "0.02"))
+
+    with tempfile.TemporaryDirectory(prefix="manatee-bench-slo-") as d:
+        tmp = Path(d)
+        (tmp / "measured").mkdir()
+        cluster = ClusterHarness(tmp / "measured", n_peers=3, n_coord=3,
+                                 session_timeout=SESSION_TIMEOUT,
+                                 disconnect_grace=DISCONNECT_GRACE,
+                                 engine="postgres")
+        fleet_proc = None
+        prober_proc = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-slo", timeout=60)
+
+            # ---- N-1 singleton sim neighbors in one fleet sitter
+            base_port = alloc_port_block(4 * n_neighbors + 2)
+            status_port = base_port + 4 * n_neighbors
+            prober_port = status_port + 1
+            froot = tmp / "fleet"
+            froot.mkdir()
+            names = ["s%02d" % k for k in range(n_neighbors)]
+            shard_entries = []
+            for k, name in enumerate(names):
+                b = base_port + 4 * k
+                sroot = froot / name
+                store = str(sroot / "store")
+                be = DirBackend(store)
+                if not await be.exists("manatee"):
+                    await be.create("manatee")
+                shard_entries.append({
+                    "name": name,
+                    "shardPath": "/manatee/%s" % name,
+                    "postgresPort": b,
+                    "backupPort": b + 2,
+                    "zfsPort": b + 3,
+                    "dataDir": str(sroot / "data"),
+                    "storageRoot": store,
+                })
+            fleet_cfg = {
+                "ip": "127.0.0.1",
+                "dataset": "manatee/pg",
+                "storageBackend": "dir",
+                "pgEngine": "sim",
+                "oneNodeWriteMode": True,
+                "statusPort": status_port,
+                "healthChkInterval": 0.5,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": SESSION_TIMEOUT,
+                             "disconnectGrace": DISCONNECT_GRACE},
+                "shards": shard_entries,
+            }
+            fleet_proc = await asyncio.to_thread(
+                spawn_fleet_sitter, fleet_cfg, froot)
+
+            base = "http://127.0.0.1:%d" % prober_port
+
+            async def slis() -> dict:
+                _s, body = await http_get(base + "/slis")
+                return {row["shard"]: row for row in body["shards"]}
+
+            async def wait_good_writes(shard_names, deadline_s):
+                """Every named shard observed >= 1 good write — the
+                prober is warm and its topology views converged."""
+                deadline = time.monotonic() + deadline_s
+                missing = set(shard_names)
+                while missing:
+                    try:
+                        rows = await slis()
+                        missing = {
+                            n for n in missing
+                            if not (rows.get(n) or {}).get("writes_ok")}
+                    except (OSError, KeyError, ValueError,
+                            asyncio.TimeoutError):
+                        pass          # prober still booting
+                    if not missing:
+                        return
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "prober never observed good writes on: %s"
+                            % sorted(missing))
+                    await asyncio.sleep(0.5)
+
+            # ---- phase 1: overhead.  ONE prober fronts all n_shards
+            # shards at the DEFAULT cadence the budget is defined at;
+            # CPU is read from /proc over a quiet window.
+            coord_cfg = {"connStr": cluster.coord_connstr,
+                         "sessionTimeout": SESSION_TIMEOUT,
+                         "disconnectGrace": DISCONNECT_GRACE}
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "statusHost": "127.0.0.1",
+                "statusPort": prober_port,
+                "probeInterval": 1.0,
+                "coordCfg": coord_cfg,
+                "shards": [{"name": "measured",
+                            "shardPath": cluster.shard_path}]
+                          + [{"name": n, "shardPath": "/manatee/%s" % n}
+                             for n in names],
+            }, tmp / "prober-fleet")
+            await wait_good_writes(set(names) | {"measured"}, 180)
+            cpu0 = _proc_cpu_seconds(prober_proc.pid)
+            await asyncio.sleep(cpu_window_s)
+            cpu = _proc_cpu_seconds(prober_proc.pid) - cpu0
+            core_per_shard = cpu / cpu_window_s / n_shards
+            await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+
+            # ---- phase 2: agreement.  A fresh prober on just the
+            # measured shard, probing fast enough to resolve a
+            # sub-second outage against its own cadence.
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "name": "measured",
+                "shardPath": cluster.shard_path,
+                "statusHost": "127.0.0.1",
+                "statusPort": prober_port,
+                "probeInterval": probe_interval,
+                "coordCfg": coord_cfg,
+            }, tmp / "prober-measured")
+            await wait_good_writes({"measured"}, 60)
+
+            async def fired_alerts() -> int:
+                _s, ev = await http_get(base + "/events")
+                return sum(1 for e in ev["events"]
+                           if e.get("event") == "slo.alert.fired")
+
+            fired0 = await fired_alerts()   # warmup noise baseline
+
+            t0 = time.monotonic()
+            p1.kill()
+            await cluster.wait_topology(primary=p2, timeout=60)
+            await cluster.wait_writable(p2, "post-slo-failover",
+                                        timeout=60)
+            harness_s = time.monotonic() - t0
+
+            window = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                row = (await slis())["measured"]
+                if not row["error_window_open"] \
+                        and row["last_error_window_s"]:
+                    window = float(row["last_error_window_s"])
+                    break
+                await asyncio.sleep(0.2)
+            if window is None:
+                raise RuntimeError("prober error window never closed")
+
+            _s, text = await http_get(
+                "http://127.0.0.1:%d/metrics" % p2.status_port)
+            total = _metric_value(
+                text, "manatee_failover_duration_seconds_sum")
+            count = _metric_value(
+                text, "manatee_failover_duration_seconds_count")
+            if not count:
+                raise RuntimeError("new primary has no "
+                                   "failover_duration_seconds sample")
+            sample = total / count
+            adjusted = sample + DISCONNECT_GRACE
+
+            await asyncio.sleep(1.2)        # one eval_loop pass
+            fired = await fired_alerts() - fired0
+
+            out = {
+                "shards": n_shards,
+                "probe_interval_s": probe_interval,
+                "prober_cpu_core_per_shard": round(core_per_shard, 5),
+                "error_window_s": round(window, 3),
+                "failover_sli_s": round(sample, 3),
+                "detection_grace_s": DISCONNECT_GRACE,
+                "harness_observed_s": round(harness_s, 3),
+                "ratio_vs_sli": round(window / sample, 3),
+                "ratio_vs_sli_plus_grace": round(window / adjusted, 3),
+                "within_15pct": abs(window - adjusted)
+                <= 0.15 * max(window, adjusted),
+                "alerts_fired": fired,
+            }
+            print("slo_probe: %d shards, prober %.5f core/shard; "
+                  "error window %.2fs vs SLI %.2fs (+%.2fs grace) = "
+                  "%.2fx (within 15%%: %s); %d alert(s) fired"
+                  % (n_shards, core_per_shard, window, sample,
+                     DISCONNECT_GRACE, out["ratio_vs_sli_plus_grace"],
+                     out["within_15pct"], fired), file=sys.stderr)
+            return out
+        finally:
+            if prober_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+            if fleet_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, fleet_proc)
+            await cluster.stop()
+
+
 def _mesh_env(n_devices: int) -> dict:
     """Subprocess env forcing an n-device virtual CPU mesh.  The flag
     must be final before jax initializes, hence subprocess-per-count
@@ -772,7 +1017,8 @@ async def main() -> None:
     }
     for name in picked:
         if name in ("restore_throughput", "incremental_rebuild",
-                    "control_plane_scale", "modelcheck_throughput"):
+                    "control_plane_scale", "modelcheck_throughput",
+                    "slo_probe"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -786,6 +1032,9 @@ async def main() -> None:
     modelcheck = None
     if "modelcheck_throughput" in picked:
         modelcheck = await bench_modelcheck_throughput()
+    slo = None
+    if "slo_probe" in picked:
+        slo = await bench_slo_probe()
     scale = None
     if "control_plane_scale" in picked:
         scale = await bench_control_plane_scale()
@@ -815,6 +1064,8 @@ async def main() -> None:
         out["control_plane_scale"] = scale
     if modelcheck is not None:
         out["modelcheck_throughput"] = modelcheck
+    if slo is not None:
+        out["slo_probe"] = slo
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

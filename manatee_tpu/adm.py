@@ -868,7 +868,8 @@ class AdmClient:
         """GET *path* from every peer's status server (and, when
         *include_backup*, its backup server too), collecting the dicts
         under each of *keys*; per-peer failures land in the errors
-        map."""
+        map.  *query* may be a callable(label) so a poll-tail can send
+        each peer its own ``since`` cursor."""
         import aiohttp
 
         out: dict[str, list] = {k: [] for k in keys}
@@ -904,12 +905,15 @@ class AdmClient:
         async with aiohttp.ClientSession(timeout=http_timeout) as http:
             await asyncio.gather(*(
                 fetch(by_label[label.split("/", 1)[0]],
-                      base + path + query, label, http)
+                      base + path
+                      + (query(label) if callable(query) else query),
+                      label, http)
                 for label, base in targets))
         return out, errors
 
     async def shard_events(self, shard: str, *,
                            limit: int | None = None,
+                           since: dict[str, int] | None = None,
                            timeout: float = 5.0) -> dict:
         """Fan out ``GET /events`` to every peer's status server, merge
         the rings by wall-clock timestamp (peer/seq as the tiebreak),
@@ -920,12 +924,66 @@ class AdmClient:
 
         The merged list is what one grep of per-peer bunyan logs could
         never give the reference's operators: a single trace-correlated
-        takeover timeline."""
+        takeover timeline.  *since* maps peer id -> last seq already
+        seen, so a follow loop (``manatee-adm events --follow``) ships
+        only each ring's new tail instead of the whole ring per poll."""
         peers = await self._shard_peers(shard)
+
+        def q(label: str) -> str:
+            parts = []
+            cursor = (since or {}).get(label)
+            if cursor:
+                parts.append("since=%d" % cursor)
+            if limit is not None:
+                parts.append("limit=%d" % limit)
+            return ("?" + "&".join(parts)) if parts else ""
+
         got, errors = await self._fan_out(
-            peers, "/events", ("events",), timeout=timeout,
-            query=("?limit=%d" % limit) if limit is not None else "")
+            peers, "/events", ("events",), timeout=timeout, query=q)
         return {"events": merge_events(got["events"]), "errors": errors}
+
+    async def shard_metrics(self, shard: str, *, timeout: float = 5.0
+                            ) -> tuple[dict[str, str], dict[str, str]]:
+        """Raw Prometheus exposition text per peer status server — the
+        `manatee-adm top` fan-out (process self-metrics, replication
+        lag, health score all ride the one scrape every sitter already
+        serves)."""
+        import aiohttp
+
+        peers = await self._shard_peers(shard)
+        targets, errors = self.peer_http_targets(peers)
+        out: dict[str, str] = {}
+
+        async def fetch(label: str, base: str, http) -> None:
+            try:
+                async with http.get(base + "/metrics") as resp:
+                    if resp.status != 200:
+                        errors[label] = "HTTP %d" % resp.status
+                        return
+                    out[label] = await resp.text()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                errors[label] = str(e) or type(e).__name__
+
+        http_timeout = aiohttp.ClientTimeout(total=timeout)
+        async with aiohttp.ClientSession(timeout=http_timeout) as http:
+            await asyncio.gather(*(fetch(label, base, http)
+                                   for label, base in targets))
+        return out, errors
+
+    @staticmethod
+    async def http_json(url: str, *, timeout: float = 5.0
+                        ) -> tuple[int, dict]:
+        """One JSON GET — how the CLI talks to a prober's /alerts and
+        /slis (the prober fronts the fleet; it is not a shard peer, so
+        the peer fan-out machinery does not apply)."""
+        import aiohttp
+
+        http_timeout = aiohttp.ClientTimeout(total=timeout)
+        async with aiohttp.ClientSession(timeout=http_timeout) as http:
+            async with http.get(url) as resp:
+                return resp.status, await resp.json()
 
     async def shard_spans(self, shard: str, *,
                           trace: str | None = None,

@@ -83,6 +83,7 @@ class BackupRestServer:
         app.router.add_post("/backup", self._post_backup)
         app.router.add_get("/backup/{uuid}", self._get_backup)
         app.router.add_get("/spans", self._spans)
+        app.router.add_get("/history", self._history)
         # the backupserver daemon's own registry (the sender's stream
         # faults live in THIS process, not the sitter)
         faults.attach_http(app)
@@ -168,5 +169,14 @@ class BackupRestServer:
         ``backup.send`` lives here, not in the sitter) — same contract
         as the status server's ``GET /spans``."""
         body, status = spans_http_reply(get_span_store(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _history(self, req: web.Request) -> web.Response:
+        """This process's on-disk metric-history ring — same contract
+        as the status server's ``GET /history``."""
+        from manatee_tpu.obs.history import (get_history,
+                                             history_http_reply)
+        body, status = history_http_reply(get_history(), req.query)
         return web.json_response(body, status=status,
                                  content_type="application/json")

@@ -17,6 +17,7 @@ import argparse
 import asyncio
 import json
 import os
+import re
 import sys
 import time
 
@@ -535,30 +536,32 @@ def cmd_events(args) -> int:
     fans out GET /events across every peer's status server, merges by
     timestamp, and prints one trace-correlated sequence — a takeover is
     reconstructed end-to-end with a single command instead of grepping
-    per-peer bunyan logs."""
-    async def go():
-        async with AdmClient(_coord(args)) as adm:
-            out = await adm.shard_events(_shard(args),
-                                         limit=args.limit)
-        events = out["events"]
+    per-peer bunyan logs.  --follow keeps polling, sending each peer
+    its own ``since`` cursor so every poll ships only the ring's new
+    tail (the journal's pagination contract, not a re-fetch)."""
+    cols = [
+        {"name": "time", "label": "TIME", "width": 24},
+        {"name": "peer", "label": "PEER", "width": 21},
+        {"name": "trace", "label": "TRACE", "width": 16},
+        {"name": "event", "label": "EVENT", "width": 24},
+        {"name": "detail", "label": "DETAIL", "width": 30},
+    ]
+    core = {"seq", "ts", "time", "peer", "event", "trace"}
+
+    def wanted(events):
         if args.trace:
             events = [e for e in events
                       if e.get("trace") == args.trace]
         if args.event:
             events = [e for e in events
                       if args.event in str(e.get("event"))]
+        return events
+
+    def emit(events, *, first: bool) -> None:
         if args.json:
             for e in events:
                 print(json.dumps(e))
         else:
-            cols = [
-                {"name": "time", "label": "TIME", "width": 24},
-                {"name": "peer", "label": "PEER", "width": 21},
-                {"name": "trace", "label": "TRACE", "width": 16},
-                {"name": "event", "label": "EVENT", "width": 24},
-                {"name": "detail", "label": "DETAIL", "width": 30},
-            ]
-            core = {"seq", "ts", "time", "peer", "event", "trace"}
             rows = []
             for e in events:
                 detail = " ".join(
@@ -571,16 +574,57 @@ def cmd_events(args) -> int:
                     "event": e.get("event", "?"),
                     "detail": detail or "-",
                 })
-            emit_table(cols, rows, omit_header=args.omit_header)
-        for peer_id, err in sorted(out["errors"].items()):
-            sys.stderr.write("warning: no events from %s: %s\n"
-                             % (peer_id, err))
-        # exit nonzero only when NO peer answered (a dead peer's ring
-        # died with it; partial timelines are still the tool's job) —
-        # judged on the UNFILTERED fetch, so a -t/-e filter matching
-        # nothing is not an error
-        return 0 if out["events"] or not out["errors"] else 1
-    return asyncio.run(go())
+            if rows or first:
+                emit_table(cols, rows,
+                           omit_header=args.omit_header or not first)
+        sys.stdout.flush()
+
+    async def go():
+        warned: set[str] = set()
+
+        def warn(errors) -> None:
+            # follow mode warns on each peer's TRANSITION to
+            # unreachable, not every poll
+            for peer_id, err in sorted(errors.items()):
+                if peer_id not in warned:
+                    sys.stderr.write("warning: no events from %s: %s\n"
+                                     % (peer_id, err))
+            warned.clear()
+            warned.update(errors)
+
+        async with AdmClient(_coord(args)) as adm:
+            shard = _shard(args)
+            out = await adm.shard_events(shard, limit=args.limit)
+            emit(wanted(out["events"]), first=True)
+            warn(out["errors"])
+            if not args.follow:
+                # exit nonzero only when NO peer answered (a dead
+                # peer's ring died with it; partial timelines are
+                # still the tool's job) — judged on the UNFILTERED
+                # fetch, so a -t/-e filter matching nothing is not an
+                # error
+                return 0 if out["events"] or not out["errors"] else 1
+            cursors: dict[str, int] = {}
+
+            def advance(events) -> None:
+                for e in events:
+                    peer, seq = e.get("peer"), e.get("seq")
+                    if peer and isinstance(seq, int):
+                        cursors[peer] = max(cursors.get(peer, 0), seq)
+
+            advance(out["events"])
+            while True:
+                await asyncio.sleep(args.interval)
+                out = await adm.shard_events(shard, since=cursors)
+                advance(out["events"])
+                emit(wanted(out["events"]), first=False)
+                warn(out["errors"])
+
+    try:
+        return asyncio.run(go())
+    except KeyboardInterrupt:
+        # Ctrl-C is how a follow tail ends; the tail shown is complete
+        return 0
 
 
 def cmd_trace(args) -> int:
@@ -608,6 +652,36 @@ def cmd_trace(args) -> int:
                 tid = args.trace_id
             out = await adm.shard_spans(_shard(args), trace=tid,
                                         limit=args.limit)
+            if args.follow:
+                # live tail of an in-flight trace: print each span as
+                # it COMPLETES, polling until the trace has spans and
+                # none remain open, then fall through to the normal
+                # post-mortem rendering (Ctrl-C stops the wait)
+                seen: set = set()
+
+                def tail(batch) -> None:
+                    new = [s for s in batch
+                           if s.get("span") not in seen]
+                    for s in sorted(new, key=lambda s:
+                                    float(s.get("ts") or 0.0)):
+                        seen.add(s.get("span"))
+                        if not args.json:
+                            print("%-24s %-24s %-21s %8.3fs"
+                                  % (s.get("time") or "?",
+                                     s.get("name") or "?",
+                                     s.get("peer") or "-",
+                                     float(s.get("dur") or 0.0)))
+                    sys.stdout.flush()
+
+                tail(out["spans"])
+                while not (out["spans"] and not out["open"]):
+                    await asyncio.sleep(args.interval)
+                    out = await adm.shard_spans(_shard(args),
+                                                trace=tid,
+                                                limit=args.limit)
+                    tail(out["spans"])
+                if not args.json:
+                    print("")
         spans = out["spans"]
         roots, children, orphans = assemble_tree(spans)
         # the critical path is computed over the tree's MAIN root: the
@@ -664,7 +738,10 @@ def cmd_trace(args) -> int:
                              % (o.get("span"), o.get("name"),
                                 o.get("peer")))
         return 0 if spans else 1
-    return asyncio.run(go())
+    try:
+        return asyncio.run(go())
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_fault(args) -> int:
@@ -800,6 +877,252 @@ def cmd_fault(args) -> int:
     return asyncio.run(go())
 
 
+# one exposition line: manatee_<name>{labels} <value> — the subset of
+# the Prometheus text format our own MetricsBuilder emits (top's
+# parser feeds on our own scrapes, never arbitrary expositions)
+_PROM_SAMPLE = re.compile(
+    r'^manatee_([A-Za-z0-9_]+?)(?:\{([^}]*)\})?[ \t]+'
+    r'(-?[0-9][0-9.eE+-]*)[ \t]*$', re.M)
+_PROM_LABEL = re.compile(r'([A-Za-z0-9_]+)="([^"]*)"')
+
+
+def _prom_samples(text: str) -> list[tuple[str, dict, float]]:
+    out = []
+    for m in _PROM_SAMPLE.finditer(text):
+        labels = dict(_PROM_LABEL.findall(m.group(2) or ""))
+        try:
+            out.append((m.group(1), labels, float(m.group(3))))
+        except ValueError:
+            continue
+    return out
+
+
+def _prom_pick(samples, name: str, peer: str | None = None
+               ) -> float | None:
+    """First sample of *name*; with *peer*, only the sample labeled
+    for that peer — a fleet sitter's one registry holds every shard's
+    gauges, and the scrape knows which peer it asked."""
+    for n, labels, v in samples:
+        if n == name and (peer is None
+                          or labels.get("peer") == peer):
+            return v
+    return None
+
+
+def _prober_url(args) -> str | None:
+    url = getattr(args, "url", None) \
+        or os.environ.get("MANATEE_PROBER_URL")
+    return url.rstrip("/") if url else None
+
+
+def cmd_slo(args) -> int:
+    """Error budgets + burn-rate alerts, fleet-wide: one GET against a
+    prober's /alerts (the prober is where the SLO engine runs — it
+    fronts every shard over one coordination connection, so its one
+    endpoint IS the fleet view).  Exits 1 while any alert is active,
+    so the chaos drill and cron checks can gate on it."""
+    base = _prober_url(args)
+    if not base:
+        die("prober URL required (-u/--url or MANATEE_PROBER_URL)")
+
+    async def go():
+        try:
+            status, body = await AdmClient.http_json(base + "/alerts")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            die("cannot reach prober at %s: %s"
+                % (base, str(e) or type(e).__name__))
+        if status == 404:
+            die(body.get("error") or "no SLO engine at %s" % base)
+        if status != 200:
+            die("%s/alerts answered HTTP %d" % (base, status))
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 1 if body.get("alerts") else 0
+        for c in body.get("configs") or []:
+            rules = " ".join(
+                "%s>=%gx(%gs/%gs)" % (sev, r["factor"], r["long_s"],
+                                      r["short_s"])
+                for sev, r in sorted(c["burn_rules"].items()))
+            print("# %s: objective %.5g%% over %gs; %s"
+                  % (c["name"], 100.0 * c["objective"], c["window_s"],
+                     rules))
+        cols = [
+            {"name": "slo", "label": "SLO", "width": 20},
+            {"name": "shard", "label": "SHARD", "width": 16},
+            {"name": "objective", "label": "OBJECTIVE", "width": 9},
+            {"name": "good", "label": "GOOD", "width": 8},
+            {"name": "bad", "label": "BAD", "width": 6},
+            {"name": "ratio", "label": "RATIO", "width": 8},
+            {"name": "budget", "label": "BUDGET", "width": 7},
+            {"name": "burn", "label": "BURN", "width": 6},
+        ]
+        rows = []
+        for r in body.get("slos") or []:
+            budget = r.get("budget_remaining")
+            rows.append({
+                "slo": r["slo"],
+                "shard": r["shard"],
+                "objective": "%.5g%%" % (100.0 * r["objective"]),
+                "good": r["good"],
+                "bad": r["bad"],
+                "ratio": ("-" if r.get("ratio") is None
+                          else "%.3f%%" % (100.0 * r["ratio"])),
+                "budget": ("-" if budget is None
+                           else "%.0f%%" % (100.0 * budget)),
+                "burn": "%.1f" % r["burn"],
+            })
+        if rows:
+            emit_table(cols, rows, omit_header=args.omit_header)
+        else:
+            print("no SLI events accounted yet at %s" % base)
+        alerts = body.get("alerts") or []
+        for a in alerts:
+            print("ALERT %-7s %s shard=%s burn %.1fx/%.1fx "
+                  "(>=%.1fx) for %ds"
+                  % (a["severity"], a["slo"], a["shard"],
+                     a["burn_long"], a["burn_short"], a["factor"],
+                     int(body.get("now", 0) - a["since"])))
+        return 1 if alerts else 0
+    return asyncio.run(go())
+
+
+def cmd_top(args) -> int:
+    """Fleet dashboard: one row per peer — role, uptime, CPU, RSS,
+    open fds (obs/process.py's self-metrics), replication lag and
+    health score — from the /metrics scrape every sitter already
+    serves; plus the prober's per-shard client-observed SLIs when a
+    prober URL is given (-u or MANATEE_PROBER_URL)."""
+    async def go():
+        rc = 0
+        async with AdmClient(_coord(args)) as adm:
+            shard = _shard(args)
+            state, _v = await adm.get_state(shard)
+            texts, errors = await adm.shard_metrics(shard)
+        roles: dict[str, str] = {}
+        if state:
+            for role, plist in (("primary", [state.get("primary")]),
+                                ("sync", [state.get("sync")]),
+                                ("async", state.get("async") or []),
+                                ("deposed", state.get("deposed") or [])):
+                for p in plist:
+                    if p and p.get("id"):
+                        roles[p["id"]] = role
+        now = time.time()
+        peers_out = []
+        for label in sorted(texts):
+            samples = _prom_samples(texts[label])
+            start = _prom_pick(samples, "process_start_time_seconds")
+            rss = _prom_pick(samples, "process_resident_memory_bytes")
+            cpu = _prom_pick(samples, "process_cpu_seconds_total")
+            fds = _prom_pick(samples, "process_open_fds")
+            lag = _prom_pick(samples, "replication_lag_seconds",
+                             peer=label)
+            score = _prom_pick(samples, "health_score", peer=label)
+            peers_out.append({
+                "peer": label,
+                "role": roles.get(label, "-"),
+                "uptime_s": (round(now - start, 1)
+                             if start is not None else None),
+                "cpu_s": cpu,
+                "rss_bytes": rss,
+                "fds": fds,
+                "lag_s": lag,
+                "health_score": score,
+            })
+        slis = None
+        base = _prober_url(args)
+        if base:
+            try:
+                status, body = await AdmClient.http_json(
+                    base + "/slis")
+                if status == 200:
+                    slis = body.get("shards")
+                else:
+                    errors[base] = "HTTP %d" % status
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                errors[base] = str(e) or type(e).__name__
+
+        if args.json:
+            print(json.dumps({"now": round(now, 3),
+                              "peers": peers_out, "slis": slis,
+                              "errors": errors},
+                             indent=2, sort_keys=True))
+            return 0 if not errors else 1
+
+        cols = [
+            {"name": "peer", "label": "PEER", "width": 21},
+            {"name": "role", "label": "ROLE", "width": 8},
+            {"name": "up", "label": "UP", "width": 8},
+            {"name": "cpu", "label": "CPU", "width": 8},
+            {"name": "rss", "label": "RSS", "width": 7},
+            {"name": "fds", "label": "FDS", "width": 5},
+            {"name": "lag", "label": "LAG", "width": 6},
+            {"name": "pred", "label": "PRED", "width": 5},
+        ]
+        rows = []
+        for p in peers_out:
+            rows.append({
+                "peer": p["peer"],
+                "role": p["role"],
+                "up": pg_duration(p["uptime_s"]),
+                "cpu": ("-" if p["cpu_s"] is None
+                        else "%.1fs" % p["cpu_s"]),
+                "rss": ("-" if p["rss_bytes"] is None
+                        else "%.0fM" % (p["rss_bytes"] / 1048576.0)),
+                "fds": ("-" if p["fds"] is None
+                        else "%d" % p["fds"]),
+                "lag": pg_duration(p["lag_s"]),
+                "pred": ("-" if p["health_score"] is None
+                         else "%.2f" % p["health_score"]),
+            })
+        emit_table(cols, rows, omit_header=args.omit_header)
+        if slis is not None:
+            scols = [
+                {"name": "shard", "label": "SHARD", "width": 16},
+                {"name": "primary", "label": "PRIMARY", "width": 21},
+                {"name": "wok", "label": "W-OK", "width": 8},
+                {"name": "werr", "label": "W-ERR", "width": 6},
+                {"name": "p50", "label": "ACK-P50", "width": 8},
+                {"name": "p99", "label": "ACK-P99", "width": 8},
+                {"name": "stale", "label": "MAX-STALE", "width": 9},
+                {"name": "outage", "label": "OUTAGE", "width": 7},
+            ]
+            srows = []
+            for s in slis:
+                staleness = [v for v in (s.get("staleness") or
+                                         {}).values()
+                             if v is not None]
+                open_win = s.get("error_window_open")
+                last_win = s.get("last_error_window_s")
+                srows.append({
+                    "shard": s.get("shard", "?"),
+                    "primary": s.get("primary") or "-",
+                    "wok": s.get("writes_ok", 0),
+                    "werr": s.get("writes_error", 0),
+                    "p50": ("-" if s.get("ack_p50_s") is None
+                            else "%.3fs" % s["ack_p50_s"]),
+                    "p99": ("-" if s.get("ack_p99_s") is None
+                            else "%.3fs" % s["ack_p99_s"]),
+                    "stale": ("-" if not staleness
+                              else "%.2fs" % max(staleness)),
+                    "outage": ("OPEN" if open_win
+                               else "-" if last_win is None
+                               else "%.2fs" % last_win),
+                })
+            print("")
+            emit_table(scols, srows, omit_header=args.omit_header)
+        for label, err in sorted(errors.items()):
+            sys.stderr.write("warning: no metrics from %s: %s\n"
+                             % (label, err))
+            rc = 1
+        return rc
+    return asyncio.run(go())
+
+
 def cmd_doctor(args) -> int:
     """Store integrity verifier (docs/crash-recovery.md): offline
     checks of coordd data dirs (--coord-data) and dir-backend store
@@ -814,6 +1137,7 @@ def cmd_doctor(args) -> int:
         check_cluster,
         check_coordd_store,
         check_dirstore,
+        check_history,
         finding,
         summarize,
     )
@@ -836,6 +1160,8 @@ def cmd_doctor(args) -> int:
         findings.extend(check_coordd_store(d))
     for root in store_roots:
         findings.extend(check_dirstore(root))
+    for d in args.history_dir or []:
+        findings.extend(check_history(d))
 
     coord_addr = args.coord or os.environ.get("COORD_ADDR") \
         or os.environ.get("ZK_IPS")
@@ -875,12 +1201,14 @@ def cmd_doctor(args) -> int:
                 "online cluster checks skipped: %s" % e))
         else:
             findings.extend(check_cluster(state, hist, events))
-    elif not (args.coord_data or store_roots or findings):
+    elif not (args.coord_data or store_roots or args.history_dir
+              or findings):
         # findings counts: a zfs-backend -c config produced a
         # store-not-dir NOTE — that is an answer, not a usage error
-        die("nothing to verify: provide --coord-data, --store-root "
-            "or -c for offline checks, and/or a coordination address "
-            "(-z/COORD_ADDR) for the online checks")
+        die("nothing to verify: provide --coord-data, --store-root, "
+            "--history-dir or -c for offline checks, and/or a "
+            "coordination address (-z/COORD_ADDR) for the online "
+            "checks")
 
     s = summarize(findings)
     if args.json:
@@ -1137,6 +1465,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="newest N events per peer")
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="keep polling, printing only each ring's new "
+                         "tail (Ctrl-C to stop)")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="follow poll interval (default 1.0)")
 
     sp = add("trace", cmd_trace,
              "cross-peer span tree + critical path for one trace")
@@ -1150,6 +1484,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="machine-readable spans + critical path")
     sp.add_argument("-n", "--limit", type=int, default=None,
                     help="newest N spans per peer")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="tail spans as they complete; render the "
+                         "tree once the trace has no open spans")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="follow poll interval (default 1.0)")
+
+    sp = add("slo", cmd_slo,
+             "error budgets + active burn-rate alerts (from a prober)",
+             shard=False)
+    sp.add_argument("-u", "--url", default=None, metavar="URL",
+                    help="prober base URL "
+                         "(env: MANATEE_PROBER_URL)")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
+
+    sp = add("top", cmd_top,
+             "fleet dashboard: per-peer resources + client-observed "
+             "SLIs")
+    sp.add_argument("-u", "--url", default=None, metavar="URL",
+                    help="also render per-shard SLIs from this "
+                         "prober (env: MANATEE_PROBER_URL)")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
 
     sp = add("history", cmd_history, "annotated cluster state history")
     sp.add_argument("-j", "--json", action="store_true")
@@ -1191,6 +1551,10 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="DIR",
                     help="verify a dir-backend store root offline "
                          "(repeatable)")
+    sp.add_argument("--history-dir", action="append", default=None,
+                    metavar="DIR",
+                    help="verify a metric-history segment ring "
+                         "offline (repeatable)")
     sp.add_argument("-c", "--config", default=None,
                     help="sitter config to derive the store root from "
                          "(env: MANATEE_SITTER_CONFIG)")

@@ -999,9 +999,17 @@ class CoordServer:
             return web.json_response(body, status=status,
                                      content_type="application/json")
 
+        async def history(req):
+            from manatee_tpu.obs.history import (get_history,
+                                                 history_http_reply)
+            body, status = history_http_reply(get_history(), req.query)
+            return web.json_response(body, status=status,
+                                     content_type="application/json")
+
         app = web.Application()
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/spans", spans)
+        app.router.add_get("/history", history)
         faults.attach_http(app)
         self._metrics_runner = web.AppRunner(app)
         await self._metrics_runner.setup()
@@ -1062,6 +1070,17 @@ class CoordServer:
                  self._watch_encodes)
         b.histogram(_RPC_HANDLE.name, _RPC_HANDLE.help,
                     _RPC_HANDLE.buckets, _RPC_HANDLE.series())
+        from manatee_tpu.obs.metrics import _fmt
+        from manatee_tpu.obs.process import (
+            process_instruments,
+            refresh_process_metrics,
+        )
+        from manatee_tpu.utils.prom import label_str
+        refresh_process_metrics()
+        for inst in process_instruments():
+            b.metric(inst.name, inst.kind, inst.help,
+                     [(label_str(**labels), _fmt(v))
+                      for labels, v in inst.samples()])
         return b.render()
 
     def _expire_due_sessions(self) -> None:

@@ -1,0 +1,705 @@
+"""manatee-prober — the black-box SLO measurement plane.
+
+Everything else in this tree grades the control plane from the inside
+(spans, events, failover_duration_seconds are all the control plane's
+own account of itself).  The prober is the outside view: one process
+fronts a whole fleet over the multiplexed coordination connection
+(CoordMux, exactly like ``manatee-sitter --fleet``), watches each
+shard's cluster state, and continuously does what a client would do —
+synchronous writes against the primary, staleness-bounded reads
+against every replica — producing per-shard **client-observed** SLIs:
+
+- write availability and ack latency (``prober_writes_total``,
+  ``prober_write_ack_seconds``);
+- read staleness per peer, from its own read-your-write probes
+  (``prober_read_staleness_seconds``) plus the peer-reported
+  ``replication_lag_seconds`` gauge scraped from each sitter
+  (health/telemetry.py's normalized lag, re-exported raw by the
+  manager);
+- the measured error window across a failover: first failed write →
+  first succeeding write (``prober_error_window_seconds`` and a
+  ``prober.error_window`` journal event) — the number the span-derived
+  failover breakdown is judged against (bench.py slo_probe leg).
+
+Good/bad events feed the SLO engine (obs/slo.py) whose burn-rate
+alerts this daemon serves at ``GET /alerts``; snapshots of the whole
+registry land in the on-disk history ring (obs/history.py) served at
+``GET /history``.  Collection follows the amortization discipline
+(RPCAcc/Poseidon, PAPERS.md): one write + one read per replica per
+shard per interval, observations serialized once into instruments the
+existing scrape plumbing already ships — O(1) per shard per tick.
+
+Config (single shard, ``-f``)::
+
+    {"shardPath": "/manatee/1",
+     "coordCfg": {"connStr": "127.0.0.1:2281"},
+     "statusPort": 14001, "probeInterval": 1.0,
+     "stalenessBudget": 5.0, "historyDir": "/var/manatee/history",
+     "slos": [{"name": "write_availability", "objective": 0.999}]}
+
+Fleet mode (``--fleet`` or a ``shards`` list in ``-f``'s config)
+mirrors the sitter: top-level keys are the shared base, each
+``shards`` entry ({name, shardPath}) overrides per shard, one probe
+loop per shard over ONE coordination connection and ONE engine per
+database flavor.
+
+The probe seams carry the ``prober.write`` and ``prober.read``
+failpoints (armable over this daemon's own ``/faults``): an ``error``
+counts a bad SLI event without touching the cluster — the way the
+chaos drill proves a fast-burn alert fires — and ``crash`` feeds the
+crash-recovery sweep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import time
+from collections import deque
+
+from manatee_tpu import faults
+from manatee_tpu.coord.api import CoordError, NoNodeError
+from manatee_tpu.coord.client import mux_handle
+from manatee_tpu.daemons.common import daemon_main
+from manatee_tpu.obs import get_journal, get_registry, get_span_store, \
+    set_peer
+from manatee_tpu.obs.history import DEFAULT_INTERVAL as HISTORY_INTERVAL
+from manatee_tpu.obs.history import (
+    HistoryRecorder,
+    get_history,
+    history_http_reply,
+    init_history,
+)
+from manatee_tpu.obs.slo import (
+    alerts_http_reply,
+    get_slo_engine,
+    init_slo_engine,
+    parse_slo_configs,
+)
+from manatee_tpu.obs.spans import spans_http_reply
+from manatee_tpu.pg.engine import PgError, parse_pg_url
+from manatee_tpu.utils.validation import ConfigError
+
+log = logging.getLogger("manatee.prober")
+
+DEFAULT_PROBE_INTERVAL = 1.0
+DEFAULT_STALENESS_BUDGET = 5.0
+PROBE_TIMEOUT = 5.0
+# peer-reported lag is scraped at most this often per peer (the probe
+# loop itself never blocks on it)
+LAG_SCRAPE_INTERVAL = 10.0
+# read-your-write matching window: acked probe writes we can still
+# recognize in a replica's table
+ACKED_RING = 1024
+
+_REG = get_registry()
+_WRITES = _REG.counter(
+    "prober_writes_total",
+    "synthetic write probes against each shard's primary",
+    ("shard", "result"))
+_WRITE_ACK = _REG.histogram(
+    "prober_write_ack_seconds",
+    "client-observed ack latency of synthetic writes",
+    ("shard",))
+_READS = _REG.counter(
+    "prober_reads_total",
+    "staleness-bounded read probes against each replica",
+    ("shard", "peer", "result"))
+_READ_STALENESS = _REG.gauge(
+    "prober_read_staleness_seconds",
+    "read-your-write staleness observed at each replica",
+    ("shard", "peer"))
+_PEER_LAG = _REG.gauge(
+    "prober_peer_reported_lag_seconds",
+    "replication lag each sitter reports for its own database "
+    "(scraped from the peer's /metrics)",
+    ("shard", "peer"))
+_ERR_WINDOW = _REG.histogram(
+    "prober_error_window_seconds",
+    "client-observed write outage: first failed write to first "
+    "succeeding write",
+    ("shard",))
+_LAST_ERR_WINDOW = _REG.gauge(
+    "prober_last_error_window_seconds",
+    "most recent closed error window per shard",
+    ("shard",))
+
+PROBER_SCHEMA = {
+    "type": "object",
+    "required": ["shardPath", "coordCfg"],
+    "properties": {
+        "name": {"type": "string"},
+        "shardPath": {"type": "string"},
+        "statusPort": {"type": "integer"},
+        "statusHost": {"type": "string"},
+        "probeInterval": {"type": "number", "exclusiveMinimum": 0},
+        "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
+        "historyDir": {"type": ["string", "null"]},
+        "historyInterval": {"type": "number", "exclusiveMinimum": 0},
+        "slos": {"type": "array", "items": {"type": "object"}},
+        "faults": {"type": "array", "items": {"type": "string"}},
+        "faultsEnabled": {"type": "boolean"},
+        "coordCfg": {
+            "type": "object",
+            "anyOf": [
+                {"required": ["host", "port"]},
+                {"required": ["connStr"]},
+            ],
+        },
+    },
+}
+
+PROBER_FLEET_SCHEMA = {
+    "type": "object",
+    "required": ["shards", "coordCfg"],
+    "properties": {
+        "shards": {
+            "type": "array",
+            "minItems": 1,
+            "items": {"type": "object", "required": ["shardPath"]},
+        },
+        "coordCfg": PROBER_SCHEMA["properties"]["coordCfg"],
+        "statusPort": {"type": "integer"},
+        "statusHost": {"type": "string"},
+        "probeInterval": {"type": "number", "exclusiveMinimum": 0},
+        "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
+        "historyDir": {"type": ["string", "null"]},
+        "historyInterval": {"type": "number", "exclusiveMinimum": 0},
+        "slos": {"type": "array", "items": {"type": "object"}},
+        "faults": {"type": "array", "items": {"type": "string"}},
+        "faultsEnabled": {"type": "boolean"},
+    },
+}
+
+
+def prober_shard_configs(cfg: dict) -> list[dict]:
+    """The fleet merge, sitter-style: shared base + per-shard
+    overrides; duplicate names/paths are config errors."""
+    if not isinstance(cfg.get("shards"), list):
+        one = dict(cfg)
+        one["name"] = str(cfg.get("name")
+                          or cfg["shardPath"].strip("/").replace("/", "-"))
+        return [one]
+    base = {k: v for k, v in cfg.items() if k != "shards"}
+    merged, names, paths = [], set(), set()
+    for i, entry in enumerate(cfg["shards"]):
+        c = dict(base)
+        c.update(entry)
+        if not c.get("shardPath"):
+            raise ConfigError("prober shard %d has no shardPath" % i)
+        name = str(c.get("name")
+                   or c["shardPath"].strip("/").replace("/", "-"))
+        c["name"] = name
+        if name in names:
+            raise ConfigError("duplicate prober shard name %r" % name)
+        if c["shardPath"] in paths:
+            raise ConfigError("duplicate prober shardPath %r"
+                              % c["shardPath"])
+        names.add(name)
+        paths.add(c["shardPath"])
+        merged.append(c)
+    return merged
+
+
+class EngineCache:
+    """One query engine per database flavor for the whole prober: the
+    sim engine is stateless; the real engine keeps its pooled psql
+    coprocess (PsqlSession) warm across probes — a probe must cost a
+    query, not a process spawn."""
+
+    def __init__(self):
+        self._engines: dict[str, object] = {}
+
+    def for_url(self, pg_url: str):
+        scheme, _h, _p = parse_pg_url(pg_url)
+        eng = self._engines.get(scheme)
+        if eng is None:
+            if scheme == "sim":
+                from manatee_tpu.pg.engine import SimPgEngine
+                eng = SimPgEngine()
+            elif scheme == "tcp":
+                import os
+                from manatee_tpu.pg.postgres import PostgresEngine
+                eng = PostgresEngine(
+                    pg_bin_dir=os.environ.get("MANATEE_PG_BIN_DIR", ""),
+                    use_sudo=False, session_pool=True)
+            else:
+                raise PgError("unsupported pgUrl scheme %r" % scheme)
+            self._engines[scheme] = eng
+        return eng
+
+    async def query(self, pg_url: str, op: dict,
+                    timeout: float) -> dict:
+        return await self.for_url(pg_url).query_url(pg_url, op, timeout)
+
+    async def aclose(self) -> None:
+        for eng in self._engines.values():
+            aclose = getattr(eng, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        self._engines.clear()
+
+
+class ShardProber:
+    """The probe loop for ONE shard: topology watch + synthetic writes
+    + per-replica reads, each observation landing in registry
+    instruments and the SLO engine."""
+
+    def __init__(self, cfg: dict, engines: EngineCache, slo_engine, *,
+                 http_get=None):
+        self.name = cfg["name"]
+        self.path = cfg["shardPath"]
+        self.interval = float(cfg.get("probeInterval",
+                                      DEFAULT_PROBE_INTERVAL))
+        self.budget = float(cfg.get("stalenessBudget",
+                                    DEFAULT_STALENESS_BUDGET))
+        self.timeout = min(PROBE_TIMEOUT,
+                           max(0.5, self.interval * 5.0))
+        coord = cfg["coordCfg"]
+        self._connstr = coord.get("connStr") or \
+            "%s:%d" % (coord["host"], int(coord["port"]))
+        self._session_timeout = float(coord.get("sessionTimeout", 60.0))
+        grace = coord.get("disconnectGrace")
+        self._disconnect_grace = None if grace is None else float(grace)
+        self._engines = engines
+        self._slo = slo_engine
+        self._http_get = http_get or _http_get_text
+        self._handle = None
+        self._dirty = True
+        self._primary: dict | None = None
+        self._replicas: list[dict] = []
+        self._wseq = 0
+        # acked probe writes, oldest first: (seq, wall ts) — the
+        # read-your-write matching set
+        self._acked: deque[tuple[int, float]] = deque(maxlen=ACKED_RING)
+        self._err_start: float | None = None   # monotonic, first failure
+        self._last_lag_scrape: dict[str, float] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._handle is not None:
+            try:
+                await self._handle.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self._handle = None
+
+    async def _run(self) -> None:
+        while True:
+            t0 = time.monotonic()
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the prober must outlive everything it measures
+                log.exception("probe tick failed on %s", self.name)
+            elapsed = time.monotonic() - t0
+            await asyncio.sleep(max(0.0, self.interval - elapsed))
+
+    # -- topology --
+
+    def _on_change(self, _ev) -> None:
+        self._dirty = True
+
+    async def _refresh_topology(self) -> None:
+        if self._handle is None:
+            self._handle = await mux_handle(
+                self._connstr,
+                session_timeout=self._session_timeout,
+                disconnect_grace=self._disconnect_grace,
+                name="prober:%s" % self.name)
+            self._handle.on_session_event(self._on_change)
+        try:
+            data, _ver = await self._handle.get(
+                self.path + "/state", watch=self._on_change)
+        except NoNodeError:
+            self._primary, self._replicas = None, []
+            # the watch did not arm (no node): stay dirty so the next
+            # tick re-reads until the shard writes its first state
+            self._dirty = True
+            return
+        except CoordError:
+            # severed/expired: drop the handle, rebuild next tick
+            try:
+                await self._handle.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self._handle = None
+            self._dirty = True
+            raise
+        self._dirty = False
+        state = json.loads(data.decode())
+        self._primary = state.get("primary") \
+            if (state.get("primary") or {}).get("pgUrl") else None
+        self._replicas = [
+            p for p in [state.get("sync")] + list(state.get("async") or [])
+            if p and p.get("pgUrl")]
+
+    # -- probes --
+
+    async def _tick(self) -> None:
+        if self._dirty:
+            try:
+                await self._refresh_topology()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("topology refresh failed on %s: %s",
+                            self.name, e)
+        await self._probe_write()
+        for rep in list(self._replicas):
+            await self._probe_read(rep)
+
+    async def _probe_write(self) -> None:
+        self._wseq += 1
+        ts = time.time()
+        value = {"probe": self.name, "seq": self._wseq,
+                 "ts": round(ts, 6)}
+        t0 = time.monotonic()
+        err = None
+        try:
+            await faults.point("prober.write")
+            if self._primary is None:
+                raise PgError("no primary in cluster state")
+            await self._engines.query(
+                self._primary["pgUrl"],
+                {"op": "insert", "value": value}, self.timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            err = e
+        now = time.monotonic()
+        if err is None:
+            _WRITES.inc(shard=self.name, result="ok")
+            _WRITE_ACK.observe(now - t0, shard=self.name)
+            self._slo.record("write_availability", good=True,
+                             shard=self.name)
+            self._acked.append((self._wseq, ts))
+            if self._err_start is not None:
+                # the outage a client saw: first failed write's issue
+                # time to this ack
+                window = now - self._err_start
+                self._err_start = None
+                _ERR_WINDOW.observe(window, shard=self.name)
+                _LAST_ERR_WINDOW.set(window, shard=self.name)
+                get_journal().record("prober.error_window",
+                                     shard=self.name,
+                                     seconds=round(window, 3))
+        else:
+            log.debug("write probe failed on %s: %s", self.name, err)
+            _WRITES.inc(shard=self.name, result="error")
+            self._slo.record("write_availability", good=False,
+                             shard=self.name)
+            if self._err_start is None:
+                self._err_start = t0
+            # a failed write is the moment to re-learn who the
+            # primary is
+            self._dirty = True
+
+    async def _probe_read(self, rep: dict) -> None:
+        peer = rep.get("id") or rep["pgUrl"]
+        try:
+            await faults.point("prober.read")
+            res = await self._engines.query(
+                rep["pgUrl"], {"op": "select"}, self.timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("read probe failed on %s/%s: %s",
+                      self.name, peer, e)
+            _READS.inc(shard=self.name, peer=peer, result="error")
+            self._slo.record("read_staleness", good=False,
+                             shard=self.name)
+            return
+        staleness = self._staleness(res.get("rows") or [])
+        if staleness is None:
+            # nothing acked yet: nothing to judge this replica by
+            _READS.inc(shard=self.name, peer=peer, result="ok")
+            return
+        _READ_STALENESS.set(round(staleness, 6),
+                            shard=self.name, peer=peer)
+        good = staleness <= self.budget
+        _READS.inc(shard=self.name, peer=peer,
+                   result="ok" if good else "stale")
+        self._slo.record("read_staleness", good=good, shard=self.name)
+        await self._maybe_scrape_lag(rep, peer)
+
+    def _staleness(self, rows: list) -> float | None:
+        """Read-your-write staleness: age of the newest acked write
+        the replica has NOT seen yet (0.0 = fully caught up), or None
+        when nothing has been acked to judge by."""
+        if not self._acked:
+            return None
+        newest = None
+        for v in reversed(rows):
+            if isinstance(v, dict) and v.get("probe") == self.name:
+                newest = v
+                break
+        if newest is None:
+            # the replica has none of our writes: behind by the full
+            # acked window
+            return max(0.0, time.time() - self._acked[0][1])
+        seen_seq = int(newest.get("seq") or 0)
+        for seq, ts in self._acked:
+            if seq > seen_seq:
+                # oldest acked write the replica is missing
+                return max(0.0, time.time() - ts)
+        return 0.0
+
+    async def _maybe_scrape_lag(self, rep: dict, peer: str) -> None:
+        """Fold in the peer's own account of its lag (the
+        replication_lag_seconds gauge its sitter exports) — scraped at
+        most once per LAG_SCRAPE_INTERVAL per peer, best-effort."""
+        now = time.monotonic()
+        last = self._last_lag_scrape.get(peer, 0.0)
+        if now - last < LAG_SCRAPE_INTERVAL:
+            return
+        self._last_lag_scrape[peer] = now
+        try:
+            _s, host, pg_port = parse_pg_url(rep["pgUrl"])
+            text = await self._http_get(
+                "http://%s:%d/metrics" % (host, pg_port + 1))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        lag = _parse_lag_gauge(text)
+        if lag is not None:
+            _PEER_LAG.set(lag, shard=self.name, peer=peer)
+
+
+_LAG_RE = re.compile(
+    r'^manatee_replication_lag_seconds\{[^}]*\}\s+([0-9.eE+-]+)\s*$',
+    re.M)
+
+
+def _parse_lag_gauge(text: str) -> float | None:
+    m = _LAG_RE.search(text)
+    return float(m.group(1)) if m else None
+
+
+def _hist_quantile(hist, q: float, **labels) -> float | None:
+    """Bucket-boundary quantile estimate (upper bound of the bucket the
+    q-th observation landed in) — the /slis dashboard numbers."""
+    snap = hist.snapshot(**labels)
+    total = snap["count"]
+    if not total:
+        return None
+    target = q * total
+    cum = 0
+    for i, ub in enumerate(hist.buckets):
+        cum = snap["counts"][i]
+        if cum >= target:
+            return ub
+    return hist.buckets[-1]
+
+
+async def _http_get_text(url: str, timeout: float = 2.0) -> str:
+    import aiohttp
+    tmo = aiohttp.ClientTimeout(total=timeout)
+    async with aiohttp.ClientSession(timeout=tmo) as http:
+        async with http.get(url) as resp:
+            return await resp.text()
+
+
+# ---- the prober's own HTTP listener ----
+#
+# Not a StatusServer: that class's /ping and /state speak for a
+# database this process does not run.  The listener reuses the same
+# pure endpoint helpers, so /metrics, /events, /spans, /history,
+# /alerts and /faults answer with exactly the contracts every other
+# daemon serves.
+
+class ProberServer:
+    def __init__(self, probers: list[ShardProber], *,
+                 host: str = "0.0.0.0", port: int = 0):
+        from aiohttp import web
+        self._web = web
+        self.probers = probers
+        self.host = host
+        self.port = port
+        self._runner = None
+        app = web.Application()
+        app.router.add_get("/", self._routes)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/events", self._events)
+        app.router.add_get("/spans", self._spans)
+        app.router.add_get("/history", self._history)
+        app.router.add_get("/alerts", self._alerts)
+        app.router.add_get("/slis", self._slis)
+        faults.attach_http(app)
+        self._app = app
+
+    async def start(self) -> None:
+        web = self._web
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        log.info("prober listening on %s:%d (%d shards)",
+                 self.host, self.port, len(self.probers))
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _routes(self, _req):
+        return self._web.json_response(
+            ["/metrics", "/events", "/spans", "/history", "/alerts",
+             "/slis", "/faults"])
+
+    async def _metrics(self, _req):
+        from manatee_tpu.obs.process import refresh_process_metrics
+        from manatee_tpu.utils.prom import MetricsBuilder
+        refresh_process_metrics()
+        b = MetricsBuilder("manatee")
+        get_registry().render_into(b)
+        return self._web.Response(text=b.render(),
+                                  content_type="text/plain")
+
+    async def _events(self, req):
+        from manatee_tpu.obs.spans import parse_page_query
+        journal = get_journal()
+        try:
+            since, limit = parse_page_query(req.query)
+        except ValueError:
+            return self._web.json_response(
+                {"error": "since/limit must be integers"}, status=400)
+        return self._web.json_response({
+            "peer": journal.peer,
+            "now": round(time.time(), 3),
+            "events": journal.events(since=since, limit=limit),
+        })
+
+    async def _spans(self, req):
+        body, status = spans_http_reply(get_span_store(), req.query)
+        return self._web.json_response(body, status=status)
+
+    async def _history(self, req):
+        body, status = history_http_reply(get_history(), req.query)
+        return self._web.json_response(body, status=status)
+
+    async def _alerts(self, req):
+        body, status = alerts_http_reply(get_slo_engine(), req.query)
+        return self._web.json_response(body, status=status)
+
+    async def _slis(self, _req):
+        """Per-shard instantaneous SLIs — what `manatee-adm top`
+        renders alongside the budget table."""
+        out = []
+        for p in self.probers:
+            out.append({
+                "shard": p.name,
+                "primary": (self._primary_id(p)),
+                "replicas": [r.get("id") for r in p._replicas],
+                "writes_ok": _WRITES.value(shard=p.name, result="ok"),
+                "writes_error": _WRITES.value(shard=p.name,
+                                              result="error"),
+                "ack_p50_s": _hist_quantile(_WRITE_ACK, 0.5,
+                                            shard=p.name),
+                "ack_p99_s": _hist_quantile(_WRITE_ACK, 0.99,
+                                            shard=p.name),
+                "staleness": {
+                    labels.get("peer"): v
+                    for labels, v in _READ_STALENESS.samples()
+                    if labels.get("shard") == p.name},
+                "last_error_window_s": _LAST_ERR_WINDOW.value(
+                    shard=p.name) or None,
+                "error_window_open": p._err_start is not None,
+            })
+        return self._web.json_response({
+            "now": round(time.time(), 3), "shards": out})
+
+    @staticmethod
+    def _primary_id(p: ShardProber):
+        return p._primary.get("id") if p._primary else None
+
+
+# ---- daemon wiring ----
+
+async def start_prober(cfg: dict):
+    shard_cfgs = prober_shard_configs(cfg)
+    host = cfg.get("statusHost", "0.0.0.0")
+    port = int(cfg.get("statusPort", 0))
+    set_peer("prober:%d" % port if port else "prober")
+    # boot-time fault arming + runtime /faults opt-in, the same
+    # contract every other daemon honors (docs/fault-injection.md):
+    # the chaos drill arms prober.write over this surface
+    faults.arm_specs(cfg.get("faults"), source="config")
+    if cfg.get("faultsEnabled"):
+        faults.enable_http()
+    slo_engine = init_slo_engine(
+        parse_slo_configs(cfg["slos"]) if cfg.get("slos") else None)
+    recorder = None
+    if cfg.get("historyDir"):
+        history = init_history(cfg["historyDir"])
+        recorder = HistoryRecorder(
+            history, float(cfg.get("historyInterval",
+                                   HISTORY_INTERVAL)))
+        recorder.start()
+    engines = EngineCache()
+    probers = [ShardProber(c, engines, slo_engine)
+               for c in shard_cfgs]
+    server = ProberServer(probers, host=host, port=port)
+    await server.start()
+    for p in probers:
+        p.start()
+
+    async def eval_loop():
+        # journal alert transitions promptly even when nobody scrapes
+        while True:
+            await asyncio.sleep(1.0)
+            slo_engine.evaluate()
+
+    eval_task = asyncio.create_task(eval_loop())
+    log.info("prober running %d shard loops on one coordination "
+             "connection", len(probers))
+
+    async def stop():
+        eval_task.cancel()
+        try:
+            await eval_task
+        except asyncio.CancelledError:
+            pass
+        for p in probers:
+            await p.stop()
+        if recorder is not None:
+            await recorder.stop()
+        await engines.aclose()
+        await server.stop()
+
+    return stop
+
+
+def main(argv=None) -> None:
+    daemon_main("manatee-prober",
+                "black-box SLO prober (synthetic writes/reads, "
+                "burn-rate alerts)",
+                PROBER_SCHEMA, start_prober, argv,
+                fleet_schema=PROBER_FLEET_SCHEMA)
+
+
+if __name__ == "__main__":
+    main()

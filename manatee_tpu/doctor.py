@@ -381,6 +381,99 @@ def _check_manifests(ds: Path, rel, on_disk: set) -> list[dict]:
     return out
 
 
+# ---- metric-history segment ring (obs/history.py) ----
+
+def check_history(directory: str | Path) -> list[dict]:
+    """Verify a metric-history ring the way the reader/writer would
+    load it, read-only: segment naming, per-line parseability, seq
+    continuity across the retained records.
+
+    The writer's crash discipline (append → flush → fsync, resume from
+    the last DURABLE record) means a crash can leave exactly one
+    signature: a torn final line — possibly mid-ring, because the
+    restarted writer opens a fresh segment rather than appending after
+    a tear.  Torn tails and empty segments are notes; an unparseable
+    line with parseable lines after it, a seq gap/regression, or a
+    segment whose name disagrees with its first record mean durable
+    records were altered or lost: damage."""
+    d = Path(directory)
+    out: list[dict] = []
+    if not d.is_dir():
+        out.append(finding(WARNING, "history-dir-missing", d,
+                           "history directory does not exist (ring "
+                           "never enabled, or wrong path)"))
+        return out
+    # the writer's own naming/reading code, never a drifting copy
+    from manatee_tpu.obs.history import (
+        SEGMENT_PREFIX,
+        list_segments,
+        parse_segment_name,
+    )
+    for p in sorted(d.glob(SEGMENT_PREFIX + "*")):
+        if parse_segment_name(p) is None:
+            out.append(finding(NOTE, "history-unrecognized-name", p,
+                               "unparseable segment name (not part "
+                               "of the ring)"))
+    segs = list_segments(d)
+    if not segs:
+        out.append(finding(NOTE, "history-empty", d,
+                           "no history segments (ring enabled but "
+                           "nothing recorded yet)"))
+        return out
+    last_seq: int | None = None
+    for path in segs:
+        try:
+            raw = path.read_bytes()
+        except OSError as e:
+            out.append(finding(DAMAGE, "history-unreadable", path,
+                               str(e)))
+            return out
+        nonempty = [part for part in raw.split(b"\n") if part.strip()]
+        if not nonempty:
+            out.append(finding(NOTE, "history-empty-segment", path,
+                               "segment has no records (crash between "
+                               "rotate and first append)"))
+            continue
+        first_in_seg = True
+        for j, line in enumerate(nonempty):
+            try:
+                rec = json.loads(line)
+                seq = int(rec["seq"])
+            except (ValueError, KeyError, TypeError):
+                if j == len(nonempty) - 1:
+                    # a tear is legal at the END of any segment: the
+                    # restarted writer rotates rather than appending
+                    # after one, so tears persist mid-ring
+                    out.append(finding(
+                        NOTE, "history-torn-tail", path,
+                        "final line is torn (crash mid-append; the "
+                        "record was never durable — readers skip it)"))
+                    break
+                out.append(finding(
+                    DAMAGE, "history-corrupt", path,
+                    "unparseable record mid-stream (line %d of the "
+                    "non-empty lines); durable records were altered"
+                    % (j + 1)))
+                return out
+            if first_in_seg:
+                first_in_seg = False
+                named = parse_segment_name(path)
+                if named != seq:
+                    out.append(finding(
+                        DAMAGE, "history-misnamed", path,
+                        "segment name says first seq %s but the first "
+                        "record is seq %d" % (named, seq)))
+                    return out
+            if last_seq is not None and seq != last_seq + 1:
+                out.append(finding(
+                    DAMAGE, "history-gap", path,
+                    "record seq %d follows %d; durable snapshots in "
+                    "between are gone" % (seq, last_seq)))
+                return out
+            last_seq = seq
+    return out
+
+
 # ---- cluster state vs history vs journal (online) ----
 
 def check_cluster(state: dict | None, history: list[dict],

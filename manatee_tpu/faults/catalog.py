@@ -110,6 +110,13 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/coord/server.py",),
         ("error", "delay", "stall", "crash"),
     ),
+    "obs.history.append": (
+        "metric-history segment append (snapshot serialize + fsync); "
+        "a crash here can tear at most the final line, which the "
+        "doctor notes but never counts as damage",
+        ("manatee_tpu/obs/history.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
     "pg.catchup": (
         "primary's wait-for-standby-catchup poll loop (each pass); "
         "stall keeps the primary read-only — a stalled takeover",
@@ -130,6 +137,20 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         "standby's full restore from the upstream's backup server, "
         "before the transfer starts",
         ("manatee_tpu/pg/manager.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "prober.read": (
+        "prober's staleness-bounded read probe against one replica, "
+        "before the query is issued; error counts a bad read-SLI "
+        "event without touching the cluster",
+        ("manatee_tpu/daemons/prober.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "prober.write": (
+        "prober's synthetic write probe against the shard's primary, "
+        "before the insert; error counts a bad write-SLI event and "
+        "opens a measured error window",
+        ("manatee_tpu/daemons/prober.py",),
         ("error", "delay", "stall", "crash"),
     ),
     "state.write": (

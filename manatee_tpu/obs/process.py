@@ -1,0 +1,88 @@
+"""Standard process self-metrics (the Prometheus client conventions):
+start time, CPU, RSS, open fds — refreshed lazily at scrape time.
+
+`manatee-adm top` and the history ring (obs/history.py) read resource
+trends per daemon from these; nothing in the control plane's hot path
+pays for them — each HTTP listener calls :func:`refresh_process_metrics`
+once per ``/metrics`` scrape (and the history recorder gets them for
+free because the recorder snapshots whatever the registry holds).
+
+Sources are stdlib-only: ``resource.getrusage`` for CPU (portable) and
+``/proc/self`` for RSS/fds/start time where available (Linux); absent
+``/proc`` the gauges simply stay unset rather than guessing.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+from manatee_tpu.obs.metrics import get_registry
+
+_REG = get_registry()
+_START_TIME = _REG.gauge(
+    "process_start_time_seconds",
+    "unix time the process started")
+_CPU = _REG.counter(
+    "process_cpu_seconds_total",
+    "user + system CPU time consumed")
+_RSS = _REG.gauge(
+    "process_resident_memory_bytes",
+    "resident set size")
+_FDS = _REG.gauge(
+    "process_open_fds",
+    "open file descriptors")
+
+_cpu_last = 0.0
+_start_set = False
+
+
+def _proc_start_time() -> float:
+    """Kernel-accounted start time: field 22 of /proc/self/stat is
+    clock ticks after boot; boot = now - /proc/uptime."""
+    with open("/proc/self/stat") as fh:
+        stat = fh.read()
+    # comm (field 2) may contain spaces/parens: split after the
+    # closing paren
+    fields = stat.rsplit(")", 1)[1].split()
+    ticks = float(fields[19])          # starttime is field 22 overall
+    hz = os.sysconf("SC_CLK_TCK")
+    with open("/proc/uptime") as fh:
+        uptime = float(fh.read().split()[0])
+    return time.time() - uptime + ticks / hz
+
+
+def refresh_process_metrics() -> None:
+    """Bring the self-metrics up to date (scrape-time, best-effort:
+    introspection must never fail a scrape)."""
+    global _cpu_last, _start_set
+    if not _start_set:
+        _start_set = True
+        try:
+            _START_TIME.set(_proc_start_time())
+        except (OSError, IndexError, ValueError):
+            _START_TIME.set(time.time())   # no /proc: import-ish time
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    cpu = ru.ru_utime + ru.ru_stime
+    if cpu > _cpu_last:
+        _CPU.inc(cpu - _cpu_last)
+        _cpu_last = cpu
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        _RSS.set(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, IndexError, ValueError):
+        # macOS fallback: ru_maxrss is bytes there (kbytes on Linux,
+        # where /proc served us already)
+        _RSS.set(ru.ru_maxrss)
+    try:
+        _FDS.set(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+
+
+def process_instruments():
+    """The four self-metrics instruments, for listeners that render a
+    hand-built exposition (coordd) instead of the whole registry."""
+    return (_START_TIME, _CPU, _RSS, _FDS)

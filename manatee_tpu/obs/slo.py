@@ -1,0 +1,329 @@
+"""SLO evaluation: error budgets and multi-window multi-burn-rate alerts.
+
+The prober (`daemons/prober.py`) produces a stream of good/bad events
+per SLI (a synchronous write acked, a replica read inside its
+staleness budget); this module turns that stream into the two numbers
+an operator actually pages on:
+
+- **budget remaining** — of the errors the objective allows over its
+  rolling window, how much is left;
+- **burn rate** — how fast the budget is being consumed right now,
+  as a multiple of the all-window-exactly-at-objective rate (burn 1.0
+  = the budget lands at zero exactly when the window closes).
+
+Alerting follows the multi-window multi-burn-rate recipe (Google SRE
+workbook): a rule fires only when BOTH a long window and a short
+window exceed the rule's burn factor — the long window keeps one
+transient blip from paging, the short window makes the alert reset
+promptly once the incident is over.  Two severities ship by default:
+``page`` (fast burn: minutes to empty) and ``ticket`` (slow burn:
+hours).  Alert transitions are recorded as journal events
+(``slo.alert.fired`` / ``slo.alert.resolved``) and counted in the
+registry; the active set is served at ``GET /alerts``
+(:func:`alerts_http_reply`) and rendered fleet-wide by
+``manatee-adm slo``.
+
+Accounting is O(1) per event: counts land in fixed-width time buckets
+in a bounded deque per (SLO, shard) series; evaluation sums at most
+``retention / bucket`` buckets on demand (scrape/poll time), never on
+the event path.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from manatee_tpu.obs.journal import get_journal
+from manatee_tpu.obs.metrics import get_registry
+
+_REG = get_registry()
+_ALERTS_FIRED = _REG.counter(
+    "slo_alerts_total", "SLO burn-rate alert firings",
+    ("slo", "severity"))
+_EVENTS = _REG.counter(
+    "slo_events_total", "good/bad events accounted against SLOs",
+    ("slo", "result"))
+
+# severity -> default (long_s, short_s, factor).  Windows are scaled
+# for this control plane's drills (seconds-to-minutes incidents), not
+# a 30-day production budget — deployments override via config.
+DEFAULT_BURN_RULES = {
+    "page": {"long_s": 60.0, "short_s": 5.0, "factor": 14.4},
+    "ticket": {"long_s": 600.0, "short_s": 60.0, "factor": 3.0},
+}
+
+DEFAULT_WINDOW_S = 3600.0
+DEFAULT_BUCKET_S = 1.0
+
+
+class SLOConfigError(ValueError):
+    """A malformed SLO definition (config wiring surfaces this)."""
+
+
+class SLOConfig:
+    """One objective: a named SLI with a target ratio over a rolling
+    window, plus its burn-rate alert rules."""
+
+    __slots__ = ("name", "description", "objective", "window_s",
+                 "burn_rules")
+
+    def __init__(self, name: str, *, objective: float,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 description: str = "",
+                 burn_rules: dict | None = None):
+        if not name:
+            raise SLOConfigError("SLO needs a name")
+        if not (0.0 < objective < 1.0):
+            raise SLOConfigError(
+                "objective must be in (0, 1), got %r" % (objective,))
+        if window_s <= 0:
+            raise SLOConfigError("window_s must be > 0")
+        self.name = name
+        self.description = description
+        self.objective = float(objective)
+        self.window_s = float(window_s)
+        rules = dict(DEFAULT_BURN_RULES) if burn_rules is None \
+            else dict(burn_rules)
+        for sev, rule in rules.items():
+            if not (rule.get("long_s", 0) > rule.get("short_s", 0) > 0):
+                raise SLOConfigError(
+                    "%s/%s: need long_s > short_s > 0" % (name, sev))
+            if rule.get("factor", 0) <= 0:
+                raise SLOConfigError(
+                    "%s/%s: factor must be > 0" % (name, sev))
+        self.burn_rules = rules
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "description": self.description,
+                "objective": self.objective, "window_s": self.window_s,
+                "burn_rules": self.burn_rules}
+
+
+def parse_slo_configs(raw) -> list[SLOConfig]:
+    """Config-file list -> validated configs (the daemon wiring path).
+    Raises :class:`SLOConfigError` on anything malformed — a typo'd
+    objective must refuse at boot, not alert wrong forever."""
+    out = []
+    for ent in raw or ():
+        if not isinstance(ent, dict):
+            raise SLOConfigError("SLO entry must be an object: %r" % ent)
+        kw = {k: ent[k] for k in ("objective", "window_s",
+                                  "description", "burn_rules")
+              if k in ent}
+        try:
+            out.append(SLOConfig(ent.get("name", ""), **kw))
+        except TypeError as e:
+            raise SLOConfigError(str(e)) from None
+    names = [c.name for c in out]
+    if len(set(names)) != len(names):
+        raise SLOConfigError("duplicate SLO names: %r" % names)
+    return out
+
+
+def default_slos() -> list[SLOConfig]:
+    """The prober's stock objectives (overridden by its config)."""
+    return [
+        SLOConfig("write_availability", objective=0.999,
+                  description="synchronous writes acked by the "
+                              "shard's primary"),
+        SLOConfig("read_staleness", objective=0.99,
+                  description="replica reads inside the staleness "
+                              "budget"),
+    ]
+
+
+class _Series:
+    """Good/bad counts for one (SLO, shard), in fixed-width time
+    buckets.  The deque is bounded by retention/bucket; recording is
+    an O(1) append/increment."""
+
+    __slots__ = ("bucket_s", "retention_s", "_buckets")
+
+    def __init__(self, bucket_s: float, retention_s: float):
+        self.bucket_s = bucket_s
+        self.retention_s = retention_s
+        maxlen = int(retention_s / bucket_s) + 2
+        self._buckets: deque[list] = deque(maxlen=maxlen)
+
+    def record(self, now: float, good: int, bad: int) -> None:
+        idx = int(now / self.bucket_s)
+        if self._buckets and self._buckets[-1][0] == idx:
+            b = self._buckets[-1]
+            b[1] += good
+            b[2] += bad
+        else:
+            self._buckets.append([idx, good, bad])
+
+    def totals(self, now: float, window_s: float) -> tuple[int, int]:
+        """(good, bad) over the trailing *window_s*."""
+        lo = int((now - window_s) / self.bucket_s)
+        good = bad = 0
+        for idx, g, b in reversed(self._buckets):
+            if idx <= lo:
+                break
+            good += g
+            bad += b
+        return good, bad
+
+
+class Alert:
+    __slots__ = ("slo", "shard", "severity", "factor", "since",
+                 "burn_long", "burn_short")
+
+    def __init__(self, slo: str, shard: str, severity: str,
+                 factor: float, since: float):
+        self.slo = slo
+        self.shard = shard
+        self.severity = severity
+        self.factor = factor
+        self.since = since
+        self.burn_long = 0.0
+        self.burn_short = 0.0
+
+    def to_dict(self) -> dict:
+        return {"slo": self.slo, "shard": self.shard,
+                "severity": self.severity, "factor": self.factor,
+                "since": round(self.since, 3),
+                "burn_long": round(self.burn_long, 2),
+                "burn_short": round(self.burn_short, 2)}
+
+
+class SLOEngine:
+    """Good/bad accounting + burn-rate evaluation for a set of SLOs,
+    per shard.  Event-loop confined like every obs singleton."""
+
+    def __init__(self, configs: list[SLOConfig] | None = None, *,
+                 bucket_s: float = DEFAULT_BUCKET_S,
+                 clock=time.time):
+        self.configs = {c.name: c
+                        for c in (configs or default_slos())}
+        self.bucket_s = float(bucket_s)
+        self._clock = clock
+        self._series: dict[tuple[str, str], _Series] = {}
+        self._active: dict[tuple[str, str, str], Alert] = {}
+
+    # -- event path (O(1)) --
+
+    def record(self, slo: str, *, good: bool, shard: str = "-",
+               n: int = 1) -> None:
+        cfg = self.configs.get(slo)
+        if cfg is None:
+            raise SLOConfigError("unknown SLO %r" % slo)
+        key = (slo, shard)
+        s = self._series.get(key)
+        if s is None:
+            retention = max([cfg.window_s]
+                            + [r["long_s"]
+                               for r in cfg.burn_rules.values()])
+            s = _Series(self.bucket_s, retention)
+            self._series[key] = s
+        s.record(self._clock(),
+                 n if good else 0, 0 if good else n)
+        _EVENTS.inc(n, slo=slo, result="good" if good else "bad")
+
+    # -- evaluation (poll/scrape path) --
+
+    def _burn(self, s: _Series, cfg: SLOConfig, now: float,
+              window_s: float) -> tuple[float, int]:
+        good, bad = s.totals(now, window_s)
+        total = good + bad
+        if total == 0:
+            return 0.0, 0
+        return (bad / total) / (1.0 - cfg.objective), total
+
+    def evaluate(self) -> list[Alert]:
+        """Re-derive the active alert set and journal transitions.
+        Returns the alerts active after this pass."""
+        now = self._clock()
+        journal = get_journal()
+        for (slo, shard), s in self._series.items():
+            cfg = self.configs[slo]
+            for sev, rule in cfg.burn_rules.items():
+                burn_long, n_long = self._burn(s, cfg, now,
+                                               rule["long_s"])
+                burn_short, _n = self._burn(s, cfg, now,
+                                            rule["short_s"])
+                key = (slo, shard, sev)
+                firing = (n_long > 0
+                          and burn_long >= rule["factor"]
+                          and burn_short >= rule["factor"])
+                alert = self._active.get(key)
+                if firing:
+                    if alert is None:
+                        alert = Alert(slo, shard, sev,
+                                      rule["factor"], now)
+                        self._active[key] = alert
+                        _ALERTS_FIRED.inc(slo=slo, severity=sev)
+                        journal.record("slo.alert.fired", slo=slo,
+                                       shard=shard, severity=sev,
+                                       burn_long=round(burn_long, 2),
+                                       burn_short=round(burn_short, 2))
+                    alert.burn_long = burn_long
+                    alert.burn_short = burn_short
+                elif alert is not None:
+                    del self._active[key]
+                    journal.record("slo.alert.resolved", slo=slo,
+                                   shard=shard, severity=sev,
+                                   after_s=round(now - alert.since, 3))
+        return sorted(self._active.values(),
+                      key=lambda a: (a.slo, a.shard, a.severity))
+
+    def status(self) -> list[dict]:
+        """Per-(SLO, shard) budget accounting over the objective's own
+        window — the `manatee-adm slo` table rows."""
+        now = self._clock()
+        out = []
+        for (slo, shard), s in sorted(self._series.items()):
+            cfg = self.configs[slo]
+            good, bad = s.totals(now, cfg.window_s)
+            total = good + bad
+            allowed = total * (1.0 - cfg.objective)
+            burn, _n = self._burn(s, cfg, now, cfg.window_s)
+            out.append({
+                "slo": slo,
+                "shard": shard,
+                "objective": cfg.objective,
+                "window_s": cfg.window_s,
+                "good": good,
+                "bad": bad,
+                "ratio": (good / total) if total else None,
+                "budget_remaining": ((allowed - bad) / allowed
+                                     if allowed > 0 else None),
+                "burn": round(burn, 3),
+            })
+        return out
+
+
+# ---- process singleton (None until a daemon wires SLOs in) ----
+
+_ENGINE: SLOEngine | None = None
+
+
+def init_slo_engine(configs: list[SLOConfig] | None = None,
+                    **kw) -> SLOEngine:
+    global _ENGINE
+    _ENGINE = SLOEngine(configs, **kw)
+    return _ENGINE
+
+
+def get_slo_engine() -> SLOEngine | None:
+    return _ENGINE
+
+
+def alerts_http_reply(engine: SLOEngine | None, _query
+                      ) -> tuple[dict, int]:
+    """The WHOLE ``GET /alerts`` endpoint minus the web framework —
+    active burn-rate alerts plus the per-SLO budget table."""
+    if engine is None:
+        return {"error": "no SLO engine on this daemon (the prober "
+                         "evaluates SLOs; see docs/observability.md)"
+                }, 404
+    alerts = engine.evaluate()
+    return {
+        "now": round(time.time(), 3),
+        "alerts": [a.to_dict() for a in alerts],
+        "slos": engine.status(),
+        "configs": [c.to_dict()
+                    for _n, c in sorted(engine.configs.items())],
+    }, 200

@@ -56,6 +56,16 @@ _PROBE_FLIPS = _REG.counter(
 _RESTORES = _REG.counter(
     "pg_restores_total", "full restores from an upstream backup server",
     ("result",))
+# the exposition surface of health/telemetry.py: raw (un-normalized)
+# replay lag and the failure-prediction score, per peer, on /metrics —
+# the gauges the router and the prober's lag feed read
+_REPL_LAG = _REG.gauge(
+    "replication_lag_seconds",
+    "standby replay lag from the last status observation", ("peer",))
+_HEALTH_SCORE = _REG.gauge(
+    "health_score",
+    "failure-prediction score from the telemetry window (0..1)",
+    ("peer",))
 
 
 class NeedsRestoreError(PgError):
@@ -945,9 +955,16 @@ class PostgresMgr:
                         else self._failed_probe_latency_ms),
             timed_out=not ok, lag_s=lag, wal_lsn=wal,
             in_recovery=in_recovery)
+        if lag is not None:
+            _REPL_LAG.set(float(lag), peer=self.peer_id)
+        elif st and not in_recovery:
+            _REPL_LAG.set(0.0, peer=self.peer_id)  # primaries: no lag
         if self._scorer.available and self.telemetry.ready():
             self.health_score = self._scorer.score(
                 self.telemetry.window_array())
+        if self.health_score is not None:
+            _HEALTH_SCORE.set(float(self.health_score),
+                              peer=self.peer_id)
         if self._telemetry_dump:
             self._dump_tick(ok, latency_ms, lag, wal, in_recovery)
 

@@ -43,6 +43,8 @@ from aiohttp import web
 
 from manatee_tpu import faults
 from manatee_tpu.obs import get_journal, get_registry, get_span_store
+from manatee_tpu.obs.history import get_history, history_http_reply
+from manatee_tpu.obs.slo import alerts_http_reply, get_slo_engine
 from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
 
 log = logging.getLogger("manatee.status")
@@ -93,6 +95,8 @@ class StatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/events", self._events)
         app.router.add_get("/spans", self._spans)
+        app.router.add_get("/history", self._history)
+        app.router.add_get("/alerts", self._alerts)
         app.router.add_get("/shards", self._shards)
         app.router.add_get("/shards/{shard}/ping", self._ping)
         app.router.add_get("/shards/{shard}/state", self._state)
@@ -129,7 +133,7 @@ class StatusServer:
 
     async def _routes(self, _req: web.Request) -> web.Response:
         routes = ["/ping", "/state", "/restore", "/metrics", "/events",
-                  "/spans", "/faults", "/shards"]
+                  "/spans", "/history", "/alerts", "/faults", "/shards"]
         if self._fleet:
             routes += ["/shards/%s/%s" % (e.name, leaf)
                        for e in self._entries
@@ -212,12 +216,28 @@ class StatusServer:
         return web.json_response(body, status=status,
                                  content_type="application/json")
 
+    async def _history(self, req: web.Request) -> web.Response:
+        """The on-disk metric-history ring (obs/history.py); 404 when
+        this daemon runs without a historyDir."""
+        body, status = history_http_reply(get_history(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _alerts(self, req: web.Request) -> web.Response:
+        """Active SLO burn-rate alerts (obs/slo.py); 404 on daemons
+        that do not evaluate SLOs (the prober does)."""
+        body, status = alerts_http_reply(get_slo_engine(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
     async def _metrics(self, _req: web.Request) -> web.Response:
         """Prometheus text exposition: state-derived gauges (labeled
         per shard in fleet mode) + the whole process-wide obs
         registry."""
+        from manatee_tpu.obs.process import refresh_process_metrics
         from manatee_tpu.utils.prom import MetricsBuilder, label_str
 
+        refresh_process_metrics()
         b = MetricsBuilder("manatee")
         # family name -> (type, help, [(labelstr, value), ...]) —
         # collected across shards so each family is emitted once
@@ -234,10 +254,10 @@ class StatusServer:
                 metric("pg_online", "gauge",
                        "1 when the local database answers health probes",
                        1 if pg.online else 0, **lb)
-                if pg.health_score is not None:
-                    metric("health_score", "gauge",
-                           "learned failure-probability score in [0,1]",
-                           "%.4f" % pg.health_score, **lb)
+                # health_score{peer} and replication_lag_seconds{peer}
+                # come from the registry (pg/manager._record_telemetry)
+                # — emitting a state-derived copy here would duplicate
+                # the family in one exposition
                 tick = pg.telemetry.last_tick()
                 if tick:
                     # normalized feature vector of the last probe

@@ -160,6 +160,27 @@ def kill_fleet_sitter(proc: subprocess.Popen) -> None:
         pass
 
 
+def spawn_prober(cfg: dict, root) -> subprocess.Popen:
+    """Spawn ``manatee-prober`` as a child process: write *cfg* to
+    ``root/prober.json``, append its output to ``root/prober.log``,
+    start it in its own process group (tear down with
+    :func:`kill_fleet_sitter` — same group semantics).  A ``shards``
+    list in *cfg* selects fleet mode; ``-f`` accepts both shapes.
+    Shared by tests and bench.py's slo_probe leg; call via
+    ``asyncio.to_thread`` from a coroutine."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "prober.json").write_text(json.dumps(cfg, indent=2))
+    with open(root / "prober.log", "ab") as logf:
+        return subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.daemons.prober",
+             "-f", str(root / "prober.json")],
+            stdout=logf, stderr=logf,
+            env=dict(os.environ, PYTHONPATH=str(REPO),
+                     MANATEE_PG_BIN_DIR=FAKEPG_BIN),
+            start_new_session=True)
+
+
 class Peer:
     def __init__(self, cluster: "ClusterHarness", idx: int):
         self.cluster = cluster

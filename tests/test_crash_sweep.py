@@ -94,6 +94,20 @@ REPO = Path(__file__).resolve().parent.parent
 #                 process runs ZfsBackend against the fake zfs(8) with
 #                 the crash armed, dies at the seam, and a clean rerun
 #                 recovers
+#   history_subproc
+#                 a child process writes registry snapshots into a
+#                 history segment ring, crashes AT the append seam,
+#                 and the parent asserts the ring verifies clean under
+#                 `manatee-adm doctor --history-dir` (crash-at-append
+#                 can cost only the never-durable final line) and that
+#                 a restarted writer resumes seq continuity
+#   prober_subproc
+#                 the prober measures the cluster from OUTSIDE, so its
+#                 seams need no live shard either: a child process
+#                 drives one ShardProber write+read probe against an
+#                 in-memory engine, crashes at the probe seam, and a
+#                 clean rerun completes the probe cycle (the prober
+#                 itself holds no durable state to damage)
 #
 # variant: "exit" (default, os._exit → CRASH_EXIT_CODE) or "kill"
 # (SIGKILL-to-self → waitpid -SIGKILL); both variants are exercised.
@@ -114,10 +128,13 @@ SCENARIOS: dict[str, dict] = {
     "coord.put_state":      dict(kind="primary_write", variant="kill"),
     "coordd.dispatch":      dict(kind="coordd", variant="kill"),
     "coordd.oplog.append":  dict(kind="coordd", induce="freeze"),
+    "obs.history.append":   dict(kind="history_subproc"),
     "pg.catchup":           dict(kind="takeover", variant="kill"),
     "pg.promote":           dict(kind="takeover"),
     "pg.repoint":           dict(kind="repoint"),
     "pg.restore":           dict(kind="boot_async", wipe=True),
+    "prober.read":          dict(kind="prober_subproc", variant="kill"),
+    "prober.write":         dict(kind="prober_subproc"),
     "state.write":          dict(kind="primary_write"),
     "storage.delta.apply":  dict(kind="incr_apply"),
     "storage.delta.send":   dict(kind="incr_sender", variant="kill"),
@@ -133,10 +150,13 @@ SCENARIOS: dict[str, dict] = {
 # on a backupserver (sender), runtime --url on coordd, and the
 # subprocess zfs driver — with both crash variants present.  The
 # repoint and primary_write families ride the full chaos-cadence sweep
-# only; anything here also runs there.
+# only; anything here also runs there.  The two observability
+# subprocess drivers (history writer, prober) are cluster-free and
+# cheap, so each surface sends a representative.
 FAST_POINTS = {"backup.post", "coord.client.send",
                "backup.send.stream", "coordd.dispatch",
-               "pg.promote", "storage.zfs.exec"}
+               "pg.promote", "storage.zfs.exec",
+               "obs.history.append", "prober.write"}
 
 
 def test_sweep_covers_every_failpoint():
@@ -320,6 +340,112 @@ def _run_zfs_subproc_scenario(tmp_path, point: str, scn: dict) -> None:
     assert (root / "state.json").exists()
 
 
+def _run_history_subproc_scenario(tmp_path, point: str, scn: dict
+                                  ) -> None:
+    """Crash a history writer AT the append seam and assert the seed
+    discipline: the segment ring stays `manatee-adm doctor`-clean (the
+    fsynced-line-at-a-time format means a crash can only cost the
+    never-durable final line) and a restarted writer resumes sequence
+    continuity instead of forking the ring."""
+    hist_dir = tmp_path / "history"
+    script = (
+        "import asyncio, sys\n"
+        "from manatee_tpu.obs.history import MetricsHistory, "
+        "read_records\n"
+        "async def main():\n"
+        "    h = MetricsHistory(sys.argv[1], segment_records=3)\n"
+        "    for _ in range(5):\n"
+        "        await h.append()\n"
+        "    h.close()\n"
+        "    print('history-ok %d'\n"
+        "          % read_records(sys.argv[1])[-1]['seq'])\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"}
+    argv = [sys.executable, "-c", script, str(hist_dir)]
+
+    def doctor_clean() -> None:
+        cp = subprocess.run(
+            [sys.executable, "-m", "manatee_tpu.cli", "doctor",
+             "--history-dir", str(hist_dir), "-j"],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert cp.returncode == 0, (cp.stdout, cp.stderr)
+        body = json.loads(cp.stdout)
+        assert body["ok"] and body["damage"] == 0, body
+
+    # seed the ring (5 records across 3-record segments), then crash a
+    # resumed writer exactly at the append seam
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60, env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "history-ok 5" in cp.stdout
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60,
+                        env={**env,
+                             "MANATEE_FAULTS": spec_for(point, variant)})
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "history-ok" not in cp.stdout
+    doctor_clean()
+    # recovery: a clean rerun resumes after the last durable record
+    # (seq 6..10, never 1..5 again) and the ring stays doctor-clean
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60, env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "history-ok 10" in cp.stdout, cp.stdout
+    doctor_clean()
+
+
+def _run_prober_subproc_scenario(tmp_path, point: str, scn: dict
+                                 ) -> None:
+    """Crash a ShardProber at a probe seam.  The prober is a pure
+    observer with no durable state, so 'recovery' is the black-box
+    contract itself: a clean rerun completes a full write+read probe
+    cycle (acked write, zero staleness, no open error window)."""
+    script = (
+        "import asyncio\n"
+        "from manatee_tpu.daemons.prober import ShardProber\n"
+        "from manatee_tpu.obs.slo import SLOEngine, default_slos\n"
+        "class MemEngine:\n"
+        "    def __init__(self):\n"
+        "        self.rows = []\n"
+        "    async def query(self, url, op, timeout):\n"
+        "        if op['op'] == 'insert':\n"
+        "            self.rows.append(op['value'])\n"
+        "            return {'ok': True}\n"
+        "        return {'rows': list(self.rows)}\n"
+        "async def main():\n"
+        "    cfg = {'name': 'sweep', 'shardPath': '/manatee/sweep',\n"
+        "           'coordCfg': {'connStr': '127.0.0.1:1'}}\n"
+        "    p = ShardProber(cfg, MemEngine(),\n"
+        "                    SLOEngine(default_slos()))\n"
+        "    p._dirty = False\n"
+        "    p._primary = {'id': 'p0', 'pgUrl': 'sim://127.0.0.1:1'}\n"
+        "    p._replicas = [{'id': 'r0',\n"
+        "                    'pgUrl': 'sim://127.0.0.1:1'}]\n"
+        "    await p._probe_write()\n"
+        "    await p._probe_read(p._replicas[0])\n"
+        "    assert p._acked, 'write probe was not acked'\n"
+        "    assert p._err_start is None, 'error window left open'\n"
+        "    print('probe-ok')\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_FAULTS": spec_for(point, variant)}
+    cp = subprocess.run([sys.executable, "-c", script],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "probe-ok" not in cp.stdout
+    env.pop("MANATEE_FAULTS")
+    cp = subprocess.run([sys.executable, "-c", script],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "probe-ok" in cp.stdout
+
+
 @pytest.mark.parametrize(
     "point",
     [pytest.param(p,
@@ -334,6 +460,12 @@ def test_crash_at_seam(tmp_path, point):
 
     if scn["kind"] == "zfs_subproc":
         _run_zfs_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "history_subproc":
+        _run_history_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "prober_subproc":
+        _run_prober_subproc_scenario(tmp_path, point, scn)
         return
 
     async def go():

@@ -1,0 +1,306 @@
+"""On-disk metric history (obs/history.py): segment-ring rotation and
+retention, since-pagination across segments, crash recovery of a torn
+final segment (including the writer's truncate-on-resume), the
+/history endpoint contract, and `manatee-adm doctor`'s verdict for
+each damage class."""
+
+import asyncio
+import json
+
+import pytest
+
+from manatee_tpu.doctor import check_history, summarize
+from manatee_tpu.obs.history import (
+    MetricsHistory,
+    HistoryRecorder,
+    dump_registry,
+    history_http_reply,
+    list_segments,
+    parse_segment_name,
+    read_records,
+    segment_name,
+)
+from manatee_tpu.obs.metrics import Registry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mk(directory, **kw) -> MetricsHistory:
+    # a private registry so parallel test files cannot perturb the
+    # snapshot contents under us
+    kw.setdefault("registry", Registry())
+    return MetricsHistory(directory, **kw)
+
+
+def append_n(h: MetricsHistory, n: int) -> None:
+    async def go():
+        for _ in range(n):
+            await h.append()
+    run(go())
+
+
+def seqs(directory) -> list[int]:
+    return [r["seq"] for r in read_records(directory)]
+
+
+def levels(findings, check):
+    return [f["level"] for f in findings if f["check"] == check]
+
+
+# ---- writer/reader units ----
+
+def test_segment_names_roundtrip(tmp_path):
+    assert parse_segment_name(segment_name(7)) == 7
+    assert parse_segment_name("history-0000000000000042.jsonl") == 42
+    assert parse_segment_name("history-notanumber.jsonl") is None
+    assert parse_segment_name("other-0000000000000001.jsonl") is None
+    assert parse_segment_name("history-1.txt") is None
+
+
+def test_dump_registry_shapes(tmp_path):
+    reg = Registry()
+    reg.counter("reqs_total", "requests", ("code",)).inc(code="200")
+    reg.gauge("depth", "queue depth").set(3)
+    reg.histogram("dur_seconds", "latency").observe(0.12)
+    snap = dump_registry(reg)
+    assert snap["reqs_total"]["kind"] == "counter"
+    assert snap["depth"]["samples"] == [[{}, 3]]
+    # histograms persist count/sum only — never the bucket vector
+    [(labels, s)] = snap["dur_seconds"]["series"]
+    assert set(s) == {"count", "sum"}
+    assert s["count"] == 1
+
+
+def test_rotation_and_ring_wrap(tmp_path):
+    h = mk(tmp_path, segment_records=3, keep_segments=2)
+    append_n(h, 10)
+    h.close()
+    # rotation every 3 records names segments 1, 4, 7, 10 — and the
+    # retention budget of 2 dropped the two oldest
+    assert [parse_segment_name(p)
+            for p in list_segments(tmp_path)] == [7, 10]
+    assert seqs(tmp_path) == [7, 8, 9, 10]
+    # a wrapped ring is still doctor-clean: continuity is judged over
+    # the RETAINED records
+    assert summarize(check_history(tmp_path))["ok"]
+
+
+def test_since_pagination_across_segments(tmp_path):
+    h = mk(tmp_path, segment_records=2)
+    append_n(h, 7)
+    h.close()
+    assert [r["seq"] for r in h.records(since=3)] == [4, 5, 6, 7]
+    # limit keeps the NEWEST n, and -0 must not slice the whole list
+    assert [r["seq"] for r in h.records(since=3, limit=2)] == [6, 7]
+    assert h.records(limit=0) == []
+    body, status = history_http_reply(h, {"since": "3", "limit": "2"})
+    assert status == 200
+    assert [r["seq"] for r in body["records"]] == [6, 7]
+
+
+def test_http_reply_contract(tmp_path):
+    body, status = history_http_reply(None, {})
+    assert status == 404 and "error" in body
+    h = mk(tmp_path)
+    body, status = history_http_reply(h, {"since": "bogus"})
+    assert status == 400
+    append_n(h, 2)
+    h.close()
+    body, status = history_http_reply(h, {})
+    assert status == 200
+    assert body["dir"] == str(h.dir)
+    assert [r["seq"] for r in body["records"]] == [1, 2]
+
+
+def test_recorder_appends_periodically(tmp_path):
+    async def go():
+        h = mk(tmp_path, segment_records=100)
+        rec = HistoryRecorder(h, interval=0.02)
+        rec.start()
+        await asyncio.sleep(0.15)
+        await rec.stop()
+    run(go())
+    assert len(seqs(tmp_path)) >= 2
+    assert summarize(check_history(tmp_path))["ok"]
+
+
+# ---- crash recovery ----
+
+def test_torn_tail_truncated_on_resume(tmp_path):
+    h = mk(tmp_path, segment_records=4)
+    append_n(h, 5)                  # segments 1 (recs 1-4) and 5
+    h.close()
+    last = list_segments(tmp_path)[-1]
+    with open(last, "ab") as fh:    # crash mid-append: a torn line
+        fh.write(b'{"seq": 6, "ts"')
+    # the reader skips it ...
+    assert seqs(tmp_path) == [1, 2, 3, 4, 5]
+    # ... the doctor notes it without calling it damage ...
+    rep = summarize(check_history(tmp_path))
+    assert rep["ok"] and levels(rep["findings"],
+                                "history-torn-tail") == ["note"]
+    # ... and a resumed writer truncates it, then resumes seq
+    # continuity from the last DURABLE record
+    h2 = mk(tmp_path, segment_records=4)
+    assert b'"seq": 6' not in last.read_bytes()
+    assert last.read_bytes().endswith(b"\n")
+    append_n(h2, 1)
+    h2.close()
+    assert seqs(tmp_path) == [1, 2, 3, 4, 5, 6]
+    assert summarize(check_history(tmp_path))["ok"]
+
+
+def test_missing_final_newline_is_completed_on_resume(tmp_path):
+    # the crash ate only the "\n": the record IS durable, and a blind
+    # append would fuse the next record onto its line
+    h = mk(tmp_path, segment_records=10)
+    append_n(h, 3)
+    h.close()
+    last = list_segments(tmp_path)[-1]
+    raw = last.read_bytes()
+    assert raw.endswith(b"\n")
+    last.write_bytes(raw[:-1])
+    h2 = mk(tmp_path, segment_records=10)
+    append_n(h2, 1)
+    h2.close()
+    assert seqs(tmp_path) == [1, 2, 3, 4]
+    assert summarize(check_history(tmp_path))["ok"]
+
+
+def test_torn_only_line_of_fresh_segment(tmp_path):
+    # crash between rotate and the first durable append: the fresh
+    # segment holds ONLY the torn line; the resumed writer empties it
+    # and the next append re-opens it under the SAME (correct) name
+    h = mk(tmp_path, segment_records=4)
+    append_n(h, 4)                  # segment 1 exactly full
+    h.close()
+    torn = tmp_path / segment_name(5)
+    torn.write_bytes(b'{"seq": 5,')
+    h2 = mk(tmp_path, segment_records=4)
+    append_n(h2, 1)
+    h2.close()
+    assert seqs(tmp_path) == [1, 2, 3, 4, 5]
+    recs = read_records(tmp_path)
+    assert recs[-1]["seq"] == 5
+    rep = summarize(check_history(tmp_path))
+    assert rep["ok"] and rep["damage"] == 0, rep
+
+
+# ---- doctor verdicts per damage class ----
+
+def healthy_ring(tmp_path, *, segment_records=2, n=5) -> None:
+    h = mk(tmp_path, segment_records=segment_records)
+    append_n(h, n)
+    h.close()
+
+
+def test_doctor_missing_and_empty_dirs(tmp_path):
+    rep = summarize(check_history(tmp_path / "nope"))
+    assert rep["ok"] and rep["warnings"] == 1
+    assert levels(rep["findings"],
+                  "history-dir-missing") == ["warning"]
+    (tmp_path / "empty").mkdir()
+    rep = summarize(check_history(tmp_path / "empty"))
+    assert rep["ok"] and levels(rep["findings"],
+                                "history-empty") == ["note"]
+
+
+def test_doctor_healthy_ring_is_silent(tmp_path):
+    healthy_ring(tmp_path)
+    assert check_history(tmp_path) == []
+
+
+def test_doctor_mid_stream_corruption_is_damage(tmp_path):
+    healthy_ring(tmp_path, segment_records=4, n=4)
+    seg = list_segments(tmp_path)[0]
+    lines = seg.read_bytes().splitlines()
+    lines[1] = b"GARBAGE NOT JSON"
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    rep = summarize(check_history(tmp_path))
+    assert not rep["ok"]
+    assert levels(rep["findings"], "history-corrupt") == ["damage"]
+
+
+def test_doctor_seq_gap_is_damage(tmp_path):
+    healthy_ring(tmp_path, segment_records=2, n=5)  # segs 1, 3, 5
+    mid = [p for p in list_segments(tmp_path)
+           if parse_segment_name(p) == 3]
+    mid[0].unlink()
+    rep = summarize(check_history(tmp_path))
+    assert not rep["ok"]
+    assert levels(rep["findings"], "history-gap") == ["damage"]
+
+
+def test_doctor_misnamed_segment_is_damage(tmp_path):
+    healthy_ring(tmp_path, segment_records=10, n=2)  # one segment, 1
+    seg = list_segments(tmp_path)[0]
+    seg.rename(seg.with_name(segment_name(2)))
+    rep = summarize(check_history(tmp_path))
+    assert not rep["ok"]
+    assert levels(rep["findings"], "history-misnamed") == ["damage"]
+
+
+def test_doctor_notes_oddities(tmp_path):
+    healthy_ring(tmp_path, segment_records=10, n=2)
+    (tmp_path / "history-garbagename.jsonl").write_text("x\n")
+    (tmp_path / segment_name(3)).write_bytes(b"")
+    rep = summarize(check_history(tmp_path))
+    assert rep["ok"] and rep["damage"] == 0
+    assert levels(rep["findings"],
+                  "history-unrecognized-name") == ["note"]
+    assert levels(rep["findings"],
+                  "history-empty-segment") == ["note"]
+
+
+def test_doctor_cli_history_dir(tmp_path):
+    """`manatee-adm doctor --history-dir` end to end: the offline
+    verdict with the CLI's exit-code/JSON contract."""
+    import subprocess
+    import sys
+
+    healthy_ring(tmp_path)
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", "doctor",
+         "--history-dir", str(tmp_path), "-j"],
+        capture_output=True, text=True, timeout=60)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    body = json.loads(cp.stdout)
+    assert body["ok"] and body["damage"] == 0
+    # damage exits nonzero
+    seg = list_segments(tmp_path)[0]
+    lines = seg.read_bytes().splitlines()
+    lines[0] = b"NOT JSON"
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+    cp = subprocess.run(
+        [sys.executable, "-m", "manatee_tpu.cli", "doctor",
+         "--history-dir", str(tmp_path), "-j"],
+        capture_output=True, text=True, timeout=60)
+    assert cp.returncode != 0
+    body = json.loads(cp.stdout)
+    assert not body["ok"] and body["damage"] >= 1
+
+
+def test_append_failpoint_error_does_not_advance_seq(tmp_path,
+                                                     monkeypatch):
+    """An error armed at obs.history.append must surface to the
+    caller (the recorder logs and continues) without burning a seq —
+    the ring's continuity invariant survives fault drills."""
+    from manatee_tpu import faults
+    from manatee_tpu.faults import FaultRegistry
+
+    reg = FaultRegistry()
+    monkeypatch.setattr(faults, "_REGISTRY", reg)
+    h = mk(tmp_path, segment_records=10)
+    append_n(h, 2)
+
+    async def go():
+        reg.arm_spec("obs.history.append=error,count=1")
+        with pytest.raises(faults.FaultError):
+            await h.append()
+        await h.append()
+    run(go())
+    h.close()
+    assert seqs(tmp_path) == [1, 2, 3]
+    assert summarize(check_history(tmp_path))["ok"]

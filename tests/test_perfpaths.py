@@ -424,12 +424,29 @@ def test_backpressure_bounds_sender_readahead(tmp_path):
                 received["n"] += len(chunk)
             writer.close()
 
-        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        # clamp both kernel socket buffers BEFORE listen/connect: the
+        # bound below must not float with the host's tcp_{r,w}mem
+        # autotuning maxima (kernels ship defaults from 4 to 32+ MiB —
+        # enough to swallow the whole source and void the test)
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, CHUNK)
+        lsock.bind(("127.0.0.1", 0))
+        server = await asyncio.start_server(serve, sock=lsock)
         try:
             port = server.sockets[0].getsockname()[1]
+            csock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            csock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, CHUNK)
+            csock.setblocking(False)
+            await asyncio.get_running_loop().sock_connect(
+                csock, ("127.0.0.1", port))
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection("127.0.0.1", port), 5.0)
+                asyncio.open_connection(sock=csock), 5.0)
             writer.transport.set_write_buffer_limits(high=CHUNK)
+            # Linux reports the bookkeeping-doubled values; sum what
+            # the kernel actually granted on each end
+            kernel = csock.getsockopt(socket.SOL_SOCKET,
+                                      socket.SO_SNDBUF) \
+                + lsock.getsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF)
             copy = asyncio.create_task(wirestream.pipeline_copy(
                 read_fn, writer, chunk_size=CHUNK,
                 readahead=READAHEAD))
@@ -437,10 +454,12 @@ def test_backpressure_bounds_sender_readahead(tmp_path):
             # bounded queue, not inhale the source
             await asyncio.sleep(0.5)
             assert not copy.done()
-            # bound: transport buffer (high-water) + kernel socket
-            # buffers (both ends) + queued chunks + one in each hand
-            kernel = 4 * 1024 * 1024   # generous cap on socket buffers
-            bound = CHUNK + kernel + (READAHEAD + 2) * CHUNK
+            # bound: transport buffer (asyncio accepts a full write
+            # past the high-water mark) + kernel socket buffers (both
+            # ends, as granted) + queued chunks + one in each hand +
+            # a couple of chunks of loopback slack beyond the nominal
+            # grants — still a small fraction of the 4 MiB source
+            bound = 2 * CHUNK + kernel + (READAHEAD + 6) * CHUNK
             assert read_pos["n"] <= bound, \
                 "sender read %d bytes ahead (bound %d)" \
                 % (read_pos["n"], bound)

@@ -1,0 +1,212 @@
+"""SLO engine (obs/slo.py): config validation, O(1) budget
+accounting, the multi-window multi-burn-rate alert lifecycle (fire on
+BOTH windows, resolve promptly, journal the transitions), and the
+/alerts endpoint contract."""
+
+import pytest
+
+from manatee_tpu.obs import get_journal
+from manatee_tpu.obs.slo import (
+    DEFAULT_BURN_RULES,
+    SLOConfig,
+    SLOConfigError,
+    SLOEngine,
+    alerts_http_reply,
+    default_slos,
+    parse_slo_configs,
+)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def engine(**cfg_kw):
+    """One SLO with tight test-sized windows: objective 0.9 (burn =
+    10 * bad-ratio), page rule long 10s / short 2s / factor 2."""
+    cfg = SLOConfig("write_availability", objective=0.9,
+                    window_s=60.0,
+                    burn_rules={"page": {"long_s": 10.0,
+                                         "short_s": 2.0,
+                                         "factor": 2.0}},
+                    **cfg_kw)
+    clk = Clock()
+    return SLOEngine([cfg], clock=clk), clk
+
+
+# ---- configuration ----
+
+def test_config_validation():
+    with pytest.raises(SLOConfigError):
+        SLOConfig("", objective=0.5)
+    for bad in (0.0, 1.0, -1, 2):
+        with pytest.raises(SLOConfigError):
+            SLOConfig("x", objective=bad)
+    with pytest.raises(SLOConfigError):
+        SLOConfig("x", objective=0.9, window_s=0)
+    # burn rules must have long > short > 0 and a positive factor
+    with pytest.raises(SLOConfigError):
+        SLOConfig("x", objective=0.9,
+                  burn_rules={"page": {"long_s": 5, "short_s": 5,
+                                       "factor": 2}})
+    with pytest.raises(SLOConfigError):
+        SLOConfig("x", objective=0.9,
+                  burn_rules={"page": {"long_s": 10, "short_s": 5,
+                                       "factor": 0}})
+
+
+def test_parse_slo_configs_refuses_malformed():
+    ok = parse_slo_configs([{"name": "a", "objective": 0.99},
+                            {"name": "b", "objective": 0.9,
+                             "window_s": 120.0}])
+    assert [c.name for c in ok] == ["a", "b"]
+    assert ok[0].burn_rules == DEFAULT_BURN_RULES
+    with pytest.raises(SLOConfigError):
+        parse_slo_configs(["not-a-dict"])
+    with pytest.raises(SLOConfigError):
+        parse_slo_configs([{"name": "a", "objective": 0.99},
+                           {"name": "a", "objective": 0.9}])
+    with pytest.raises(SLOConfigError):
+        parse_slo_configs([{"name": "a"}])   # objective is required
+
+
+def test_default_slos_cover_the_prober():
+    names = {c.name for c in default_slos()}
+    assert names == {"write_availability", "read_staleness"}
+
+
+def test_record_unknown_slo_refuses():
+    eng, _clk = engine()
+    with pytest.raises(SLOConfigError):
+        eng.record("typo_slo", good=True)
+
+
+# ---- budget accounting ----
+
+def test_status_budget_accounting():
+    eng, clk = engine()
+    for _ in range(95):
+        eng.record("write_availability", good=True, shard="1")
+    for _ in range(5):
+        eng.record("write_availability", good=False, shard="1")
+    [row] = eng.status()
+    assert (row["slo"], row["shard"]) == ("write_availability", "1")
+    assert (row["good"], row["bad"]) == (95, 5)
+    assert row["ratio"] == pytest.approx(0.95)
+    # objective 0.9 over 100 events allows 10 bad; 5 spent
+    assert row["budget_remaining"] == pytest.approx(0.5)
+    assert row["burn"] == pytest.approx(0.5, abs=0.01)
+    # the window forgets: an hour later the series is clean
+    clk.t += 3600.0
+    [row] = eng.status()
+    assert (row["good"], row["bad"]) == (0, 0)
+    assert row["ratio"] is None and row["budget_remaining"] is None
+
+
+def test_series_are_per_shard():
+    eng, _clk = engine()
+    eng.record("write_availability", good=True, shard="1")
+    eng.record("write_availability", good=False, shard="2")
+    rows = {r["shard"]: r for r in eng.status()}
+    assert rows["1"]["bad"] == 0 and rows["2"]["bad"] == 1
+
+
+# ---- alert lifecycle ----
+
+def events_named(name):
+    return [e for e in get_journal().events() if e["event"] == name]
+
+
+def test_alert_fires_on_both_windows_and_resolves():
+    eng, clk = engine()
+    fired_before = len(events_named("slo.alert.fired"))
+    # steady failure: both windows hot
+    for _ in range(10):
+        eng.record("write_availability", good=False, shard="1")
+        clk.t += 1.0
+    [alert] = eng.evaluate()
+    assert (alert.slo, alert.shard, alert.severity) \
+        == ("write_availability", "1", "page")
+    assert alert.burn_long == pytest.approx(10.0)
+    assert len(events_named("slo.alert.fired")) == fired_before + 1
+    # still firing: no duplicate journal event
+    eng.evaluate()
+    assert len(events_named("slo.alert.fired")) == fired_before + 1
+    # recovery: goods refill the short window -> prompt resolve even
+    # though the long window still remembers the incident
+    for _ in range(4):
+        eng.record("write_availability", good=True, shard="1")
+        clk.t += 1.0
+    assert eng.evaluate() == []
+    resolved = events_named("slo.alert.resolved")
+    assert resolved and resolved[-1]["shard"] == "1"
+
+
+def test_one_blip_does_not_page():
+    """The long window's whole point: a transient blip whose LONG burn
+    stays under the factor never fires, however hot the short window
+    momentarily ran."""
+    eng, clk = engine()
+    eng.record("write_availability", good=False, shard="1")
+    for _ in range(60):
+        eng.record("write_availability", good=True, shard="1")
+        clk.t += 0.2
+    assert eng.evaluate() == []
+
+
+def test_stale_burst_outside_short_window_does_not_fire():
+    """Both windows must exceed the factor: once the short window has
+    gone quiet the incident is over, even while the long window still
+    carries the burst."""
+    eng, clk = engine()
+    for _ in range(5):
+        eng.record("write_availability", good=False, shard="1")
+    clk.t += 5.0          # inside long (10s), outside short (2s)
+    assert eng.evaluate() == []
+
+
+def test_healthy_stream_never_alerts():
+    """The zero-false-positive contract the chaos soak asserts live:
+    an all-good stream must never fire, at any evaluation cadence."""
+    eng, clk = engine()
+    for _ in range(300):
+        eng.record("write_availability", good=True, shard="1")
+        clk.t += 0.5
+        assert eng.evaluate() == []
+
+
+def test_default_rules_fire_under_sustained_failure():
+    """The stock page rule (60s/5s, 14.4x) fires for a shard whose
+    writes all fail for ~10s — the partition-drill assertion."""
+    clk = Clock()
+    eng = SLOEngine(default_slos(), clock=clk)
+    for _ in range(10):
+        eng.record("write_availability", good=False, shard="1")
+        clk.t += 1.0
+    alerts = eng.evaluate()
+    assert any(a.severity == "page"
+               and a.slo == "write_availability" for a in alerts)
+
+
+# ---- endpoint contract ----
+
+def test_alerts_http_reply_contract():
+    body, status = alerts_http_reply(None, {})
+    assert status == 404 and "error" in body
+    eng, clk = engine()
+    for _ in range(10):
+        eng.record("write_availability", good=False, shard="1")
+        clk.t += 1.0
+    body, status = alerts_http_reply(eng, {})
+    assert status == 200
+    assert {"now", "alerts", "slos", "configs"} <= set(body)
+    [a] = body["alerts"]
+    assert a["severity"] == "page" and a["burn_long"] > 2.0
+    [cfg] = body["configs"]
+    assert cfg["name"] == "write_availability"
+    [row] = body["slos"]
+    assert row["bad"] == 10

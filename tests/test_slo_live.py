@@ -1,0 +1,210 @@
+"""Live burn-rate alert discipline (env-gated: MANATEE_CHAOS=1).
+
+The SLO engine's unit tier (tests/test_slo.py) proves the multi-window
+math; this tier proves the OPERATIONAL contract against a real shard
+with a real `manatee-prober` process watching it:
+
+  * a healthy cluster, soaked under continuous probing, fires ZERO
+    alerts — a pager that cries wolf on a quiet fleet is worse than no
+    pager;
+  * an asymmetric coordination partition of the primary (armed through
+    the real `manatee-adm fault` CLI) is CLIENT-SEAMLESS: the deposed
+    primary keeps acking writes while the sync takes over, and the
+    prober's topology watch re-points it without paging anyone — a
+    clean failover must not burn error budget;
+  * a genuine write outage (the documented ``prober.write`` failpoint,
+    armed over the prober's own /faults exactly as
+    docs/fault-injection.md describes, layered on the partition) opens
+    a measured error window and fires at least one fast-burn ("page")
+    alert, which resolves after the fault clears.
+
+Runs in the chaos CI jobs alongside tests/test_chaos.py.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from tests.harness import (
+    ClusterHarness,
+    alloc_port_block,
+    kill_fleet_sitter,
+    run_cli,
+    spawn_prober,
+)
+from tests.test_partition import http_get
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("MANATEE_CHAOS"),
+    reason="live soak + partition drill; opt in with MANATEE_CHAOS=1 "
+           "(make chaos)")
+
+SOAK_S = float(os.environ.get("MANATEE_SLO_SOAK_SECONDS", "20"))
+PROBE_INTERVAL = 0.05
+# how long prober.write stays armed: >= ~1s of solid failure pushes
+# the stock page rule (60s/5s, 14.4x at objective 0.999) over the
+# factor on BOTH windows; 3s leaves margin for the 1s eval cadence
+OUTAGE_S = 3.0
+
+
+def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3,
+                                 session_timeout=1.0)
+        prober_proc = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-soak", timeout=60)
+
+            port = alloc_port_block(1)
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "name": "1",
+                "shardPath": cluster.shard_path,
+                "statusHost": "127.0.0.1",
+                "statusPort": port,
+                "probeInterval": PROBE_INTERVAL,
+                "faultsEnabled": True,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": 1.0},
+            }, tmp_path / "prober")
+            base = "http://127.0.0.1:%d" % port
+
+            async def sli_row() -> dict:
+                _s, body = await http_get(base + "/slis")
+                return body["shards"][0]
+
+            async def alert_events() -> list[dict]:
+                _s, body = await http_get(base + "/events")
+                return [e for e in body["events"]
+                        if e["event"] == "slo.alert.fired"]
+
+            # warm: steady good writes, no open error window, and any
+            # boot-transient alert already resolved
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    row = await sli_row()
+                    _s, al = await http_get(base + "/alerts")
+                    if row["writes_ok"] >= 20 \
+                            and not row["error_window_open"] \
+                            and not al["alerts"]:
+                        break
+                except (OSError, KeyError, IndexError, ValueError,
+                        asyncio.TimeoutError):
+                    pass
+                assert time.monotonic() < deadline, \
+                    "prober never reached a quiet warm state"
+                await asyncio.sleep(0.5)
+
+            # ---- healthy soak: zero false positives
+            fired0 = len(await alert_events())
+            errors0 = (await sli_row())["writes_error"]
+            await asyncio.sleep(SOAK_S)
+            fired = await alert_events()
+            row = await sli_row()
+            assert len(fired) == fired0, \
+                "healthy soak fired alerts: %r" % fired[fired0:]
+            _s, al = await http_get(base + "/alerts")
+            assert al["alerts"] == [], \
+                "active alerts on a healthy cluster: %r" % al["alerts"]
+            assert row["writes_error"] == errors0, \
+                "probe writes failed during the healthy soak"
+            cursor = max((e["seq"] for e in fired), default=0)
+            old_primary = row["primary"]
+
+            # ---- partition drill, act 1: black-hole the primary's
+            # coordination traffic.  Its session expires and the sync
+            # takes over, but the deposed primary keeps acking writes,
+            # so the failover is client-seamless: the prober's watch
+            # re-points it to the new primary and nobody gets paged.
+            cp = run_cli(cluster, "fault", "set",
+                         "coord.client.connect=drop",
+                         "coord.client.send=drop", "-n", p1.name,
+                         timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            await cluster.wait_topology(primary=p2, timeout=60)
+            await cluster.wait_writable(p2, "post-takeover",
+                                        timeout=60)
+            deadline = time.monotonic() + 30
+            while True:
+                row = await sli_row()
+                if row["primary"] and row["primary"] != old_primary:
+                    break
+                assert time.monotonic() < deadline, \
+                    "prober never re-pointed to the new primary"
+                await asyncio.sleep(0.2)
+            paged = [e for e in await alert_events()
+                     if e["seq"] > cursor]
+            assert not paged, \
+                "clean failover burned the pager: %r" % paged
+
+            # ---- partition drill, act 2: a real write outage.  Arm
+            # the documented prober.write failpoint over the prober's
+            # own /faults; every probe write now fails, which must
+            # open a measured error window and trip the fast-burn rule
+            # on both windows.
+            cp = run_cli(cluster, "fault", "set", "prober.write=error",
+                         "--url", base, timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            await asyncio.sleep(OUTAGE_S)
+            cp = run_cli(cluster, "fault", "clear", "prober.write",
+                         "--url", base, timeout=30)
+            assert cp.returncode == 0, cp.stderr
+
+            window = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                row = await sli_row()
+                if not row["error_window_open"] \
+                        and row["last_error_window_s"]:
+                    window = float(row["last_error_window_s"])
+                    break
+                await asyncio.sleep(0.2)
+            assert window is not None, \
+                "error window never closed after the outage"
+            # the window is the armed duration plus at most a couple
+            # of probe intervals on either edge
+            assert 1.0 <= window <= OUTAGE_S + 5.0, \
+                "implausible window %.3fs for a %.1fs outage" \
+                % (window, OUTAGE_S)
+
+            paged = [e for e in await alert_events()
+                     if e["seq"] > cursor
+                     and e["severity"] == "page"]
+            assert paged, "write outage fired no fast-burn alert"
+            assert any(e["slo"] == "write_availability"
+                       for e in paged), paged
+
+            # the pager un-pages: once goods refill the page rule's
+            # 5s short window the fast-burn alert resolves.  The
+            # slow-burn ticket may linger — its 60s short window
+            # still carries the outage, which is the point of a
+            # ticket — so only the page's resolution is asserted.
+            deadline = time.monotonic() + 30
+            while True:
+                _s, al = await http_get(base + "/alerts")
+                if not any(a["severity"] == "page"
+                           for a in al["alerts"]):
+                    break
+                assert time.monotonic() < deadline, \
+                    "page alert never resolved after the fault " \
+                    "cleared: %r" % al["alerts"]
+                await asyncio.sleep(0.5)
+
+            print("slo-live: soak quiet %.0fs; seamless takeover; "
+                  "outage window %.2fs, %d page alert(s), resolved"
+                  % (SOAK_S, window, len(paged)), flush=True)
+
+            run_cli(cluster, "fault", "clear", "--url",
+                    "http://127.0.0.1:%d" % p1.status_port, timeout=30)
+        finally:
+            if prober_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+            await cluster.stop()
+
+    asyncio.run(go())

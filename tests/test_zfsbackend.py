@@ -346,8 +346,13 @@ def test_delta_send_recv_contract(tmp_path):
             lambda r: be.recv_delta("dst", r, base="1700000000333"))
         _r, writer = await asyncio.wait_for(
             asyncio.open_connection("127.0.0.1", port), 30)
-        await be.send("src", "1700000000222", writer,
-                      from_snapshot="1700000000111", stream_id="j2")
+        try:
+            await be.send("src", "1700000000222", writer,
+                          from_snapshot="1700000000111", stream_id="j2")
+        except StorageError:
+            # the receiver refuses the base and closes; whether the
+            # sender sees the reset mid-stream is a kernel-timing race
+            pass
         writer.close()
         await asyncio.wait_for(done.wait(), 30)
         server.close()
