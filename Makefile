@@ -8,7 +8,7 @@ PYTHON ?= python3
 .DELETE_ON_ERROR:
 
 .PHONY: all test test-unit test-integ test-integ-postgres lint \
-    lint-fast bench \
+    lint-fast bench flamegraph \
     devcluster native clean modelcheck modelcheck-jax chaos \
     chaos-postgres chaos-partition man \
     train-health eval-recorded
@@ -95,11 +95,20 @@ eval-recorded:
 bench:
 	$(PYTHON) bench.py
 
+# folded stacks (GET /profile, `manatee-adm profile`) -> SVG
+# (docs/observability.md has the worked capture-to-graph example)
+flamegraph:
+	@test -n "$(FOLDED)" || { echo "usage: make flamegraph \
+FOLDED=stacks.folded [SVG=out.svg]" >&2; exit 2; }
+	$(PYTHON) tools/flamegraph $(FOLDED) -o $(or $(SVG),flamegraph.svg)
+	@echo wrote $(or $(SVG),flamegraph.svg)
+
 # roff man pages generated from the markdown source (reference:
 # Makefile:68-79)
 man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1 \
 		man/man1/manatee-sitter.1 man/man1/manatee-prober.1 \
-		man/man1/manatee-adm-slo.1
+		man/man1/manatee-adm-slo.1 man/man1/manatee-adm-profile.1 \
+		man/man1/manatee-adm-tasks.1
 man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
@@ -115,6 +124,12 @@ man/man1/manatee-prober.1: docs/man/manatee-prober.md tools/md2man
 man/man1/manatee-adm-slo.1: docs/man/manatee-adm-slo.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm-slo.md > $@
+man/man1/manatee-adm-profile.1: docs/man/manatee-adm-profile.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-adm-profile.md > $@
+man/man1/manatee-adm-tasks.1: docs/man/manatee-adm-tasks.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-adm-tasks.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

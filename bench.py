@@ -192,16 +192,57 @@ async def one_run(tmp: Path, *, n_coord: int,
             if hung is not None:
                 cluster.signal_coordd(hung, signal.SIGCONT)
         if grab_trace:
-            breakdown = await grab_breakdown(cluster)
+            breakdown = await grab_breakdown(cluster, peer=p2,
+                                             window_s=dt)
         return dt, breakdown
     finally:
         await cluster.stop()
 
 
-async def grab_breakdown(cluster: ClusterHarness) -> dict | None:
+def _fold_text_to_agg(text: str) -> dict:
+    agg: dict = {}
+    for line in text.splitlines():
+        stack, _sep, cnt = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            agg[stack] = agg.get(stack, 0) + int(cnt)
+        except ValueError:
+            continue
+    return agg
+
+
+async def _top_self_stack_http(base: str, *,
+                               seconds: float) -> dict | None:
+    """The hottest folded stack over the trailing window from a live
+    daemon's always-on profiler (GET /profile) — names where the self
+    time went, e.g. the new primary's hot path while taking over.
+    Best-effort like the trace analyzer: a bench must not die on it."""
+    from manatee_tpu.obs.profile import top_self_stack
+    from tests.test_partition import http_get
+    try:
+        status, text = await http_get(
+            base + "/profile?seconds=%g" % seconds)
+        if status != 200 or not isinstance(text, str):
+            return None
+        top = top_self_stack(_fold_text_to_agg(text))
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        return None
+    if top is None:
+        return None
+    return {"stack": top[0], "samples": top[1]}
+
+
+async def grab_breakdown(cluster: ClusterHarness, *, peer=None,
+                         window_s: float | None = None) -> dict | None:
     """Fetch the last failover's per-stage critical path from the live
     shard via the real analyzer CLI (best-effort: a bench must not die
-    on a missing span)."""
+    on a missing span).  With *peer* (the taking-over primary), the
+    breakdown also names the hottest self-time stack its profiler saw
+    over the failover window — the span tree says which stage was
+    slow, this says which code was hot."""
     await asyncio.sleep(0.3)   # let the tail spans land in the rings
     try:
         cp = await asyncio.to_thread(
@@ -215,7 +256,7 @@ async def grab_breakdown(cluster: ClusterHarness) -> dict | None:
     path = out.get("critical_path")
     if not path:
         return None
-    return {
+    bd = {
         "trace": out.get("trace"),
         "total_s": path.get("total_s"),
         "stages": [{"name": st.get("name"),
@@ -225,6 +266,11 @@ async def grab_breakdown(cluster: ClusterHarness) -> dict | None:
                     "pct": st.get("pct")}
                    for st in path.get("stages", [])],
     }
+    if peer is not None:
+        bd["top_self_stack"] = await _top_self_stack_http(
+            "http://127.0.0.1:%d" % peer.status_port,
+            seconds=max((window_s or 0.0) + 1.0, 5.0))
+    return bd
 
 
 async def bench_config(name: str, **kw) -> tuple[float, dict | None]:
@@ -566,8 +612,42 @@ async def bench_control_plane_scale() -> dict:
 
             _s, coordd_metrics = await http_get(
                 cluster.coord_metrics_url(0) + "/metrics")
-            _s, fleet_metrics = await http_get(
-                "http://127.0.0.1:%d/metrics" % status_port)
+            # the overhead budget, as a measured number: the fleet
+            # process's sampler CPU (its own thread-time counter) over
+            # the process's whole lifetime — one sampler serving all
+            # N-1 shards (docs/observability.md "Profiling & loop
+            # health").  Lifetime, not the churn window; the sampler
+            # batches its counter flush to ~1/s, so right after boot
+            # the first flush may not have landed yet — retry briefly
+            # rather than report a false zero.
+            prof_self = prof_samples = 0.0
+            for _ in range(8):
+                _s, fleet_metrics = await http_get(
+                    "http://127.0.0.1:%d/metrics" % status_port)
+                prof_self = _metric_value(
+                    fleet_metrics,
+                    "manatee_profiler_self_seconds_total") or 0.0
+                prof_samples = _metric_value(
+                    fleet_metrics,
+                    "manatee_profiler_samples_total") or 0.0
+                if prof_samples:
+                    break
+                await asyncio.sleep(1.0)
+            started = _metric_value(
+                fleet_metrics, "manatee_process_start_time_seconds")
+            up = time.time() - started if started else None
+            prof_core = (prof_self / up
+                         if up is not None and up > 0 else None)
+            _s, fleet_folded = await http_get(
+                "http://127.0.0.1:%d/profile?seconds=%g"
+                % (status_port, window + 5.0))
+            artifact = os.environ.get("MANATEE_PROFILE_ARTIFACT")
+            if artifact and isinstance(fleet_folded, str):
+                await asyncio.to_thread(Path(artifact).write_text,
+                                        fleet_folded)
+            from manatee_tpu.obs.profile import top_self_stack
+            fleet_top = (top_self_stack(_fold_text_to_agg(fleet_folded))
+                         if isinstance(fleet_folded, str) else None)
 
             # ---- failover of the measured shard under neighbor churn
             stop_churn = asyncio.Event()
@@ -645,18 +725,38 @@ async def bench_control_plane_scale() -> dict:
                 "watch_p99_ms": round(_percentile(all_lat, 99) * 1e3, 2),
                 "failover_s": round(failover_s, 3),
                 "failover_churn_rounds": churned[0],
+                "profiler": {
+                    "samples": int(prof_samples),
+                    "sampler_cpu_core": (round(prof_core, 5)
+                                         if prof_core is not None
+                                         else None),
+                    "sampler_cpu_core_per_shard": (
+                        round(prof_core / n_neighbors, 6)
+                        if prof_core is not None else None),
+                    # the 1%-of-one-core always-on budget, for the
+                    # whole multi-shard process — stricter than the
+                    # per-shard phrasing on purpose
+                    "overhead_within_budget": (
+                        prof_core is not None and prof_core < 0.01),
+                    "top_self_stack": ({"stack": fleet_top[0],
+                                        "samples": fleet_top[1]}
+                                       if fleet_top else None),
+                },
                 "per_shard": per_shard,
             }
             print("control_plane_scale: %d shards, fleet process "
                   "coord connections=%s sessions=%s (mux handles=%s); "
                   "watch p50=%.2fms p99=%.2fms; coordd cpu/shard=%s "
-                  "core; failover with %d churning neighbors %.2fs"
+                  "core; profiler %s core (budget ok=%s); failover "
+                  "with %d churning neighbors %.2fs"
                   % (n_shards, out["fleet_coord_connections"],
                      out["fleet_coord_sessions"],
                      out["fleet_mux_handles"], out["watch_p50_ms"],
                      out["watch_p99_ms"],
-                     out["coordd_cpu_core_per_shard"], n_neighbors,
-                     failover_s), file=sys.stderr)
+                     out["coordd_cpu_core_per_shard"],
+                     out["profiler"]["sampler_cpu_core"],
+                     out["profiler"]["overhead_within_budget"],
+                     n_neighbors, failover_s), file=sys.stderr)
             return out
         finally:
             for h in handles:
@@ -1009,7 +1109,7 @@ async def main() -> None:
     results: dict[str, float] = {}
     breakdown = None
     failover_kw = {
-        "ensemble": {"n_coord": 3},
+        "ensemble": {"n_coord": 3, "grab_trace": True},
         "single": {"n_coord": 1},
         "ensemble_hung_follower": {"n_coord": 3, "hang_follower": True},
         "ensemble_postgres": {"n_coord": 3, "engine": "postgres",

@@ -942,25 +942,28 @@ class AdmClient:
             peers, "/events", ("events",), timeout=timeout, query=q)
         return {"events": merge_events(got["events"]), "errors": errors}
 
-    async def shard_metrics(self, shard: str, *, timeout: float = 5.0
-                            ) -> tuple[dict[str, str], dict[str, str]]:
-        """Raw Prometheus exposition text per peer status server — the
-        `manatee-adm top` fan-out (process self-metrics, replication
-        lag, health score all ride the one scrape every sitter already
-        serves)."""
+    @staticmethod
+    async def _gather_raw(targets, path: str, errors: dict, *,
+                          timeout: float, as_json: bool = False
+                          ) -> dict:
+        """GET *path* from each (label, base URL) target, returning
+        the whole body per label (text, or parsed JSON with
+        *as_json*); per-target failures land in *errors*.  The
+        NON-merging fan-out under shard_metrics / shard_profile /
+        shard_tasks — those endpoints are per-process snapshots, not
+        rings to merge."""
         import aiohttp
 
-        peers = await self._shard_peers(shard)
-        targets, errors = self.peer_http_targets(peers)
-        out: dict[str, str] = {}
+        out: dict = {}
 
         async def fetch(label: str, base: str, http) -> None:
             try:
-                async with http.get(base + "/metrics") as resp:
+                async with http.get(base + path) as resp:
                     if resp.status != 200:
                         errors[label] = "HTTP %d" % resp.status
                         return
-                    out[label] = await resp.text()
+                    out[label] = (await resp.json() if as_json
+                                  else await resp.text())
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -970,6 +973,44 @@ class AdmClient:
         async with aiohttp.ClientSession(timeout=http_timeout) as http:
             await asyncio.gather(*(fetch(label, base, http)
                                    for label, base in targets))
+        return out
+
+    async def shard_metrics(self, shard: str, *, timeout: float = 5.0
+                            ) -> tuple[dict[str, str], dict[str, str]]:
+        """Raw Prometheus exposition text per peer status server — the
+        `manatee-adm top` fan-out (process self-metrics, replication
+        lag, health score all ride the one scrape every sitter already
+        serves)."""
+        peers = await self._shard_peers(shard)
+        targets, errors = self.peer_http_targets(peers)
+        out = await self._gather_raw(targets, "/metrics", errors,
+                                     timeout=timeout)
+        return out, errors
+
+    async def shard_profile(self, shard: str, *,
+                            seconds: float = 30.0,
+                            timeout: float = 15.0
+                            ) -> tuple[dict[str, str], dict[str, str]]:
+        """Folded-stack profile text per peer status server
+        (``GET /profile?seconds=N``) — the `manatee-adm profile`
+        fan-out.  Each body is already flamegraph food; the CLI
+        prefixes a ``peer:<id>`` root frame when merging peers."""
+        peers = await self._shard_peers(shard)
+        targets, errors = self.peer_http_targets(peers)
+        out = await self._gather_raw(
+            targets, "/profile?seconds=%g" % seconds, errors,
+            timeout=timeout)
+        return out, errors
+
+    async def shard_tasks(self, shard: str, *, timeout: float = 5.0
+                          ) -> tuple[dict[str, dict], dict[str, str]]:
+        """Live asyncio task census per peer (``GET /tasks``) — the
+        `manatee-adm tasks` fan-out and the post-failover leak check's
+        data source."""
+        peers = await self._shard_peers(shard)
+        targets, errors = self.peer_http_targets(peers)
+        out = await self._gather_raw(targets, "/tasks", errors,
+                                     timeout=timeout, as_json=True)
         return out, errors
 
     @staticmethod

@@ -30,8 +30,7 @@ from aiohttp import web
 
 from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
-from manatee_tpu.obs import get_span_store
-from manatee_tpu.obs.spans import spans_http_reply
+from manatee_tpu.daemons.common import attach_obs_routes
 from manatee_tpu.storage.base import (
     StorageBackend,
     is_epoch_ms_snapshot,
@@ -82,11 +81,11 @@ class BackupRestServer:
         app = web.Application()
         app.router.add_post("/backup", self._post_backup)
         app.router.add_get("/backup/{uuid}", self._get_backup)
-        app.router.add_get("/spans", self._spans)
-        app.router.add_get("/history", self._history)
-        # the backupserver daemon's own registry (the sender's stream
-        # faults live in THIS process, not the sitter)
-        faults.attach_http(app)
+        # the full shared introspection surface: this process's spans
+        # (the sender's backup.send lives here, not in the sitter), its
+        # journal, profile, task census, fault surface, and the generic
+        # registry /metrics exposition (daemons/common.py)
+        attach_obs_routes(app, metrics=True)
         self._app = app
 
     async def start(self) -> None:
@@ -163,20 +162,3 @@ class BackupRestServer:
         if job is None:
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(job.to_dict())
-
-    async def _spans(self, req: web.Request) -> web.Response:
-        """This process's completed spans (the backup sender's
-        ``backup.send`` lives here, not in the sitter) — same contract
-        as the status server's ``GET /spans``."""
-        body, status = spans_http_reply(get_span_store(), req.query)
-        return web.json_response(body, status=status,
-                                 content_type="application/json")
-
-    async def _history(self, req: web.Request) -> web.Response:
-        """This process's on-disk metric-history ring — same contract
-        as the status server's ``GET /history``."""
-        from manatee_tpu.obs.history import (get_history,
-                                             history_http_reply)
-        body, status = history_http_reply(get_history(), req.query)
-        return web.json_response(body, status=status,
-                                 content_type="application/json")

@@ -909,6 +909,39 @@ def _prom_pick(samples, name: str, peer: str | None = None
     return None
 
 
+def _prom_quantile(samples, name: str, q: float) -> float | None:
+    """Approximate quantile from a histogram's cumulative
+    ``<name>_bucket`` samples: the upper bound of the bucket the
+    target rank lands in (good enough for a dashboard column).  A
+    rank landing in +Inf reports the largest finite bound — the
+    truth is ">= that"."""
+    pts = []
+    for n, labels, v in samples:
+        if n == name + "_bucket" and "le" in labels:
+            le = labels["le"]
+            try:
+                ub = float("inf") if le == "+Inf" else float(le)
+            except ValueError:
+                continue
+            pts.append((ub, v))
+    if not pts:
+        return None
+    pts.sort()
+    total = pts[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    best = None
+    for ub, c in pts:
+        if c >= rank:
+            best = ub
+            break
+    if best == float("inf"):
+        finite = [ub for ub, _c in pts if ub != float("inf")]
+        best = max(finite) if finite else None
+    return best
+
+
 def _prober_url(args) -> str | None:
     url = getattr(args, "url", None) \
         or os.environ.get("MANATEE_PROBER_URL")
@@ -1020,6 +1053,12 @@ def cmd_top(args) -> int:
             lag = _prom_pick(samples, "replication_lag_seconds",
                              peer=label)
             score = _prom_pick(samples, "health_score", peer=label)
+            # event-loop health (obs/profile.py's monitor): scheduling
+            # lag every coroutine in that process experiences, and how
+            # often a callback blocked the loop outright
+            loop_p99 = _prom_quantile(samples,
+                                      "event_loop_lag_seconds", 0.99)
+            stalls = _prom_pick(samples, "event_loop_stalls_total")
             peers_out.append({
                 "peer": label,
                 "role": roles.get(label, "-"),
@@ -1030,6 +1069,8 @@ def cmd_top(args) -> int:
                 "fds": fds,
                 "lag_s": lag,
                 "health_score": score,
+                "loop_p99_s": loop_p99,
+                "loop_stalls": stalls,
             })
         slis = None
         base = _prober_url(args)
@@ -1062,6 +1103,8 @@ def cmd_top(args) -> int:
             {"name": "fds", "label": "FDS", "width": 5},
             {"name": "lag", "label": "LAG", "width": 6},
             {"name": "pred", "label": "PRED", "width": 5},
+            {"name": "loop", "label": "LOOP-P99", "width": 8},
+            {"name": "stalls", "label": "STALLS", "width": 6},
         ]
         rows = []
         for p in peers_out:
@@ -1078,6 +1121,10 @@ def cmd_top(args) -> int:
                 "lag": pg_duration(p["lag_s"]),
                 "pred": ("-" if p["health_score"] is None
                          else "%.2f" % p["health_score"]),
+                "loop": ("-" if p["loop_p99_s"] is None
+                         else "%.3gs" % p["loop_p99_s"]),
+                "stalls": ("-" if p["loop_stalls"] is None
+                           else "%d" % p["loop_stalls"]),
             })
         emit_table(cols, rows, omit_header=args.omit_header)
         if slis is not None:
@@ -1123,6 +1170,101 @@ def cmd_top(args) -> int:
     return asyncio.run(go())
 
 
+async def _introspection_bodies(args, path: str, *, timeout: float,
+                                as_json: bool = False
+                                ) -> tuple[dict, dict[str, str]]:
+    """(bodies-by-label, errors) for one introspection GET (/profile,
+    /tasks): --url targets a single daemon directly (coordd's metrics
+    listener, a backupserver, a prober); -n narrows the shard fan-out
+    to one peer; default is every peer's status server."""
+    errors: dict[str, str] = {}
+    if getattr(args, "url", None):
+        base = args.url.rstrip("/")
+        out = await AdmClient._gather_raw(
+            [(base, base)], path, errors, timeout=timeout,
+            as_json=as_json)
+        return out, errors
+    async with AdmClient(_coord(args)) as adm:
+        targets, errors = await adm.fault_targets(
+            _shard(args), zonename=getattr(args, "zonename", None))
+        out = await adm._gather_raw(targets, path, errors,
+                                    timeout=timeout, as_json=as_json)
+    return out, errors
+
+
+def cmd_profile(args) -> int:
+    """Folded wall-clock stacks from the always-on sampling profiler
+    (obs/profile.py) on every peer's status server — or one peer with
+    -n, or any single daemon with --url.  Output is flamegraph food:
+    pipe it to tools/flamegraph (or use `make flamegraph`).  In the
+    fan-out form each line gains a ``peer:<id>`` root frame so one
+    merged flamegraph shows where the whole shard's CPU time went."""
+    async def go():
+        out, errors = await _introspection_bodies(
+            args, "/profile?seconds=%g" % args.seconds,
+            timeout=args.seconds + 10.0)
+        # one explicit target -> raw folded body (round-trippable);
+        # a fan-out merge needs the per-peer root frame
+        single = bool(args.url or args.zonename)
+        for label in sorted(out):
+            for line in out[label].splitlines():
+                if not line.strip():
+                    continue
+                print(line if single
+                      else "peer:%s;%s" % (label, line))
+        rc = 0
+        for label, err in sorted(errors.items()):
+            sys.stderr.write("warning: no profile from %s: %s\n"
+                             % (label, err))
+            rc = 1
+        return rc
+    return asyncio.run(go())
+
+
+def cmd_tasks(args) -> int:
+    """Live asyncio task census (GET /tasks) per peer: every task's
+    name, age, suspension point, and bound trace id.  The leaked-task
+    triage view — after a failover this should shrink back to the
+    steady-state set, exactly like `manatee-adm trace`'s open-span
+    check."""
+    async def go():
+        path = "/tasks"
+        if args.name:
+            from urllib.parse import quote
+            path += "?name=%s" % quote(args.name)
+        out, errors = await _introspection_bodies(
+            args, path, timeout=5.0, as_json=True)
+        if args.json:
+            print(json.dumps({"peers": out, "errors": errors},
+                             indent=2, sort_keys=True))
+            return 0 if not errors else 1
+        cols = [
+            {"name": "peer", "label": "PEER", "width": 21},
+            {"name": "task", "label": "TASK", "width": 24},
+            {"name": "age", "label": "AGE", "width": 8},
+            {"name": "trace", "label": "TRACE", "width": 16},
+            {"name": "where", "label": "WHERE", "width": 40},
+        ]
+        rows = []
+        for label in sorted(out):
+            for t in out[label].get("tasks") or []:
+                rows.append({
+                    "peer": label,
+                    "task": t.get("name") or "-",
+                    "age": pg_duration(t.get("age_s")),
+                    "trace": t.get("trace") or "-",
+                    "where": t.get("where") or "-",
+                })
+        emit_table(cols, rows, omit_header=args.omit_header)
+        rc = 0
+        for label, err in sorted(errors.items()):
+            sys.stderr.write("warning: no task census from %s: %s\n"
+                             % (label, err))
+            rc = 1
+        return rc
+    return asyncio.run(go())
+
+
 def cmd_doctor(args) -> int:
     """Store integrity verifier (docs/crash-recovery.md): offline
     checks of coordd data dirs (--coord-data) and dir-backend store
@@ -1138,6 +1280,7 @@ def cmd_doctor(args) -> int:
         check_coordd_store,
         check_dirstore,
         check_history,
+        check_introspection,
         finding,
         summarize,
     )
@@ -1201,6 +1344,7 @@ def cmd_doctor(args) -> int:
                 "online cluster checks skipped: %s" % e))
         else:
             findings.extend(check_cluster(state, hist, events))
+            findings.extend(check_introspection(events))
     elif not (args.coord_data or store_roots or args.history_dir
               or findings):
         # findings counts: a zfs-backend -c config produced a
@@ -1507,6 +1651,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-u", "--url", default=None, metavar="URL",
                     help="also render per-shard SLIs from this "
                          "prober (env: MANATEE_PROBER_URL)")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
+
+    sp = add("profile", cmd_profile,
+             "folded-stack CPU profile from the always-on sampler "
+             "(flamegraph food)")
+    sp.add_argument("-n", "--zonename", default=None,
+                    help="profile one peer (zoneId or full peer id)")
+    sp.add_argument("--url", default=None,
+                    help="profile one server directly, e.g. coordd's "
+                         "metrics listener http://host:port")
+    sp.add_argument("--seconds", type=float, default=30.0,
+                    metavar="N",
+                    help="window of samples to fold (default 30)")
+
+    sp = add("tasks", cmd_tasks,
+             "live asyncio task census per peer (leak triage)")
+    sp.add_argument("-n", "--zonename", default=None,
+                    help="census one peer (zoneId or full peer id)")
+    sp.add_argument("--url", default=None,
+                    help="census one server directly")
+    sp.add_argument("-e", "--name", default=None,
+                    help="only tasks whose name contains this string")
     sp.add_argument("-j", "--json", action="store_true")
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")

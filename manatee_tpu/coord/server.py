@@ -105,7 +105,6 @@ from manatee_tpu.coord.api import (
 )
 from manatee_tpu.obs import bind_parent, bind_trace, get_span_store
 from manatee_tpu.obs.metrics import Histogram
-from manatee_tpu.obs.spans import spans_http_reply
 from manatee_tpu.utils.logutil import setup_logging
 
 log = logging.getLogger("manatee.coordd")
@@ -989,28 +988,17 @@ class CoordServer:
     async def _start_metrics(self) -> None:
         from aiohttp import web
 
+        from manatee_tpu.daemons.common import attach_obs_routes
+
         async def metrics(_req):
             return web.Response(text=self._render_metrics(),
                                 content_type="text/plain")
 
-        async def spans(req):
-            body, status = spans_http_reply(get_span_store(),
-                                            req.query)
-            return web.json_response(body, status=status,
-                                     content_type="application/json")
-
-        async def history(req):
-            from manatee_tpu.obs.history import (get_history,
-                                                 history_http_reply)
-            body, status = history_http_reply(get_history(), req.query)
-            return web.json_response(body, status=status,
-                                     content_type="application/json")
-
         app = web.Application()
         app.router.add_get("/metrics", metrics)
-        app.router.add_get("/spans", spans)
-        app.router.add_get("/history", history)
-        faults.attach_http(app)
+        # the shared introspection table — /events, /spans, /history,
+        # /alerts, /profile, /tasks, /faults (daemons/common.py)
+        attach_obs_routes(app)
         self._metrics_runner = web.AppRunner(app)
         await self._metrics_runner.setup()
         site = web.TCPSite(self._metrics_runner, self.host,
@@ -1928,6 +1916,11 @@ def main(argv: list[str] | None = None) -> None:
         ensemble = parse_connstr(args.ensemble)
 
     async def run():
+        from manatee_tpu.daemons.common import start_daemon_introspection
+
+        # the always-on profiling plane; the metrics listener serves
+        # its /profile and /tasks when --metrics-port is given
+        intro = start_daemon_introspection(None)
         server = CoordServer(args.host, args.port, tick=args.tick,
                              data_dir=args.data_dir,
                              ensemble=ensemble,
@@ -1943,6 +1936,7 @@ def main(argv: list[str] | None = None) -> None:
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
         await server.stop()
+        await intro.stop()
 
     asyncio.run(run())
 

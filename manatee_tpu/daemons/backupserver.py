@@ -9,7 +9,10 @@ from __future__ import annotations
 import logging
 
 from manatee_tpu.backup import BackupQueue, BackupRestServer, BackupSender
-from manatee_tpu.daemons.common import daemon_main
+from manatee_tpu.daemons.common import (
+    daemon_main,
+    start_daemon_introspection,
+)
 from manatee_tpu.obs import set_peer
 from manatee_tpu.shard import build_ident, build_storage
 
@@ -52,12 +55,14 @@ async def start_backupserver(cfg: dict):
                               storage=storage,
                               dataset=cfg["dataset"])
     sender = BackupSender(queue, storage, cfg["dataset"])
+    intro = start_daemon_introspection(cfg)
     await server.start()
     sender.start()
 
     async def stop():
         await sender.stop()
         await server.stop()
+        await intro.stop()
 
     return stop
 

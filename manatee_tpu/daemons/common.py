@@ -16,6 +16,119 @@ from manatee_tpu.utils.validation import (
 )
 
 
+# The introspection surface every daemon listener serves, in one
+# table.  Until PR 16 each of the four listeners (StatusServer, coordd
+# metrics, backup REST, prober) hand-maintained its own route list and
+# they had drifted: coordd and the backup server lacked /events and
+# /alerts, the backup server had no /metrics at all, and none served
+# the new /profile and /tasks.  attach_obs_routes is now the only way
+# these endpoints get mounted, so the contract cannot drift again.
+OBS_ROUTES = ("/events", "/spans", "/history", "/alerts", "/profile",
+              "/tasks", "/faults")
+
+
+def attach_obs_routes(app, *, metrics: bool = False) -> list[str]:
+    """Mount the shared introspection endpoints on an aiohttp *app*:
+    ``/events``, ``/spans``, ``/history``, ``/alerts``, ``/profile``,
+    ``/tasks`` (all through the pure ``*_http_reply`` helpers against
+    the process-wide obs singletons) plus the ``/faults`` surface.
+
+    *metrics*: also mount the generic registry-only ``GET /metrics``
+    exposition — for listeners without daemon-specific gauges (the
+    backup server, the prober).  The status server and coordd keep
+    their own /metrics handlers.
+
+    Returns the mounted paths, for ``GET /`` route listings."""
+    import time as _time
+
+    from aiohttp import web
+
+    from manatee_tpu import faults
+    from manatee_tpu.obs import get_journal, get_span_store
+    from manatee_tpu.obs.history import get_history, history_http_reply
+    from manatee_tpu.obs.profile import (
+        get_profiler,
+        profile_http_reply,
+        tasks_http_reply,
+    )
+    from manatee_tpu.obs.slo import alerts_http_reply, get_slo_engine
+    from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
+
+    async def _events(req):
+        journal = get_journal()
+        try:
+            since, limit = parse_page_query(req.query)
+        except ValueError:
+            return web.json_response(
+                {"error": "since/limit must be integers"}, status=400,
+                content_type="application/json")
+        return web.json_response({
+            "peer": journal.peer,
+            "now": round(_time.time(), 3),
+            "events": journal.events(since=since, limit=limit),
+        }, content_type="application/json")
+
+    async def _spans(req):
+        body, status = spans_http_reply(get_span_store(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _history(req):
+        body, status = history_http_reply(get_history(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _alerts(req):
+        body, status = alerts_http_reply(get_slo_engine(), req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _profile(req):
+        body, status = profile_http_reply(get_profiler(), req.query)
+        if isinstance(body, str):
+            # folded-stack text, ready for `tools/flamegraph`
+            return web.Response(text=body, status=status,
+                                content_type="text/plain")
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _tasks(req):
+        body, status = tasks_http_reply(req.query)
+        return web.json_response(body, status=status,
+                                 content_type="application/json")
+
+    async def _metrics(_req):
+        from manatee_tpu.obs import get_registry
+        from manatee_tpu.obs.process import refresh_process_metrics
+        from manatee_tpu.utils.prom import MetricsBuilder
+        refresh_process_metrics()
+        b = MetricsBuilder("manatee")
+        get_registry().render_into(b)
+        return web.Response(text=b.render(),
+                            content_type="text/plain")
+
+    app.router.add_get("/events", _events)
+    app.router.add_get("/spans", _spans)
+    app.router.add_get("/history", _history)
+    app.router.add_get("/alerts", _alerts)
+    app.router.add_get("/profile", _profile)
+    app.router.add_get("/tasks", _tasks)
+    faults.attach_http(app)
+    mounted = list(OBS_ROUTES)
+    if metrics:
+        app.router.add_get("/metrics", _metrics)
+        mounted.insert(0, "/metrics")
+    return mounted
+
+
+def start_daemon_introspection(cfg: dict | None):
+    """The always-on profiling plane (obs/profile.py), started from
+    every daemon's wiring exactly like the history recorder — one per
+    process no matter how many shards it runs."""
+    from manatee_tpu.obs.profile import start_introspection
+    return start_introspection(cfg)
+
+
 def parse_daemon_args(description: str, argv=None, *,
                       fleet: bool = False) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=description)

@@ -62,23 +62,15 @@ from collections import deque
 from manatee_tpu import faults
 from manatee_tpu.coord.api import CoordError, NoNodeError
 from manatee_tpu.coord.client import mux_handle
-from manatee_tpu.daemons.common import daemon_main
-from manatee_tpu.obs import get_journal, get_registry, get_span_store, \
-    set_peer
+from manatee_tpu.daemons.common import (
+    attach_obs_routes,
+    daemon_main,
+    start_daemon_introspection,
+)
+from manatee_tpu.obs import get_journal, get_registry, set_peer
 from manatee_tpu.obs.history import DEFAULT_INTERVAL as HISTORY_INTERVAL
-from manatee_tpu.obs.history import (
-    HistoryRecorder,
-    get_history,
-    history_http_reply,
-    init_history,
-)
-from manatee_tpu.obs.slo import (
-    alerts_http_reply,
-    get_slo_engine,
-    init_slo_engine,
-    parse_slo_configs,
-)
-from manatee_tpu.obs.spans import spans_http_reply
+from manatee_tpu.obs.history import HistoryRecorder, init_history
+from manatee_tpu.obs.slo import init_slo_engine, parse_slo_configs
 from manatee_tpu.pg.engine import PgError, parse_pg_url
 from manatee_tpu.utils.validation import ConfigError
 
@@ -541,13 +533,9 @@ class ProberServer:
         self._runner = None
         app = web.Application()
         app.router.add_get("/", self._routes)
-        app.router.add_get("/metrics", self._metrics)
-        app.router.add_get("/events", self._events)
-        app.router.add_get("/spans", self._spans)
-        app.router.add_get("/history", self._history)
-        app.router.add_get("/alerts", self._alerts)
         app.router.add_get("/slis", self._slis)
-        faults.attach_http(app)
+        # /metrics + the shared introspection table (daemons/common.py)
+        self._obs_routes = attach_obs_routes(app, metrics=True)
         self._app = app
 
     async def start(self) -> None:
@@ -566,44 +554,7 @@ class ProberServer:
             await self._runner.cleanup()
 
     async def _routes(self, _req):
-        return self._web.json_response(
-            ["/metrics", "/events", "/spans", "/history", "/alerts",
-             "/slis", "/faults"])
-
-    async def _metrics(self, _req):
-        from manatee_tpu.obs.process import refresh_process_metrics
-        from manatee_tpu.utils.prom import MetricsBuilder
-        refresh_process_metrics()
-        b = MetricsBuilder("manatee")
-        get_registry().render_into(b)
-        return self._web.Response(text=b.render(),
-                                  content_type="text/plain")
-
-    async def _events(self, req):
-        from manatee_tpu.obs.spans import parse_page_query
-        journal = get_journal()
-        try:
-            since, limit = parse_page_query(req.query)
-        except ValueError:
-            return self._web.json_response(
-                {"error": "since/limit must be integers"}, status=400)
-        return self._web.json_response({
-            "peer": journal.peer,
-            "now": round(time.time(), 3),
-            "events": journal.events(since=since, limit=limit),
-        })
-
-    async def _spans(self, req):
-        body, status = spans_http_reply(get_span_store(), req.query)
-        return self._web.json_response(body, status=status)
-
-    async def _history(self, req):
-        body, status = history_http_reply(get_history(), req.query)
-        return self._web.json_response(body, status=status)
-
-    async def _alerts(self, req):
-        body, status = alerts_http_reply(get_slo_engine(), req.query)
-        return self._web.json_response(body, status=status)
+        return self._web.json_response(["/slis"] + self._obs_routes)
 
     async def _slis(self, _req):
         """Per-shard instantaneous SLIs — what `manatee-adm top`
@@ -662,6 +613,7 @@ async def start_prober(cfg: dict):
     engines = EngineCache()
     probers = [ShardProber(c, engines, slo_engine)
                for c in shard_cfgs]
+    intro = start_daemon_introspection(cfg)
     server = ProberServer(probers, host=host, port=port)
     await server.start()
     for p in probers:
@@ -689,6 +641,7 @@ async def start_prober(cfg: dict):
             await recorder.stop()
         await engines.aclose()
         await server.stop()
+        await intro.stop()
 
     return stop
 

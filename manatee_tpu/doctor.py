@@ -538,3 +538,51 @@ def check_cluster(state: dict | None, history: list[dict],
             "stored state is at %d — the store rolled back an acked "
             "transition" % (max(seen), state["generation"])))
     return out
+
+
+# ---- runtime <-> static cross-check (obs/profile.py's monitor) ----
+
+def check_introspection(events: list[dict]) -> list[dict]:
+    """Pure checks over the merged event journal's loop-health
+    records.  An ``obs.lint.discrepancy`` means the blocked-loop
+    watchdog caught a stack stalling the event loop INSIDE a function
+    mnt-lint's blocking-call rules were told to ignore (a path
+    disable or an inline suppression) — runtime evidence the static
+    exemption hides a real blocking call.  Raw ``obs.loop.stall``
+    events are NOTEs: real, but already on `manatee-adm top`'s
+    STALLS column; the discrepancy is the actionable finding."""
+    out: list[dict] = []
+    seen: set = set()
+    stalls: dict[str, int] = {}
+    worst: dict[str, float] = {}
+    for ev in events or []:
+        name = ev.get("event")
+        if name == "obs.lint.discrepancy":
+            key = (ev.get("file"), ev.get("line"), ev.get("rule"))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(finding(
+                WARNING, "lint-exemption-blocks",
+                "%s:%s" % (ev.get("file"), ev.get("line")),
+                "the event loop stalled inside %s(), but the %s "
+                "rule is exempted there via %s — the static "
+                "exemption hides a real blocking call; fix the "
+                "call or drop the exemption"
+                % (ev.get("func"), ev.get("rule") or "blocking-call",
+                   ev.get("via") or "suppression")))
+        elif name == "obs.loop.stall":
+            peer = ev.get("peer") or "?"
+            stalls[peer] = stalls.get(peer, 0) + 1
+            try:
+                blocked = float(ev.get("blocked_s") or 0.0)
+            except (TypeError, ValueError):
+                blocked = 0.0
+            worst[peer] = max(worst.get(peer, 0.0), blocked)
+    for peer in sorted(stalls):
+        out.append(finding(
+            NOTE, "loop-stalls", peer,
+            "%d event-loop stall(s) journaled (worst %.3fs); "
+            "`manatee-adm events -e obs.loop.stall` has the "
+            "captured stacks" % (stalls[peer], worst[peer])))
+    return out

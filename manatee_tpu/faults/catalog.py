@@ -117,6 +117,20 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/obs/history.py",),
         ("error", "delay", "stall", "crash"),
     ),
+    "obs.loop.tick": (
+        "loop monitor's self-timing tick (each pass); stall wedges "
+        "the tick coroutine WITHOUT blocking the loop — the watchdog "
+        "must not report a stall for it",
+        ("manatee_tpu/obs/profile.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "obs.profile.sample": (
+        "profiler's aggregation pass (pending folded stacks -> the "
+        "bounded ring), on the event loop; error/stall starve "
+        "GET /profile of fresh buckets but never the daemon",
+        ("manatee_tpu/obs/profile.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
     "pg.catchup": (
         "primary's wait-for-standby-catchup poll loop (each pass); "
         "stall keeps the primary read-only — a stalled takeover",

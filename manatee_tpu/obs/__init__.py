@@ -57,6 +57,20 @@ from manatee_tpu.obs.trace import (
     new_trace_id,
 )
 
+# imported last: profile.py reads the journal/metrics/spans/trace
+# singletons above (the sampling profiler, event-loop monitor, and
+# task census — the runtime introspection plane)
+from manatee_tpu.obs.profile import (  # noqa: E402
+    LoopMonitor,
+    SamplingProfiler,
+    get_loop_monitor,
+    get_profiler,
+    profile_http_reply,
+    start_introspection,
+    tasks_http_reply,
+    tasks_payload,
+)
+
 
 def set_peer(peer_id: str) -> None:
     """Stamp this process's peer identity onto subsequent journal
@@ -71,7 +85,9 @@ __all__ = [
     "EventJournal",
     "Gauge",
     "Histogram",
+    "LoopMonitor",
     "Registry",
+    "SamplingProfiler",
     "Span",
     "SpanStore",
     "TraceLogFilter",
@@ -81,13 +97,19 @@ __all__ = [
     "current_trace",
     "ensure_trace",
     "get_journal",
+    "get_loop_monitor",
+    "get_profiler",
     "get_registry",
     "get_span_store",
     "new_span_id",
     "new_trace_id",
+    "profile_http_reply",
     "record_span",
     "set_peer",
     "set_span_peer",
     "span",
+    "start_introspection",
+    "tasks_http_reply",
+    "tasks_payload",
     "traced",
 ]

@@ -1,0 +1,736 @@
+"""Continuous profiling plane: sampling profiler, event-loop health,
+live task census.
+
+The rest of ``manatee_tpu/obs`` can say *that* time was spent (spans,
+critical path) and *that* clients were hurt (prober, burn rates); this
+module says what the CPU and the event loop were actually *doing*.
+Three always-on surfaces, wired into every daemon's listener by
+``daemons/common.attach_obs_routes``:
+
+- a **sampling wall-clock profiler** (:class:`SamplingProfiler`): a
+  background thread samples ``sys._current_frames()`` at a configurable
+  rate, folds each thread's stack into a collapsed-stack string, and
+  accumulates counts.  An async drain task moves the accumulated
+  counts into a bounded time-bucketed ring about once a second (the
+  ``obs.profile.sample`` failpoint seam), so ``GET /profile?seconds=N``
+  can answer for any recent window in folded-stack format — one
+  ``frame;frame;frame count`` line per distinct stack, ready for
+  ``tools/flamegraph`` or any flamegraph renderer.  The sampler meters
+  its own CPU (``profiler_self_seconds_total``) so the overhead budget
+  is a measured number, not a promise;
+- an **event-loop health monitor** (:class:`LoopMonitor`): a self-timing
+  tick coroutine (the ``obs.loop.tick`` seam) feeds the overshoot of
+  every sleep into the ``event_loop_lag_seconds`` histogram, while a
+  watchdog thread detects a *blocked* loop — a callback holding the
+  loop past ``stall_threshold`` — and, while the loop is still stuck,
+  captures the loop thread's running frame and journals
+  ``obs.loop.stall`` with the offending stack.  The runtime detector
+  also audits the static allowlist: a stalled frame that mnt-lint's
+  blocking-call rules *exempt* (path-disable or an inline suppression)
+  is journaled as ``obs.lint.discrepancy`` for `manatee-adm doctor`;
+- a **live task census** (:func:`tasks_payload`, ``GET /tasks``): every
+  asyncio task's name, age, innermost frame, and bound trace/span id —
+  task leaks become observable the way open spans already are.
+
+Everything here is stdlib-only and allocation-light, and every loop
+swallows its own errors: observability must never be able to hurt HA.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from pathlib import Path
+
+from manatee_tpu import faults
+from manatee_tpu.obs import spans as _spans_mod
+from manatee_tpu.obs import trace as _trace_mod
+from manatee_tpu.obs.journal import get_journal
+from manatee_tpu.obs.metrics import get_registry
+
+log = logging.getLogger("manatee.obs.profile")
+
+DEFAULT_HZ = 20.0          # sampling passes per second (0 = off)
+DEFAULT_TICK = 0.25        # loop-monitor tick interval, seconds
+DEFAULT_STALL = 1.0        # loop blocked longer than this = a stall
+DRAIN_INTERVAL = 1.0       # pending samples -> ring, seconds
+RING_WINDOW = 600.0        # how far back GET /profile can reach
+MAX_STACK_DEPTH = 64
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# mnt-lint's runtime counterparts (lint/rules_async.py): a stall caught
+# inside a frame these rules were told to ignore is a discrepancy
+_BLOCK_RULES = frozenset({"blocking-call-in-async",
+                          "blocking-io-in-async"})
+
+# code object -> collapsed-stack frame label (code objects are few and
+# long-lived; caching them bounds per-sample allocation)
+_LABELS: dict = {}
+
+# (root, code-object chain) -> folded string.  Labels carry no line
+# numbers, so the same call path always folds identically; caching the
+# whole fold turns the hot sampling path into one tuple build + one
+# dict hit.  Distinct call paths are finite but unbounded in theory,
+# so the cache is dropped wholesale if it ever balloons.
+_FOLDS: dict = {}
+_FOLDS_MAX = 4096
+
+
+def _short_path(filename: str) -> str:
+    """Repo-relative path for tree files, basename for everything
+    else — short enough to read in a flamegraph box."""
+    for marker in ("/manatee_tpu/", "/tests/", "/tools/"):
+        i = filename.rfind(marker)
+        if i >= 0:
+            return filename[i + 1:]
+    return os.path.basename(filename)
+
+
+def _label(code) -> str:
+    lbl = _LABELS.get(code)
+    if lbl is None:
+        name = getattr(code, "co_qualname", None) or code.co_name
+        lbl = "%s:%s" % (_short_path(code.co_filename), name)
+        # ';' separates frames and ' ' separates stack from count in
+        # the folded format; neither may leak out of a label
+        lbl = lbl.replace(";", ":").replace(" ", "_")
+        _LABELS[code] = lbl
+    return lbl
+
+
+def _fold_stack(frame, root: str) -> str:
+    """One thread's stack as a collapsed-stack string, outermost
+    first, rooted at the thread name."""
+    codes = []
+    f = frame
+    while f is not None and len(codes) < MAX_STACK_DEPTH:
+        codes.append(f.f_code)
+        f = f.f_back
+    key = (root, tuple(codes))
+    folded = _FOLDS.get(key)
+    if folded is None:
+        if len(_FOLDS) >= _FOLDS_MAX:
+            _FOLDS.clear()
+        parts = [_label(c) for c in codes]
+        parts.append(root.replace(";", ":").replace(" ", "_"))
+        parts.reverse()
+        folded = ";".join(parts)
+        _FOLDS[key] = folded
+    return folded
+
+
+def _frame_list(frame, limit: int = MAX_STACK_DEPTH) -> list[tuple]:
+    """Innermost-first ``(path, line, func)`` triples for a captured
+    frame — what the stall journal entry and the lint cross-check
+    consume."""
+    out = []
+    f = frame
+    while f is not None and len(out) < limit:
+        code = f.f_code
+        out.append((_short_path(code.co_filename), f.f_lineno,
+                    code.co_name))
+        f = f.f_back
+    return out
+
+
+def render_folded(agg: dict) -> str:
+    """Folded-stack text: ``stack count`` per line, hottest first."""
+    lines = ["%s %d" % (stack, count)
+             for stack, count in sorted(agg.items(),
+                                        key=lambda kv: (-kv[1], kv[0]))]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def top_self_stack(agg: dict) -> tuple[str, int] | None:
+    """The hottest collapsed stack (self time = sample count, since
+    every sample attributes to exactly one leaf stack)."""
+    if not agg:
+        return None
+    stack = max(agg, key=lambda s: (agg[s], s))
+    return stack, agg[stack]
+
+
+# ---- sampling profiler ----
+
+class SamplingProfiler:
+    """Wall-clock sampling of every thread but its own.
+
+    The sampler thread folds stacks into a lock-protected pending dict;
+    :meth:`drain_forever` (run on the event loop, so the
+    ``obs.profile.sample`` seam is awaitable) moves pending counts into
+    a bounded ring of ``(ts, counts, n_samples)`` buckets about once a
+    second.  :meth:`folded` merges the buckets newer than a cutoff.
+    """
+
+    def __init__(self, hz: float = DEFAULT_HZ,
+                 window: float = RING_WINDOW):
+        self.hz = float(hz)
+        self.window = float(window)
+        self._lock = threading.Lock()
+        self._pending: dict[str, int] = {}
+        self._pending_n = 0
+        self._buckets: deque = deque(
+            maxlen=max(2, int(window / DRAIN_INTERVAL) + 1))
+        self._thread: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+        self._names: dict[int, str] = {}
+        # tid -> (frame, f_lasti, folded): a thread parked at the
+        # same bytecode position (an idle selector poll, a waiting
+        # Event) has by definition the same stack — the caller chain
+        # of a live activation is immutable — so the previous fold is
+        # reused without walking a single frame.  The held frame
+        # reference pins that activation for at most one sample
+        # interval (it is replaced or pruned on the next pass).
+        self._last: dict[int, tuple] = {}
+        self.started_at: float | None = None
+        reg = get_registry()
+        self._c_samples = reg.counter(
+            "profiler_samples_total",
+            "sampling passes the wall-clock profiler has taken")
+        self._c_self = reg.counter(
+            "profiler_self_seconds_total",
+            "CPU consumed by the profiler's own sampling thread — "
+            "the measured overhead the bench budget is judged against")
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.running or self.hz <= 0:
+            return
+        self.started_at = time.time()
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="manatee-profiler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        # metric updates are batched to ~1/s: a contended counter lock
+        # at sampling rate would itself show up in the overhead budget
+        flush_every = max(1, int(self.hz))
+        passes, self_cpu = 0, 0.0
+        while not self._stop_evt.wait(interval):
+            t0 = time.thread_time()
+            try:
+                self.sample_once()
+            except Exception:           # pragma: no cover - paranoia
+                pass                    # sampling must never hurt HA
+            self_cpu += max(0.0, time.thread_time() - t0)
+            passes += 1
+            if passes >= flush_every:
+                self._c_samples.inc(passes)
+                self._c_self.inc(self_cpu)
+                passes, self_cpu = 0, 0.0
+        if passes:
+            self._c_samples.inc(passes)
+            self._c_self.inc(self_cpu)
+
+    def sample_once(self) -> None:
+        me = threading.get_ident()
+        frames = sys._current_frames()
+        # thread names change ~never: refresh the tid->name map only
+        # when a tid is missing (a new thread) instead of paying
+        # threading.enumerate() every sample
+        names = self._names
+        if any(tid not in names for tid in frames):
+            names = {t.ident: t.name for t in threading.enumerate()}
+            self._names = names
+        last = self._last
+        folded = []
+        for tid, frame in frames.items():
+            if tid == me:
+                continue
+            prev = last.get(tid)
+            if prev is not None and prev[0] is frame \
+                    and prev[1] == frame.f_lasti:
+                folded.append(prev[2])
+                continue
+            name = names.get(tid)
+            if name is None:
+                name = "thread-%d" % tid
+            s = _fold_stack(frame, name)
+            last[tid] = (frame, frame.f_lasti, s)
+            folded.append(s)
+        if len(last) > len(frames):
+            # dead threads must not pin their final frame forever
+            for tid in [t for t in last if t not in frames]:
+                del last[tid]
+        with self._lock:
+            for s in folded:
+                self._pending[s] = self._pending.get(s, 0) + 1
+            self._pending_n += 1
+
+    def drain_once(self) -> None:
+        with self._lock:
+            if not self._pending_n:
+                return
+            counts, n = self._pending, self._pending_n
+            self._pending, self._pending_n = {}, 0
+        self._buckets.append((time.time(), counts, n))
+
+    async def drain_forever(self,
+                            interval: float = DRAIN_INTERVAL) -> None:
+        while True:
+            try:
+                await asyncio.sleep(interval)
+                await faults.point("obs.profile.sample")
+                self.drain_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # an injected error must not kill the drain (and must
+                # not spin it either: the sleep above already paced us)
+                log.debug("profile drain failed: %s", e)
+
+    def folded(self, seconds: float = 30.0) -> tuple[dict, int]:
+        """``(stack -> count, total samples)`` over the trailing
+        *seconds*, undrained pending samples included."""
+        cutoff = time.time() - float(seconds)
+        with self._lock:
+            buckets = list(self._buckets)
+            agg = dict(self._pending)
+            total = self._pending_n
+        for ts, counts, n in buckets:
+            if ts < cutoff:
+                continue
+            total += n
+            for s, c in counts.items():
+                agg[s] = agg.get(s, 0) + c
+        return agg, total
+
+
+# ---- event-loop health monitor ----
+
+def _loop_is_idle(frames: list[tuple]) -> bool:
+    """True when the loop thread's innermost frame is the selector
+    poll — the loop is *waiting*, not blocked (seen when the tick
+    coroutine itself is wedged, e.g. by an armed ``obs.loop.tick``
+    stall: the loop stays healthy, so no stall may be reported)."""
+    return bool(frames) and frames[0][0] in ("selectors.py",
+                                             "selector_events.py")
+
+
+class LoopMonitor:
+    """Self-timing tick coroutine + blocked-loop watchdog thread.
+
+    The tick coroutine measures how late every ``sleep(interval)``
+    wakes (``event_loop_lag_seconds``) and stamps ``_last_tick``; the
+    watchdog thread notices the stamp going stale past
+    ``stall_threshold`` and — while the loop is still blocked —
+    captures the loop thread's frame via ``sys._current_frames()``,
+    bumps ``event_loop_stalls_total``, and journals ``obs.loop.stall``
+    with the offending stack (once per stall episode).  Journal and
+    metric writes are plain dict/deque operations, safe from a thread.
+    """
+
+    def __init__(self, tick_interval: float = DEFAULT_TICK,
+                 stall_threshold: float = DEFAULT_STALL,
+                 lint_check: bool = True):
+        self.tick_interval = float(tick_interval)
+        self.stall_threshold = float(stall_threshold)
+        self.lint_check = lint_check
+        self._task: asyncio.Task | None = None
+        self._watchdog: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+        self._factory_loop = None
+        self._prev_factory = None
+        self._factory = None
+        self._loop_tid: int | None = None
+        self._last_tick: float | None = None
+        self._stall_open = False
+        self._first_seen: weakref.WeakKeyDictionary = \
+            weakref.WeakKeyDictionary()
+        # recent captured stalls, newest last (tests and /tasks don't
+        # need to trawl the journal for them)
+        self.stalls: deque = deque(maxlen=64)
+        reg = get_registry()
+        self._h_lag = reg.histogram(
+            "event_loop_lag_seconds",
+            "how late the monitor's event-loop tick wakes up — "
+            "scheduling lag every coroutine on this loop experiences")
+        self._c_stalls = reg.counter(
+            "event_loop_stalls_total",
+            "times a callback blocked the event loop past the stall "
+            "threshold (each journaled as obs.loop.stall)")
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop_evt.clear()
+        loop = asyncio.get_running_loop()
+        # trace/span capture for the census (see _census_task_factory)
+        self._factory_loop = loop
+        self._prev_factory = loop.get_task_factory()
+        self._factory = _census_task_factory(self._prev_factory)
+        loop.set_task_factory(self._factory)
+        self._task = loop.create_task(
+            self._tick_loop(), name="obs-loop-tick")
+        if self.stall_threshold > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="manatee-loop-watchdog",
+                daemon=True)
+            self._watchdog.start()
+
+    async def stop(self) -> None:
+        self._stop_evt.set()
+        loop = self._factory_loop
+        if loop is not None and not loop.is_closed() \
+                and loop.get_task_factory() is self._factory:
+            # restore only if still ours: never clobber a factory
+            # someone installed on top of the census wrapper
+            loop.set_task_factory(self._prev_factory)
+        self._factory_loop = None
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.join(timeout=2.0)
+
+    def first_seen(self, task) -> float | None:
+        """Epoch time this task was first observed by a tick (None
+        until the monitor has ticked over it) — the census's age."""
+        return self._first_seen.get(task)
+
+    async def _tick_loop(self) -> None:
+        self._loop_tid = threading.get_ident()
+        self._last_tick = time.monotonic()
+        while True:
+            try:
+                await faults.point("obs.loop.tick")
+                t0 = time.monotonic()
+                await asyncio.sleep(self.tick_interval)
+                lag = max(0.0,
+                          time.monotonic() - t0 - self.tick_interval)
+                self._h_lag.observe(lag)
+                self._last_tick = time.monotonic()
+                self._note_tasks()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # an injected error must not kill (or spin) the tick
+                log.debug("loop tick failed: %s", e)
+                await asyncio.sleep(self.tick_interval)
+                self._last_tick = time.monotonic()
+
+    def _note_tasks(self) -> None:
+        now = time.time()
+        for t in asyncio.all_tasks():
+            if t not in self._first_seen:
+                self._first_seen[t] = now
+
+    def _watch(self) -> None:
+        interval = max(0.02, min(self.stall_threshold / 4.0, 0.25))
+        while not self._stop_evt.wait(interval):
+            last, tid = self._last_tick, self._loop_tid
+            if last is None or tid is None:
+                continue
+            blocked = time.monotonic() - last - self.tick_interval
+            if blocked <= self.stall_threshold:
+                self._stall_open = False
+                continue
+            if self._stall_open:
+                continue        # one journal entry per stall episode
+            try:
+                frame = sys._current_frames().get(tid)
+            except Exception:   # pragma: no cover - paranoia
+                continue
+            if frame is None:
+                continue
+            frames = _frame_list(frame)
+            if _loop_is_idle(frames):
+                continue
+            self._stall_open = True
+            try:
+                self._record_stall(blocked, frames)
+            except Exception:   # pragma: no cover - paranoia
+                pass            # the watchdog must never hurt HA
+
+    def _record_stall(self, blocked: float,
+                      frames: list[tuple]) -> None:
+        file, line, func = frames[0]
+        stack = ";".join("%s:%s" % (p, fn)
+                         for p, _ln, fn in reversed(frames))
+        ent = {"blocked_s": round(blocked, 3), "file": file,
+               "line": line, "func": func, "stack": stack}
+        self._c_stalls.inc()
+        get_journal().record("obs.loop.stall", **ent)
+        self.stalls.append(dict(ent))
+        if self.lint_check:
+            disc = find_lint_exemption(frames)
+            if disc is not None:
+                get_journal().record("obs.lint.discrepancy", **disc)
+
+
+# ---- runtime <-> static cross-check (mnt-lint audit) ----
+
+_LINT_CACHE: dict = {"loaded": False, "cfg": None, "sup": {}}
+
+
+def find_lint_exemption(frames: list[tuple]) -> dict | None:
+    """The innermost stalled frame mnt-lint's blocking rules were told
+    to ignore — via ``.mnt-lint.json`` path-disable or an inline
+    ``# mnt-lint: disable=`` suppression — or None.  A hit means the
+    static allowlist exempted code that demonstrably blocks the loop:
+    the runtime detector auditing the static one.
+
+    *frames* is innermost-first ``(path, line, func)`` with
+    repo-relative paths.  Runs only on the rare stall path, so lazily
+    loading the lint config and per-file suppressions is fine.
+    """
+    try:
+        from manatee_tpu.lint.engine import Config, parse_suppressions
+    except Exception:               # pragma: no cover - partial tree
+        return None
+    if not _LINT_CACHE["loaded"]:
+        _LINT_CACHE["loaded"] = True
+        try:
+            p = _REPO_ROOT / ".mnt-lint.json"
+            _LINT_CACHE["cfg"] = (Config.from_file(p) if p.exists()
+                                  else Config())
+        except Exception:
+            _LINT_CACHE["cfg"] = None
+    cfg = _LINT_CACHE["cfg"]
+    for path, line, func in frames:
+        if not path.startswith(("manatee_tpu/", "tests/", "tools/")):
+            continue
+        if cfg is not None:
+            off = _BLOCK_RULES & cfg.disabled_for(path)
+            if off:
+                return {"file": path, "line": line, "func": func,
+                        "rule": sorted(off)[0], "via": "path-disable"}
+        sup = _LINT_CACHE["sup"].get(path)
+        if sup is None:
+            try:
+                sup = parse_suppressions(
+                    (_REPO_ROOT / path).read_text())
+            except Exception:
+                sup = {}
+            _LINT_CACHE["sup"][path] = sup
+        rules = sup.get(line) or set()
+        hit = _BLOCK_RULES & rules
+        if not hit and "all" in rules:
+            hit = _BLOCK_RULES
+        if hit:
+            return {"file": path, "line": line, "func": func,
+                    "rule": sorted(hit)[0], "via": "suppression"}
+    return None
+
+
+# ---- live task census ----
+
+# task -> (trace id, span id) captured at creation.  Before 3.12
+# (Task.get_context) a C task's snapshotted context is unreadable from
+# outside, so the loop monitor wraps the loop's task factory and reads
+# the ids in the CREATING context — by definition the values the new
+# task snapshots.  Weak keys: the census must never keep a task alive.
+_TASK_IDS: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+
+
+def _census_task_factory(prev):
+    def factory(loop, coro, **kw):
+        task = (prev(loop, coro, **kw) if prev is not None
+                else asyncio.Task(coro, loop=loop, **kw))
+        try:
+            ids = (_trace_mod._current.get(),
+                   _spans_mod._current_span.get())
+            if ids != (None, None):
+                _TASK_IDS[task] = ids
+        except Exception:       # pragma: no cover - paranoia
+            pass                # the census must never hurt HA
+        return task
+    return factory
+
+
+def _task_where(task) -> str | None:
+    """``path:func:line`` of the innermost frame of the task's
+    coroutine chain (follow ``cr_await`` down to where it is actually
+    suspended)."""
+    try:
+        obj = task.get_coro()
+    except Exception:
+        return None
+    frame = None
+    for _ in range(MAX_STACK_DEPTH):
+        if obj is None:
+            break
+        f = getattr(obj, "cr_frame", None) \
+            or getattr(obj, "gi_frame", None)
+        if f is not None:
+            frame = f
+        obj = getattr(obj, "cr_await", None) \
+            or getattr(obj, "gi_yieldfrom", None)
+    if frame is None:
+        return None
+    code = frame.f_code
+    return "%s:%s:%d" % (_short_path(code.co_filename), code.co_name,
+                         frame.f_lineno)
+
+
+def _task_context_ids(task) -> tuple:
+    """(trace id, span id) bound in the task's snapshotted context —
+    ``Task.get_context`` where available (3.12+), the private
+    ``_context`` on pure-Python tasks, else the loop monitor's
+    creation-time capture (``_census_task_factory``).
+    ``contextvars.Context`` is a mapping, so no path enters the
+    context."""
+    get_ctx = getattr(task, "get_context", None)
+    try:
+        ctx = (get_ctx() if callable(get_ctx)
+               else getattr(task, "_context", None))
+        if ctx is not None:
+            return (ctx.get(_trace_mod._current, None),
+                    ctx.get(_spans_mod._current_span, None))
+    except Exception:
+        pass
+    # a C task before 3.12: fall back to the creation-time capture
+    return _TASK_IDS.get(task, (None, None))
+
+
+def tasks_payload() -> dict:
+    """Every live asyncio task on the running loop: name, age (since
+    the loop monitor first saw it), innermost frame, bound trace/span.
+    Must be called from the loop (the HTTP handlers are)."""
+    now = round(time.time(), 3)
+    mon = get_loop_monitor()
+    try:
+        live = asyncio.all_tasks()
+    except RuntimeError:
+        live = set()
+    items = []
+    for t in live:
+        trace_id, span_id = _task_context_ids(t)
+        first = mon.first_seen(t) if mon is not None else None
+        items.append({
+            "name": t.get_name(),
+            "age_s": (round(now - first, 3)
+                      if first is not None else None),
+            "where": _task_where(t),
+            "trace": trace_id,
+            "span": span_id,
+        })
+    items.sort(key=lambda i: (-(i["age_s"] or 0.0), i["name"]))
+    return {"peer": get_journal().peer, "now": now,
+            "count": len(items), "tasks": items}
+
+
+# ---- pure HTTP endpoint helpers (one contract on every listener) ----
+
+def profile_http_reply(profiler, query) -> tuple:
+    """``GET /profile?seconds=N`` -> (body, status): folded-stack text
+    (str body) on 200, an error object (dict body) on 400/503."""
+    if profiler is None or not profiler.running:
+        return {"error": "profiler not running"}, 503
+    raw = query.get("seconds", "30")
+    try:
+        seconds = float(raw)
+        if not seconds > 0:
+            raise ValueError(raw)
+    except (TypeError, ValueError):
+        return {"error": "seconds must be a positive number"}, 400
+    agg, _total = profiler.folded(seconds)
+    return render_folded(agg), 200
+
+
+def tasks_http_reply(query) -> tuple:
+    """``GET /tasks?name=SUBSTR`` -> (body, status)."""
+    body = tasks_payload()
+    substr = query.get("name")
+    if substr:
+        body["tasks"] = [t for t in body["tasks"]
+                         if substr in (t["name"] or "")]
+        body["count"] = len(body["tasks"])
+    return body, 200
+
+
+# ---- daemon wiring ----
+
+_PROFILER: SamplingProfiler | None = None
+_MONITOR: LoopMonitor | None = None
+
+
+def get_profiler() -> SamplingProfiler | None:
+    return _PROFILER
+
+
+def get_loop_monitor() -> LoopMonitor | None:
+    return _MONITOR
+
+
+class Introspection:
+    """Handle returned by :func:`start_introspection`; ``await
+    stop()`` unwinds everything it started."""
+
+    def __init__(self, profiler, monitor, drain_task):
+        self.profiler = profiler
+        self.monitor = monitor
+        self._drain = drain_task
+
+    async def stop(self) -> None:
+        global _PROFILER, _MONITOR
+        if self._drain is not None:
+            self._drain.cancel()
+            try:
+                await self._drain
+            except asyncio.CancelledError:
+                pass
+            self._drain = None
+        if self.monitor is not None:
+            await self.monitor.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
+        if _PROFILER is self.profiler:
+            _PROFILER = None
+        if _MONITOR is self.monitor:
+            _MONITOR = None
+
+
+def start_introspection(cfg: dict | None = None) -> Introspection:
+    """Wire the always-on introspection plane for this process (called
+    from every daemon's startup, inside the running loop).  Config
+    keys, all optional: ``profileHz`` (0 disables the sampler),
+    ``loopTickInterval``, ``loopStallThreshold`` (0 disables the
+    blocked-loop watchdog; the lag histogram stays on)."""
+    global _PROFILER, _MONITOR
+    cfg = cfg or {}
+    hz = float(cfg.get("profileHz", DEFAULT_HZ))
+    loop = asyncio.get_running_loop()
+    profiler = None
+    drain = None
+    if hz > 0:
+        profiler = SamplingProfiler(hz=hz)
+        profiler.start()
+        drain = loop.create_task(profiler.drain_forever(),
+                                 name="obs-profile-drain")
+    monitor = LoopMonitor(
+        tick_interval=float(cfg.get("loopTickInterval", DEFAULT_TICK)),
+        stall_threshold=float(cfg.get("loopStallThreshold",
+                                      DEFAULT_STALL)))
+    monitor.start()
+    _PROFILER, _MONITOR = profiler, monitor
+    return Introspection(profiler, monitor, drain)

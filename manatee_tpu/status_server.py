@@ -20,6 +20,10 @@ scrape bunyan logs):
 - ``GET /spans``   this peer's completed-span ring
   (``?since=SEQ&limit=N&trace=ID``) plus its open spans — the per-peer
   feed `manatee-adm trace` reassembles into the cross-peer tree;
+- ``GET /profile`` folded-stack output of the sampling profiler and
+  ``GET /tasks`` the live asyncio task census (``obs/profile.py``) —
+  mounted, like every introspection route above, through the shared
+  table in ``daemons/common.attach_obs_routes``;
 - ``GET/POST/DELETE /faults`` the sitter process's live fault-injection
   surface (`manatee_tpu.faults`): list armed rules + the failpoint
   catalog, arm by spec, disarm — what `manatee-adm fault` talks to.
@@ -37,15 +41,11 @@ process-wide — journal, spans, and fault registry are per process.
 from __future__ import annotations
 
 import logging
-import time
 
 from aiohttp import web
 
-from manatee_tpu import faults
-from manatee_tpu.obs import get_journal, get_registry, get_span_store
-from manatee_tpu.obs.history import get_history, history_http_reply
-from manatee_tpu.obs.slo import alerts_http_reply, get_slo_engine
-from manatee_tpu.obs.spans import parse_page_query, spans_http_reply
+from manatee_tpu.daemons.common import attach_obs_routes
+from manatee_tpu.obs import get_journal, get_registry
 
 log = logging.getLogger("manatee.status")
 
@@ -93,15 +93,13 @@ class StatusServer:
         app.router.add_get("/state", self._state)
         app.router.add_get("/restore", self._restore)
         app.router.add_get("/metrics", self._metrics)
-        app.router.add_get("/events", self._events)
-        app.router.add_get("/spans", self._spans)
-        app.router.add_get("/history", self._history)
-        app.router.add_get("/alerts", self._alerts)
         app.router.add_get("/shards", self._shards)
         app.router.add_get("/shards/{shard}/ping", self._ping)
         app.router.add_get("/shards/{shard}/state", self._state)
         app.router.add_get("/shards/{shard}/restore", self._restore)
-        faults.attach_http(app)
+        # /events, /spans, /history, /alerts, /profile, /tasks, /faults
+        # — the shared table every listener mounts (daemons/common.py)
+        self._obs_routes = attach_obs_routes(app)
         self._app = app
 
     async def start(self) -> None:
@@ -132,8 +130,8 @@ class StatusServer:
         return None
 
     async def _routes(self, _req: web.Request) -> web.Response:
-        routes = ["/ping", "/state", "/restore", "/metrics", "/events",
-                  "/spans", "/history", "/alerts", "/faults", "/shards"]
+        routes = ["/ping", "/state", "/restore", "/metrics",
+                  "/shards"] + self._obs_routes
         if self._fleet:
             routes += ["/shards/%s/%s" % (e.name, leaf)
                        for e in self._entries
@@ -191,44 +189,6 @@ class StatusServer:
         if e.name is not None:
             body["shard"] = e.name
         return web.json_response(body)
-
-    async def _events(self, req: web.Request) -> web.Response:
-        """The peer's event journal, oldest first.  ?since=SEQ returns
-        only events after that per-process sequence number (incremental
-        tailing); ?limit=N keeps the newest N of what remains."""
-        journal = get_journal()
-        try:
-            since, limit = parse_page_query(req.query)
-        except ValueError:
-            return web.json_response(
-                {"error": "since/limit must be integers"}, status=400,
-                content_type="application/json")
-        return web.json_response({
-            "peer": journal.peer,
-            "now": round(time.time(), 3),
-            "events": journal.events(since=since, limit=limit),
-        }, content_type="application/json")
-
-    async def _spans(self, req: web.Request) -> web.Response:
-        """The peer's completed spans, oldest first, plus its open
-        spans; ?trace=ID filters to one trace's records."""
-        body, status = spans_http_reply(get_span_store(), req.query)
-        return web.json_response(body, status=status,
-                                 content_type="application/json")
-
-    async def _history(self, req: web.Request) -> web.Response:
-        """The on-disk metric-history ring (obs/history.py); 404 when
-        this daemon runs without a historyDir."""
-        body, status = history_http_reply(get_history(), req.query)
-        return web.json_response(body, status=status,
-                                 content_type="application/json")
-
-    async def _alerts(self, req: web.Request) -> web.Response:
-        """Active SLO burn-rate alerts (obs/slo.py); 404 on daemons
-        that do not evaluate SLOs (the prober does)."""
-        body, status = alerts_http_reply(get_slo_engine(), req.query)
-        return web.json_response(body, status=status,
-                                 content_type="application/json")
 
     async def _metrics(self, _req: web.Request) -> web.Response:
         """Prometheus text exposition: state-derived gauges (labeled

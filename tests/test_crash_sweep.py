@@ -108,6 +108,14 @@ REPO = Path(__file__).resolve().parent.parent
 #                 in-memory engine, crashes at the probe seam, and a
 #                 clean rerun completes the probe cycle (the prober
 #                 itself holds no durable state to damage)
+#   profile_subproc
+#                 the introspection plane (obs/profile.py) runs in
+#                 every daemon but holds no durable state: a child
+#                 process runs the sampling profiler's drain task and
+#                 the loop monitor's tick at high rate, crashes AT the
+#                 armed seam within a few passes, and a clean rerun
+#                 proves the plane works end to end (folded /profile
+#                 body, observed loop-lag ticks)
 #
 # variant: "exit" (default, os._exit → CRASH_EXIT_CODE) or "kill"
 # (SIGKILL-to-self → waitpid -SIGKILL); both variants are exercised.
@@ -129,6 +137,9 @@ SCENARIOS: dict[str, dict] = {
     "coordd.dispatch":      dict(kind="coordd", variant="kill"),
     "coordd.oplog.append":  dict(kind="coordd", induce="freeze"),
     "obs.history.append":   dict(kind="history_subproc"),
+    "obs.loop.tick":        dict(kind="profile_subproc"),
+    "obs.profile.sample":   dict(kind="profile_subproc",
+                                 variant="kill"),
     "pg.catchup":           dict(kind="takeover", variant="kill"),
     "pg.promote":           dict(kind="takeover"),
     "pg.repoint":           dict(kind="repoint"),
@@ -150,13 +161,14 @@ SCENARIOS: dict[str, dict] = {
 # on a backupserver (sender), runtime --url on coordd, and the
 # subprocess zfs driver — with both crash variants present.  The
 # repoint and primary_write families ride the full chaos-cadence sweep
-# only; anything here also runs there.  The two observability
-# subprocess drivers (history writer, prober) are cluster-free and
-# cheap, so each surface sends a representative.
+# only; anything here also runs there.  The observability subprocess
+# drivers (history writer, prober, introspection plane) are
+# cluster-free and cheap, so each surface sends a representative.
 FAST_POINTS = {"backup.post", "coord.client.send",
                "backup.send.stream", "coordd.dispatch",
                "pg.promote", "storage.zfs.exec",
-               "obs.history.append", "prober.write"}
+               "obs.history.append", "obs.loop.tick",
+               "prober.write"}
 
 
 def test_sweep_covers_every_failpoint():
@@ -446,6 +458,51 @@ def _run_prober_subproc_scenario(tmp_path, point: str, scn: dict
     assert "probe-ok" in cp.stdout
 
 
+def _run_profile_subproc_scenario(tmp_path, point: str, scn: dict
+                                  ) -> None:
+    """Crash the introspection plane at its two seams (the profiler's
+    drain pass, the loop monitor's tick).  Like the prober it holds no
+    durable state, so 'recovery' is the plane's contract itself: a
+    clean rerun samples real stacks into the ring (a non-empty folded
+    /profile body) and observes loop-lag ticks."""
+    script = (
+        "import asyncio\n"
+        "from manatee_tpu.obs.profile import (\n"
+        "    LoopMonitor, SamplingProfiler, profile_http_reply)\n"
+        "async def main():\n"
+        "    prof = SamplingProfiler(hz=200.0)\n"
+        "    prof.start()\n"
+        "    mon = LoopMonitor(tick_interval=0.02, stall_threshold=0)\n"
+        "    mon.start()\n"
+        "    drain = asyncio.get_running_loop().create_task(\n"
+        "        prof.drain_forever(interval=0.05))\n"
+        "    await asyncio.sleep(0.5)\n"
+        "    body, status = profile_http_reply(prof,\n"
+        "                                      {'seconds': '30'})\n"
+        "    assert status == 200 and body, (status, body)\n"
+        "    assert mon._h_lag.snapshot()['count'] > 0, 'no ticks'\n"
+        "    drain.cancel()\n"
+        "    await mon.stop()\n"
+        "    prof.stop()\n"
+        "    print('profile-ok')\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_FAULTS": spec_for(point, variant)}
+    cp = subprocess.run([sys.executable, "-c", script],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "profile-ok" not in cp.stdout
+    env.pop("MANATEE_FAULTS")
+    cp = subprocess.run([sys.executable, "-c", script],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "profile-ok" in cp.stdout
+
+
 @pytest.mark.parametrize(
     "point",
     [pytest.param(p,
@@ -466,6 +523,9 @@ def test_crash_at_seam(tmp_path, point):
         return
     if scn["kind"] == "prober_subproc":
         _run_prober_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "profile_subproc":
+        _run_profile_subproc_scenario(tmp_path, point, scn)
         return
 
     async def go():

@@ -1,0 +1,417 @@
+"""Unit tier for the introspection plane (obs/profile.py): folded-stack
+mechanics and sampler correctness (including the frame-identity memo),
+the /profile and /tasks endpoint contracts, the event-loop monitor's
+lag histogram and blocked-loop watchdog (one journal entry per stall
+episode), the runtime<->static lint cross-check, and the shared
+attach_obs_routes table every daemon listener mounts."""
+
+import asyncio
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.obs import trace as trace_mod
+from manatee_tpu.obs.journal import get_journal
+from manatee_tpu.obs.profile import (
+    LoopMonitor,
+    SamplingProfiler,
+    _LINT_CACHE,
+    _fold_stack,
+    _loop_is_idle,
+    find_lint_exemption,
+    get_loop_monitor,
+    get_profiler,
+    profile_http_reply,
+    render_folded,
+    start_introspection,
+    tasks_http_reply,
+    tasks_payload,
+    top_self_stack,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def journal_since(cursor: int, event: str) -> list[dict]:
+    return [e for e in get_journal().events(since=cursor)
+            if e["event"] == event]
+
+
+# ---- folded-stack mechanics ----
+
+def test_render_folded_hottest_first_stable_ties():
+    agg = {"a;b": 2, "z;z": 5, "a;c": 5}
+    text = render_folded(agg)
+    assert text == "a;c 5\nz;z 5\na;b 2\n"
+    assert render_folded({}) == ""
+
+
+def test_top_self_stack():
+    assert top_self_stack({}) is None
+    assert top_self_stack({"a;b": 2, "z;z": 5, "a;c": 5}) == ("z;z", 5)
+
+
+def test_fold_sanitizes_separators():
+    # ';' joins frames and ' ' splits stack from count in the folded
+    # format; neither may survive inside a label or the root
+    ns: dict = {"sys": sys}
+    exec(compile("def f():\n    return sys._getframe()",
+                 "odd dir;file.py", "exec"), ns)
+    folded = _fold_stack(ns["f"](), "we ird;root")
+    parts = folded.split(";")
+    assert parts[0] == "we_ird:root"
+    assert "odd_dir:file.py:f" in parts
+    assert " " not in folded
+
+
+def _parked(evt: threading.Event) -> None:
+    evt.wait()
+
+
+def test_sampler_folds_thread_stacks_and_reuses_parked_frames():
+    evt = threading.Event()
+    th = threading.Thread(target=_parked, args=(evt,),
+                          name="park-probe", daemon=True)
+    th.start()
+    prof = SamplingProfiler(hz=50.0)
+    try:
+        time.sleep(0.05)            # let the thread reach evt.wait()
+        prof.sample_once()
+        prof.sample_once()          # identical stack: memo must count
+        prof.drain_once()
+        agg, total = prof.folded(60.0)
+        assert total == 2
+        mine = [s for s in agg if s.startswith("park-probe;")]
+        assert mine, "parked thread missing from %r" % sorted(agg)
+        assert agg[mine[0]] == 2
+        assert "tests/test_profile.py:_parked" in mine[0].split(";")
+        # the sampler never samples the calling thread
+        caller = threading.current_thread().name
+        assert not any(s.startswith(caller + ";") for s in agg)
+    finally:
+        evt.set()
+        th.join(timeout=2.0)
+
+
+def test_folded_window_cutoff_and_pending():
+    prof = SamplingProfiler(hz=0)
+    prof._buckets.append((time.time() - 100.0, {"old;x": 5}, 5))
+    prof._buckets.append((time.time() - 1.0, {"new;x": 2}, 2))
+    prof._pending = {"pend;y": 1}
+    prof._pending_n = 1
+    agg, total = prof.folded(30.0)
+    assert agg == {"new;x": 2, "pend;y": 1} and total == 3
+    agg, total = prof.folded(300.0)
+    assert agg == {"old;x": 5, "new;x": 2, "pend;y": 1} and total == 8
+
+
+def test_profile_http_reply_contract():
+    assert profile_http_reply(None, {}) == \
+        ({"error": "profiler not running"}, 503)
+    prof = SamplingProfiler(hz=100.0)
+    assert profile_http_reply(prof, {})[1] == 503    # never started
+    prof.start()
+    try:
+        time.sleep(0.1)
+        prof.drain_once()
+        for bad in ("abc", "0", "-1", ""):
+            body, status = profile_http_reply(prof, {"seconds": bad})
+            assert status == 400 and "seconds" in body["error"]
+        body, status = profile_http_reply(prof, {"seconds": "30"})
+        assert status == 200 and isinstance(body, str) and body.strip()
+    finally:
+        prof.stop()
+    assert profile_http_reply(prof, {})[1] == 503    # stopped
+
+
+# ---- live task census ----
+
+def test_tasks_payload_and_name_filter():
+    async def go():
+        # ages come from the PROCESS-WIDE monitor (tasks_payload asks
+        # get_loop_monitor), so wire it the way the daemons do
+        intro = start_introspection({"profileHz": 0,
+                                     "loopTickInterval": 0.02,
+                                     "loopStallThreshold": 0})
+        tok = trace_mod._current.set("t-census")
+        task = asyncio.get_running_loop().create_task(
+            asyncio.sleep(30), name="census-probe")
+        trace_mod._current.reset(tok)
+        await asyncio.sleep(0.1)    # a tick must note the task's birth
+        try:
+            body = tasks_payload()
+            assert body["count"] == len(body["tasks"]) >= 2
+            by_name = {t["name"]: t for t in body["tasks"]}
+            assert "obs-loop-tick" in by_name
+            ent = by_name["census-probe"]
+            assert ent["age_s"] is not None and ent["age_s"] >= 0
+            assert ent["trace"] == "t-census"
+            # where is path:func:line of the innermost frame
+            path, func, line = ent["where"].rsplit(":", 2)
+            assert path and func and int(line) > 0
+            filt, status = tasks_http_reply({"name": "census"})
+            assert status == 200 and filt["count"] == 1
+            assert filt["tasks"][0]["name"] == "census-probe"
+            none, status = tasks_http_reply({"name": "no-such-task"})
+            assert status == 200 and none["count"] == 0
+        finally:
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            await intro.stop()
+    run(go())
+
+
+# ---- event-loop health monitor ----
+
+def test_loop_monitor_observes_lag():
+    async def go():
+        mon = LoopMonitor(tick_interval=0.02, stall_threshold=0)
+        before = mon._h_lag.snapshot()["count"]
+        mon.start()
+        assert mon.running
+        await asyncio.sleep(0.15)
+        await mon.stop()
+        assert not mon.running
+        assert mon._h_lag.snapshot()["count"] > before
+    run(go())
+
+
+def test_watchdog_journals_one_stall_per_episode():
+    cursor = get_journal()._seq
+
+    async def go():
+        mon = LoopMonitor(tick_interval=0.02, stall_threshold=0.05)
+        mon.start()
+        await asyncio.sleep(0.1)    # ticks running, watchdog armed
+
+        def blocker(seconds):
+            time.sleep(seconds)     # deliberately blocks the loop
+
+        blocker(0.4)                # episode 1
+        await asyncio.sleep(0.15)   # recover: _stall_open re-arms
+        blocker(0.3)                # episode 2
+        await asyncio.sleep(0.15)
+        await mon.stop()
+        return mon
+
+    mon = run(go())
+    stalls = journal_since(cursor, "obs.loop.stall")
+    assert len(stalls) == 2, stalls
+    ent = stalls[0]
+    assert ent["blocked_s"] >= 0.05
+    assert ent["file"] == "tests/test_profile.py"
+    assert ent["func"] == "blocker"
+    assert ent["stack"].endswith("tests/test_profile.py:blocker")
+    assert list(mon.stalls)[-2:] == \
+        [{k: e[k] for k in ("blocked_s", "file", "line", "func",
+                            "stack")} for e in stalls]
+    # the stalled frame sits in tests/, which .mnt-lint.json exempts
+    # from the blocking rules — exactly what the runtime cross-check
+    # exists to catch
+    disc = journal_since(cursor, "obs.lint.discrepancy")
+    assert disc and disc[0]["via"] == "path-disable"
+    assert disc[0]["rule"] == "blocking-io-in-async"
+    assert disc[0]["file"] == "tests/test_profile.py"
+
+
+def test_idle_selector_poll_is_not_a_stall():
+    assert _loop_is_idle([("selectors.py", 469, "select")])
+    assert _loop_is_idle([("selector_events.py", 120, "_run_once")])
+    assert not _loop_is_idle([("tests/test_profile.py", 1, "f")])
+    assert not _loop_is_idle([])
+
+
+# ---- runtime <-> static lint cross-check ----
+
+@pytest.fixture
+def lint_cache():
+    _LINT_CACHE.update({"loaded": False, "cfg": None, "sup": {}})
+    yield _LINT_CACHE
+    _LINT_CACHE.update({"loaded": False, "cfg": None, "sup": {}})
+
+
+def test_lint_exemption_ignores_frames_outside_the_tree(lint_cache):
+    assert find_lint_exemption([("selectors.py", 1, "select"),
+                                ("asyncio/base_events.py", 2, "run")]) \
+        is None
+
+
+def test_lint_exemption_path_disable(lint_cache):
+    # .mnt-lint.json path-disables blocking-io-in-async for tests/*
+    hit = find_lint_exemption([("selectors.py", 1, "select"),
+                               ("tests/test_profile.py", 10, "go")])
+    assert hit == {"file": "tests/test_profile.py", "line": 10,
+                   "func": "go", "rule": "blocking-io-in-async",
+                   "via": "path-disable"}
+
+
+def test_lint_exemption_inline_suppression(lint_cache):
+    # no blocking-rule suppression exists in the real tree (that is
+    # the point of the cross-check), so seed the per-file suppression
+    # cache for a manatee_tpu/ path, where no path-disable applies
+    lint_cache["sup"]["manatee_tpu/fake_mod.py"] = {
+        10: {"blocking-call-in-async"},
+        11: {"all"},
+    }
+    hit = find_lint_exemption([("manatee_tpu/fake_mod.py", 10, "f")])
+    assert hit == {"file": "manatee_tpu/fake_mod.py", "line": 10,
+                   "func": "f", "rule": "blocking-call-in-async",
+                   "via": "suppression"}
+    # disable=all exempts every rule, the blocking ones included
+    hit = find_lint_exemption([("manatee_tpu/fake_mod.py", 11, "g")])
+    assert hit is not None and hit["via"] == "suppression"
+    # a clean line in the same file is not a discrepancy
+    assert find_lint_exemption(
+        [("manatee_tpu/fake_mod.py", 12, "h")]) is None
+
+
+# ---- daemon wiring ----
+
+def test_start_introspection_lifecycle():
+    async def go():
+        intro = start_introspection({"profileHz": 200.0,
+                                     "loopTickInterval": 0.02,
+                                     "loopStallThreshold": 0})
+        try:
+            assert get_profiler() is intro.profiler
+            assert get_loop_monitor() is intro.monitor
+            assert intro.profiler.running and intro.monitor.running
+            await asyncio.sleep(0.15)
+            names = {t["name"] for t in tasks_payload()["tasks"]}
+            assert {"obs-profile-drain", "obs-loop-tick"} <= names
+            body, status = profile_http_reply(get_profiler(),
+                                              {"seconds": "30"})
+            assert status == 200 and body.strip()
+        finally:
+            await intro.stop()
+        assert get_profiler() is None and get_loop_monitor() is None
+        assert profile_http_reply(get_profiler(), {})[1] == 503
+        names = {t["name"] for t in tasks_payload()["tasks"]}
+        assert "obs-profile-drain" not in names
+        assert "obs-loop-tick" not in names
+    run(go())
+
+
+def test_profile_hz_zero_disables_sampler_only():
+    async def go():
+        intro = start_introspection({"profileHz": 0,
+                                     "loopTickInterval": 0.02,
+                                     "loopStallThreshold": 0})
+        try:
+            assert get_profiler() is None
+            assert get_loop_monitor() is not None
+            assert get_loop_monitor().running
+            assert profile_http_reply(get_profiler(), {})[1] == 503
+        finally:
+            await intro.stop()
+    run(go())
+
+
+def test_attach_obs_routes_serves_the_shared_surface():
+    from aiohttp import web
+
+    from manatee_tpu.daemons.common import OBS_ROUTES, attach_obs_routes
+    from tests.test_partition import http_get
+
+    async def go():
+        app = web.Application()
+        mounted = attach_obs_routes(app, metrics=True)
+        assert mounted[0] == "/metrics"
+        assert set(OBS_ROUTES) <= set(mounted)
+        intro = start_introspection({"profileHz": 100.0,
+                                     "loopTickInterval": 0.02,
+                                     "loopStallThreshold": 0})
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        base = "http://127.0.0.1:%d" % runner.addresses[0][1]
+        try:
+            await asyncio.sleep(0.1)
+            status, body = await http_get(base + "/profile?seconds=30")
+            assert status == 200 and isinstance(body, str)
+            assert body.strip()
+            status, _ = await http_get(base + "/profile?seconds=nope")
+            assert status == 400
+            status, body = await http_get(base + "/tasks")
+            assert status == 200 and body["count"] >= 1
+            status, body = await http_get(base + "/tasks?name=obs-loop")
+            assert status == 200 and body["tasks"]
+            assert all("obs-loop" in t["name"] for t in body["tasks"])
+            status, _ = await http_get(base + "/events")
+            assert status == 200
+            status, _ = await http_get(base + "/spans")
+            assert status == 200
+            status, _ = await http_get(base + "/faults")
+            assert status == 200
+            status, body = await http_get(base + "/metrics")
+            assert status == 200
+            assert "manatee_profiler_samples_total" in body
+            assert "manatee_event_loop_lag_seconds_bucket" in body
+            # surfaces a daemon opts into elsewhere still answer with
+            # their documented not-enabled contract, not a 500
+            status, _ = await http_get(base + "/history")
+            assert status in (200, 404)
+            status, _ = await http_get(base + "/alerts")
+            assert status in (200, 404)
+            await intro.stop()
+            status, _ = await http_get(base + "/profile")
+            assert status == 503
+        finally:
+            await runner.cleanup()
+    run(go())
+
+
+# ---- tools/flamegraph (the folded-stack consumer) ----
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def flamegraph(text: str, *argv: str) -> str:
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "flamegraph"), *argv],
+        input=text, capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    return res.stdout
+
+
+def test_flamegraph_renders_folded_stacks():
+    folded = ("main;a;b 3\nmain;a;c 5\nmain;d 2\n"
+              "this line is not folded\n")
+    svg = flamegraph(folded, "--title", "drill")
+    assert svg.startswith("<svg") and svg.rstrip().endswith("</svg>")
+    # bg + root + main/a/b/c/d boxes; hover titles carry the counts
+    assert svg.count("<rect") >= 7
+    assert "c (5 samples, 50.00%)" in svg
+    assert "a (8 samples, 80.00%)" in svg
+    assert ">drill</text>" in svg
+    # deterministic: a second render is byte-identical (diffable)
+    assert flamegraph(folded, "--title", "drill") == svg
+
+
+def test_flamegraph_escapes_and_survives_empty_input():
+    svg = flamegraph("root;<f>&co 1\n")
+    assert "&lt;f&gt;&amp;co (1 samples" in svg
+    svg = flamegraph("")
+    assert "<svg" in svg and "no samples" in svg
+
+
+def test_flamegraph_roundtrips_profiler_output(tmp_path):
+    # the exact bytes GET /profile serves (via render_folded) are
+    # valid flamegraph input, through the file/-o path make uses
+    agg = {"MainThread;x:run;y:step": 7, "MainThread;x:run": 2,
+           "helper;z:wait": 1}
+    src = tmp_path / "stacks.folded"
+    src.write_text(render_folded(agg))
+    out = tmp_path / "out.svg"
+    flamegraph("", str(src), "-o", str(out))
+    svg = out.read_text()
+    assert "y:step (7 samples, 70.00%)" in svg
+    assert "MainThread (9 samples, 90.00%)" in svg

@@ -18,10 +18,18 @@ with a real `manatee-prober` process watching it:
     a measured error window and fires at least one fast-burn ("page")
     alert, which resolves after the fault clears.
 
+PR 16 rides the same soak for the introspection plane's two live
+claims: the sampling profiler (obs/profile.py) runs at FULL rate the
+whole time and must stay inside its self-measured overhead budget
+without perturbing the SLO engine into a false page, and after the
+failover no live peer's task census may still carry the takeover's
+trace — the ``/tasks`` mirror of the open-span leak check.
+
 Runs in the chaos CI jobs alongside tests/test_chaos.py.
 """
 
 import asyncio
+import json
 import os
 import time
 
@@ -47,6 +55,11 @@ PROBE_INTERVAL = 0.05
 # the stock page rule (60s/5s, 14.4x at objective 0.999) over the
 # factor on BOTH windows; 3s leaves margin for the 1s eval cadence
 OUTAGE_S = 3.0
+# the prober's profiler runs the soak at 5x the default sampling rate
+# and still must stay inside the always-on overhead budget (<1% of
+# one core, self-measured via thread CPU time)
+PROFILE_HZ = 100.0
+PROFILER_BUDGET = 0.01
 
 
 def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
@@ -68,6 +81,7 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                 "statusHost": "127.0.0.1",
                 "statusPort": port,
                 "probeInterval": PROBE_INTERVAL,
+                "profileHz": PROFILE_HZ,
                 "faultsEnabled": True,
                 "coordCfg": {"connStr": cluster.coord_connstr,
                              "sessionTimeout": 1.0},
@@ -101,12 +115,27 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                     "prober never reached a quiet warm state"
                 await asyncio.sleep(0.5)
 
-            # ---- healthy soak: zero false positives
+            async def profiler_metrics() -> tuple[float, float]:
+                from manatee_tpu.cli import _prom_pick, _prom_samples
+                _s, text = await http_get(base + "/metrics")
+                samples = _prom_samples(text)
+                return (_prom_pick(
+                            samples,
+                            "profiler_self_seconds_total") or 0.0,
+                        _prom_pick(samples,
+                                   "profiler_samples_total") or 0.0)
+
+            # ---- healthy soak: zero false positives, with the
+            # profiler sampling at full rate the whole time
             fired0 = len(await alert_events())
             errors0 = (await sli_row())["writes_error"]
+            self0, n0 = await profiler_metrics()
+            t0 = time.monotonic()
             await asyncio.sleep(SOAK_S)
             fired = await alert_events()
             row = await sli_row()
+            self1, n1 = await profiler_metrics()
+            elapsed = time.monotonic() - t0
             assert len(fired) == fired0, \
                 "healthy soak fired alerts: %r" % fired[fired0:]
             _s, al = await http_get(base + "/alerts")
@@ -114,6 +143,23 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                 "active alerts on a healthy cluster: %r" % al["alerts"]
             assert row["writes_error"] == errors0, \
                 "probe writes failed during the healthy soak"
+            # the profiler really ran (it was sampling, not idling)
+            # and its self-measured CPU stayed inside the always-on
+            # budget — "observability must never hurt HA" with
+            # numbers attached
+            assert n1 - n0 >= PROFILE_HZ * elapsed * 0.5, \
+                "profiler took %.0f samples in %.1fs (expected ~%d " \
+                "at %gHz)" % (n1 - n0, elapsed,
+                              PROFILE_HZ * elapsed, PROFILE_HZ)
+            overhead = (self1 - self0) / elapsed
+            assert overhead < PROFILER_BUDGET, \
+                "profiler overhead %.2f%% of one core exceeds the " \
+                "%.0f%% budget" % (100 * overhead,
+                                   100 * PROFILER_BUDGET)
+            _s, folded = await http_get(base + "/profile?seconds=%g"
+                                        % SOAK_S)
+            assert _s == 200 and folded.strip(), \
+                "soak produced no folded stacks"
             cursor = max((e["seq"] for e in fired), default=0)
             old_primary = row["primary"]
 
@@ -142,6 +188,35 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                      if e["seq"] > cursor]
             assert not paged, \
                 "clean failover burned the pager: %r" % paged
+
+            # -- the /tasks mirror of the open-span check: the
+            # takeover's trace reassembles with no open spans, and no
+            # live peer's task census may still carry that trace — a
+            # transition task outliving its own trace is a leak the
+            # census exists to catch
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "trace", "--last-failover", "-j")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            tr = json.loads(cp.stdout)
+            assert tr["open"] == [], \
+                "failover left spans open: %r" % tr["open"]
+            deadline = time.monotonic() + 30
+            while True:
+                bound: dict = {}
+                for peer in (p2, p3):
+                    _s, body = await http_get(
+                        "http://127.0.0.1:%d/tasks"
+                        % peer.status_port)
+                    hung = [t for t in body["tasks"]
+                            if t.get("trace") == tr["trace"]]
+                    if hung:
+                        bound[peer.name] = hung
+                if not bound:
+                    break
+                assert time.monotonic() < deadline, \
+                    "tasks still bound to failover trace %s: %r" \
+                    % (tr["trace"], bound)
+                await asyncio.sleep(0.5)
 
             # ---- partition drill, act 2: a real write outage.  Arm
             # the documented prober.write failpoint over the prober's
