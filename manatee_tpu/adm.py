@@ -457,7 +457,9 @@ class AdmClient:
                                    ) -> ClusterDetails:
         canned = os.environ.get("MANATEE_ADM_TEST_STATE")
         if canned:
-            return load_test_state(canned)
+            # the hook may name a file on disk: read it off-loop like
+            # every other file the async client touches
+            return await asyncio.to_thread(load_test_state, canned)
         if legacy_order_mode:
             state = await self.legacy_state(shard)
         else:

@@ -185,7 +185,7 @@ async def _load_details(args) -> ClusterDetails:
     canned = os.environ.get("MANATEE_ADM_TEST_STATE")
     if canned:
         from manatee_tpu.adm import load_test_state
-        return load_test_state(canned)
+        return await asyncio.to_thread(load_test_state, canned)
     async with AdmClient(_coord(args)) as adm:
         return await adm.load_cluster_details(_shard(args))
 

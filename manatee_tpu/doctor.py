@@ -545,12 +545,15 @@ def check_cluster(state: dict | None, history: list[dict],
 def check_introspection(events: list[dict]) -> list[dict]:
     """Pure checks over the merged event journal's loop-health
     records.  An ``obs.lint.discrepancy`` means the blocked-loop
-    watchdog caught a stack stalling the event loop INSIDE a function
-    mnt-lint's blocking-call rules were told to ignore (a path
-    disable or an inline suppression) — runtime evidence the static
-    exemption hides a real blocking call.  Raw ``obs.loop.stall``
-    events are NOTEs: real, but already on `manatee-adm top`'s
-    STALLS column; the discrepancy is the actionable finding."""
+    watchdog caught a stack stalling the event loop that the static
+    side cannot account for: either mnt-lint's blocking rules were
+    told to ignore the frame (``via`` = path-disable / suppression),
+    or the culprit is not derivable from the interprocedural
+    may-block summaries at all (``via=not-derived`` — the call graph
+    has a hole: a dynamic dispatch, an extension module, or a catalog
+    gap).  Raw ``obs.loop.stall`` events are NOTEs: real, but already
+    on `manatee-adm top`'s STALLS column; the discrepancy is the
+    actionable finding."""
     out: list[dict] = []
     seen: set = set()
     stalls: dict[str, int] = {}
@@ -562,6 +565,18 @@ def check_introspection(events: list[dict]) -> list[dict]:
             if key in seen:
                 continue
             seen.add(key)
+            if (ev.get("via") or "") == "not-derived":
+                out.append(finding(
+                    WARNING, "lint-underived-stall",
+                    "%s:%s" % (ev.get("file"), ev.get("line")),
+                    "the event loop stalled inside %s(), but no "
+                    "may-block summary derives a blocking chain "
+                    "there — the static analysis is blind to this "
+                    "stall (dynamic dispatch, extension code, or a "
+                    "blocking-catalog gap); teach lint/summaries.py "
+                    "about the edge or catalog the primitive"
+                    % ev.get("func")))
+                continue
             out.append(finding(
                 WARNING, "lint-exemption-blocks",
                 "%s:%s" % (ev.get("file"), ev.get("line")),

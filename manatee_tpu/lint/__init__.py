@@ -10,6 +10,17 @@ cancels, lock hygiene, unbounded network waits) and
 per-function CFGs built by :mod:`manatee_tpu.lint.cfg`: broken atomic
 sections, inconsistent locksets, cancellation-unsafe acquisitions).
 
+v4 adds the interprocedural layer: :mod:`manatee_tpu.lint.callgraph`
+(project-wide call resolution) and :mod:`manatee_tpu.lint.summaries`
+(per-function effect summaries — may-suspend, may-block, lock effects,
+resource escape, cancellation swallowing — propagated to fixpoint).
+The flow rules consult the summaries to see through helper calls;
+:mod:`manatee_tpu.lint.rules_interproc` adds the chain-reporting rules
+(``transitive-blocking-in-async``,
+``cancellation-swallowed-transitively``) and
+:mod:`manatee_tpu.lint.rules_obs` the metric/journal-name ↔
+docs/observability.md contract (``obs-name-undocumented``).
+
 ``tools/lint`` is a thin shim over :func:`main`; ``python -m
 manatee_tpu.lint`` works too.  See docs/lint.md for the rule catalog.
 """
@@ -29,6 +40,8 @@ from manatee_tpu.lint import rules_style  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_async  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_faults  # noqa: F401  (registration)
 from manatee_tpu.lint import rules_flow  # noqa: F401  (registration)
+from manatee_tpu.lint import rules_interproc  # noqa: F401  (registration)
+from manatee_tpu.lint import rules_obs  # noqa: F401  (registration)
 
 __all__ = [
     "RULES",
@@ -42,4 +55,6 @@ __all__ = [
     "rules_async",
     "rules_faults",
     "rules_flow",
+    "rules_interproc",
+    "rules_obs",
 ]

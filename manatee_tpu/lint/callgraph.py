@@ -1,0 +1,388 @@
+"""Project-wide call graph: name/attribute resolution over the package.
+
+:func:`scan_module` extracts one file's *declaration surface* — import
+maps, classes/bases/methods, module functions, per-def shape — as a
+plain JSON-able dict, and :class:`CallGraph` assembles those dicts into
+a resolvable graph.  The split matters for incrementality: declaration
+dicts depend only on their own file's content, so the ``--cache`` layer
+can persist them per file and rebuild the whole graph from cache
+without re-parsing an unchanged tree (resolution itself is always
+re-run in memory — it is cross-file by nature and cheap).
+
+Resolution is *bounded and syntactic*: no dataflow, no type inference
+beyond what the module text states directly.  What resolves:
+
+- bare names: module-level functions, ``from x import y`` aliases
+  (including aliases into other project modules);
+- ``mod.func`` / ``alias.func`` where the head is an ``import``-bound
+  alias pointing at a project module;
+- ``self.meth`` / ``cls.meth``: the enclosing class, then its base
+  classes (bases resolved through the same import maps, walk bounded
+  by ``_MRO_BOUND``);
+- ``Class.meth`` for classes reachable from the same module;
+- ``self.attr.meth`` where the class assigns exactly ``self.attr =
+  ClassName(...)`` and ``ClassName`` resolves to a project class (one
+  attribute level, no chains; an attribute also assigned from anything
+  else loses the fact).
+
+Everything else — computed receivers, duck-typed attributes, calls into
+the stdlib or site-packages — stays *unresolved*, and the summary layer
+(:mod:`manatee_tpu.lint.summaries`) applies sound defaults there: an
+unresolved call may do anything the v3 per-function rules already
+assumed an opaque call could do, so a resolution failure can only ever
+cost precision, never soundness.
+
+:meth:`CallGraph.canonical` additionally maps an *aliased* name back to
+its canonical dotted path (``from time import sleep`` makes ``sleep``
+canonicalize to ``time.sleep``) so catalog lookups — the blocking-call
+lists — see through import renames even when the target is not a
+project function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import PurePosixPath
+
+from manatee_tpu.lint.engine import dotted
+
+# how many classes an MRO walk will visit before giving up
+# (pathological diamond hierarchies stay bounded)
+_MRO_BOUND = 16
+
+
+def module_name(path: str) -> str:
+    """Dotted module name for a repo-relative *path*.
+
+    ``manatee_tpu/pg/manager.py`` -> ``manatee_tpu.pg.manager``;
+    ``manatee_tpu/obs/__init__.py`` -> ``manatee_tpu.obs``; a shebang
+    script without ``.py`` (``tools/lint``) keeps its basename.
+    """
+    p = PurePosixPath(str(path).replace("\\", "/"))
+    parts = list(p.parts)
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "<root>"
+
+
+@dataclasses.dataclass
+class FuncDef:
+    """One function definition somewhere in the project (plain data —
+    reconstructible from a cached declaration dict, no AST held)."""
+    fqn: str                  # "pkg.mod:Class.meth" / "pkg.mod:func"
+    path: str
+    module: str
+    qualname: str             # "Class.meth", "func", "f.<locals>.g"
+    name: str
+    line: int
+    end_line: int
+    is_async: bool
+    cls: str | None           # enclosing class name for methods
+    params: tuple             # positional params, self/cls stripped
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: list          # dotted base-class names as written
+    methods: dict        # name -> FuncDef
+    attr_types: dict     # attr -> dotted class name from
+                         # `self.attr = ClassName(...)`
+
+
+class ModuleInfo:
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.imports: dict[str, str] = {}       # alias -> module path
+        self.from_imports: dict[str, str] = {}  # alias -> "mod.attr"
+        self.functions: dict[str, FuncDef] = {}
+        self.classes: dict[str, ClassInfo] = {}
+
+
+# ---- per-file declaration scan ----
+
+def _scan_imports(tree: ast.AST, modname: str) -> tuple[dict, dict]:
+    imports: dict[str, str] = {}
+    from_imports: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname is not None:
+                    imports[a.asname] = a.name
+                else:
+                    imports[a.name.split(".")[0]] = a.name.split(".")[0]
+                    if "." in a.name:
+                        # `import a.b.c` also makes `a.b.c.f` a legal
+                        # spelling of the deep module's attribute
+                        imports[a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:       # relative: resolve against the pkg
+                base = modname.split(".")
+                base = base[:len(base) - node.level]
+                src = ".".join(base + ([node.module] if node.module
+                                       else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                from_imports[a.asname or a.name] = \
+                    "%s.%s" % (src, a.name) if src else a.name
+    return imports, from_imports
+
+
+def _attr_ctor_types(cls_node: ast.ClassDef) -> dict:
+    """``self.attr = ClassName(...)`` assignments anywhere in the
+    class: the one attribute-type fact cheap enough to trust."""
+    out: dict[str, str] = {}
+    ambiguous: set[str] = set()
+    for node in ast.walk(cls_node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        attr = t.attr
+        if isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor and ctor.rsplit(".", 1)[-1][:1].isupper():
+                if attr in out and out[attr] != ctor:
+                    ambiguous.add(attr)
+                out.setdefault(attr, ctor)
+                continue
+        ambiguous.add(attr)      # assigned from something else too
+    for attr in ambiguous:
+        out.pop(attr, None)
+    return out
+
+
+def _def_params(node, in_class: bool) -> list[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if in_class and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def scan_module(path: str, tree: ast.AST) -> tuple[dict, dict]:
+    """(declaration dict, qualname -> def AST node).
+
+    The dict is JSON-able and content-determined; the node map exists
+    only for the caller that just parsed the tree (fact extraction).
+    """
+    modname = module_name(path)
+    imports, from_imports = _scan_imports(tree, modname)
+    decl = {"name": modname, "path": str(path), "imports": imports,
+            "from_imports": from_imports, "functions": {},
+            "classes": {}, "defs": {}}
+    nodes: dict[str, ast.AST] = {}
+
+    def add_def(node, qual: list, cls_name: str | None) -> str:
+        qualname = ".".join(qual + [node.name])
+        decl["defs"][qualname] = {
+            "line": node.lineno,
+            "end_line": getattr(node, "end_lineno", node.lineno),
+            "is_async": isinstance(node, ast.AsyncFunctionDef),
+            "cls": cls_name,
+            "params": _def_params(node, cls_name is not None),
+        }
+        nodes[qualname] = node
+        return qualname
+
+    def visit(body, qual: list, cls_name: str | None):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qualname = add_def(node, qual, cls_name)
+                if cls_name is None and not qual:
+                    decl["functions"][node.name] = qualname
+                elif cls_name is not None and qual == [cls_name]:
+                    decl["classes"][cls_name]["methods"][node.name] = \
+                        qualname
+                visit(node.body, qual + [node.name, "<locals>"], None)
+            elif isinstance(node, ast.ClassDef):
+                if not qual:
+                    decl["classes"][node.name] = {
+                        "bases": [d for b in node.bases
+                                  if (d := dotted(b)) is not None],
+                        "methods": {},
+                        "attr_types": _attr_ctor_types(node),
+                    }
+                    visit(node.body, [node.name], node.name)
+                else:
+                    visit(node.body, qual + [node.name], node.name)
+
+    visit(tree.body, [], None)
+    return decl, nodes
+
+
+# ---- the graph ----
+
+class CallGraph:
+    """Defs, per-module import/class tables, and call resolution."""
+
+    def __init__(self):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.defs: dict[str, FuncDef] = {}
+        # (path, lineno, funcname) -> FuncDef: how rules (which parse
+        # files independently) find "their" def in the graph
+        self._by_loc: dict[tuple, FuncDef] = {}
+
+    def add(self, decl: dict) -> None:
+        """Install one :func:`scan_module` declaration dict."""
+        path, modname = decl["path"], decl["name"]
+        mod = ModuleInfo(modname, path)
+        mod.imports = dict(decl["imports"])
+        mod.from_imports = dict(decl["from_imports"])
+        self.modules[modname] = mod
+        made: dict[str, FuncDef] = {}
+        for qualname, d in decl["defs"].items():
+            fd = FuncDef(
+                fqn="%s:%s" % (modname, qualname), path=path,
+                module=modname, qualname=qualname,
+                name=qualname.rsplit(".", 1)[-1], line=d["line"],
+                end_line=d["end_line"], is_async=d["is_async"],
+                cls=d["cls"], params=tuple(d["params"]))
+            self.defs[fd.fqn] = fd
+            self._by_loc[(path, fd.line, fd.name)] = fd
+            made[qualname] = fd
+        for name, qualname in decl["functions"].items():
+            if qualname in made:
+                mod.functions[name] = made[qualname]
+        for cname, c in decl["classes"].items():
+            ci = ClassInfo(cname, modname, list(c["bases"]),
+                           {m: made[q] for m, q in c["methods"].items()
+                            if q in made},
+                           dict(c["attr_types"]))
+            mod.classes[cname] = ci
+
+    # -- lookups --
+
+    def def_at(self, path: str, lineno: int,
+               name: str) -> FuncDef | None:
+        return self._by_loc.get((str(path), lineno, name))
+
+    def _class(self, module: ModuleInfo, name: str) -> ClassInfo | None:
+        """A class by (possibly imported) *name* as seen from
+        *module*."""
+        if name in module.classes:
+            return module.classes[name]
+        tgt = module.from_imports.get(name)
+        if tgt and "." in tgt:
+            src, cls_name = tgt.rsplit(".", 1)
+            src_mod = self.modules.get(src)
+            if src_mod:
+                return src_mod.classes.get(cls_name)
+        if "." in name:          # "mod.Class" through a module alias
+            head, cls_name = name.rsplit(".", 1)
+            tgt = module.imports.get(head)
+            src_mod = self.modules.get(tgt) if tgt else None
+            if src_mod:
+                return src_mod.classes.get(cls_name)
+        return None
+
+    def _method(self, module: ModuleInfo, cls: ClassInfo,
+                meth: str) -> FuncDef | None:
+        """*meth* on *cls* or a base class, bounded walk."""
+        seen: set[str] = set()
+        queue = [(module, cls)]
+        steps = 0
+        while queue and steps < _MRO_BOUND:
+            steps += 1
+            mod, c = queue.pop(0)
+            key = "%s.%s" % (c.module, c.name)
+            if key in seen:
+                continue
+            seen.add(key)
+            if meth in c.methods:
+                return c.methods[meth]
+            for base in c.bases:
+                bc = self._class(mod, base)
+                if bc is not None:
+                    queue.append((self.modules.get(bc.module, mod), bc))
+        return None
+
+    def canonical(self, path: str, name: str | None) -> str | None:
+        """*name* with import aliases expanded to the canonical dotted
+        path, for catalog lookups (``sleep`` -> ``time.sleep`` after a
+        ``from time import sleep``).  Unknown names pass through."""
+        if not name:
+            return name
+        mod = self.modules.get(module_name(path))
+        if mod is None:
+            return name
+        head, _, rest = name.partition(".")
+        tgt = mod.from_imports.get(head)
+        if tgt is not None:
+            return tgt + ("." + rest if rest else "")
+        tgt = mod.imports.get(head)
+        if tgt is not None and tgt != head:
+            return tgt + ("." + rest if rest else "")
+        return name
+
+    def resolve(self, caller: FuncDef | None, path: str,
+                name: str | None) -> FuncDef | None:
+        """The project function a dotted call *name* at a call site in
+        (*caller*, *path*) refers to, or None when unresolvable."""
+        if not name:
+            return None
+        mod = self.modules.get(module_name(path))
+        if mod is None:
+            return None
+        parts = name.split(".")
+        # self.meth / cls.meth / self.attr.meth
+        if parts[0] in ("self", "cls"):
+            if caller is None or caller.cls is None:
+                return None
+            cls = mod.classes.get(caller.cls)
+            if cls is None:
+                return None
+            if len(parts) == 2:
+                return self._method(mod, cls, parts[1])
+            if len(parts) == 3:
+                ctor = cls.attr_types.get(parts[1])
+                if ctor:
+                    tc = self._class(mod, ctor)
+                    if tc is not None:
+                        owner = self.modules.get(tc.module, mod)
+                        return self._method(owner, tc, parts[2])
+            return None
+        if len(parts) == 1:
+            fd = mod.functions.get(parts[0])
+            if fd is not None:
+                return fd
+            tgt = mod.from_imports.get(parts[0])
+            if tgt and "." in tgt:
+                src, fn = tgt.rsplit(".", 1)
+                src_mod = self.modules.get(src)
+                if src_mod:
+                    return src_mod.functions.get(fn)
+            return None
+        # alias.func / alias.Class.meth through a module import
+        head, rest = parts[0], parts[1:]
+        tgt = mod.imports.get(head) or mod.from_imports.get(head)
+        if tgt is not None:
+            src_mod = self.modules.get(tgt)
+            if src_mod is not None and len(rest) == 1:
+                return src_mod.functions.get(rest[0])
+            if src_mod is not None and len(rest) == 2:
+                tc = src_mod.classes.get(rest[0])
+                if tc is not None:
+                    return self._method(src_mod, tc, rest[1])
+        # Class.meth reachable from this module (static-style call)
+        if len(parts) == 2:
+            tc = self._class(mod, parts[0])
+            if tc is not None:
+                owner = self.modules.get(tc.module, mod)
+                return self._method(owner, tc, parts[1])
+        return None
+
+    def stats(self) -> dict:
+        return {"modules": len(self.modules),
+                "functions": len(self.defs)}

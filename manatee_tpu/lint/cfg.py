@@ -473,7 +473,8 @@ HIT = "hit"
 
 
 def scan_paths(cfg: FuncCFG, start: tuple, classify,
-               follow_exceptions: bool = True) -> list:
+               follow_exceptions: bool = True,
+               suspends=None) -> list:
     """Forward reachability from *start* = (block, idx), exclusive.
 
     ``classify(event, awaited)`` is called for every event reachable
@@ -490,6 +491,13 @@ def scan_paths(cfg: FuncCFG, start: tuple, classify,
     walk sticks to normal-flow edges (cancellation-window rules: a
     cancel lands at an await on the normal path, never "inside" an
     exception edge).
+
+    ``suspends(event) -> bool``, when given, filters AWAIT events: only
+    those it accepts flip ``awaited``.  The v4 rules pass a summary-
+    backed filter so ``await helper()`` of a project coroutine proven
+    never to suspend is NOT an interleave point (the event loop runs it
+    inline); without the callable every await suspends, the sound v3
+    default.
     """
     hits = []
     hit_keys = set()
@@ -521,7 +529,7 @@ def scan_paths(cfg: FuncCFG, start: tuple, classify,
                 hit_keys.add(hkey)
                 hits.append((e, awaited))
             continue
-        if e.kind == AWAIT:
+        if e.kind == AWAIT and (suspends is None or suspends(e)):
             awaited = True
         stack.append((blk, idx + 1, awaited))
     return hits

@@ -44,6 +44,7 @@ import json
 import re
 import subprocess
 import sys
+import time
 from pathlib import Path
 from typing import Callable, Iterator
 
@@ -130,6 +131,19 @@ class Config:
     # an attribute's lock discipline (below this, a lock seen once is
     # just coincidence, not a contract)
     lockset_min_guarded: int = 2
+    # transitive-blocking-in-async: "<path-glob>::<qualname-glob>"
+    # entries naming helpers whose blocking is a DOCUMENTED design
+    # decision (dirstore's no-await meta RMW, coordd's synchronous
+    # shutdown snapshot).  The helper's may_block summary is UNCHANGED
+    # — the runtime stall watchdog still derives it, keeping the
+    # two-sided obs.loop.stall contract honest — but chains ending
+    # only in declared helpers are not reported at call sites.
+    # Unused entries are flagged by unused-suppression on full runs.
+    blocking_by_design: frozenset = frozenset()
+    # v4: consult interprocedural summaries (callgraph.py/summaries.py)
+    # at call events.  Off = exact v3 per-function behavior; the seeded
+    # -bug regression tests pin both sides of that contract.
+    interproc: bool = True
 
     _KEYS = {
         "max-line": "max_line",
@@ -145,7 +159,9 @@ class Config:
         "acquire-calls": "acquire_calls",
         "acquire-discard-calls": "acquire_discard_calls",
         "acquire-discard-allow": "acquire_discard_allow",
+        "blocking-by-design": "blocking_by_design",
         "lockset-min-guarded": "lockset_min_guarded",
+        "interprocedural": "interproc",
         "notes": None,       # free-form justifications, ignored here
     }
 
@@ -162,6 +178,8 @@ class Config:
                 continue
             if field in ("max_line", "lockset_min_guarded"):
                 kw[field] = int(val)
+            elif field == "interproc":
+                kw[field] = bool(val)
             elif field == "exclude":
                 kw[field] = tuple(val)
             elif field == "path_disable":
@@ -197,6 +215,10 @@ class LintResult:
     path: str
     findings: list
     suppressed: list
+    # "<path-glob>::<func-glob>" allowlist entries a rule consulted and
+    # matched while checking this file (cached with the result so a
+    # full cached run can still report unused allowlist entries)
+    allow_used: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -288,6 +310,8 @@ class FileContext:
         self._cfgs: dict | None = None
         self._annotations: list | None = None
         self._module_globals: frozenset | None = None
+        self._summaries = None
+        self._summaries_set = False
 
     def finding(self, line: int, rule_name: str, msg: str) -> Finding:
         return Finding(self.path, line, rule_name, msg)
@@ -335,6 +359,29 @@ class FileContext:
             self._cfgs = {fn: cfgmod.build_cfg(fn)
                           for fn in cfgmod.iter_function_defs(self.tree)}
         return self._cfgs
+
+    @property
+    def summaries(self):
+        """The interprocedural :class:`~.summaries.SummaryDB` rules
+        consult at call events, or None with ``interproc`` off.
+
+        ``check_paths`` injects the project-wide database; a bare
+        ``check_source`` (unit fixtures, editor integration) lazily
+        builds a single-file one, so in-file helper chains still
+        resolve even without the full tree."""
+        if not self.config.interproc:
+            return None
+        if not self._summaries_set:
+            from manatee_tpu.lint import summaries as summod
+            self._summaries = summod.SummaryDB.build_from_sources(
+                [(self.path, self.text, self.tree)], self.config)
+            self._summaries_set = True
+        return self._summaries
+
+    @summaries.setter
+    def summaries(self, db):
+        self._summaries = db
+        self._summaries_set = True
 
     @property
     def annotations(self) -> list:
@@ -442,7 +489,8 @@ def _annotation_accounting(ctx: FileContext) -> Iterator[Finding]:
 # ---- core per-file run ----
 
 def check_source(text: str, path: str = "<string>",
-                 config: Config | None = None) -> LintResult:
+                 config: Config | None = None,
+                 summaries=None) -> LintResult:
     config = config or Config()
     try:
         tree = ast.parse(text, filename=path)
@@ -453,12 +501,16 @@ def check_source(text: str, path: str = "<string>",
     except ValueError as e:        # e.g. source with null bytes
         return LintResult(path, [Finding(path, 0, "syntax", str(e))], [])
     ctx = FileContext(path, text, tree, config)
+    if summaries is not None:
+        ctx.summaries = summaries
     disabled = config.disabled_for(path)
     findings: list[Finding] = []
+    before_allow = set(_ALLOW_USED)
     for r in RULES.values():
         if r.name in disabled:
             continue
         findings.extend(r.fn(ctx))
+    allow_used = sorted(_ALLOW_USED - before_allow)
     supp = parse_suppressions(text)
     kept, suppressed = [], []
     used: dict[int, set] = {}
@@ -487,10 +539,11 @@ def check_source(text: str, path: str = "<string>",
                     % what))
         kept.extend(_annotation_accounting(ctx))
         kept.sort()
-    return LintResult(path, kept, suppressed)
+    return LintResult(path, kept, suppressed, allow_used)
 
 
-def check_file(path: Path, config: Config | None = None) -> LintResult:
+def check_file(path: Path, config: Config | None = None,
+               summaries=None) -> LintResult:
     try:
         text = path.read_text()
     except UnicodeDecodeError:
@@ -501,7 +554,7 @@ def check_file(path: Path, config: Config | None = None) -> LintResult:
         return LintResult(str(path),
                           [Finding(str(path), 0, "syntax",
                                    "unreadable: %s" % e)], [])
-    return check_source(text, str(path), config)
+    return check_source(text, str(path), config, summaries)
 
 
 # ---- file iteration ----
@@ -538,35 +591,61 @@ def iter_files(paths, config: Config) -> Iterator[Path]:
 
 
 def check_paths(paths, config: Config | None = None,
-                cache: "ResultCache | None" = None
-                ) -> tuple[int, list, list]:
-    """(files checked, findings, suppressed findings) over *paths*."""
+                cache: "ResultCache | None" = None,
+                summaries=None) -> tuple[int, list, list]:
+    """(files checked, findings, suppressed findings) over *paths*.
+
+    With ``interproc`` on and no *summaries* database supplied, one is
+    built over *paths* first (reusing per-file facts from *cache*) so
+    every rule sees the same project-wide call graph."""
     config = config or Config()
+    if summaries is None and config.interproc:
+        from manatee_tpu.lint import summaries as summod
+        summaries = summod.SummaryDB.build(paths, config, cache)
+    if cache is not None:
+        cache.summaries = summaries
     n = 0
     findings: list[Finding] = []
     suppressed: list[Finding] = []
+    allow_used: set = set()
     for f in iter_files(paths, config):
         n += 1
         res = cache.lookup(f) if cache is not None else None
         if res is None:
-            res = check_file(f, config)
+            res = check_file(f, config, summaries)
             if cache is not None:
                 cache.store(f, res)
         findings.extend(res.findings)
         suppressed.extend(res.suppressed)
+        allow_used.update(res.allow_used)
+    _ALLOW_USED.update(allow_used)
     return n, findings, suppressed
 
 
 # ---- content-hash result cache (--cache) ----
 
 class ResultCache:
-    """Per-path lint results keyed on a content hash.
+    """Per-path lint results and interprocedural facts keyed on a
+    content hash.
 
     The key folds in the file bytes, the effective config, and a digest
     of the lint package's own sources — editing a rule invalidates
     everything, editing one file invalidates that file.  Stored as JSON,
     one entry per path; entries for files that no longer exist are
     pruned at save() time.
+
+    Two layers with different invalidation:
+
+    - ``facts``: per-file extraction output for the summary database
+      (callgraph declaration + local function facts).  Depends only on
+      that file's content, so an unchanged file never re-parses even
+      when its callees changed — the fixpoint re-runs in memory.
+    - ``entries``: per-file lint RESULTS.  A result consumed summaries
+      of functions in OTHER files, so each entry also records a ``deps``
+      map (callee fqn -> summary digest); at lookup time every recorded
+      digest must match the freshly-computed summary database, which is
+      exactly the "my callee changed may-block under me" case the v3
+      cache could not see.
     """
 
     def __init__(self, path: str | Path, config: Config):
@@ -574,12 +653,15 @@ class ResultCache:
         self.salt = hashlib.sha256(
             (_tool_digest() + _config_digest(config)).encode()).hexdigest()
         self.entries: dict[str, dict] = {}
+        self.facts: dict[str, dict] = {}
+        self.summaries = None         # set by check_paths after build
         self.hits = 0
         self.misses = 0
         try:
             data = json.loads(self.path.read_text())
             if isinstance(data, dict) and data.get("salt") == self.salt:
                 self.entries = data.get("entries", {})
+                self.facts = data.get("facts", {})
         except (OSError, ValueError):
             pass
 
@@ -590,26 +672,53 @@ class ResultCache:
             return None
         return hashlib.sha256(self.salt.encode() + blob).hexdigest()
 
+    def lookup_facts(self, path: Path) -> dict | None:
+        """Cached extraction facts for *path*, content-validated."""
+        ent = self.facts.get(str(path))
+        if not ent or ent.get("key") != self._key(path):
+            return None
+        return ent["facts"]
+
+    def store_facts(self, path: Path, facts: dict):
+        key = self._key(path)
+        if key is not None:
+            self.facts[str(path)] = {"key": key, "facts": facts}
+
+    def _deps_fresh(self, ent: dict) -> bool:
+        deps = ent.get("deps")
+        if not deps:
+            return True
+        if self.summaries is None:
+            return False
+        return all(self.summaries.digest(fqn) == dig
+                   for fqn, dig in deps.items())
+
     def lookup(self, path: Path) -> LintResult | None:
         ent = self.entries.get(str(path))
-        if not ent or ent.get("key") != self._key(path):
+        if not ent or ent.get("key") != self._key(path) \
+                or not self._deps_fresh(ent):
             self.misses += 1
             return None
         self.hits += 1
         return LintResult(
             str(path),
             [Finding(**d) for d in ent["findings"]],
-            [Finding(**d) for d in ent["suppressed"]])
+            [Finding(**d) for d in ent["suppressed"]],
+            list(ent.get("allow_used", ())))
 
     def store(self, path: Path, res: LintResult):
         key = self._key(path)
         if key is None:
             return
-        self.entries[str(path)] = {
+        ent = {
             "key": key,
             "findings": [f.as_dict() for f in res.findings],
             "suppressed": [f.as_dict() for f in res.suppressed],
+            "allow_used": list(res.allow_used),
         }
+        if self.summaries is not None:
+            ent["deps"] = self.summaries.file_deps(str(path))
+        self.entries[str(path)] = ent
 
     def save(self):
         # entries whose file is gone (renames, deletions) are dropped
@@ -617,9 +726,12 @@ class ResultCache:
         # with every path that ever existed
         self.entries = {p: ent for p, ent in self.entries.items()
                         if Path(p).is_file()}
+        self.facts = {p: ent for p, ent in self.facts.items()
+                      if Path(p).is_file()}
         try:
             self.path.write_text(json.dumps(
-                {"salt": self.salt, "entries": self.entries},
+                {"salt": self.salt, "entries": self.entries,
+                 "facts": self.facts},
                 sort_keys=True))
         except OSError as e:
             print("mnt-lint: cannot write cache %s: %s"
@@ -707,12 +819,20 @@ def select_changed(roots, config: Config, base: str | None = None
     return picked
 
 
-# ---- allowlist matching (used by unbounded-wait) ----
+# ---- allowlist matching (used by unbounded-wait and friends) ----
+
+# entries that matched at least once this process — check_source diffs
+# this around the rule runs so every LintResult carries the allowlist
+# entries it consumed, and a full run can report the never-consumed
+# ones as unused-suppression findings against the config file itself
+_ALLOW_USED: set = set()
+
 
 def allow_matches(entries, path: str, funcname: str) -> bool:
     """True when any "<path-glob>::<func-glob>" entry matches.  The path
     part matches against the end of the reported path so entries stay
     stable regardless of how the tool was invoked."""
+    hit = False
     for entry in entries:
         pat_path, sep, pat_fn = entry.partition("::")
         if not sep:
@@ -721,8 +841,9 @@ def allow_matches(entries, path: str, funcname: str) -> bool:
             continue
         if fnmatch.fnmatch(path, pat_path) \
                 or fnmatch.fnmatch(path, "*" + pat_path.lstrip("*")):
-            return True
-    return False
+            _ALLOW_USED.add(entry)
+            hit = True
+    return hit
 
 
 # ---- SARIF output (--format sarif) ----
@@ -799,6 +920,29 @@ def _build_config(args) -> Config:
     return dataclasses.replace(cfg, **overrides)
 
 
+def _unused_allow_findings(args, config: Config) -> list:
+    """Allowlist entries no rule consumed during a full run, reported
+    as unused-suppression findings against the config file: allowlist
+    debt follows the same no-stale-exemptions contract as inline
+    disables."""
+    src = args.config \
+        or (".mnt-lint.json" if Path(".mnt-lint.json").is_file()
+            else "<config>")
+    out = []
+    for key, entries in (
+            ("acquire-discard-allow", config.acquire_discard_allow),
+            ("unbounded-allow", config.unbounded_allow),
+            ("blocking-by-design", config.blocking_by_design)):
+        for entry in sorted(entries):
+            if entry not in _ALLOW_USED:
+                out.append(Finding(
+                    src, 0, "unused-suppression",
+                    "%s entry %r matched no finding in a full run — "
+                    "remove it (stale allowlist entries hide future "
+                    "regressions)" % (key, entry)))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mnt-lint",
@@ -832,6 +976,10 @@ def main(argv=None) -> int:
                     help="JSON {\"suppressed\": N}: fail when the "
                          "suppressed-finding count exceeds N (zero "
                          "NEW suppressions vs the committed baseline)")
+    ap.add_argument("--stats", metavar="FILE",
+                    help="write run statistics (call-graph size, "
+                         "summary counts, cache hit rates, wall time) "
+                         "as JSON to FILE")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -844,6 +992,14 @@ def main(argv=None) -> int:
     config = _build_config(args)
     roots = args.paths or DEFAULT_PATHS
     cache = ResultCache(args.cache, config) if args.cache else None
+    t0 = time.monotonic()
+    summaries = None
+    if config.interproc:
+        from manatee_tpu.lint import summaries as summod
+        # the database always spans the full roots: a --changed run
+        # still needs the unchanged callees' summaries to judge the
+        # change (that's the whole point of interprocedural analysis)
+        summaries = summod.SummaryDB.build(roots, config, cache)
     if args.changed is not None:
         targets = select_changed(roots, config, args.changed)
         if not targets:
@@ -851,7 +1007,14 @@ def main(argv=None) -> int:
                   % ", ".join(map(str, roots)), file=sys.stderr)
     else:
         targets = roots
-    n, findings, suppressed = check_paths(targets, config, cache)
+    n, findings, suppressed = check_paths(targets, config, cache,
+                                          summaries)
+    if args.changed is None and not args.paths \
+            and "unused-suppression" not in config.disable:
+        # only a full default-roots run can prove an allowlist entry
+        # dead; targeted runs see too few candidate sites to judge
+        findings.extend(_unused_allow_findings(args, config))
+        findings.sort()
     if cache is not None:
         cache.save()
     rc = 1 if findings else 0
@@ -890,6 +1053,7 @@ def main(argv=None) -> int:
         if cache is not None:
             summary += " [cache: %d hits, %d misses]" % (cache.hits,
                                                          cache.misses)
+        summary += " in %.1fs" % (time.monotonic() - t0)
         print(summary, file=sys.stderr)
     else:
         for f in findings:
@@ -899,5 +1063,24 @@ def main(argv=None) -> int:
         if cache is not None:
             summary += " [cache: %d hits, %d misses]" % (cache.hits,
                                                          cache.misses)
+        summary += " in %.1fs" % (time.monotonic() - t0)
         print(summary, file=sys.stderr)
+    if args.stats:
+        stats = {
+            "files": n,
+            "problems": len(findings),
+            "suppressed": len(suppressed),
+            "wall_ms": int((time.monotonic() - t0) * 1000),
+            "result_cache": ({"hits": cache.hits,
+                              "misses": cache.misses}
+                             if cache is not None else None),
+            "summaries": (summaries.stats()
+                          if summaries is not None else None),
+        }
+        try:
+            Path(args.stats).write_text(
+                json.dumps(stats, indent=2, sort_keys=True) + "\n")
+        except OSError as e:
+            print("mnt-lint: cannot write stats %s: %s"
+                  % (args.stats, e), file=sys.stderr)
     return rc

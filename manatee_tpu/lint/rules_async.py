@@ -20,6 +20,11 @@ from manatee_tpu.lint.engine import (
     rule,
     walk_no_defs,
 )
+from manatee_tpu.lint.summaries import (
+    BLOCKING_CALLS,
+    BLOCKING_IO_CALLS,
+    BLOCKING_IO_METHODS,
+)
 
 # ---------------------------------------------------------------- spawn
 
@@ -81,22 +86,13 @@ def orphan_task(ctx: FileContext):
 
 # ------------------------------------------------- blocking-call-in-async
 
-_BLOCKING_CALLS = frozenset({
-    "time.sleep",
-    "os.system", "os.popen", "os.wait", "os.waitpid",
-    "subprocess.run", "subprocess.call", "subprocess.check_call",
-    "subprocess.check_output", "subprocess.getoutput",
-    "subprocess.getstatusoutput", "subprocess.Popen",
-    "socket.create_connection", "socket.getaddrinfo",
-    "urllib.request.urlopen",
-    "requests.get", "requests.post", "requests.put", "requests.delete",
-    "requests.head", "requests.request",
-})
-# sync file I/O: the open() builtin plus pathlib-style method names
-_BLOCKING_IO_CALLS = frozenset({"open"})
-_BLOCKING_IO_METHODS = frozenset({
-    "read_text", "write_text", "read_bytes", "write_bytes",
-})
+# the blocking-call catalogs live in the summary layer (summaries.py)
+# so the per-call rules here, the transitive may-block propagation, and
+# the runtime stall cross-check (obs/profile.py) can never disagree on
+# what counts as blocking
+_BLOCKING_CALLS = BLOCKING_CALLS
+_BLOCKING_IO_CALLS = BLOCKING_IO_CALLS
+_BLOCKING_IO_METHODS = BLOCKING_IO_METHODS
 
 
 def _sync_calls_in_async(ctx: FileContext):
@@ -111,6 +107,15 @@ def _sync_calls_in_async(ctx: FileContext):
             yield node
 
 
+def _canonical(ctx: FileContext, name: str | None) -> str | None:
+    """Import aliases expanded (``sleep`` -> ``time.sleep`` after a
+    ``from time import sleep``) when summaries are available; the raw
+    dotted name otherwise (the v3 behavior)."""
+    if name is None or ctx.summaries is None:
+        return name
+    return ctx.summaries.canonical(ctx.path, name)
+
+
 @rule("blocking-call-in-async", "sync sleep/subprocess/DNS in async def")
 def blocking_call_in_async(ctx: FileContext):
     """A synchronous sleep, subprocess wait, or DNS/TCP setup inside
@@ -120,7 +125,7 @@ def blocking_call_in_async(ctx: FileContext):
     worker thread (``loop.run_in_executor`` / ``asyncio.to_thread``)."""
     blocking = _BLOCKING_CALLS | ctx.config.blocking_extra
     for node in _sync_calls_in_async(ctx):
-        name = dotted(node.func)
+        name = _canonical(ctx, dotted(node.func))
         if name in blocking:
             yield ctx.finding(
                 node.lineno, "blocking-call-in-async",
@@ -138,7 +143,7 @@ def blocking_io_in_async(ctx: FileContext):
     rule via the ``path-disable`` config (tiny fixture writes do not
     need a thread hop)."""
     for node in _sync_calls_in_async(ctx):
-        name = dotted(node.func)
+        name = _canonical(ctx, dotted(node.func))
         if name in _BLOCKING_IO_CALLS:
             yield ctx.finding(
                 node.lineno, "blocking-io-in-async",

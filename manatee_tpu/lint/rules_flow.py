@@ -45,6 +45,7 @@ from manatee_tpu.lint.engine import (
     rule,
     walk_no_defs,
 )
+from manatee_tpu.lint.summaries import CLOSE_METHODS
 
 RULE_ATOMIC = "atomic-section-broken"
 RULE_LOCKSET = "lockset-inconsistent"
@@ -97,6 +98,76 @@ def _glob_stem(name: str, globs) -> str | None:
     return None
 
 
+# ------------------------------------------- interprocedural plumbing
+#
+# Every helper below degrades to the v3 behavior when ctx.summaries is
+# None (interprocedural analysis off) or a call does not resolve: an
+# opaque call keeps the sound default the per-function rules always
+# assumed.
+
+def _suspend_filter(ctx: FileContext, fn):
+    """scan_paths ``suspends`` callable: ``await helper()`` of a
+    project coroutine whose summary proves it never suspends runs
+    inline — no other task can interleave there."""
+    db = ctx.summaries
+    if db is None:
+        return None
+
+    def suspends(e) -> bool:
+        node = e.node
+        if isinstance(node, ast.Await) \
+                and isinstance(node.value, ast.Call):
+            name = dotted(node.value.func)
+            if name is not None:
+                s = db.resolve_call(ctx.path, fn, name)
+                if s is not None and s.is_async and not s.may_suspend:
+                    return False
+        return True
+
+    return suspends
+
+
+def _await_suspends(ctx: FileContext, fn, node) -> bool:
+    """AST-level twin of :func:`_suspend_filter` for rules that walk
+    the tree instead of the CFG."""
+    db = ctx.summaries
+    if db is None or not isinstance(node, ast.Await) \
+            or not isinstance(node.value, ast.Call):
+        return True
+    name = dotted(node.value.func)
+    if name is None:
+        return True
+    s = db.resolve_call(ctx.path, fn, name)
+    return not (s is not None and s.is_async and not s.may_suspend)
+
+
+def _callee_params(ctx: FileContext, summary) -> tuple:
+    fd = ctx.summaries.graph.defs.get(summary.fqn) \
+        if ctx.summaries is not None else None
+    return fd.params if fd is not None else ()
+
+
+def _map_arg0(ctx: FileContext, summary, call, spec):
+    """A callee-side first-argument spec (``["param", name]`` /
+    ``["dump", ast-dump]``) translated into the caller's frame: the
+    ast.dump of the caller expression, or None when unmappable (the
+    pair check is then skipped — sound, may over-match)."""
+    if spec is None:
+        return None
+    kind, payload = spec
+    if kind == "dump":
+        return payload
+    params = _callee_params(ctx, summary)
+    if payload in params:
+        pos = params.index(payload)
+        if pos < len(call.args):
+            return ast.dump(call.args[pos])
+    for kw in call.keywords:
+        if kw.arg == payload:
+            return ast.dump(kw.value)
+    return None
+
+
 # ----------------------------------------------------- atomic-section-broken
 
 @rule(RULE_ATOMIC,
@@ -129,6 +200,8 @@ def _atomic_declared(ctx: FileContext):
                     # layer treats nested defs as opaque for the same
                     # reason)
                     continue
+                if not _await_suspends(ctx, owner, node):
+                    continue      # proven-inline helper: still atomic
                 what = {ast.Await: "await",
                         ast.AsyncFor: "async for",
                         ast.AsyncWith: "async with"}[type(node)]
@@ -140,7 +213,7 @@ def _atomic_declared(ctx: FileContext):
                     % (" %r" % label if label else "", begin, what))
 
 
-def _state_of(ctx: FileContext, value, local_names: set,
+def _state_of(ctx: FileContext, fn, value, local_names: set,
               declared_globals: set):
     """What shared state an assignment's RHS reads, if any."""
     if isinstance(value, ast.Attribute):
@@ -163,6 +236,17 @@ def _state_of(ctx: FileContext, value, local_names: set,
         if recv is not None and stem is not None:
             arg0 = ast.dump(call.args[0]) if call.args else None
             return ("loadcall", recv, stem, arg0)
+    # a helper that RETURNS a *load* read (summary load_returns): the
+    # assignment is a load of that state one call level down
+    if isinstance(call, ast.Call) and ctx.summaries is not None:
+        name = dotted(call.func)
+        s = ctx.summaries.resolve_call(ctx.path, fn, name) \
+            if name is not None else None
+        if s is not None and s.load_returns \
+                and (not s.is_async or isinstance(value, ast.Await)):
+            lr = s.load_returns[0]
+            return ("loadcall", lr["recv"], lr["stem"],
+                    _map_arg0(ctx, s, call, lr["arg0"]))
     return None
 
 
@@ -192,24 +276,73 @@ def _save_anchors(ctx: FileContext, fn, state, local: str) -> dict:
                     out[id(t)] = (t.lineno, state[1])
         else:                    # loadcall
             _, recv, stem, arg0 = state
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)):
+            if not isinstance(node, ast.Call):
                 continue
-            if dotted(node.func.value) != recv:
-                continue
-            save_stem = _glob_stem(node.func.attr,
-                                   ctx.config.atomic_save_calls)
-            if save_stem is None or save_stem != stem:
-                continue
-            args = list(node.args) + [kw.value for kw in node.keywords]
-            if not any(_mentions(a, {local}) for a in args):
-                continue
-            if arg0 is not None and node.args \
-                    and ast.dump(node.args[0]) != arg0:
-                continue         # a different dataset/key: not this pair
-            out[id(node)] = (node.lineno,
-                             "%s.%s(...)" % (recv, node.func.attr))
+            if isinstance(node.func, ast.Attribute) \
+                    and dotted(node.func.value) == recv:
+                save_stem = _glob_stem(node.func.attr,
+                                       ctx.config.atomic_save_calls)
+                if save_stem is None or save_stem != stem:
+                    pass
+                else:
+                    args = list(node.args) + [kw.value
+                                              for kw in node.keywords]
+                    if not any(_mentions(a, {local}) for a in args):
+                        continue
+                    if arg0 is not None and node.args \
+                            and ast.dump(node.args[0]) != arg0:
+                        continue   # a different dataset/key
+                    out[id(node)] = (node.lineno,
+                                     "%s.%s(...)" % (recv,
+                                                     node.func.attr))
+                    continue
+            hit = _helper_save(ctx, fn, node, recv, stem, arg0, local)
+            if hit is not None:
+                out[id(node)] = hit
     return out
+
+
+def _helper_save(ctx: FileContext, fn, call, recv, stem, arg0,
+                 local: str):
+    """Does *call* resolve to a helper whose summary performs the
+    matching ``*save*`` of (*recv*, *stem*) with the loaded *local*
+    flowing into the saved value?  (line, description) when yes."""
+    db = ctx.summaries
+    if db is None:
+        return None
+    name = dotted(call.func)
+    if name is None:
+        return None
+    s = db.resolve_call(ctx.path, fn, name)
+    if s is None or not s.save_calls:
+        return None
+    if s.is_async and not isinstance(ctx.parents.get(call), ast.Await):
+        return None              # un-awaited coroutine: nothing ran
+    params = _callee_params(ctx, s)
+    for sc in s.save_calls:
+        if sc["stem"] != stem or sc["recv"] != recv:
+            continue
+        # the loaded value must flow into a save-value parameter
+        feeds = False
+        for pname in sc["value_params"]:
+            if pname in params:
+                pos = params.index(pname)
+                if pos < len(call.args) \
+                        and _mentions(call.args[pos], {local}):
+                    feeds = True
+            for kw in call.keywords:
+                if kw.arg == pname and _mentions(kw.value, {local}):
+                    feeds = True
+        if not feeds:
+            continue
+        helper_arg0 = _map_arg0(ctx, s, call, sc["arg0"])
+        if arg0 is not None and helper_arg0 is not None \
+                and helper_arg0 != arg0:
+            continue             # a different dataset/key: not this pair
+        return (call.lineno,
+                "%s.%s (via %s)" % (recv, stem.strip("_") or "state",
+                                    name))
+    return None
 
 
 def _atomic_inferred(ctx: FileContext):
@@ -229,7 +362,7 @@ def _atomic_inferred(ctx: FileContext):
                     or not isinstance(node.targets[0], ast.Name):
                 continue
             local = node.targets[0].id
-            state = _state_of(ctx, node.value, local_names,
+            state = _state_of(ctx, fn, node.value, local_names,
                               declared_globals)
             if state is None:
                 continue
@@ -251,7 +384,8 @@ def _atomic_inferred(ctx: FileContext):
                     return STOP   # re-loaded/rebound: a fresh window
                 return KEEP
 
-            for e2, _ in scan_paths(cfg, start, classify):
+            for e2, _ in scan_paths(cfg, start, classify,
+                                    suspends=_suspend_filter(ctx, fn)):
                 if _shares_lock_stmt(ctx, node, e2.node):
                     continue      # one lock spans load and save
                 line, desc = anchors[id(e2.node)]
@@ -322,6 +456,17 @@ def lockset_inconsistent(ctx: FileContext):
             locks = guarding.get(key)
             if not locks:
                 continue
+            req = frozenset()
+            if ctx.summaries is not None:
+                sm = ctx.summaries.summary_for(ctx.path, cfg.func)
+                if sm is not None:
+                    # every resolved call site of this private method
+                    # provably holds these locks around the call: a
+                    # window inside it is already guarded by the
+                    # callers (the summary layer's required_held fact)
+                    req = sm.required_held
+            if locks & req:
+                continue
 
             def classify(e, awaited, *, _key=key, _e1=e1):
                 if e.kind == STORE and e.name \
@@ -330,7 +475,9 @@ def lockset_inconsistent(ctx: FileContext):
                     return HIT if awaited else STOP
                 return KEEP
 
-            for e2, _ in scan_paths(cfg, (b1, i1), classify):
+            for e2, _ in scan_paths(
+                    cfg, (b1, i1), classify,
+                    suspends=_suspend_filter(ctx, cfg.func)):
                 pos2 = cfg.position_of(e2.node)
                 locks2 = pos2[0].locks if pos2 else frozenset()
                 if locks & b1.locks & locks2 \
@@ -356,10 +503,9 @@ def lockset_inconsistent(ctx: FileContext):
 # --------------------------------------------------- cancel-unsafe-acquire
 
 _ACQ_WRAPPERS = {"wait_for", "shield"}
-_CLOSE_METHODS = {
-    "close", "aclose", "terminate", "kill", "release", "cancel",
-    "unlink", "wait_closed", "shutdown", "stop", "abort", "detach",
-}
+# shared with the summary layer's resource-escape extraction, so both
+# sides agree on what counts as "closing" a handle
+_CLOSE_METHODS = CLOSE_METHODS
 
 
 def _qualname(ctx: FileContext, node) -> str:
@@ -468,16 +614,23 @@ def _idempotent_ensure(ctx: FileContext, node) -> bool:
     return False
 
 
-def _protecting_use(ctx: FileContext, name_node) -> bool:
+def _protecting_use(ctx: FileContext, fn, name_node) -> bool:
     """A bare-name use of a handle that transfers or guards ownership:
     with-item, return/yield, call argument, stored into an object, or
-    aliased to another name."""
+    aliased to another name.
+
+    v3 treated ANY call argument as an ownership transfer.  With
+    summaries, a call resolved to a project function whose parameter
+    summary says the handle is *leaked* (never closed, stored, or
+    passed on) is NOT a transfer — the window stays open through the
+    helper.  Unresolved calls keep the v3 benefit of the doubt."""
     cur, parent = name_node, ctx.parents.get(name_node)
     while parent is not None and not isinstance(parent, ast.stmt):
         if isinstance(parent, ast.withitem):
             return True
         if isinstance(parent, ast.Call) and cur is not parent.func:
-            return True          # passed as an argument: ownership moves
+            if not _leaky_pass(ctx, fn, parent, cur):
+                return True      # passed as an argument: ownership moves
         if isinstance(parent, (ast.Return, ast.Yield)):
             return True
         cur, parent = parent, ctx.parents.get(parent)
@@ -487,6 +640,25 @@ def _protecting_use(ctx: FileContext, name_node) -> bool:
                                                     {name_node.id}):
         return True              # stored/aliased: the new owner cleans up
     return False
+
+
+def _leaky_pass(ctx: FileContext, fn, call, arg) -> bool:
+    """True when *arg* passed to *call* provably does NOT transfer
+    ownership: the callee's summary marks that parameter leaked."""
+    db = ctx.summaries
+    if db is None or arg not in call.args:
+        return False
+    name = dotted(call.func)
+    if name is None:
+        return False
+    s = db.resolve_call(ctx.path, fn, name)
+    if s is None:
+        return False
+    params = _callee_params(ctx, s)
+    pos = call.args.index(arg)
+    if pos >= len(params):
+        return False
+    return s.param_effects.get(params[pos]) == "leaked"
 
 
 @rule(RULE_CANCEL,
@@ -500,15 +672,27 @@ def cancel_unsafe_acquire(ctx: FileContext):
     the tar spawn).  Flagged when a path from the acquisition reaches
     an await before the handle is protected or ownership moves."""
     config = ctx.config
+    db = ctx.summaries
     for fn, cfg in ctx.cfgs.items():
         if not isinstance(fn, ast.AsyncFunctionDef):
             continue
+        susp = _suspend_filter(ctx, fn)
         for b, i, e in list(cfg.events()):
             if e.kind != CALL:
                 continue
             handleish = _name_match(config.acquire_calls, e.name)
             discardish = _name_match(config.acquire_discard_calls,
                                      e.name)
+            if not handleish and not discardish and db is not None:
+                # a helper whose summary RETURNS an acquired handle is
+                # itself an acquire: calling it opens the same cancel
+                # window the direct call would
+                s = db.resolve_call(ctx.path, fn, e.name)
+                if s is not None and s.returns_resource and (
+                        not s.is_async
+                        or isinstance(ctx.parents.get(e.node),
+                                      ast.Await)):
+                    handleish = True
             if not handleish and not discardish:
                 continue
             kind, data = _binding_of(ctx, e.node)
@@ -527,6 +711,9 @@ def cancel_unsafe_acquire(ctx: FileContext):
 
                 def classify_discard(ev, awaited):
                     if ev.kind == AWAIT:
+                        if susp is not None and not susp(ev):
+                            return KEEP   # proven inline: cancel
+                                          # cannot land here
                         return STOP if _cleanup_try(ctx, ev.node, None) \
                             else HIT
                     return KEEP
@@ -556,7 +743,7 @@ def cancel_unsafe_acquire(ctx: FileContext):
             if start is None:
                 continue
 
-            def classify(ev, awaited, *, _handles=handles):
+            def classify(ev, awaited, *, _handles=handles, _fn=fn):
                 if ev.kind == STORE_NAME and ev.name in _handles:
                     return STOP   # rebound: this window is over
                 if ev.kind == LOAD and ev.name:
@@ -566,8 +753,12 @@ def cancel_unsafe_acquire(ctx: FileContext):
                         return STOP   # direct close/transfer call
                     return KEEP
                 if ev.kind == LOAD_NAME and ev.name in _handles:
-                    return STOP if _protecting_use(ctx, ev.node) else KEEP
+                    return STOP if _protecting_use(ctx, _fn, ev.node) \
+                        else KEEP
                 if ev.kind == AWAIT:
+                    if susp is not None and not susp(ev):
+                        return KEEP   # proven inline: cancel cannot
+                                      # land here
                     return STOP if _cleanup_try(ctx, ev.node, _handles) \
                         else HIT
                 return KEEP

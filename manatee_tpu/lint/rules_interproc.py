@@ -1,0 +1,101 @@
+"""Rules that only exist because of the summary layer: findings whose
+evidence lives entirely in OTHER functions.
+
+``transitive-blocking-in-async`` is the static half of the
+``obs.loop.stall`` contract (docs/lint.md, docs/observability.md): any
+call chain the runtime watchdog could catch blocking the loop must be
+derivable here, and vice versa — a stall whose culprit these summaries
+cannot derive is journaled as an ``obs.lint.discrepancy`` by
+obs/profile.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from manatee_tpu.lint.engine import FileContext, dotted, rule
+from manatee_tpu.lint.rules_async import _sync_calls_in_async
+from manatee_tpu.lint.summaries import is_blocking_name
+
+RULE_TRANSITIVE = "transitive-blocking-in-async"
+RULE_SWALLOW_TRANS = "cancellation-swallowed-transitively"
+
+
+def _render_chain(db, fqn: str, kind: str = "block") -> str:
+    links = db.chain(fqn, kind)
+    return " -> ".join(links) if links else fqn
+
+
+@rule(RULE_TRANSITIVE,
+      "sync helper chain that blocks, called from a coroutine")
+def transitive_blocking_in_async(ctx: FileContext):
+    """``blocking-call-in-async`` sees ``time.sleep`` spelled at the
+    call site; it cannot see ``self._persist()`` three frames above it.
+    This rule resolves every un-awaited call inside a coroutine through
+    the project call graph and flags the ones whose summary proves the
+    chain reaches the blocking catalog — with the full witness chain in
+    the message, because the fix usually belongs at the BOTTOM of the
+    chain (or the whole helper belongs in ``asyncio.to_thread``, which
+    breaks the call edge and the finding with it).  Chains that end
+    only in ``blocking-by-design`` config entries (documented
+    deliberate blocking, e.g. dirstore's no-await meta RMW) are not
+    reported; the may_block summary itself stays whole, so the
+    runtime stall watchdog still derives those stalls."""
+    db = ctx.summaries
+    if db is None:
+        return
+    owners = ctx.owners
+    for node in _sync_calls_in_async(ctx):
+        name = dotted(node.func)
+        if name is None:
+            continue
+        attr = node.func.attr \
+            if isinstance(node.func, ast.Attribute) else None
+        if is_blocking_name(db.canonical(ctx.path, name), attr,
+                            ctx.config):
+            continue             # direct hit: the v1 rules own it
+        s = db.resolve_call(ctx.path, owners.get(node), name)
+        if s is None or s.is_async or not s.reportable_block:
+            continue
+        yield ctx.finding(
+            node.lineno, RULE_TRANSITIVE,
+            "%s() transitively blocks the event loop: %s — make the "
+            "chain async, or push the whole helper into "
+            "run_in_executor/to_thread" % (name,
+                                           _render_chain(db, s.fqn)))
+
+
+@rule(RULE_SWALLOW_TRANS,
+      "awaited helper whose generic except eats CancelledError")
+def cancellation_swallowed_transitively(ctx: FileContext):
+    """``swallowed-cancellation`` flags the generic ``except`` where it
+    is written; this flags the *await* that trusts it.  Awaiting a
+    coroutine that swallows cancellation means a ``.cancel()`` on THIS
+    task can vanish inside the callee — the canceller hangs while this
+    frame keeps running.  In a clean tree the base rule keeps the
+    callee-side finding from ever existing, so this rule fires only
+    when the swallow is suppressed or path-disabled somewhere else —
+    exactly the hole a caller cannot see."""
+    db = ctx.summaries
+    if db is None:
+        return
+    owners = ctx.owners
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Await) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        owner = owners.get(node)
+        if not isinstance(owner, ast.AsyncFunctionDef):
+            continue
+        name = dotted(node.value.func)
+        if name is None:
+            continue
+        s = db.resolve_call(ctx.path, owner, name)
+        if s is None or not s.swallows:
+            continue
+        yield ctx.finding(
+            node.lineno, RULE_SWALLOW_TRANS,
+            "awaiting %s() can swallow this task's cancellation: %s — "
+            "re-raise CancelledError in the callee (or cancel-shield "
+            "deliberately and say so)"
+            % (name, _render_chain(db, s.fqn, "swallow")))

@@ -1,0 +1,166 @@
+"""Observability catalog drift: emitted names must be documented.
+
+docs/observability.md carries the metric and journal-event catalogs the
+operator tooling (and the SLO dashboards built on top) navigate by.
+The faults catalog is drift-proof because ``faultpoint-unregistered``
+makes an uncataloged name a lint error; this module gives metric names
+and journal event types the same property, in both directions:
+
+- the rule here flags any ``*.counter/gauge/histogram("name", ...)`` or
+  ``journal.record("event", ...)`` whose literal name is absent from
+  the doc's backtick-quoted catalog entries;
+- tests/test_obs_catalog.py (tier-1) sweeps the production tree with
+  the same collector, so the contract holds even for files a targeted
+  lint run skipped.
+
+Computed names are skipped — except the constant-prefix forms
+(``"coord.session." + event``, f-strings with a literal head), which
+are checked as prefixes against the catalog (the doc documents those
+families as ``coord.session.connected|disconnected|expired``).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from manatee_tpu.lint.engine import FileContext, dotted, rule
+
+RULE = "obs-name-undocumented"
+
+DOC = "docs/observability.md"
+_DOC_PATH = Path(__file__).resolve().parents[2] / DOC
+
+# receivers that identify the metric registry / the journal
+_REGISTRY_RECV = {"_REG", "reg", "_registry", "registry"}
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _recv_kind(func: ast.Attribute) -> str | None:
+    """'metric' / 'journal' when the call receiver is the metrics
+    registry or the event journal, else None."""
+    recv = func.value
+    if isinstance(recv, ast.Call):
+        inner = dotted(recv.func)
+        last = inner.rsplit(".", 1)[-1] if inner else ""
+        if last == "get_registry" and func.attr in _METRIC_METHODS:
+            return "metric"
+        if last == "get_journal" and func.attr == "record":
+            return "journal"
+        return None
+    name = dotted(recv)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last in _REGISTRY_RECV and func.attr in _METRIC_METHODS:
+        return "metric"
+    if last.endswith("journal") and func.attr == "record":
+        return "journal"
+    return None
+
+
+def _literal_or_prefix(arg) -> tuple:
+    """('name', s) for a string literal, ('prefix', s) for a constant
+    head of a computed name, (None, None) otherwise."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return ("name", arg.value)
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+            and isinstance(arg.left, ast.Constant) \
+            and isinstance(arg.left.value, str):
+        return ("prefix", arg.left.value)
+    if isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant) \
+            and isinstance(arg.values[0].value, str):
+        return ("prefix", arg.values[0].value)
+    return (None, None)
+
+
+def collect_obs_names(tree) -> list:
+    """[(kind, 'name'|'prefix', value, line)] for every metric
+    registration and journal record in *tree* — the single collector
+    the lint rule and the tier-1 sync test share."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        kind = _recv_kind(node.func)
+        if kind is None or not node.args:
+            continue
+        how, value = _literal_or_prefix(node.args[0])
+        if how is None or not value:
+            continue
+        out.append((kind, how, value, node.lineno))
+    return out
+
+
+def documented_names(text: str) -> set:
+    """Every backtick-quoted token in the doc, with the catalog's
+    ``a.b.c|d|e`` / ``a_b|c`` alternation expanded (each alternative
+    replaces the last dotted/underscored segment)."""
+    names: set = set()
+    for raw in _backtick_tokens(text):
+        parts = raw.split("|")
+        names.add(parts[0])
+        if len(parts) > 1:
+            head = parts[0]
+            for sep in (".", "_"):
+                if sep in head:
+                    stem = head.rsplit(sep, 1)[0]
+                    for alt in parts[1:]:
+                        names.add(stem + sep + alt)
+                    break
+            else:
+                names.update(parts[1:])
+    return names
+
+
+def _backtick_tokens(text: str):
+    out = []
+    cur = None
+    for ch in text:
+        if ch == "`":
+            if cur is None:
+                cur = []
+            else:
+                tok = "".join(cur).strip()
+                if tok:
+                    out.append(tok)
+                cur = None
+        elif cur is not None:
+            cur.append(ch)
+    return out
+
+
+def _doc_names() -> set | None:
+    try:
+        return documented_names(_DOC_PATH.read_text())
+    except OSError:
+        return None
+
+
+@rule(RULE, "metric/journal name missing from the observability "
+            "catalog (%s)" % DOC)
+def obs_name_undocumented(ctx: FileContext):
+    documented = _doc_names()
+    if documented is None:
+        return                   # no doc checkout: nothing to enforce
+    for kind, how, value, line in collect_obs_names(ctx.tree):
+        label = "metric" if kind == "metric" else "journal event"
+        if how == "name":
+            if value in documented:
+                continue
+            yield ctx.finding(
+                line, RULE,
+                "%s %r is not in the %s catalog — document it there "
+                "(name, type/labels, meaning) or stop emitting it"
+                % (label, value, DOC))
+        else:                    # constant prefix of a computed name
+            if any(d.startswith(value) for d in documented):
+                continue
+            yield ctx.finding(
+                line, RULE,
+                "computed %s name with prefix %r matches nothing in "
+                "the %s catalog — document the family (e.g. "
+                "'%s...') or emit a cataloged literal"
+                % (label, value, DOC, value))

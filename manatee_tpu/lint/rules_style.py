@@ -61,6 +61,20 @@ def unused_import(ctx: FileContext):
                               "unused import %r" % name)
 
 
+def _is_accessor_overload(child) -> bool:
+    """``@x.setter``/``@x.getter``/``@x.deleter`` (and
+    ``@singledispatch``-style ``@x.register``) deliberately redefine
+    ``x`` — the decorator consumes the previous binding."""
+    for dec in child.decorator_list:
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Attribute) \
+                and dec.attr in ("setter", "getter", "deleter",
+                                 "register"):
+            return True
+    return False
+
+
 @rule("shadowed-def", "duplicate def/class in the same scope")
 def shadowed_def(ctx: FileContext):
     """A shadowed def is almost always a copy-paste bug."""
@@ -72,7 +86,8 @@ def shadowed_def(ctx: FileContext):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.ClassDef)):
                 key = child.name
-                if key in names and not key.startswith("_dup_ok"):
+                if key in names and not key.startswith("_dup_ok") \
+                        and not _is_accessor_overload(child):
                     yield ctx.finding(
                         child.lineno, "shadowed-def",
                         "%r shadows definition at line %d"

@@ -1,0 +1,942 @@
+"""Per-function interprocedural summaries, computed to fixpoint.
+
+The v3 flow rules analyze one function at a time, so a helper call is
+an opaque event: an await, a blocking syscall, or a resource
+acquisition one call level down is invisible.  This module closes that
+hole.  For every function def in the project it computes a
+:class:`Summary` with:
+
+- **may_suspend** — the function (async) transitively contains a real
+  suspension point.  ``await g()`` where ``g`` is a project coroutine
+  that never suspends runs inline without yielding to the loop, so the
+  flow rules can stop treating such awaits as interleave points;
+- **may_block** — transitively reaches a call in the blocking catalog
+  (``time.sleep``, subprocess, sync file I/O, ...) on the calling
+  thread, with a witness chain for the message and for the runtime
+  stall cross-check (obs/profile.py);
+- **swallows_cancellation** — a generic except arm around awaits, or an
+  awaited callee that has one: awaiting this function can absorb a
+  cancel;
+- **returns_resource** — a handle from an acquire call (config
+  ``acquire-calls``) flows to the return value: calling this function
+  IS acquiring, so cancel-unsafe-acquire treats the call site as the
+  acquisition;
+- **param_effects** — per parameter: ``closed`` (a close method or
+  ``with`` scope), ``escaped`` (returned / stored / aliased),
+  ``unknown`` (passed to something unresolvable — protective, sound),
+  or ``leaked`` (none of the above on any path: passing a handle here
+  is NOT an ownership transfer);
+- **lock-effects** — locks acquired/released, locks held for the whole
+  body, and ``required_held``: locks every same-class resolved call
+  site provably holds around the call (windows inside such a helper
+  are already guarded by the callers);
+- **save_calls / load_returns** — the function performs a
+  ``*save*``-glob state write with a parameter as the value (or
+  returns a ``*load*``-glob read), letting atomic-section-broken pair
+  load-modify-save windows through one helper level.
+
+Soundness contract (see docs/lint.md): every fact is *may* (or, for
+``required_held``/``param_effects`` protections, *must*) information
+with the default chosen so an UNRESOLVED call behaves exactly like the
+opaque call v3 assumed — sharper resolution can only remove false
+negatives or false positives, never add unsound silence.  Extraction
+is purely per-file (content-cacheable); resolution and the fixpoint
+always re-run in memory over the whole graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+
+from manatee_tpu.lint import callgraph as cg
+from manatee_tpu.lint.engine import (
+    Config,
+    allow_matches,
+    dotted,
+    iter_files,
+    walk_no_defs,
+)
+
+# ---- shared catalogs (single source for rules_async + summaries) ----
+
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "os.system", "os.popen", "os.wait", "os.waitpid",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "subprocess.getstatusoutput", "subprocess.Popen",
+    "socket.create_connection", "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+})
+# sync file I/O: the open() builtin plus pathlib-style method names
+BLOCKING_IO_CALLS = frozenset({"open"})
+BLOCKING_IO_METHODS = frozenset({
+    "read_text", "write_text", "read_bytes", "write_bytes",
+})
+# methods that close/terminate a handle (shared with rules_flow)
+CLOSE_METHODS = frozenset({
+    "close", "aclose", "terminate", "kill", "release", "cancel",
+    "unlink", "wait_closed", "shutdown", "stop", "abort", "detach",
+})
+_ACQ_WRAPPERS = frozenset({"wait_for", "shield"})
+_GENERIC_EXC = frozenset({"Exception", "BaseException"})
+
+# witness chains and fixpoint rounds are bounded (cycles in the call
+# graph converge anyway; these keep pathological graphs cheap)
+_CHAIN_BOUND = 12
+_ROUND_BOUND = 100
+
+
+def _name_match(entries, name: str | None) -> bool:
+    if not name:
+        return False
+    for entry in entries:
+        if "." in entry:
+            if name == entry:
+                return True
+        elif name == entry or name.endswith("." + entry):
+            return True
+    return False
+
+
+def is_blocking_name(name: str | None, attr: str | None,
+                     config: Config) -> str | None:
+    """The catalog entry a (canonicalized) call name hits, or None.
+    *attr* is the raw attribute name for method-style I/O."""
+    if name and name in (BLOCKING_CALLS | config.blocking_extra):
+        return name
+    if name and name in BLOCKING_IO_CALLS:
+        return name
+    if attr and attr in BLOCKING_IO_METHODS:
+        return "." + attr
+    return None
+
+
+# ---- per-file fact extraction (content-determined, cacheable) ----
+
+def _mentions(node, names: set) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(node))
+
+
+def _lock_stack(parents: dict, node, fn) -> tuple:
+    """Dotted with-locks lexically enclosing *node* within *fn*."""
+    out = []
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                d = dotted(item.context_expr)
+                if d:
+                    out.append(d)
+        cur = parents.get(cur)
+    return tuple(sorted(set(out)))
+
+
+def _glob_stem(name: str, globs) -> str | None:
+    import fnmatch
+    for g in globs:
+        if fnmatch.fnmatch(name, g):
+            core = g.replace("*", "")
+            if core and core in name:
+                return name.replace(core, "", 1)
+            return name
+    return None
+
+
+def _handler_swallows(try_node: ast.Try) -> int | None:
+    """Line of the first generic handler that can eat CancelledError
+    (mirrors the swallowed-cancellation rule's arm logic)."""
+    cancel_armed = False
+    for h in try_node.handlers:
+        names = set()
+        if h.type is not None:
+            nodes = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            for n in nodes:
+                d = dotted(n)
+                if d:
+                    names.add(d.rsplit(".", 1)[-1])
+        if "CancelledError" in names:
+            cancel_armed = True
+            continue
+        generic = h.type is None or (names & _GENERIC_EXC)
+        if not generic or cancel_armed:
+            continue
+        if any(isinstance(n, ast.Raise) for s in h.body
+               for n in walk_no_defs(s)):
+            continue
+        return h.lineno
+    return None
+
+
+def _local_has_await(stmts) -> bool:
+    for stmt in stmts:
+        for node in walk_no_defs(stmt):
+            if isinstance(node,
+                          (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                return True
+    return False
+
+
+class _FuncExtractor:
+    """Local facts for one def: everything the fixpoint needs, no
+    resolution, JSON-able output."""
+
+    def __init__(self, path, fn, parents, config: Config):
+        self.path = path
+        self.fn = fn
+        self.parents = parents
+        self.config = config
+        self.is_async = isinstance(fn, ast.AsyncFunctionDef)
+
+    def run(self) -> dict:
+        fn, parents, config = self.fn, self.parents, self.config
+        calls = []
+        blocking = []
+        hard_suspends = False
+        swallow_line = None
+        save_calls = []
+        load_returns = []
+        locks_acquired: set = set()
+        locks_released: set = set()
+        acq_locals: set = set()
+        ret_nodes = []
+        params = cg._def_params(fn, self._in_class())
+        param_set = set(params)
+        param_close: set = set()
+        param_escape: set = set()
+        param_pass: dict = {p: [] for p in params}
+        return_acquire = False
+
+        for node in walk_no_defs(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                ret_nodes.append(node.value)
+            if isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+                hard_suspends = True
+            if isinstance(node, ast.Yield) and self.is_async:
+                hard_suspends = True
+            if isinstance(node, ast.Await):
+                v = node.value
+                if not (isinstance(v, ast.Call)
+                        and dotted(v.func) is not None):
+                    hard_suspends = True
+            if isinstance(node, ast.Try) and self.is_async \
+                    and swallow_line is None and _local_has_await(node.body):
+                swallow_line = _handler_swallows(node)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = dotted(item.context_expr)
+                    if d:
+                        locks_acquired.add(d)
+                    # `with p:` scope-protects a parameter handle
+                    if isinstance(item.context_expr, ast.Name) \
+                            and item.context_expr.id in param_set:
+                        param_close.add(item.context_expr.id)
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            attr = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else None
+            if attr == "release" and name:
+                locks_released.add(name.rsplit(".", 1)[0])
+            # parameter effects: receiver of a close method, or passed
+            # as an argument to another call
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in param_set:
+                if attr in CLOSE_METHODS:
+                    param_close.add(node.func.value.id)
+            for pos, a in enumerate(node.args):
+                if isinstance(a, ast.Name) and a.id in param_set:
+                    param_pass[a.id].append([name, pos])
+            if name is None:
+                continue
+            awaited = isinstance(self.parents.get(node), ast.Await)
+            catalog = is_blocking_name(None if name is None else name,
+                                       attr, config)
+            # catalog membership is re-checked at fixpoint time with
+            # import canonicalization; record the raw hit here for the
+            # common spelled-out case
+            if catalog and not awaited:
+                blocking.append([catalog, node.lineno])
+            bound = self._binding_locals(node)
+            if _name_match(config.acquire_calls, name):
+                if bound:
+                    acq_locals.update(bound)
+                elif self._in_return(node):
+                    # `return open(path)`: the acquire IS the return
+                    # value, no local ever binds it
+                    return_acquire = True
+            calls.append({
+                "name": name, "line": node.lineno, "awaited": awaited,
+                "bound": sorted(bound),
+                "in_return": self._in_return(node),
+                "locks": list(_lock_stack(parents, node, fn)),
+            })
+            stem = _glob_stem(name.rsplit(".", 1)[-1],
+                              config.atomic_save_calls)
+            if stem is not None and "." in name:
+                recv = name.rsplit(".", 1)[0]
+                value_args = list(node.args) + [kw.value
+                                                for kw in node.keywords]
+                value_params = sorted(
+                    p for p in param_set
+                    if any(_mentions(a, {p}) for a in value_args))
+                if value_params:
+                    arg0 = None
+                    if node.args:
+                        a0 = node.args[0]
+                        if isinstance(a0, ast.Name) \
+                                and a0.id in param_set:
+                            arg0 = ["param", a0.id]
+                        else:
+                            arg0 = ["dump", ast.dump(a0)]
+                    save_calls.append({
+                        "recv": recv,
+                        "stem": stem,
+                        "value_params": value_params,
+                        "arg0": arg0,
+                        "line": node.lineno,
+                    })
+
+        # `return <recv>.<load-glob>(args)` (possibly awaited)
+        for val in ret_nodes:
+            v = val.value if isinstance(val, ast.Await) else val
+            if not (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)):
+                continue
+            recv = dotted(v.func.value)
+            stem = _glob_stem(v.func.attr, config.atomic_load_calls)
+            if recv is None or stem is None:
+                continue
+            arg0 = None
+            if v.args:
+                a0 = v.args[0]
+                if isinstance(a0, ast.Name) and a0.id in param_set:
+                    arg0 = ["param", a0.id]
+                else:
+                    arg0 = ["dump", ast.dump(a0)]
+            load_returns.append({"recv": recv, "stem": stem,
+                                 "arg0": arg0, "line": v.lineno})
+
+        returns_resource = return_acquire or (any(
+            self._escaping_names(val, acq_locals)
+            for val in ret_nodes) if acq_locals else False)
+        for val in ret_nodes:
+            for p in param_set & {n.id for n in ast.walk(val)
+                                  if isinstance(n, ast.Name)}:
+                param_escape.add(p)
+        for node in walk_no_defs(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                stores = any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    or isinstance(t, ast.Name)
+                    for t in targets)
+                if stores:
+                    for p in param_set:
+                        if _mentions(node.value, {p}):
+                            param_escape.add(p)
+            elif isinstance(node, ast.Yield) and node.value is not None:
+                for p in param_set:
+                    if _mentions(node.value, {p}):
+                        param_escape.add(p)
+
+        param_local = {}
+        for p in params:
+            if p in param_close:
+                param_local[p] = "closed"
+            elif p in param_escape:
+                param_local[p] = "escaped"
+            elif param_pass[p]:
+                param_local[p] = "passed"
+            else:
+                param_local[p] = "leaked"
+
+        holds = frozenset()
+        body = fn.body
+        while len(body) == 1 and isinstance(body[0], (ast.With,
+                                                      ast.AsyncWith)):
+            names = {d for item in body[0].items
+                     if (d := dotted(item.context_expr)) is not None}
+            holds = holds | names
+            body = body[0].body
+
+        return {
+            "is_async": self.is_async,
+            "line": self.fn.lineno,
+            "end_line": getattr(self.fn, "end_lineno", self.fn.lineno),
+            "params": params,
+            "calls": calls,
+            "blocking": blocking,
+            "hard_suspends": hard_suspends,
+            "swallow_line": swallow_line,
+            "returns_resource": returns_resource,
+            "param_local": param_local,
+            "param_pass": {p: v for p, v in param_pass.items() if v},
+            "save_calls": save_calls,
+            "load_returns": load_returns,
+            "locks_acquired": sorted(locks_acquired),
+            "locks_released": sorted(locks_released),
+            "holds_throughout": sorted(holds),
+        }
+
+    def _in_class(self) -> bool:
+        return isinstance(self.parents.get(self.fn), ast.ClassDef)
+
+    def _binding_locals(self, call) -> set:
+        """Locals the call's result is bound to, climbing await and
+        wait_for/shield wrappers (mirrors rules_flow._binding_of)."""
+        cur, parent = call, self.parents.get(call)
+        while True:
+            if isinstance(parent, ast.Await):
+                cur, parent = parent, self.parents.get(parent)
+                continue
+            if isinstance(parent, ast.Call):
+                pname = dotted(parent.func)
+                if pname and pname.rsplit(".", 1)[-1] in _ACQ_WRAPPERS \
+                        and cur in parent.args:
+                    cur, parent = parent, self.parents.get(parent)
+                    continue
+            break
+        if isinstance(parent, ast.Assign) and parent.value is cur \
+                and len(parent.targets) == 1:
+            t = parent.targets[0]
+            if isinstance(t, ast.Name):
+                return {t.id}
+            if isinstance(t, ast.Tuple) and all(
+                    isinstance(e, ast.Name) for e in t.elts):
+                return {e.id for e in t.elts}
+        return set()
+
+    def _in_return(self, call) -> bool:
+        cur, parent = call, self.parents.get(call)
+        while isinstance(parent, (ast.Await, ast.Tuple, ast.List)):
+            cur, parent = parent, self.parents.get(parent)
+        return isinstance(parent, ast.Return)
+
+    def _escaping_names(self, val, names: set) -> set:
+        """Names from *names* that *val* hands to the caller AS
+        THEMSELVES: a bare load, not an attribute read off them —
+        ``return proc.returncode`` does not hand over ``proc``, so the
+        caller has nothing to close."""
+        out = set()
+        for n in ast.walk(val):
+            if isinstance(n, ast.Name) and n.id in names:
+                par = self.parents.get(n)
+                if isinstance(par, ast.Attribute) and par.value is n:
+                    continue
+                out.add(n.id)
+        return out
+
+
+def extract_file_facts(path: str, tree: ast.AST,
+                       config: Config) -> dict:
+    """Declaration dict + per-def local facts for one file."""
+    decl, nodes = cg.scan_module(str(path), tree)
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    funcs = {}
+    for qualname, fn in nodes.items():
+        funcs[qualname] = _FuncExtractor(path, fn, parents,
+                                         config).run()
+    return {"decl": decl, "funcs": funcs}
+
+
+# ---- summaries + fixpoint ----
+
+class Summary:
+    """Fixpoint result for one function (see module docstring)."""
+
+    __slots__ = ("fqn", "path", "qualname", "line", "end_line",
+                 "is_async", "may_suspend", "may_block", "block_via",
+                 "reportable_block", "swallows", "swallow_via",
+                 "returns_resource", "resource_via", "param_effects",
+                 "save_calls", "load_returns", "locks_acquired",
+                 "locks_released", "holds_throughout", "required_held",
+                 "callees")
+
+    def __init__(self, fd: cg.FuncDef, facts: dict):
+        self.fqn = fd.fqn
+        self.path = fd.path
+        self.qualname = fd.qualname
+        self.line = facts["line"]
+        self.end_line = facts["end_line"]
+        self.is_async = facts["is_async"]
+        self.may_suspend = False
+        self.may_block = False
+        self.block_via = None      # ("direct", name, line) |
+                                   # ("call", fqn, line)
+        # may_block minus chains accounted for by blocking-by-design
+        # config entries — what transitive-blocking-in-async reports.
+        # may_block itself stays whole for the runtime stall contract.
+        self.reportable_block = False
+        self.swallows = False
+        self.swallow_via = None
+        self.returns_resource = facts["returns_resource"]
+        self.resource_via = "acquire" if self.returns_resource else None
+        self.param_effects: dict = {}
+        self.save_calls = facts["save_calls"]
+        self.load_returns = facts["load_returns"]
+        self.locks_acquired = frozenset(facts["locks_acquired"])
+        self.locks_released = frozenset(facts["locks_released"])
+        self.holds_throughout = frozenset(facts["holds_throughout"])
+        self.required_held: frozenset = frozenset()
+        self.callees: dict = {}    # fqn -> True (resolved out-edges)
+
+    def digest(self) -> str:
+        """Content digest of everything a CALLER can observe; cache
+        entries of callers record these per dependency."""
+        payload = {
+            "suspend": self.may_suspend, "block": self.may_block,
+            "reportable": self.reportable_block,
+            "swallows": self.swallows,
+            "resource": self.returns_resource,
+            "params": self.param_effects,
+            "saves": self.save_calls, "loads": self.load_returns,
+            "req": sorted(self.required_held),
+            "holds": sorted(self.holds_throughout),
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+
+class SummaryDB:
+    """The project-wide summary database rules consult."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.graph = cg.CallGraph()
+        self.summaries: dict[str, Summary] = {}
+        self._facts: dict[str, dict] = {}     # path -> file facts
+        self.trees: dict[str, tuple] = {}     # path -> (text, tree)
+        self.facts_hits = 0
+        self.facts_misses = 0
+        self.rounds = 0
+        self.resolved_edges = 0
+        self.unresolved_edges = 0
+
+    # -- construction --
+
+    @classmethod
+    def build(cls, paths, config: Config, cache=None,
+              root=None) -> "SummaryDB":
+        """Scan *paths* (directories/files, same walk as the linter),
+        reusing per-file facts from *cache* (a ResultCache) when the
+        content hash still matches.  *root*, when given, relativizes
+        path keys (module names depend on repo-relative paths)."""
+        import os
+        db = cls(config)
+        for f in iter_files(paths, config):
+            path = str(f)
+            if root is not None:
+                try:
+                    path = os.path.relpath(path, str(root))
+                except ValueError:
+                    pass
+            facts = cache.lookup_facts(f) if cache is not None else None
+            if facts is not None:
+                db.facts_hits += 1
+                db._facts[path] = facts
+                continue
+            db.facts_misses += 1
+            try:
+                text = f.read_text()
+                tree = ast.parse(text, filename=path)
+            except (OSError, SyntaxError, UnicodeDecodeError,
+                    ValueError):
+                continue
+            db.trees[path] = (text, tree)
+            facts = extract_file_facts(path, tree, config)
+            db._facts[path] = facts
+            if cache is not None:
+                cache.store_facts(f, facts)
+        db._assemble()
+        return db
+
+    @classmethod
+    def build_from_sources(cls, files, config: Config) -> "SummaryDB":
+        """*files*: iterable of (path, text, tree) already in hand
+        (single-file contexts, unit fixtures)."""
+        db = cls(config)
+        for path, text, tree in files:
+            path = str(path)
+            db.trees[path] = (text, tree)
+            db._facts[path] = extract_file_facts(path, tree, config)
+            db.facts_misses += 1
+        db._assemble()
+        return db
+
+    def _assemble(self):
+        for facts in self._facts.values():
+            self.graph.add(facts["decl"])
+        self._propagate()
+
+    # -- fixpoint --
+
+    def _each_func(self):
+        for path, facts in self._facts.items():
+            modname = facts["decl"]["name"]
+            for qualname, ff in facts["funcs"].items():
+                fqn = "%s:%s" % (modname, qualname)
+                yield path, fqn, ff
+
+    def _propagate(self):
+        graph, config = self.graph, self.config
+        bydesign = config.blocking_by_design
+        declared: set = set()      # fqns blocking-by-design covers
+        # seed summaries + resolve every call edge once
+        edges: dict[str, list] = {}
+        in_edges: dict[str, list] = {}
+        for path, fqn, ff in self._each_func():
+            fd = graph.defs.get(fqn)
+            if fd is None:
+                continue
+            s = Summary(fd, ff)
+            self.summaries[fqn] = s
+            if bydesign and allow_matches(bydesign, fd.path,
+                                          fd.qualname):
+                declared.add(fqn)
+            out = []
+            for call in ff["calls"]:
+                callee = graph.resolve(fd, path, call["name"])
+                if callee is None:
+                    self.unresolved_edges += 1
+                    # sound default: an awaited call we cannot resolve
+                    # (asyncio.sleep, a peer RPC, a queue get) may
+                    # genuinely suspend — only a RESOLVED project
+                    # coroutine can ever be proven inline
+                    if call["awaited"] and s.is_async:
+                        s.may_suspend = True
+                    # canonicalized catalog check: `sleep(1)` after
+                    # `from time import sleep` is a direct block
+                    canon = graph.canonical(path, call["name"])
+                    attr = call["name"].rsplit(".", 1)[-1] \
+                        if "." in call["name"] else None
+                    hit = is_blocking_name(canon, attr, config)
+                    if hit and not call["awaited"] \
+                            and [hit, call["line"]] not in ff["blocking"]:
+                        ff["blocking"].append([hit, call["line"]])
+                    continue
+                self.resolved_edges += 1
+                out.append((callee.fqn, call))
+                s.callees[callee.fqn] = True
+                in_edges.setdefault(callee.fqn, []).append((fqn, call))
+            edges[fqn] = out
+            if ff["blocking"]:
+                name, line = ff["blocking"][0]
+                s.may_block = True
+                s.block_via = ("direct", name, line)
+                s.reportable_block = fqn not in declared
+            if ff["hard_suspends"] and s.is_async:
+                s.may_suspend = True
+            if ff["swallow_line"] is not None and s.is_async:
+                s.swallows = True
+                s.swallow_via = ("direct", "except",
+                                 ff["swallow_line"])
+
+        facts_of = {fqn: ff for _p, fqn, ff in self._each_func()}
+
+        # required_held: private methods whose every same-class
+        # resolved call site holds the same lock(s) around the call
+        for fqn, s in self.summaries.items():
+            fd = graph.defs.get(fqn)
+            if fd is None or fd.cls is None \
+                    or not fd.name.startswith("_"):
+                continue
+            callers = in_edges.get(fqn, [])
+            if not callers:
+                continue
+            held = None
+            for caller_fqn, call in callers:
+                cfd = graph.defs.get(caller_fqn)
+                if cfd is None or cfd.cls != fd.cls \
+                        or cfd.module != fd.module:
+                    held = frozenset()
+                    break
+                site = frozenset(call["locks"])
+                held = site if held is None else (held & site)
+            s.required_held = held or frozenset()
+
+        # monotone fixpoint over may_* / swallows / returns_resource /
+        # param effects
+        for self.rounds in range(1, _ROUND_BOUND + 1):
+            changed = False
+            for fqn, s in self.summaries.items():
+                ff = facts_of.get(fqn)
+                if ff is None:
+                    continue
+                for callee_fqn, call in edges.get(fqn, ()):
+                    c = self.summaries.get(callee_fqn)
+                    if c is None:
+                        continue
+                    runs_inline = (not c.is_async) or call["awaited"]
+                    if runs_inline and c.may_block and not s.may_block:
+                        s.may_block = True
+                        s.block_via = ("call", callee_fqn,
+                                       call["line"])
+                        changed = True
+                    if runs_inline and c.reportable_block \
+                            and not s.reportable_block \
+                            and fqn not in declared:
+                        s.reportable_block = True
+                        changed = True
+                    if s.is_async and call["awaited"] and c.is_async:
+                        if c.may_suspend and not s.may_suspend:
+                            s.may_suspend = True
+                            changed = True
+                        if c.swallows and not s.swallows:
+                            s.swallows = True
+                            s.swallow_via = ("call", callee_fqn,
+                                             call["line"])
+                            changed = True
+                    if call["in_return"] and c.returns_resource \
+                            and runs_inline and not s.returns_resource:
+                        s.returns_resource = True
+                        s.resource_via = callee_fqn
+                        changed = True
+                # param effects: a pure-pass param is protected when
+                # some resolved target protects it; unresolved targets
+                # are protective by default (sound)
+                for p, local in ff["param_local"].items():
+                    if local != "passed":
+                        if s.param_effects.get(p) != local:
+                            s.param_effects[p] = local
+                            changed = True
+                        continue
+                    cur = s.param_effects.get(p, "leaked")
+                    if cur != "leaked":
+                        continue
+                    effect = "leaked"
+                    for callee_name, pos in ff["param_pass"].get(p, ()):
+                        fd = self.graph.defs.get(fqn)
+                        target = self.graph.resolve(
+                            fd, s.path, callee_name)
+                        if target is None:
+                            effect = "unknown"
+                            break
+                        tsum = self.summaries.get(target.fqn)
+                        tparams = target.params
+                        if tsum is None or pos >= len(tparams):
+                            effect = "unknown"
+                            break
+                        te = tsum.param_effects.get(tparams[pos],
+                                                    "leaked")
+                        if te != "leaked":
+                            effect = "unknown"
+                            break
+                    if effect != cur:
+                        s.param_effects[p] = effect
+                        changed = True
+            if not changed:
+                break
+
+    # -- queries --
+
+    def enabled(self) -> bool:
+        return True
+
+    def def_for(self, path: str, fn_node) -> cg.FuncDef | None:
+        return self.graph.def_at(str(path), fn_node.lineno,
+                                 fn_node.name)
+
+    def summary_for(self, path: str, fn_node) -> Summary | None:
+        fd = self.def_for(path, fn_node)
+        return self.summaries.get(fd.fqn) if fd else None
+
+    def resolve_call(self, path: str, fn_node,
+                     name: str | None) -> Summary | None:
+        """Summary of the project function a dotted call *name* inside
+        *fn_node* refers to (None: unresolved, apply sound default)."""
+        caller = self.def_for(path, fn_node) if fn_node is not None \
+            else None
+        fd = self.graph.resolve(caller, str(path), name)
+        return self.summaries.get(fd.fqn) if fd else None
+
+    def canonical(self, path: str, name: str | None) -> str | None:
+        return self.graph.canonical(str(path), name)
+
+    def function_at(self, path: str, line: int) -> Summary | None:
+        """Innermost def whose span contains *line* in *path*."""
+        best = None
+        for s in self.summaries.values():
+            if s.path == str(path) and s.line <= line <= s.end_line:
+                if best is None or s.line > best.line:
+                    best = s
+        return best
+
+    def chain(self, fqn: str, kind: str = "block") -> list[str]:
+        """Human-readable witness chain for a may_block (or swallows)
+        fact: ``["a (p.py:3)", "b (q.py:9)", "time.sleep (q.py:12)"]``."""
+        out = []
+        cur = fqn
+        for _ in range(_CHAIN_BOUND):
+            s = self.summaries.get(cur)
+            if s is None:
+                break
+            via = s.block_via if kind == "block" else s.swallow_via
+            if via is None:
+                break
+            what, target, line = via
+            if what == "direct":
+                out.append("%s (%s:%d)" % (target, s.path, line))
+                break
+            nxt = self.summaries.get(target)
+            label = nxt.qualname if nxt else target
+            out.append("%s (%s:%d)" % (label, s.path, line))
+            cur = target
+        return out
+
+    def digest(self, fqn: str) -> str | None:
+        s = self.summaries.get(fqn)
+        return s.digest() if s else None
+
+    def file_deps(self, path: str) -> dict:
+        """fqn -> digest for every summary a cached result for *path*
+        depends on: the file's own defs (required_held and friends are
+        computed from callers elsewhere) plus every resolved callee."""
+        deps: dict[str, str] = {}
+        path = str(path)
+        for s in self.summaries.values():
+            if s.path != path:
+                continue
+            deps[s.fqn] = s.digest()
+            for callee in s.callees:
+                c = self.summaries.get(callee)
+                if c is not None:
+                    deps[callee] = c.digest()
+        return deps
+
+    def stats(self) -> dict:
+        blocking = sum(1 for s in self.summaries.values()
+                       if s.may_block)
+        return {
+            "modules": len(self.graph.modules),
+            "functions": len(self.summaries),
+            "resolved_edges": self.resolved_edges,
+            "unresolved_edges": self.unresolved_edges,
+            "may_block": blocking,
+            "may_suspend": sum(1 for s in self.summaries.values()
+                               if s.may_suspend),
+            "swallows_cancellation": sum(
+                1 for s in self.summaries.values() if s.swallows),
+            "returns_resource": sum(
+                1 for s in self.summaries.values()
+                if s.returns_resource),
+            "fixpoint_rounds": self.rounds,
+            "facts_cache": {"hits": self.facts_hits,
+                            "misses": self.facts_misses},
+        }
+
+
+# ---- runtime <-> static cross-check (obs/profile.py) ----
+
+class StaticBlockingAudit:
+    """The may-block side of the ``obs.loop.stall`` two-sided contract.
+
+    Built lazily (on the first stall) from the on-disk tree; answers,
+    for a stalled frame stack, whether the static analysis *derives*
+    the culprit (may_block) and whether the blocking rules were told to
+    ignore it (path-disable / inline suppression).  Every journaled
+    ``obs.lint.discrepancy`` is one of:
+
+    - ``via=path-disable`` / ``via=suppression``: lint was exempted
+      from code that demonstrably blocks the loop;
+    - ``via=not-derived``: the stall's culprit frame is NOT derivable
+      from the may-block summaries — the static side is blind and one
+      of the two must be fixed.
+    """
+
+    BLOCK_RULES = ("blocking-call-in-async", "blocking-io-in-async",
+                   "transitive-blocking-in-async")
+
+    def __init__(self, root, config: Config | None = None):
+        from pathlib import Path
+        self.root = Path(root)
+        cfg_path = self.root / ".mnt-lint.json"
+        if config is None:
+            try:
+                config = Config.from_file(cfg_path) \
+                    if cfg_path.is_file() else Config()
+            except (OSError, ValueError):
+                config = Config()
+        self.config = config
+        self._db: SummaryDB | None = None
+        self._sup_cache: dict[str, dict] = {}
+
+    @property
+    def db(self) -> SummaryDB:
+        """The project SummaryDB, built on first use — an exemption
+        verdict (path-disable / suppression) never pays for it; only
+        the derivability side of the contract does."""
+        if self._db is None:
+            paths = [self.root / p for p in
+                     ("manatee_tpu", "tests", "tools")]
+            self._db = SummaryDB.build(
+                [p for p in paths if p.exists()], self.config,
+                root=self.root)
+        return self._db
+
+    def _suppressions(self, rel: str) -> dict:
+        from manatee_tpu.lint.engine import parse_suppressions
+        sup = self._sup_cache.get(rel)
+        if sup is None:
+            try:
+                sup = parse_suppressions(
+                    (self.root / rel).read_text())
+            except OSError:
+                sup = {}
+            self._sup_cache[rel] = sup
+        return sup
+
+    def _exemption(self, rel: str, line: int) -> tuple | None:
+        off = frozenset(self.BLOCK_RULES) \
+            & self.config.disabled_for(rel)
+        if off:
+            return (sorted(off)[0], "path-disable")
+        rules = self._sup_cache_line(rel, line)
+        hit = frozenset(self.BLOCK_RULES) & rules
+        if not hit and "all" in rules:
+            hit = frozenset(self.BLOCK_RULES)
+        if hit:
+            return (sorted(hit)[0], "suppression")
+        return None
+
+    def _sup_cache_line(self, rel: str, line: int) -> frozenset:
+        return frozenset(self._suppressions(rel).get(line) or ())
+
+    def derivable(self, rel: str, line: int) -> bool:
+        """True when the innermost project frame's function carries a
+        may_block summary (the stall was statically predicted)."""
+        s = self.db.function_at(rel, line)
+        return bool(s is not None and s.may_block)
+
+    def verdict(self, frames) -> dict | None:
+        """*frames*: innermost-first (path, line, func) with
+        repo-relative paths; a discrepancy dict, or None when the
+        static side already accounts for this stall."""
+        project = [(p, ln, fn) for p, ln, fn in frames
+                   if p.startswith(("manatee_tpu/", "tests/",
+                                    "tools/"))]
+        if not project:
+            return None
+        for rel, line, func in project:
+            ex = self._exemption(rel, line)
+            if ex is not None:
+                rule_name, via = ex
+                return {"file": rel, "line": line, "func": func,
+                        "rule": rule_name, "via": via}
+        rel, line, func = project[0]
+        if not self.derivable(rel, line):
+            return {"file": rel, "line": line, "func": func,
+                    "rule": "transitive-blocking-in-async",
+                    "via": "not-derived"}
+        return None

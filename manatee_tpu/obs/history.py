@@ -184,7 +184,13 @@ class MetricsHistory:
 
     async def append(self) -> dict:
         """Snapshot the registry and append one record (the
-        ``obs.history.append`` seam)."""
+        ``obs.history.append`` seam).
+
+        The snapshot happens on the loop (registry reads are loop-side
+        state); the rotate+write+fsync tail goes through ``to_thread``
+        so the per-record fsync never stalls the loop on a slow disk.
+        HistoryRecorder._run is the only caller, so the file handle is
+        never raced."""
         from manatee_tpu import faults
         await faults.point("obs.history.append")
         self._seq += 1
@@ -192,13 +198,16 @@ class MetricsHistory:
         rec = {"seq": self._seq, "ts": ts, "time": _iso_ms(ts),
                "metrics": dump_registry(self._registry)}
         line = json.dumps(rec, separators=(",", ":")) + "\n"
+        await asyncio.to_thread(self._append_durable, line)
+        return rec
+
+    def _append_durable(self, line: str) -> None:
         if self._fh is None or self._fh_records >= self.segment_records:
             self._rotate()
         self._fh.write(line)
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._fh_records += 1
-        return rec
 
     def _rotate(self) -> None:
         """Close the current segment, open a fresh one named by the

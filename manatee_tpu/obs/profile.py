@@ -25,9 +25,11 @@ Three always-on surfaces, wired into every daemon's listener by
   loop past ``stall_threshold`` — and, while the loop is still stuck,
   captures the loop thread's running frame and journals
   ``obs.loop.stall`` with the offending stack.  The runtime detector
-  also audits the static allowlist: a stalled frame that mnt-lint's
-  blocking-call rules *exempt* (path-disable or an inline suppression)
-  is journaled as ``obs.lint.discrepancy`` for `manatee-adm doctor`;
+  also audits the static analysis (lint/summaries.py): a stalled frame
+  that mnt-lint's blocking rules *exempt* (path-disable or an inline
+  suppression), or whose culprit is not derivable from the
+  interprocedural may-block summaries, is journaled as
+  ``obs.lint.discrepancy`` for `manatee-adm doctor`;
 - a **live task census** (:func:`tasks_payload`, ``GET /tasks``): every
   asyncio task's name, age, innermost frame, and bound trace/span id —
   task leaks become observable the way open spans already are.
@@ -64,11 +66,6 @@ RING_WINDOW = 600.0        # how far back GET /profile can reach
 MAX_STACK_DEPTH = 64
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
-
-# mnt-lint's runtime counterparts (lint/rules_async.py): a stall caught
-# inside a frame these rules were told to ignore is a discrepancy
-_BLOCK_RULES = frozenset({"blocking-call-in-async",
-                          "blocking-io-in-async"})
 
 # code object -> collapsed-stack frame label (code objects are few and
 # long-lived; caching them bounds per-sample allocation)
@@ -489,57 +486,43 @@ class LoopMonitor:
 
 # ---- runtime <-> static cross-check (mnt-lint audit) ----
 
-_LINT_CACHE: dict = {"loaded": False, "cfg": None, "sup": {}}
+_AUDIT: dict = {"loaded": False, "audit": None}
+
+
+def _get_audit():
+    """Lazy singleton StaticBlockingAudit over the repo checkout, or
+    None when the lint package is unavailable (stripped install)."""
+    if not _AUDIT["loaded"]:
+        _AUDIT["loaded"] = True
+        try:
+            from manatee_tpu.lint.summaries import StaticBlockingAudit
+            _AUDIT["audit"] = StaticBlockingAudit(_REPO_ROOT)
+        except Exception:           # pragma: no cover - partial tree
+            _AUDIT["audit"] = None
+    return _AUDIT["audit"]
 
 
 def find_lint_exemption(frames: list[tuple]) -> dict | None:
-    """The innermost stalled frame mnt-lint's blocking rules were told
-    to ignore — via ``.mnt-lint.json`` path-disable or an inline
-    ``# mnt-lint: disable=`` suppression — or None.  A hit means the
-    static allowlist exempted code that demonstrably blocks the loop:
-    the runtime detector auditing the static one.
+    """The static side's account of a stall, per the two-sided
+    contract (docs/lint.md): a discrepancy dict when mnt-lint's
+    blocking rules were told to ignore the stalled frame
+    (``via=path-disable`` / ``via=suppression``), or when the culprit
+    is not derivable from the interprocedural may-block summaries at
+    all (``via=not-derived``) — or None when the static analysis
+    already predicted this stall.
 
     *frames* is innermost-first ``(path, line, func)`` with
     repo-relative paths.  Runs only on the rare stall path, so lazily
-    loading the lint config and per-file suppressions is fine.
+    building the summary database is fine — and an exemption verdict
+    never needs it at all.
     """
-    try:
-        from manatee_tpu.lint.engine import Config, parse_suppressions
-    except Exception:               # pragma: no cover - partial tree
+    audit = _get_audit()
+    if audit is None:
         return None
-    if not _LINT_CACHE["loaded"]:
-        _LINT_CACHE["loaded"] = True
-        try:
-            p = _REPO_ROOT / ".mnt-lint.json"
-            _LINT_CACHE["cfg"] = (Config.from_file(p) if p.exists()
-                                  else Config())
-        except Exception:
-            _LINT_CACHE["cfg"] = None
-    cfg = _LINT_CACHE["cfg"]
-    for path, line, func in frames:
-        if not path.startswith(("manatee_tpu/", "tests/", "tools/")):
-            continue
-        if cfg is not None:
-            off = _BLOCK_RULES & cfg.disabled_for(path)
-            if off:
-                return {"file": path, "line": line, "func": func,
-                        "rule": sorted(off)[0], "via": "path-disable"}
-        sup = _LINT_CACHE["sup"].get(path)
-        if sup is None:
-            try:
-                sup = parse_suppressions(
-                    (_REPO_ROOT / path).read_text())
-            except Exception:
-                sup = {}
-            _LINT_CACHE["sup"][path] = sup
-        rules = sup.get(line) or set()
-        hit = _BLOCK_RULES & rules
-        if not hit and "all" in rules:
-            hit = _BLOCK_RULES
-        if hit:
-            return {"file": path, "line": line, "func": func,
-                    "rule": sorted(hit)[0], "via": "suppression"}
-    return None
+    try:
+        return audit.verdict(frames)
+    except Exception:               # pragma: no cover - paranoia
+        return None
 
 
 # ---- live task census ----

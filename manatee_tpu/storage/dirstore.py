@@ -474,9 +474,12 @@ class DirBackend(StorageBackend):
             pass
         except (ValueError, KeyError, OSError):
             pass          # unreadable/torn: recompute from the dir
-        files = await asyncio.to_thread(manifest_scan, snapdir)
-        self._write_manifest(dataset, name, files)
-        return files
+        def scan_and_install():
+            files = manifest_scan(snapdir)
+            self._write_manifest(dataset, name, files)
+            return files
+
+        return await asyncio.to_thread(scan_and_install)
 
     async def snapshot(self, dataset: str, name: str | None = None) -> Snapshot:
         # error:StorageError models a failed disk write at snapshot
@@ -514,10 +517,10 @@ class DirBackend(StorageBackend):
                 if ent.get("t") == "f":
                     ent["h"] = hashes.get(str(dst / rel)) \
                         or _sha256_file(dst / rel)
+            self._write_manifest(dataset, name, files)
             return files
 
-        files = await asyncio.to_thread(copy_and_scan)
-        self._write_manifest(dataset, name, files)
+        await asyncio.to_thread(copy_and_scan)
         now = time.time()
         # mnt-lint: atomic-section=snapshot-record
         # RE-load: the copy ran in a worker thread while the loop kept
@@ -1099,7 +1102,8 @@ class DirBackend(StorageBackend):
             snapdir = self._dspath(dataset) / "@snapshots" / snapname
             await asyncio.to_thread(shutil.copytree, data, snapdir,
                                     symlinks=True)
-            self._write_manifest(dataset, snapname, manifest)
+            await asyncio.to_thread(self._write_manifest, dataset,
+                                    snapname, manifest)
             meta = self._load_meta(dataset)
             meta["snaps"][snapname] = time.time()
             meta["mounted"] = False

@@ -1,0 +1,68 @@
+"""Seeded-bug fixtures: PR 11's three worked-example bugs, each moved
+ONE call level down into a helper.
+
+Under per-function analysis (``interprocedural: false``) every
+function here lints clean — the helper hides the evidence.  The v4
+summaries make each one a finding again, and
+tests/test_lint_summaries.py pins BOTH directions, so this file is the
+machine-checked demonstration that the interprocedural layer closes
+the exact regression ISSUE 17 names.
+
+Do not "fix" these: they are deliberately wrong.
+"""
+
+import asyncio
+
+
+class MetaClobber:
+    """dirstore's torn-meta bug, helper-hidden: the load and the save
+    both live one call down and the await sits between them — a
+    concurrent writer lands during the flush and this save reinstates
+    the stale meta."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def _read_meta(self, dataset):
+        return self._store.load_meta(dataset)
+
+    def _put_meta(self, dataset, meta):
+        self._store.save_meta(dataset, meta)
+
+    async def set_prop(self, dataset, key, value):
+        meta = self._read_meta(dataset)
+        await self._store.flush()
+        meta[key] = value
+        self._put_meta(dataset, meta)
+
+
+class HalfHandshake:
+    """the half-handshaken socket leak: the acquire hides inside an
+    async helper that returns the handle pair; a cancellation landing
+    on the drain strands the connection forever."""
+
+    async def _connect(self, host, port):
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), 5.0)
+        return reader, writer
+
+    async def handshake(self, host, port):
+        reader, writer = await self._connect(host, port)
+        await writer.drain()
+        writer.close()
+        return reader
+
+
+class WalReceiver:
+    """the walreceiver fd leak: a sync helper opens the segment file
+    and hands the fd back; a cancellation between the open and the
+    close leaks it."""
+
+    def _open_segment(self, path):
+        return open(path, "rb")
+
+    async def stream(self, path, sink):
+        fh = self._open_segment(path)
+        await sink.ready()
+        sink.push(fh.read())
+        fh.close()

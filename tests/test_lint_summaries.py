@@ -1,0 +1,774 @@
+"""mnt-lint v4: the call graph, the per-function summaries and their
+fixpoint, the interprocedural rule upgrades, and the callee-aware
+result cache.
+
+Structure mirrors the layer being pinned:
+
+- call-graph resolution (name/alias/self/base-class/attr-ctor) and the
+  canonicalizer;
+- summary extraction + fixpoint over diamond / recursive / mutually
+  recursive chains, with the soundness defaults for unresolved calls;
+- one positive and one negative per upgraded or new rule, exercised
+  through ``check_source`` so the whole engine path runs;
+- the seeded-bug fixture (tests/data/lint/interproc_seeded.py): PR
+  11's three worked-example bugs moved one helper level down must fail
+  v4 and pass v3 — the acceptance demonstration for ISSUE 17;
+- ``--cache`` summary-dependency invalidation in a real git repo: an
+  edit to ONLY the callee must re-lint the caller.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from manatee_tpu.lint import Config, check_source
+from manatee_tpu.lint.callgraph import module_name
+from manatee_tpu.lint.summaries import SummaryDB, is_blocking_name
+
+REPO = Path(__file__).parent.parent
+SEEDED = Path(__file__).parent / "data" / "lint" / "interproc_seeded.py"
+
+
+def db_of(*files, config: Config | None = None) -> SummaryDB:
+    """SummaryDB over (path, source) pairs (dedented)."""
+    cfg = config or Config()
+    srcs = []
+    for path, src in files:
+        text = textwrap.dedent(src)
+        srcs.append((path, text, ast.parse(text)))
+    return SummaryDB.build_from_sources(srcs, cfg)
+
+
+def lint(src: str, config: Config | None = None,
+         path: str = "snippet.py"):
+    return check_source(textwrap.dedent(src), path, config)
+
+
+def rules_of(src: str, config: Config | None = None,
+             path: str = "snippet.py") -> set:
+    return {f.rule for f in lint(src, config, path).findings}
+
+
+# ---- call-graph resolution ----
+
+def test_module_name_shapes():
+    assert module_name("manatee_tpu/pg/manager.py") \
+        == "manatee_tpu.pg.manager"
+    assert module_name("manatee_tpu/obs/__init__.py") == "manatee_tpu.obs"
+    assert module_name("tools/lint") == "tools.lint"
+
+
+def test_resolve_module_function_and_from_import():
+    db = db_of(
+        ("a.py", """\
+            def work():
+                pass
+        """),
+        ("b.py", """\
+            from a import work
+
+            def go():
+                work()
+        """))
+    fd = db.graph.resolve(None, "b.py", "work")
+    assert fd is not None and fd.fqn == "a:work"
+
+
+def test_resolve_module_alias():
+    db = db_of(
+        ("a.py", "def work():\n    pass\n"),
+        ("b.py", "import a as aa\n\ndef go():\n    aa.work()\n"))
+    fd = db.graph.resolve(None, "b.py", "aa.work")
+    assert fd is not None and fd.fqn == "a:work"
+
+
+def test_resolve_self_method_and_base_class():
+    db = db_of(
+        ("base.py", """\
+            class Base:
+                def ground(self):
+                    pass
+        """),
+        ("sub.py", """\
+            from base import Base
+
+            class Sub(Base):
+                def top(self):
+                    self.ground()
+        """))
+    caller = db.graph.defs["sub:Sub.top"]
+    fd = db.graph.resolve(caller, "sub.py", "self.ground")
+    assert fd is not None and fd.fqn == "base:Base.ground"
+
+
+def test_resolve_attr_ctor_one_level():
+    db = db_of(("m.py", """\
+        class Engine:
+            def rev(self):
+                pass
+
+        class Car:
+            def __init__(self):
+                self.engine = Engine()
+
+            def drive(self):
+                self.engine.rev()
+    """))
+    caller = db.graph.defs["m:Car.drive"]
+    fd = db.graph.resolve(caller, "m.py", "self.engine.rev")
+    assert fd is not None and fd.fqn == "m:Engine.rev"
+    # an attribute ALSO assigned from something else loses the fact
+    db2 = db_of(("m.py", """\
+        class Engine:
+            def rev(self):
+                pass
+
+        class Car:
+            def __init__(self):
+                self.engine = Engine()
+
+            def swap(self, other):
+                self.engine = other
+
+            def drive(self):
+                self.engine.rev()
+    """))
+    caller2 = db2.graph.defs["m:Car.drive"]
+    assert db2.graph.resolve(caller2, "m.py", "self.engine.rev") is None
+
+
+def test_canonical_sees_through_from_import():
+    db = db_of(("m.py", """\
+        from time import sleep
+
+        def nap():
+            sleep(1)
+    """))
+    assert db.graph.canonical("m.py", "sleep") == "time.sleep"
+    assert is_blocking_name(db.graph.canonical("m.py", "sleep"), None,
+                            Config()) == "time.sleep"
+
+
+def test_unresolved_stays_unresolved():
+    db = db_of(("m.py", "def f(x):\n    x.quack()\n"))
+    caller = db.graph.defs["m:f"]
+    assert db.graph.resolve(caller, "m.py", "x.quack") is None
+    assert db.unresolved_edges >= 1
+
+
+# ---- fixpoint: chains, cycles, soundness defaults ----
+
+DIAMOND = ("m.py", """\
+    import time
+
+    def bottom():
+        time.sleep(1)
+
+    def left():
+        bottom()
+
+    def right():
+        bottom()
+
+    def top():
+        left()
+        right()
+""")
+
+
+def test_may_block_diamond():
+    db = db_of(DIAMOND)
+    for fn in ("bottom", "left", "right", "top"):
+        assert db.summaries["m:%s" % fn].may_block, fn
+    chain = db.chain("m:top")
+    assert chain[-1].startswith("time.sleep")
+    assert len(chain) <= 3
+
+
+def test_may_block_self_recursion_converges():
+    db = db_of(("m.py", """\
+        import time
+
+        def f(n):
+            if n:
+                f(n - 1)
+            time.sleep(1)
+    """))
+    assert db.summaries["m:f"].may_block
+    assert db.rounds < 10
+
+
+def test_may_block_mutual_recursion_converges():
+    db = db_of(("m.py", """\
+        import time
+
+        def ping(n):
+            if n:
+                pong(n - 1)
+
+        def pong(n):
+            time.sleep(1)
+            if n:
+                ping(n - 1)
+    """))
+    assert db.summaries["m:ping"].may_block
+    assert db.summaries["m:pong"].may_block
+    assert db.rounds < 10
+
+
+def test_to_thread_breaks_the_block_edge():
+    # the helper is PASSED to to_thread, not called: no block edge
+    db = db_of(("m.py", """\
+        import asyncio
+        import time
+
+        def helper():
+            time.sleep(1)
+
+        async def go():
+            await asyncio.to_thread(helper)
+    """))
+    assert db.summaries["m:helper"].may_block
+    assert not db.summaries["m:go"].may_block
+
+
+def test_awaited_blocking_coroutine_still_blocks():
+    # awaiting an async callee that blocks inline still stalls the
+    # loop: the await is not a thread hop
+    db = db_of(("m.py", """\
+        import time
+
+        async def bad():
+            time.sleep(1)
+
+        async def caller():
+            await bad()
+    """))
+    assert db.summaries["m:caller"].may_block
+
+
+def test_may_suspend_proven_inline_coroutine():
+    db = db_of(("m.py", """\
+        class C:
+            async def note(self):
+                self.x = 1
+
+            async def outer(self):
+                await self.note()
+    """))
+    assert not db.summaries["m:C.note"].may_suspend
+    assert not db.summaries["m:C.outer"].may_suspend
+
+
+def test_may_suspend_unresolved_await_is_sound():
+    # `await asyncio.sleep(0)` resolves to nothing — the sound default
+    # is that an unresolvable awaited call MAY suspend
+    db = db_of(("m.py", """\
+        import asyncio
+
+        async def napper():
+            await asyncio.sleep(0)
+
+        async def outer():
+            await napper()
+    """))
+    assert db.summaries["m:napper"].may_suspend
+    assert db.summaries["m:outer"].may_suspend
+
+
+def test_swallows_cancellation_propagates_through_await():
+    db = db_of(("m.py", """\
+        async def eats(coro):
+            try:
+                await coro
+            except Exception:
+                return None
+
+        async def trusts(coro):
+            await eats(coro)
+    """))
+    assert db.summaries["m:eats"].swallows
+    assert db.summaries["m:trusts"].swallows
+    # re-raising arms are not swallows
+    db2 = db_of(("m.py", """\
+        async def honest(coro):
+            try:
+                await coro
+            except Exception:
+                raise
+    """))
+    assert not db2.summaries["m:honest"].swallows
+
+
+def test_returns_resource_bound_and_direct():
+    db = db_of(("m.py", """\
+        def via_local(path):
+            fh = open(path)
+            return fh
+
+        def direct(path):
+            return open(path, "rb")
+
+        def attr_only(proc):
+            return proc.returncode
+    """))
+    assert db.summaries["m:via_local"].returns_resource
+    assert db.summaries["m:direct"].returns_resource
+    assert not db.summaries["m:attr_only"].returns_resource
+
+
+def test_returns_resource_propagates_through_wrapper():
+    db = db_of(("m.py", """\
+        def inner(path):
+            return open(path)
+
+        def outer(path):
+            return inner(path)
+    """))
+    assert db.summaries["m:outer"].returns_resource
+
+
+def test_param_effects_closed_escaped_leaked_unknown():
+    db = db_of(("m.py", """\
+        class C:
+            def closes(self, fh):
+                fh.close()
+
+            def stores(self, fh):
+                self.fh = fh
+
+            def ignores(self, fh):
+                print(fh.name)
+
+            def forwards(self, fh):
+                self.closes(fh)
+
+            def launders(self, fh):
+                mystery(fh)
+    """))
+    eff = lambda q, p: db.summaries["m:C.%s" % q].param_effects[p]
+    assert eff("closes", "fh") == "closed"
+    assert eff("stores", "fh") == "escaped"
+    assert eff("ignores", "fh") == "leaked"
+    # passed to a resolved callee that protects it -> protected;
+    # passed to an UNRESOLVED callee -> unknown (protective default)
+    assert eff("forwards", "fh") == "unknown"
+    assert eff("launders", "fh") == "unknown"
+
+
+def test_required_held_from_caller_locksets():
+    db = db_of(("m.py", """\
+        class C:
+            async def a(self):
+                async with self._lock:
+                    self._mut()
+
+            async def b(self):
+                async with self._lock:
+                    self._mut()
+
+            def _mut(self):
+                self.items = []
+    """))
+    assert "self._lock" in db.summaries["m:C._mut"].required_held
+    # one caller without the lock drops the guarantee
+    db2 = db_of(("m.py", """\
+        class C:
+            async def a(self):
+                async with self._lock:
+                    self._mut()
+
+            async def b(self):
+                self._mut()
+
+            def _mut(self):
+                self.items = []
+    """))
+    assert not db2.summaries["m:C._mut"].required_held
+
+
+def test_blocking_by_design_masks_reporting_not_derivation():
+    cfg = Config(blocking_by_design=frozenset({"m.py::C._sync_flush"}))
+    db = db_of(("m.py", """\
+        import time
+
+        class C:
+            def _sync_flush(self):
+                time.sleep(1)
+
+            def outer(self):
+                self._sync_flush()
+    """), config=cfg)
+    flush = db.summaries["m:C._sync_flush"]
+    outer = db.summaries["m:C.outer"]
+    # the runtime stall contract still derives the block...
+    assert flush.may_block and outer.may_block
+    # ...but neither end of the chain is reportable
+    assert not flush.reportable_block
+    assert not outer.reportable_block
+    # a caller that blocks on its own stays reportable
+    db2 = db_of(("m.py", """\
+        import time
+
+        class C:
+            def _sync_flush(self):
+                time.sleep(1)
+
+            def outer(self):
+                time.sleep(2)
+                self._sync_flush()
+    """), config=cfg)
+    assert db2.summaries["m:C.outer"].reportable_block
+
+
+# ---- upgraded/new rules: one positive + one negative each ----
+
+def test_transitive_blocking_positive_with_chain():
+    res = lint("""\
+        import time
+
+        def step():
+            time.sleep(5)
+
+        def middle():
+            step()
+
+        async def tick():
+            middle()
+    """)
+    hits = [f for f in res.findings
+            if f.rule == "transitive-blocking-in-async"]
+    assert len(hits) == 1 and hits[0].line == 10
+    assert "middle" in hits[0].msg and "time.sleep" in hits[0].msg
+
+
+def test_transitive_blocking_negative_to_thread():
+    assert "transitive-blocking-in-async" not in rules_of("""\
+        import asyncio
+        import time
+
+        def step():
+            time.sleep(5)
+
+        async def tick():
+            await asyncio.to_thread(step)
+    """)
+
+
+def test_transitive_blocking_direct_hits_stay_with_v1_rules():
+    # a spelled-out time.sleep belongs to blocking-call-in-async, not
+    # the transitive rule (one finding, not two)
+    res = lint("""\
+        import time
+
+        async def tick():
+            time.sleep(5)
+    """)
+    rules = [f.rule for f in res.findings]
+    assert rules.count("blocking-call-in-async") == 1
+    assert "transitive-blocking-in-async" not in rules
+
+
+def test_transitive_blocking_by_design_quiet():
+    cfg = Config(blocking_by_design=frozenset(
+        {"snippet.py::_flush_now"}))
+    src = """\
+        import time
+
+        def _flush_now():
+            time.sleep(1)
+
+        async def tick():
+            _flush_now()
+    """
+    assert "transitive-blocking-in-async" in rules_of(src)
+    assert "transitive-blocking-in-async" not in rules_of(src, cfg)
+
+
+def test_blocking_call_canonicalized_through_import():
+    assert "blocking-call-in-async" in rules_of("""\
+        from time import sleep
+
+        async def tick():
+            sleep(1)
+    """)
+    # a project function named sleep is not time.sleep
+    assert "blocking-call-in-async" not in rules_of("""\
+        def sleep(n):
+            pass
+
+        async def tick():
+            sleep(1)
+    """)
+
+
+def test_swallow_transitively_positive_and_negative():
+    res = lint("""\
+        async def eats(coro):
+            try:
+                await coro
+            except Exception:
+                return None
+
+        async def trusts(coro):
+            await eats(coro)
+    """)
+    hits = [f for f in res.findings
+            if f.rule == "cancellation-swallowed-transitively"]
+    assert len(hits) == 1 and hits[0].line == 8
+    assert "eats" in hits[0].msg
+    assert "cancellation-swallowed-transitively" not in rules_of("""\
+        async def honest(coro):
+            try:
+                await coro
+            except Exception:
+                raise
+
+        async def trusts(coro):
+            await honest(coro)
+    """)
+
+
+def test_atomic_break_hidden_in_helpers():
+    src = """\
+        class C:
+            def _read(self, ds):
+                return self._store.load_meta(ds)
+
+            def _put(self, ds, meta):
+                self._store.save_meta(ds, meta)
+
+            async def set_prop(self, ds, k, v):
+                meta = self._read(ds)
+                %s
+                meta[k] = v
+                self._put(ds, meta)
+    """
+    assert "atomic-section-broken" in rules_of(src % "await g()")
+    assert "atomic-section-broken" not in rules_of(src % "pass")
+    # v3 cannot see it: the helpers hide both halves
+    assert "atomic-section-broken" not in rules_of(
+        src % "await g()", Config(interproc=False))
+
+
+def test_atomic_inline_coroutine_await_not_a_break():
+    # an await of a project coroutine PROVEN never to suspend is not
+    # an interleave point — and the same body with a real suspension
+    # in the callee turns back into a finding
+    src = """\
+        class C:
+            async def note(self):
+                %s
+
+            async def bump(self):
+                cur = self.counter
+                await self.note()
+                self.counter = cur + 1
+    """
+    assert "atomic-section-broken" not in rules_of(src % "self.seen = 1")
+    assert "atomic-section-broken" in rules_of(
+        src % "await asyncio.sleep(0)")
+
+
+def test_declared_region_tolerates_inline_await():
+    begin = "# mnt-lint: " + "atomic-section"
+    end = "# mnt-lint: " + "end-atomic-section"
+    res = lint("""\
+        class C:
+            async def note(self):
+                self.seen = 1
+
+            async def f(self):
+                %s
+                a = self.x
+                await self.note()
+                self.y = a
+                %s
+    """ % (begin, end))
+    assert "atomic-section-broken" not in {f.rule for f in res.findings}
+
+
+def test_lockset_required_held_exempts_private_helper():
+    src = """\
+        class C:
+            async def a(self):
+                async with self._lock:
+                    self.items = self.items + [1]
+
+            async def b(self):
+                async with self._lock:
+                    self.items = []
+
+            async def _mut(self):
+                n = self.items
+                await g()
+                self.items = n + [2]
+
+            async def run%s(self):
+                %s
+                    await self._mut()
+    """
+    guarded = src % ("", "async with self._lock:")
+    assert "lockset-inconsistent" not in rules_of(guarded)
+    # an unguarded caller voids required_held: the window reports
+    unguarded = src % ("", "if True:")
+    assert "lockset-inconsistent" in rules_of(unguarded)
+
+
+def test_cancel_acquire_through_helper():
+    src = """\
+        class C:
+            def _open_segment(self, path):
+                return open(path, "rb")
+
+            async def stream(self, path, sink):
+                fh = self._open_segment(path)
+                %s
+    """
+    bad = src % "await sink.ready()\n        fh.close()"
+    res = lint(bad)
+    assert "cancel-unsafe-acquire" in {f.rule for f in res.findings}
+    assert "cancel-unsafe-acquire" not in rules_of(
+        bad, Config(interproc=False))
+    good = src % ("try:\n            await sink.ready()\n"
+                  "        finally:\n            fh.close()")
+    assert "cancel-unsafe-acquire" not in rules_of(good)
+
+
+def test_cancel_leaky_pass_is_not_a_transfer():
+    # v3 treated ANY call argument as an ownership transfer; a callee
+    # whose summary proves the parameter is ignored is not one
+    src = """\
+        def _note(fh):
+            print("opened")
+
+        async def f(path):
+            fh = open(path)
+            _note(fh)
+            await g()
+            fh.close()
+    """
+    assert "cancel-unsafe-acquire" in rules_of(src)
+    assert "cancel-unsafe-acquire" not in rules_of(
+        src, Config(interproc=False))
+    # a callee that CLOSES the handle ends the window
+    assert "cancel-unsafe-acquire" not in rules_of("""\
+        def _discard(fh):
+            fh.close()
+
+        async def f(path):
+            fh = open(path)
+            _discard(fh)
+            await g()
+    """)
+
+
+# ---- the seeded-bug acceptance fixture ----
+
+def test_seeded_bugs_fail_v4_pass_v3():
+    text = SEEDED.read_text()
+    v4 = check_source(text, str(SEEDED), Config())
+    got = sorted({(f.line, f.rule) for f in v4.findings})
+    by_rule = sorted(r for _, r in got)
+    assert by_rule.count("atomic-section-broken") == 1      # MetaClobber
+    assert by_rule.count("cancel-unsafe-acquire") == 2      # both leaks
+    assert "transitive-blocking-in-async" in by_rule        # the fd open
+    v3 = check_source(text, str(SEEDED), Config(interproc=False))
+    assert v3.findings == []
+
+
+# ---- callee-aware cache invalidation (real git repo, subprocess) ----
+
+CALLER_SRC = """\
+import helper
+
+
+async def tick():
+    helper.work()
+"""
+
+HELPER_BLOCKS = "import time\n\n\ndef work():\n    time.sleep(1)\n"
+HELPER_CLEAN = "def work():\n    return 1\n"
+
+
+def run_lint(tmp_repo, *args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint"), *args],
+        cwd=tmp_repo, capture_output=True, text=True)
+
+
+@pytest.fixture
+def tmp_repo(tmp_path):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "caller.py").write_text(CALLER_SRC)
+    (tmp_path / "helper.py").write_text(HELPER_BLOCKS)
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    return tmp_path
+
+
+def _cache_stats(stderr: str) -> tuple:
+    part = stderr.split("cache: ")[1]
+    return (int(part.split(" hits")[0]),
+            int(part.split(", ")[1].split(" misses")[0]))
+
+
+def test_cache_invalidated_by_callee_only_change(tmp_repo):
+    r1 = run_lint(tmp_repo, ".", "--cache")
+    assert r1.returncode == 1
+    assert "transitive-blocking-in-async" in r1.stdout
+    assert _cache_stats(r1.stderr) == (0, 2)
+    # no-op re-run: both files served from cache, same verdict
+    r2 = run_lint(tmp_repo, ".", "--cache")
+    assert r2.returncode == 1
+    assert _cache_stats(r2.stderr) == (2, 0)
+    # edit ONLY the callee: the caller's bytes are unchanged, but its
+    # recorded summary dependency no longer matches — both re-lint and
+    # the caller's finding dissolves
+    (tmp_repo / "helper.py").write_text(HELPER_CLEAN)
+    r3 = run_lint(tmp_repo, ".", "--cache")
+    assert r3.returncode == 0
+    assert _cache_stats(r3.stderr) == (0, 2)
+    # and the now-clean verdict caches normally again
+    r4 = run_lint(tmp_repo, ".", "--cache")
+    assert r4.returncode == 0
+    assert _cache_stats(r4.stderr) == (2, 0)
+
+
+def test_facts_cache_hits_on_noop_rerun(tmp_repo):
+    stats = tmp_repo / "stats.json"
+    run_lint(tmp_repo, ".", "--cache", "--stats", str(stats))
+    cold = json.loads(stats.read_text())
+    assert cold["summaries"]["facts_cache"] == {"hits": 0, "misses": 2}
+    run_lint(tmp_repo, ".", "--cache", "--stats", str(stats))
+    warm = json.loads(stats.read_text())
+    # the no-op re-run must not re-extract a single file: this is the
+    # guard against the fixpoint going quadratic in CI (ISSUE 17)
+    assert warm["summaries"]["facts_cache"] == {"hits": 2, "misses": 0}
+    assert warm["result_cache"] == {"hits": 2, "misses": 0}
+    assert warm["summaries"]["functions"] == 2
+    assert warm["wall_ms"] >= 0
+
+
+def test_stats_shape_without_cache(tmp_repo):
+    stats = tmp_repo / "stats.json"
+    run_lint(tmp_repo, ".", "--stats", str(stats))
+    data = json.loads(stats.read_text())
+    assert data["result_cache"] is None
+    s = data["summaries"]
+    assert s["modules"] == 2 and s["functions"] == 2
+    assert s["may_block"] == 2          # helper.work + caller.tick
+    assert s["resolved_edges"] == 1     # caller -> helper
+    assert s["fixpoint_rounds"] >= 1

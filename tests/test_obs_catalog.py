@@ -1,0 +1,84 @@
+"""docs/observability.md <-> code drift guard (tier-1).
+
+Same contract faultpoint-unregistered gives the faults catalog: every
+metric registered against the obs registry and every journal event
+type recorded anywhere in the production tree must appear in the doc's
+catalog (backtick-quoted, `a.b.c|d` alternation allowed).  The lint
+rule ``obs-name-undocumented`` enforces this per-file during targeted
+runs; this test sweeps the whole tree so the contract holds even for
+files no lint run touched, using the same collector so the two can
+never disagree about what counts as an emission site.
+"""
+
+import ast
+from pathlib import Path
+
+from manatee_tpu.lint import rules_obs
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _documented():
+    return rules_obs.documented_names(
+        (REPO / "docs" / "observability.md").read_text())
+
+
+def test_every_emitted_obs_name_is_documented():
+    doc = _documented()
+    missing = []
+    for path in sorted((REPO / "manatee_tpu").rglob("*.py")):
+        tree = ast.parse(path.read_text(), str(path))
+        for kind, how, value, line in rules_obs.collect_obs_names(tree):
+            if how == "name":
+                ok = value in doc
+            else:
+                ok = any(d.startswith(value) for d in doc)
+            if not ok:
+                missing.append("%s:%d: %s %r" % (
+                    path.relative_to(REPO), line, kind, value))
+    assert not missing, \
+        "emitted but not in docs/observability.md:\n" + "\n".join(missing)
+
+
+def test_collector_sees_the_emission_idioms():
+    src = (
+        "_REG.counter('c_total', 'h', ('l',))\n"
+        "get_registry().gauge('g_now')\n"
+        "reg.histogram('h_seconds', 'h')\n"
+        "journal.record('a.b')\n"
+        "get_journal().record('c.d', x=1)\n"
+        "self._journal.record('e.f')\n"
+        "get_journal().record('coord.session.' + event)\n"
+        # non-emissions the collector must NOT count:
+        "get_span_store().record(span)\n"
+        "s.record('span.name', 0.1)\n"
+        "self._slo.record('write', ok=True)\n"
+        "builder.histogram(inst.name, inst.help)\n"
+    )
+    got = rules_obs.collect_obs_names(ast.parse(src))
+    assert [(k, h, v) for k, h, v, _ in got] == [
+        ("metric", "name", "c_total"),
+        ("metric", "name", "g_now"),
+        ("metric", "name", "h_seconds"),
+        ("journal", "name", "a.b"),
+        ("journal", "name", "c.d"),
+        ("journal", "name", "e.f"),
+        ("journal", "prefix", "coord.session."),
+    ]
+
+
+def test_alternation_expansion():
+    doc = rules_obs.documented_names(
+        "events: `pg.reconfigure.begin|done|failed` and "
+        "`coord_connections` / `coord_sessions` plus `a_b|c`.")
+    assert "pg.reconfigure.begin" in doc
+    assert "pg.reconfigure.done" in doc
+    assert "pg.reconfigure.failed" in doc
+    assert "coord_connections" in doc and "coord_sessions" in doc
+    assert "a_b" in doc and "a_c" in doc
+
+
+def test_prefix_emission_matches_documented_family():
+    doc = _documented()
+    # the one computed-name emission in the tree today
+    assert any(d.startswith("coord.session.") for d in doc)

@@ -19,8 +19,8 @@ from manatee_tpu.obs.journal import get_journal
 from manatee_tpu.obs.profile import (
     LoopMonitor,
     SamplingProfiler,
-    _LINT_CACHE,
     _fold_stack,
+    _get_audit,
     _loop_is_idle,
     find_lint_exemption,
     get_loop_monitor,
@@ -231,19 +231,22 @@ def test_idle_selector_poll_is_not_a_stall():
 # ---- runtime <-> static lint cross-check ----
 
 @pytest.fixture
-def lint_cache():
-    _LINT_CACHE.update({"loaded": False, "cfg": None, "sup": {}})
-    yield _LINT_CACHE
-    _LINT_CACHE.update({"loaded": False, "cfg": None, "sup": {}})
+def lint_audit():
+    audit = _get_audit()
+    assert audit is not None
+    saved = dict(audit._sup_cache)
+    yield audit
+    audit._sup_cache.clear()
+    audit._sup_cache.update(saved)
 
 
-def test_lint_exemption_ignores_frames_outside_the_tree(lint_cache):
+def test_lint_exemption_ignores_frames_outside_the_tree(lint_audit):
     assert find_lint_exemption([("selectors.py", 1, "select"),
                                 ("asyncio/base_events.py", 2, "run")]) \
         is None
 
 
-def test_lint_exemption_path_disable(lint_cache):
+def test_lint_exemption_path_disable(lint_audit):
     # .mnt-lint.json path-disables blocking-io-in-async for tests/*
     hit = find_lint_exemption([("selectors.py", 1, "select"),
                                ("tests/test_profile.py", 10, "go")])
@@ -252,11 +255,11 @@ def test_lint_exemption_path_disable(lint_cache):
                    "via": "path-disable"}
 
 
-def test_lint_exemption_inline_suppression(lint_cache):
+def test_lint_exemption_inline_suppression(lint_audit):
     # no blocking-rule suppression exists in the real tree (that is
     # the point of the cross-check), so seed the per-file suppression
     # cache for a manatee_tpu/ path, where no path-disable applies
-    lint_cache["sup"]["manatee_tpu/fake_mod.py"] = {
+    lint_audit._sup_cache["manatee_tpu/fake_mod.py"] = {
         10: {"blocking-call-in-async"},
         11: {"all"},
     }
@@ -267,9 +270,26 @@ def test_lint_exemption_inline_suppression(lint_cache):
     # disable=all exempts every rule, the blocking ones included
     hit = find_lint_exemption([("manatee_tpu/fake_mod.py", 11, "g")])
     assert hit is not None and hit["via"] == "suppression"
-    # a clean line in the same file is not a discrepancy
+
+
+def test_stall_in_underivable_frame_is_a_discrepancy(lint_audit):
+    # v4's other direction: a stall whose innermost project frame has
+    # no may_block summary means the static side is blind to it
+    hit = find_lint_exemption([("manatee_tpu/fake_mod.py", 12, "h")])
+    assert hit == {"file": "manatee_tpu/fake_mod.py", "line": 12,
+                   "func": "h", "rule": "transitive-blocking-in-async",
+                   "via": "not-derived"}
+
+
+def test_stall_in_derivable_frame_is_accounted_for(lint_audit):
+    # a frame the may-block summaries DO derive is not a discrepancy:
+    # pick a real blocking line from the summary database itself
+    db = lint_audit.db
+    derived = next(s for s in db.summaries.values()
+                   if s.may_block and s.path.startswith("manatee_tpu/")
+                   and not lint_audit._exemption(s.path, s.line))
     assert find_lint_exemption(
-        [("manatee_tpu/fake_mod.py", 12, "h")]) is None
+        [(derived.path, derived.line, derived.qualname)]) is None
 
 
 # ---- daemon wiring ----

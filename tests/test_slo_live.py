@@ -97,6 +97,19 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                 return [e for e in body["events"]
                         if e["event"] == "slo.alert.fired"]
 
+            async def lint_discrepancies() -> list[dict]:
+                found = []
+                for url in [base] + [
+                        "http://127.0.0.1:%d" % p.status_port
+                        for p in (p1, p2, p3)]:
+                    try:
+                        _s, body = await http_get(url + "/events")
+                    except OSError:
+                        continue    # partitioned peer may be gone
+                    found.extend(e for e in body["events"]
+                                 if e["event"] == "obs.lint.discrepancy")
+                return found
+
             # warm: steady good writes, no open error window, and any
             # boot-transient alert already resolved
             deadline = time.monotonic() + 60
@@ -270,6 +283,18 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
                     "page alert never resolved after the fault " \
                     "cleared: %r" % al["alerts"]
                 await asyncio.sleep(0.5)
+
+            # ---- the two-sided stall contract, live (PR 17): every
+            # obs.loop.stall any process journaled across the soak,
+            # the takeover and the outage must have been statically
+            # accounted for by the v4 may-block summaries — a stall
+            # the lint could neither derive nor point at an exemption
+            # journals obs.lint.discrepancy, and the whole fleet must
+            # have zero of them (docs/lint.md)
+            disc = await lint_discrepancies()
+            assert disc == [], \
+                "stalls the lint summaries cannot account for: %r" \
+                % disc
 
             print("slo-live: soak quiet %.0fs; seamless takeover; "
                   "outage window %.2fs, %d page alert(s), resolved"
