@@ -78,6 +78,22 @@ And the black-box measurement-plane leg:
                              (within_15pct in the JSON), plus how many
                              burn-rate alerts the outage fired.
 
+And the forensics-plane leg:
+
+  - incident_reconstruction:  the postmortem pipeline measured on the
+                              MANATEE_SCALE_SHARDS fleet: a real
+                              prober.write outage fires a page alert,
+                              then `manatee-adm incident --last-alert
+                              -j` reconstructs it — reporting the
+                              collect+analyze wall time (CLI boot
+                              subtracted) and whether the report named
+                              the injected failpoint; plus the HLC
+                              stamping overhead, judged from lifetime
+                              counters (journal seq + hlc_merge_total
+                              deltas over a quiet window x the
+                              microbenchmarked per-stamp cost) against
+                              the <1%-of-a-core budget.
+
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
 every perf PR's effect is attributable stage by stage; the breakdown
@@ -125,7 +141,8 @@ DISCONNECT_GRACE = 0.35
 ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
                "incremental_rebuild", "control_plane_scale",
-               "modelcheck_throughput", "slo_probe")
+               "modelcheck_throughput", "slo_probe",
+               "incident_reconstruction")
 # total shards in the control_plane_scale leg: one measured 3-peer
 # shard + (N-1) singleton neighbors in ONE fleet sitter process
 SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
@@ -1004,6 +1021,240 @@ async def bench_slo_probe() -> dict:
             await cluster.stop()
 
 
+def _metric_sum(text: str, name: str) -> float:
+    """Sum every sample of a (possibly labeled) counter — e.g. all
+    outcome labels of manatee_hlc_merge_total."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            try:
+                total += float(line.split()[-1])
+            except ValueError:
+                pass
+    return total
+
+
+async def bench_incident_reconstruction() -> dict:
+    """Forensics-plane leg: the postmortem pipeline measured at fleet
+    scale.  One prober fronts the measured 3-peer shard plus
+    SCALE_SHARDS-1 sim singleton neighbors; two numbers come out:
+
+    - **HLC stamping overhead**: lifetime counters (every journal
+      record's seq is a per-process stamp count; hlc_merge_total is
+      the boundary-merge count) are read across the fleet's obs
+      listeners over a quiet window, and the measured stamp rate is
+      multiplied by the microbenchmarked per-stamp cost — judged
+      against the same <1%-of-a-core budget the PR 16 profiler is
+      held to.
+    - **reconstruction wall time**: a real prober.write outage fires
+      a page alert, and `manatee-adm incident --last-alert -j` (the
+      full collect + analyze + render pipeline over every obs route)
+      is timed end to end, CLI boot subtracted, with the closed-loop
+      check riding along: the report must name prober.write."""
+    from manatee_tpu.obs.causal import HybridClock
+    from manatee_tpu.storage import DirBackend
+    from tests.harness import (
+        alloc_port_block,
+        kill_fleet_sitter,
+        spawn_fleet_sitter,
+        spawn_prober,
+    )
+    from tests.test_partition import http_get
+
+    n_shards = max(2, SCALE_SHARDS)
+    n_neighbors = n_shards - 1
+    window_s = float(os.environ.get("MANATEE_INCIDENT_WINDOW", "10"))
+    hlc_budget = 0.01
+
+    with tempfile.TemporaryDirectory(
+            prefix="manatee-bench-incident-") as d:
+        tmp = Path(d)
+        (tmp / "measured").mkdir()
+        cluster = ClusterHarness(tmp / "measured", n_peers=3,
+                                 session_timeout=SESSION_TIMEOUT,
+                                 disconnect_grace=DISCONNECT_GRACE)
+        fleet_proc = None
+        prober_proc = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-incident", timeout=60)
+
+            base_port = alloc_port_block(4 * n_neighbors + 2)
+            status_port = base_port + 4 * n_neighbors
+            prober_port = status_port + 1
+            froot = tmp / "fleet"
+            froot.mkdir()
+            names = ["s%02d" % k for k in range(n_neighbors)]
+            shard_entries = []
+            for k, name in enumerate(names):
+                b = base_port + 4 * k
+                sroot = froot / name
+                store = str(sroot / "store")
+                be = DirBackend(store)
+                if not await be.exists("manatee"):
+                    await be.create("manatee")
+                shard_entries.append({
+                    "name": name,
+                    "shardPath": "/manatee/%s" % name,
+                    "postgresPort": b,
+                    "backupPort": b + 2,
+                    "zfsPort": b + 3,
+                    "dataDir": str(sroot / "data"),
+                    "storageRoot": store,
+                })
+            fleet_cfg = {
+                "ip": "127.0.0.1",
+                "dataset": "manatee/pg",
+                "storageBackend": "dir",
+                "pgEngine": "sim",
+                "oneNodeWriteMode": True,
+                "statusPort": status_port,
+                "healthChkInterval": 0.5,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": SESSION_TIMEOUT,
+                             "disconnectGrace": DISCONNECT_GRACE},
+                "shards": shard_entries,
+            }
+            fleet_proc = await asyncio.to_thread(
+                spawn_fleet_sitter, fleet_cfg, froot)
+
+            base = "http://127.0.0.1:%d" % prober_port
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "statusHost": "127.0.0.1",
+                "statusPort": prober_port,
+                "probeInterval": 1.0,
+                "faultsEnabled": True,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": SESSION_TIMEOUT,
+                             "disconnectGrace": DISCONNECT_GRACE},
+                "shards": [{"name": "measured",
+                            "shardPath": cluster.shard_path}]
+                          + [{"name": n, "shardPath": "/manatee/%s" % n}
+                             for n in names],
+            }, tmp / "prober", crash_dir=cluster.crash_dir)
+
+            deadline = time.monotonic() + 180
+            while True:
+                try:
+                    _s, body = await http_get(base + "/slis")
+                    if all(r.get("writes_ok")
+                           for r in body["shards"]):
+                        break
+                except (OSError, KeyError, ValueError,
+                        asyncio.TimeoutError):
+                    pass
+                if time.monotonic() > deadline:
+                    raise RuntimeError("prober never warmed up")
+                await asyncio.sleep(0.5)
+
+            # ---- HLC stamp rate from lifetime counters: every obs
+            # listener's journal seq (one stamp per record) plus its
+            # boundary-merge counter, sampled over a quiet window
+            endpoints = [base,
+                         "http://127.0.0.1:%d" % status_port] + \
+                        ["http://127.0.0.1:%d" % p.status_port
+                         for p in (p1, p2, p3)]
+
+            async def stamp_count() -> float:
+                total = 0.0
+                for url in endpoints:
+                    try:
+                        _s, ev = await http_get(url + "/events?limit=1")
+                        total += max((e.get("seq") or 0
+                                      for e in ev.get("events") or []),
+                                     default=0)
+                        _s, text = await http_get(url + "/metrics")
+                        total += _metric_sum(
+                            text, "manatee_hlc_merge_total")
+                    except (OSError, ValueError,
+                            asyncio.TimeoutError):
+                        pass
+                return total
+
+            c0 = await stamp_count()
+            await asyncio.sleep(window_s)
+            stamp_rate = (await stamp_count() - c0) / window_s
+
+            # per-stamp cost, microbenchmarked on this host
+            clk = HybridClock()
+            n = 200_000
+            t0 = time.perf_counter()
+            for _ in range(n):
+                clk.now()
+            per_stamp_s = (time.perf_counter() - t0) / n
+            hlc_core = stamp_rate * per_stamp_s
+
+            # ---- a real incident to reconstruct: prober.write outage
+            # -> page alert -> `manatee-adm incident --last-alert`
+            cp = run_cli(cluster, "fault", "set", "prober.write=error",
+                         "--url", base, timeout=30)
+            if cp.returncode != 0:
+                raise RuntimeError("arming prober.write failed: %s"
+                                   % cp.stderr)
+            await asyncio.sleep(2.5)
+            run_cli(cluster, "fault", "clear", "prober.write",
+                    "--url", base, timeout=30)
+            deadline = time.monotonic() + 60
+            while True:
+                _s, ev = await http_get(base + "/events")
+                if any(e.get("event") == "slo.alert.fired"
+                       and e.get("severity") == "page"
+                       for e in ev["events"]):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("outage fired no page alert")
+                await asyncio.sleep(0.2)
+
+            t0 = time.monotonic()
+            cp = run_cli(cluster, "incident", "--last-alert", "-j",
+                         "-u", base,
+                         "--crash-dir", str(cluster.crash_dir),
+                         timeout=120)
+            incident_wall = time.monotonic() - t0
+            if cp.returncode != 0:
+                raise RuntimeError("incident reconstruction failed: "
+                                   "%s" % cp.stderr)
+            report = json.loads(cp.stdout)
+            t0 = time.monotonic()
+            run_cli(cluster, "version", timeout=30)
+            cli_boot = time.monotonic() - t0
+            reconstruct_s = max(0.0, incident_wall - cli_boot)
+            rc = report.get("root_cause") or {}
+            attributed = (report.get("verdict") == "incident"
+                          and rc.get("point") == "prober.write")
+
+            out = {
+                "shards": n_shards,
+                "evidence_records": sum(
+                    report.get("counts", {}).values()),
+                "reconstruct_s": round(reconstruct_s, 3),
+                "cli_boot_s": round(cli_boot, 3),
+                "attributed": attributed,
+                "hlc_stamp_rate_per_s": round(stamp_rate, 1),
+                "hlc_stamp_cost_us": round(per_stamp_s * 1e6, 3),
+                "hlc_core": round(hlc_core, 6),
+                "hlc_within_budget": hlc_core < hlc_budget,
+            }
+            print("incident_reconstruction: %d shards, %d evidence "
+                  "records, reconstruct %.2fs; HLC %.0f stamps/s x "
+                  "%.2fus = %.4f core (budget %.2f, within: %s); "
+                  "attributed: %s"
+                  % (n_shards, out["evidence_records"], reconstruct_s,
+                     stamp_rate, out["hlc_stamp_cost_us"], hlc_core,
+                     hlc_budget, out["hlc_within_budget"], attributed),
+                  file=sys.stderr)
+            return out
+        finally:
+            if prober_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+            if fleet_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, fleet_proc)
+            await cluster.stop()
+
+
 def _mesh_env(n_devices: int) -> dict:
     """Subprocess env forcing an n-device virtual CPU mesh.  The flag
     must be final before jax initializes, hence subprocess-per-count
@@ -1118,7 +1369,7 @@ async def main() -> None:
     for name in picked:
         if name in ("restore_throughput", "incremental_rebuild",
                     "control_plane_scale", "modelcheck_throughput",
-                    "slo_probe"):
+                    "slo_probe", "incident_reconstruction"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -1135,6 +1386,9 @@ async def main() -> None:
     slo = None
     if "slo_probe" in picked:
         slo = await bench_slo_probe()
+    incident = None
+    if "incident_reconstruction" in picked:
+        incident = await bench_incident_reconstruction()
     scale = None
     if "control_plane_scale" in picked:
         scale = await bench_control_plane_scale()
@@ -1166,6 +1420,8 @@ async def main() -> None:
         out["modelcheck_throughput"] = modelcheck
     if slo is not None:
         out["slo_probe"] = slo
+    if incident is not None:
+        out["incident_reconstruction"] = incident
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

@@ -27,6 +27,8 @@ from dataclasses import dataclass
 from manatee_tpu.coord.api import BadVersionError, CoordClient, \
     NoNodeError, cluster_state_txn
 from manatee_tpu.coord.client import mux_handle
+from manatee_tpu.obs.causal import hlc_now, hlc_sort_key, \
+    merge_remote_sync, observe_peer_clock
 from manatee_tpu.pg.engine import PgError, parse_pg_url
 from manatee_tpu.state.types import role_of
 from manatee_tpu.utils import iso_ms as _now_iso
@@ -307,7 +309,16 @@ def history_annotation(state: dict, last: dict | None) -> str:
 
 def merge_events(events: list[dict]) -> list[dict]:
     """Merge per-peer journal/span rings into one shard timeline:
-    wall-clock timestamp first, then (peer, seq) as the tiebreak.
+    hybrid-logical-clock stamp first (obs/causal.py — every record the
+    fleet emits carries one, and the stamps piggyback on every
+    boundary the trace id crosses, so cause sorts before effect
+    regardless of wall-clock skew), then wall clock, then (peer, seq)
+    as the tiebreak.  Records from old peers carry no stamp and fall
+    back to their wall time — `hlc_sort_key` slots them in at
+    ``(ts*1000, -1)`` so a mixed fleet still merges into one
+    deterministic timeline (skew CAN misorder those records, which is
+    why the doctor warns when measured skew exceeds
+    ``MERGE_SKEW_BOUND_S``).
 
     The tiebreak matters: two peers' clocks quantize to the same
     millisecond constantly during a failover (the reacting peers all
@@ -316,9 +327,7 @@ def merge_events(events: list[dict]) -> list[dict]:
     two runs of `manatee-adm events` over the same rings would render
     different timelines.  Within one peer, seq preserves the ring's
     own causality regardless of any clock step between its records."""
-    return sorted(events, key=lambda e: (e.get("ts") or 0.0,
-                                         str(e.get("peer")),
-                                         e.get("seq") or 0))
+    return sorted(events, key=hlc_sort_key)
 
 
 class AdmClient:
@@ -578,6 +587,10 @@ class AdmClient:
             tid = new_trace_id()
             new["trace"] = tid
             new.pop("span", None)
+            # the written state object is an HLC piggyback boundary:
+            # peers reacting to the watch merge the writer's stamp, so
+            # their reaction records sort after this write at any skew
+            new["hlc"] = hlc_now()
             try:
                 with bind_trace(tid):
                     await self._client.multi(cluster_state_txn(
@@ -865,14 +878,22 @@ class AdmClient:
     async def _fan_out(self, peers: dict[str, dict], path: str,
                        keys: tuple[str, ...], *, timeout: float,
                        query: str = "",
-                       include_backup: bool = False
+                       include_backup: bool = False,
+                       skew: dict[str, float] | None = None
                        ) -> tuple[dict[str, list], dict[str, str]]:
         """GET *path* from every peer's status server (and, when
         *include_backup*, its backup server too), collecting the dicts
         under each of *keys*; per-peer failures land in the errors
         map.  *query* may be a callable(label) so a poll-tail can send
-        each peer its own ``since`` cursor."""
+        each peer its own ``since`` cursor.  Every reply body carries
+        the server's wall clock and HLC stamp: the stamp is merged
+        into this process's clock (so anything we journal afterward
+        sorts after everything we saw), and when *skew* is given the
+        measured per-peer clock offset lands there (doctor's
+        skew-vs-merge-bound check, the incident report's skew
+        table)."""
         import aiohttp
+        import time as _time
 
         out: dict[str, list] = {k: [] for k in keys}
         targets, errors = self.peer_http_targets(
@@ -881,6 +902,7 @@ class AdmClient:
 
         async def fetch(peer: dict, url: str, err_key: str,
                         http) -> None:
+            t0 = _time.time()
             try:
                 async with http.get(url) as resp:
                     if resp.status != 200:
@@ -892,6 +914,12 @@ class AdmClient:
             except Exception as e:
                 errors[err_key] = str(e) or type(e).__name__
                 return
+            merge_remote_sync(body.get("hlc"))
+            if skew is not None and body.get("now") is not None:
+                off = observe_peer_clock(err_key, body.get("now"),
+                                         t0, _time.time())
+                if off is not None:
+                    skew[err_key] = round(off, 6)
             for key in keys:
                 for ent in body.get(key) or []:
                     if not isinstance(ent, dict):
@@ -940,9 +968,12 @@ class AdmClient:
                 parts.append("limit=%d" % limit)
             return ("?" + "&".join(parts)) if parts else ""
 
+        skew: dict[str, float] = {}
         got, errors = await self._fan_out(
-            peers, "/events", ("events",), timeout=timeout, query=q)
-        return {"events": merge_events(got["events"]), "errors": errors}
+            peers, "/events", ("events",), timeout=timeout, query=q,
+            skew=skew)
+        return {"events": merge_events(got["events"]), "errors": errors,
+                "skew": skew}
 
     @staticmethod
     async def _gather_raw(targets, path: str, errors: dict, *,
@@ -1042,17 +1073,18 @@ class AdmClient:
             q.append("trace=%s" % trace)
         if limit is not None:
             q.append("limit=%d" % limit)
+        skew: dict[str, float] = {}
         got, errors = await self._fan_out(
             peers, "/spans", ("spans", "open"), timeout=timeout,
             query=("?" + "&".join(q)) if q else "",
-            include_backup=True)
+            include_backup=True, skew=skew)
         opens = got["open"]
         if trace is not None:
             # the trace query filters completed spans server-side;
             # open spans come back whole (they are the leak signal)
             opens = [o for o in opens if o.get("trace") == trace]
         return {"spans": merge_events(got["spans"]), "open": opens,
-                "errors": errors}
+                "errors": errors, "skew": skew}
 
     # -- live fault injection (manatee-adm fault set|list|clear) --
 

@@ -32,6 +32,8 @@ from manatee_tpu.obs import (
     current_span_id,
     current_trace,
     get_journal,
+    hlc_now,
+    merge_remote,
     span,
 )
 from manatee_tpu.storage import stream as wirestream
@@ -418,6 +420,10 @@ class RestoreClient:
                              # span parents under our receive span
                              "trace": current_trace(),
                              "span": current_span_id(),
+                             # causal identity: the server folds this
+                             # in, so sender-side records order after
+                             # our request (old servers ignore it)
+                             "hlc": hlc_now(),
                              # wire codecs we can decode, best first;
                              # an old server ignores the key and
                              # streams raw (storage.stream)
@@ -437,6 +443,9 @@ class RestoreClient:
                             "backup request refused: %d %s"
                             % (resp.status, await resp.text()))
                     body = await resp.json()
+                    # fold the server's reply stamp: our restore's
+                    # subsequent records order after the enqueue
+                    await merge_remote(body.get("hlc"))
                     job_path = body["jobPath"]
                     jobid = body.get("jobid")
                     expected["jobid"] = jobid \

@@ -30,6 +30,7 @@ from aiohttp import web
 
 from manatee_tpu import faults
 from manatee_tpu.backup.queue import BackupJob, BackupQueue
+from manatee_tpu.obs import hlc_now, merge_remote
 from manatee_tpu.daemons.common import attach_obs_routes
 from manatee_tpu.storage.base import (
     StorageBackend,
@@ -115,6 +116,10 @@ class BackupRestServer:
                 status=409)
         trace = params.get("trace")
         span_id = params.get("span")
+        # POST /backup is an HLC piggyback boundary like the coord RPC
+        # frames: fold the requester's stamp so the job's sender-side
+        # records order after the request at any wall-clock skew
+        await merge_remote(params.get("hlc"))
         # the requester's codec offer (absent/malformed = old peer =
         # raw); only string names survive into the job
         offered = params.get("compress")
@@ -151,6 +156,7 @@ class BackupRestServer:
                  "incremental from %s" % base if base else "full")
         return web.json_response(
             {"jobid": job.uuid, "jobPath": "/backup/%s" % job.uuid,
+             "hlc": hlc_now(),
              # the requester prepares its receive path off this BEFORE
              # the stream arrives (old requesters ignore the key)
              "basis": ({"mode": "incremental", "base": base}

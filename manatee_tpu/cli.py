@@ -1073,6 +1073,7 @@ def cmd_top(args) -> int:
                 "loop_stalls": stalls,
             })
         slis = None
+        skew_by_peer: dict[str, float] = {}
         base = _prober_url(args)
         if base:
             try:
@@ -1086,6 +1087,18 @@ def cmd_top(args) -> int:
                 raise
             except Exception as e:
                 errors[base] = str(e) or type(e).__name__
+            # the prober is the fleet's clock surveyor: its
+            # clock_skew_seconds{peer} gauges (NTP-style offsets it
+            # measures every clock-probe pass) feed the SKEW column
+            texts2 = await AdmClient._gather_raw(
+                [(base, base)], "/metrics", errors, timeout=5.0)
+            for name, labels, v in _prom_samples(
+                    texts2.get(base, "")):
+                if name == "clock_skew_seconds" \
+                        and labels.get("peer"):
+                    skew_by_peer[labels["peer"]] = v
+        for p in peers_out:
+            p["skew_s"] = skew_by_peer.get(p["peer"])
 
         if args.json:
             print(json.dumps({"now": round(now, 3),
@@ -1102,6 +1115,7 @@ def cmd_top(args) -> int:
             {"name": "rss", "label": "RSS", "width": 7},
             {"name": "fds", "label": "FDS", "width": 5},
             {"name": "lag", "label": "LAG", "width": 6},
+            {"name": "skew", "label": "SKEW", "width": 7},
             {"name": "pred", "label": "PRED", "width": 5},
             {"name": "loop", "label": "LOOP-P99", "width": 8},
             {"name": "stalls", "label": "STALLS", "width": 6},
@@ -1119,6 +1133,8 @@ def cmd_top(args) -> int:
                 "fds": ("-" if p["fds"] is None
                         else "%d" % p["fds"]),
                 "lag": pg_duration(p["lag_s"]),
+                "skew": ("-" if p["skew_s"] is None
+                         else "%+.2fs" % p["skew_s"]),
                 "pred": ("-" if p["health_score"] is None
                          else "%.2f" % p["health_score"]),
                 "loop": ("-" if p["loop_p99_s"] is None
@@ -1281,6 +1297,7 @@ def cmd_doctor(args) -> int:
         check_dirstore,
         check_history,
         check_introspection,
+        check_skew,
         finding,
         summarize,
     )
@@ -1317,10 +1334,12 @@ def cmd_doctor(args) -> int:
                 state, _v = await adm.get_state(shard)
                 hist = await adm.get_history(shard)
                 events: list[dict] = []
+                skew: dict = {}
                 if state is not None:
                     try:
-                        events = (await adm.shard_events(
-                            shard))["events"]
+                        out = await adm.shard_events(shard)
+                        events = out["events"]
+                        skew = out.get("skew") or {}
                     except asyncio.CancelledError:
                         raise
                     except Exception as e:
@@ -1329,9 +1348,9 @@ def cmd_doctor(args) -> int:
                             "no event journal reachable (%s); "
                             "generation checks ran against the "
                             "history only" % e))
-                return state, hist, events
+                return state, hist, events, skew
         try:
-            state, hist, events = asyncio.run(go())
+            state, hist, events, skew = asyncio.run(go())
         except KeyboardInterrupt:
             raise
         except Exception as e:
@@ -1345,6 +1364,7 @@ def cmd_doctor(args) -> int:
         else:
             findings.extend(check_cluster(state, hist, events))
             findings.extend(check_introspection(events))
+            findings.extend(check_skew(skew))
     elif not (args.coord_data or store_roots or args.history_dir
               or findings):
         # findings counts: a zfs-backend -c config produced a
@@ -1366,6 +1386,162 @@ def cmd_doctor(args) -> int:
               % (s["damage"], s["warnings"], s["notes"],
                  "CLEAN" if s["ok"] else "DAMAGED"))
     return 0 if s["ok"] else 1
+
+
+def cmd_incident(args) -> int:
+    """Automated incident reconstruction (docs/observability.md,
+    "Incident forensics"): collect HLC-stamped evidence from every
+    standard obs surface — the merged journals and spans, the prober's
+    burn-rate alerts and metric history, doctor findings, crash
+    fingerprints — into one causally-ordered fleet timeline, then walk
+    it backward from the client-visible symptom through the failover's
+    critical path to the initiating evidence (an injected fault, a
+    crash fingerprint, a loop stall, partition-era backoff, a session
+    expiry).  --last-alert (the default) starts from the freshest
+    symptom; --around reconstructs everything sharing one trace id;
+    --window bounds the investigation to [A, B] unix seconds."""
+    from manatee_tpu.doctor import check_cluster
+    from manatee_tpu.obs.incident import (
+        analyze,
+        build_timeline,
+        collect_evidence,
+        render_report,
+        write_report_file,
+    )
+
+    if sum(map(bool, (args.last_alert, args.around,
+                      args.window))) > 1:
+        die("choose one of --last-alert / --around / --window")
+    mode = ("around" if args.around
+            else "window" if args.window else "last-alert")
+    window = tuple(args.window) if args.window else None
+
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            shard = _shard(args)
+            base = _prober_url(args)
+
+            # Extra obs journals beyond the sitter fan-out: the fleet's
+            # fault.injected / crash evidence is not all in sitter
+            # rings — a prober.write outage lives in the PROBER's
+            # journal, a coordd.oplog.append error in COORDD's.  The
+            # prober (when -u names one) and every --source URL join
+            # the events stream so those classes attribute too.
+            extras: list[tuple[str, str]] = []
+            if base:
+                extras.append(("prober", base))
+            for spec in args.source or []:
+                label, sep, url = spec.partition("=")
+                if sep and "://" not in label:
+                    extras.append((label, url))
+                else:
+                    extras.append((spec, spec))
+
+            async def fetch_extra_events(out):
+                from manatee_tpu.obs.causal import (
+                    merge_remote_sync,
+                    observe_peer_clock,
+                )
+                q = "?limit=%d" % args.limit if args.limit else ""
+                for label, url in extras:
+                    t0 = time.time()
+                    try:
+                        status, body = await AdmClient.http_json(
+                            url.rstrip("/") + "/events" + q)
+                        if status != 200:
+                            raise AdmError(
+                                "%s/events answered HTTP %d"
+                                % (url, status))
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        out.setdefault("errors", {})[label] = \
+                            str(e) or type(e).__name__
+                        continue
+                    t1 = time.time()
+                    merge_remote_sync(body.get("hlc"))
+                    peer = str(body.get("peer") or label)
+                    off = observe_peer_clock(peer, body.get("now"),
+                                             t0, t1)
+                    if off is not None:
+                        out.setdefault("skew", {})[peer] = \
+                            round(off, 6)
+                    for e in body.get("events") or []:
+                        if isinstance(e, dict):
+                            ent = dict(e)
+                            ent.setdefault("peer", peer)
+                            out.setdefault("events", []).append(ent)
+
+            extras_fetched = False
+
+            async def events_source(since):
+                nonlocal extras_fetched
+                out = await adm.shard_events(
+                    shard, since=since or None, limit=args.limit)
+                # extra journals are fetched whole, once — no paging
+                if extras and not extras_fetched:
+                    extras_fetched = True
+                    await fetch_extra_events(out)
+                return out
+
+            async def spans_source():
+                return await adm.shard_spans(shard, limit=args.limit)
+
+            async def doctor_source():
+                state, _v = await adm.get_state(shard)
+                hist = await adm.get_history(shard)
+                # journal-vs-store checks run over the durable data
+                # only; journal evidence is already on the timeline
+                return check_cluster(state, hist, [])
+
+            sources = {"events": events_source,
+                       "spans": spans_source,
+                       "doctor": doctor_source}
+            if base:
+                async def alerts_source():
+                    status, body = await AdmClient.http_json(
+                        base + "/alerts")
+                    if status != 200:
+                        raise AdmError("%s/alerts answered HTTP %d"
+                                       % (base, status))
+                    return body
+
+                async def history_source():
+                    status, body = await AdmClient.http_json(
+                        base + "/history")
+                    if status != 200:
+                        raise AdmError("%s/history answered HTTP %d"
+                                       % (base, status))
+                    return body
+
+                sources["alerts"] = alerts_source
+                sources["history"] = history_source
+
+            crash_dir = args.crash_dir \
+                or os.environ.get("MANATEE_CRASH_DIR")
+            collected = await collect_evidence(sources,
+                                               crash_dir=crash_dir)
+        timeline = build_timeline(collected["evidence"])
+        report = analyze(timeline, mode=mode, trace=args.around,
+                         window=window, skew=collected["skew"],
+                         errors=collected["errors"])
+        report["shard"] = shard
+        report["generated_ts"] = collected["collected_ts"]
+        if args.output:
+            await asyncio.to_thread(write_report_file, args.output,
+                                    report)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            for line in render_report(report):
+                print(line)
+            if args.output:
+                print("report written to %s" % args.output)
+        # 0 for any completed reconstruction (quiet included); 1 only
+        # for a symptom the analyzer could not attribute
+        return 0 if report["verdict"] != "symptom-unattributed" else 1
+
+    return asyncio.run(go())
 
 
 def cmd_rebuild(args) -> int:
@@ -1731,6 +1907,43 @@ def build_parser() -> argparse.ArgumentParser:
                          "when a coordination address is available")
     sp.add_argument("-j", "--json", action="store_true",
                     help="machine-readable findings + summary")
+
+    sp = add("incident", cmd_incident,
+             "reconstruct an incident from the HLC-ordered fleet "
+             "timeline (symptom -> root cause)")
+    sp.add_argument("--last-alert", action="store_true",
+                    dest="last_alert",
+                    help="walk back from the freshest client-visible "
+                         "symptom (the default mode)")
+    sp.add_argument("--around", default=None, metavar="TRACE",
+                    help="reconstruct everything sharing this trace "
+                         "id")
+    sp.add_argument("--window", nargs=2, type=float, default=None,
+                    metavar=("A", "B"),
+                    help="bound the investigation to [A, B] unix "
+                         "seconds")
+    sp.add_argument("-u", "--url", default=None, metavar="URL",
+                    help="prober base URL for alert/history evidence "
+                         "(env: MANATEE_PROBER_URL); its journal "
+                         "joins the events timeline too")
+    sp.add_argument("--source", action="append", default=None,
+                    metavar="[LABEL=]URL",
+                    help="extra obs base URL (a coordd metrics "
+                         "listener, a backup server) whose /events "
+                         "journal should join the timeline; "
+                         "repeatable")
+    sp.add_argument("--crash-dir", default=None, metavar="DIR",
+                    dest="crash_dir",
+                    help="crash-fingerprint directory "
+                         "(env: MANATEE_CRASH_DIR)")
+    sp.add_argument("-n", "--limit", type=int, default=None,
+                    help="newest N records per peer per page")
+    sp.add_argument("-o", "--output", default=None, metavar="FILE",
+                    help="also write the machine-readable report "
+                         "atomically to FILE")
+    sp.add_argument("-j", "--json", action="store_true",
+                    help="print the machine-readable report instead "
+                         "of the postmortem text")
 
     sp = add("rebuild", cmd_rebuild, "rebuild this peer from upstream")
     sp.add_argument("-c", "--config",

@@ -46,6 +46,8 @@ from manatee_tpu.obs import (
     get_journal,
     get_registry,
     get_span_store,
+    hlc_now,
+    merge_remote,
 )
 from manatee_tpu.utils.retry import Backoff
 
@@ -389,6 +391,10 @@ class NetCoord(CoordClient):
                     continue
                 if await faults.point("coord.client.recv") == "drop":
                     continue    # the frame vanished in flight
+                # merge the server's piggybacked HLC before delivering
+                # the frame: degrades to wall-clock ordering on any
+                # failure, never fails the frame (obs/causal.py)
+                await merge_remote(msg.get("hlc"))
                 if "watch" in msg:
                     self._deliver_watch(msg["watch"])
                     continue
@@ -513,6 +519,10 @@ class NetCoord(CoordClient):
         sid = current_span_id()
         if sid is not None and "span" not in req:
             req["span"] = sid
+        # HLC piggyback (obs/causal.py): every frame carries our clock
+        # so the server's handling — and anything it journals — sorts
+        # after this send regardless of wall-clock skew
+        req["hlc"] = hlc_now()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
         t0 = time.monotonic()

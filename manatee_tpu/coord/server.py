@@ -103,7 +103,8 @@ from manatee_tpu.coord.api import (
     NotLeaderError,
     Op,
 )
-from manatee_tpu.obs import bind_parent, bind_trace, get_span_store
+from manatee_tpu.obs import bind_parent, bind_trace, get_span_store, \
+    hlc_now, merge_remote
 from manatee_tpu.obs.metrics import Histogram
 from manatee_tpu.utils.logutil import setup_logging
 
@@ -169,8 +170,12 @@ def encode_frame(msg: dict) -> bytes:
     """One wire frame (newline-delimited JSON).  The hot fan-out paths
     (watch fires, replication ships, leader pings) encode a message
     ONCE with this and hand the same bytes to every subscriber
-    connection instead of re-serializing per connection."""
-    return (json.dumps(msg) + "\n").encode()
+    connection instead of re-serializing per connection.  Every
+    outbound frame carries the server's HLC stamp (obs/causal.py):
+    clients merge it, so a reaction to a watch fire or a reply sorts
+    after the server-side work that produced it at any clock skew.
+    Fan-out frames share one stamp — still a valid send event."""
+    return (json.dumps({**msg, "hlc": hlc_now()}) + "\n").encode()
 
 
 def _b64(data: bytes) -> str:
@@ -1120,6 +1125,12 @@ class CoordServer:
                                "msg": "bad json"})
                     continue
                 conn.in_dispatch = True
+                # fold the client's piggybacked HLC in BEFORE dispatch
+                # so everything this request causes (oplog append,
+                # watch fires, journal records) stamps after the
+                # client's send; degrades to wall-clock ordering on
+                # any merge failure, never fails the request
+                await merge_remote(req.get("hlc"))
                 tid = req.get("trace")
                 sid = req.get("span")
                 t0 = time.monotonic()

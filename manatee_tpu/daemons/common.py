@@ -45,6 +45,7 @@ def attach_obs_routes(app, *, metrics: bool = False) -> list[str]:
 
     from manatee_tpu import faults
     from manatee_tpu.obs import get_journal, get_span_store
+    from manatee_tpu.obs.causal import hlc_now
     from manatee_tpu.obs.history import get_history, history_http_reply
     from manatee_tpu.obs.profile import (
         get_profiler,
@@ -65,6 +66,7 @@ def attach_obs_routes(app, *, metrics: bool = False) -> list[str]:
         return web.json_response({
             "peer": journal.peer,
             "now": round(_time.time(), 3),
+            "hlc": hlc_now(),
             "events": journal.events(since=since, limit=limit),
         }, content_type="application/json")
 

@@ -67,7 +67,13 @@ from manatee_tpu.daemons.common import (
     daemon_main,
     start_daemon_introspection,
 )
-from manatee_tpu.obs import get_journal, get_registry, set_peer
+from manatee_tpu.obs import (
+    get_journal,
+    get_registry,
+    merge_remote,
+    observe_peer_clock,
+    set_peer,
+)
 from manatee_tpu.obs.history import DEFAULT_INTERVAL as HISTORY_INTERVAL
 from manatee_tpu.obs.history import HistoryRecorder, init_history
 from manatee_tpu.obs.slo import init_slo_engine, parse_slo_configs
@@ -82,6 +88,9 @@ PROBE_TIMEOUT = 5.0
 # peer-reported lag is scraped at most this often per peer (the probe
 # loop itself never blocks on it)
 LAG_SCRAPE_INTERVAL = 10.0
+# wall-clock skew probes (clock_skew_seconds{peer}) at the same
+# cadence: skew drifts far slower than replication lag
+CLOCK_PROBE_INTERVAL = 10.0
 # read-your-write matching window: acked probe writes we can still
 # recognize in a replica's table
 ACKED_RING = 1024
@@ -268,6 +277,7 @@ class ShardProber:
         self._acked: deque[tuple[int, float]] = deque(maxlen=ACKED_RING)
         self._err_start: float | None = None   # monotonic, first failure
         self._last_lag_scrape: dict[str, float] = {}
+        self._last_clock_probe: dict[str, float] = {}
         self._task: asyncio.Task | None = None
 
     # -- lifecycle --
@@ -361,6 +371,10 @@ class ShardProber:
         await self._probe_write()
         for rep in list(self._replicas):
             await self._probe_read(rep)
+        if self._primary is not None:
+            await self._maybe_probe_clock(
+                self._primary,
+                self._primary.get("id") or self._primary["pgUrl"])
 
     async def _probe_write(self) -> None:
         self._wseq += 1
@@ -435,6 +449,7 @@ class ShardProber:
                    result="ok" if good else "stale")
         self._slo.record("read_staleness", good=good, shard=self.name)
         await self._maybe_scrape_lag(rep, peer)
+        await self._maybe_probe_clock(rep, peer)
 
     def _staleness(self, rows: list) -> float | None:
         """Read-your-write staleness: age of the newest acked write
@@ -478,6 +493,36 @@ class ShardProber:
         lag = _parse_lag_gauge(text)
         if lag is not None:
             _PEER_LAG.set(lag, shard=self.name, peer=peer)
+
+    async def _maybe_probe_clock(self, rep: dict, peer: str) -> None:
+        """NTP-style skew probe, best-effort: each peer's ``/events``
+        payload carries its wall clock (``now``) and HLC stamp; the
+        RTT midpoint gives the offset (``clock_skew_seconds{peer}``,
+        rendered on this daemon's /metrics and the SKEW column of
+        `manatee-adm top`), and folding the stamp keeps everything
+        this prober journals causally after what it observed."""
+        mono = time.monotonic()
+        last = self._last_clock_probe.get(peer, 0.0)
+        if mono - last < CLOCK_PROBE_INTERVAL:
+            return
+        self._last_clock_probe[peer] = mono
+        try:
+            _s, host, pg_port = parse_pg_url(rep["pgUrl"])
+            t0 = time.time()
+            text = await self._http_get(
+                "http://%s:%d/events?limit=0" % (host, pg_port + 1))
+            t1 = time.time()
+            body = json.loads(text)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        if not isinstance(body, dict):
+            return
+        now = body.get("now")
+        if isinstance(now, (int, float)):
+            observe_peer_clock(peer, float(now), t0, t1)
+        await merge_remote(body.get("hlc"))
 
 
 _LAG_RE = re.compile(

@@ -601,3 +601,32 @@ def check_introspection(events: list[dict]) -> list[dict]:
             "`manatee-adm events -e obs.loop.stall` has the "
             "captured stacks" % (stalls[peer], worst[peer])))
     return out
+
+
+# ---- wall-clock skew vs the journal-merge safety bound ----
+
+def check_skew(skew: dict | None) -> list[dict]:
+    """Pure check over measured per-peer clock offsets (the fan-out's
+    ``skew`` map / ``clock_skew_seconds{peer}``): warn when a peer's
+    skew exceeds :data:`~manatee_tpu.obs.causal.MERGE_SKEW_BOUND_S`.
+    HLC-stamped records merge correctly at ANY skew; the bound exists
+    for records from pre-HLC peers, which merge on wall clocks alone
+    — past it, their cause-and-effect ordering in `manatee-adm
+    events` (and the incident analyzer's timeline) is no longer
+    trustworthy."""
+    from manatee_tpu.obs.causal import MERGE_SKEW_BOUND_S
+    out: list[dict] = []
+    for peer in sorted(skew or {}):
+        try:
+            off = float(skew[peer])
+        except (TypeError, ValueError):
+            continue
+        if abs(off) > MERGE_SKEW_BOUND_S:
+            out.append(finding(
+                WARNING, "skew-exceeds-merge-bound", peer,
+                "measured wall-clock skew %+.3fs exceeds the "
+                "journal-merge safety bound (%.1fs): records from "
+                "pre-HLC peers merge on wall clocks alone and may "
+                "misorder cause and effect; HLC-stamped records are "
+                "unaffected" % (off, MERGE_SKEW_BOUND_S)))
+    return out

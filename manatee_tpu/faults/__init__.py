@@ -419,6 +419,47 @@ class FaultRegistry:
         return verdict
 
 
+def _write_crash_fingerprint(name: str, rule: FaultRule) -> None:
+    """Best-effort crash breadcrumb for the forensics plane: a process
+    about to die takes its in-memory journal with it, so when
+    ``MANATEE_CRASH_DIR`` points somewhere, drop one small JSON file
+    naming the seam, variant, and the exit status the parent is about
+    to observe.  The incident analyzer (obs/incident.py) reads these
+    to turn an opaque ``exit 86`` / SIGKILL into a named root cause.
+    Fully fenced: fingerprinting must never keep a crash from
+    crashing."""
+    try:
+        crash_dir = os.environ.get("MANATEE_CRASH_DIR")
+        if not crash_dir:
+            return
+        import json as _json
+
+        from manatee_tpu.obs.causal import hlc_now
+        from manatee_tpu.obs.journal import get_journal as _gj
+        ts = time.time()
+        fp = {
+            "kind": "crash",
+            "point": name,
+            "action": "crash",
+            "variant": rule.variant,
+            "ts": round(ts, 6),
+            "hlc": hlc_now(),
+            "peer": _gj().peer,
+            "pid": os.getpid(),
+            "status": (-signal.SIGKILL if rule.variant == "kill"
+                       else CRASH_EXIT_CODE),
+        }
+        path = os.path.join(crash_dir,
+                            "crash-%d-%d.json" % (os.getpid(),
+                                                  int(ts * 1000)))
+        with open(path, "w") as f:
+            f.write(_json.dumps(fp))
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:
+        pass
+
+
 def _crash_now(name: str, rule: FaultRule) -> None:
     """Terminate THIS process at the seam, un-catchably.  ``exit`` is a
     hard ``os._exit`` — no exception propagation, no finally blocks, no
@@ -430,6 +471,7 @@ def _crash_now(name: str, rule: FaultRule) -> None:
     that nothing after this instant is guaranteed to run."""
     log.critical("failpoint %s: crashing the process (variant=%s, "
                  "rule %d)", name, rule.variant, rule.rule_id)
+    _write_crash_fingerprint(name, rule)
     try:
         sys.stderr.flush()
         sys.stdout.flush()

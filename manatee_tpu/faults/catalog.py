@@ -83,6 +83,14 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/coord/client.py",),
         ("error", "delay", "stall", "drop", "crash"),
     ),
+    "coord.hlc.merge": (
+        "inbound hybrid-logical-clock stamp merge (every piggyback "
+        "boundary: coord frames, written state, POST /backup, prober "
+        "clock probes); error degrades that record to wall-clock "
+        "ordering — it must never fail the carrying RPC",
+        ("manatee_tpu/obs/causal.py",),
+        ("error", "delay", "crash"),
+    ),
     "coord.mux.demux": (
         "mux watch demultiplexer: where one shared coordd "
         "connection's watch stream fans back out to per-shard logical "
@@ -115,6 +123,13 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         "a crash here can tear at most the final line, which the "
         "doctor notes but never counts as damage",
         ("manatee_tpu/obs/history.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "obs.incident.collect": (
+        "incident evidence collector, before the fleet fan-out; a "
+        "crash mid-collection must leave no partial report artifact "
+        "(reports land via tmp+rename)",
+        ("manatee_tpu/obs/incident.py",),
         ("error", "delay", "stall", "crash"),
     ),
     "obs.loop.tick": (

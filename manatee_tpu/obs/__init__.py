@@ -27,6 +27,16 @@ Everything here is stdlib-only and allocation-light: observability must
 never be able to hurt HA.
 """
 
+from manatee_tpu.obs.causal import (
+    MERGE_SKEW_BOUND_S,
+    HybridClock,
+    get_clock,
+    hlc_now,
+    hlc_sort_key,
+    merge_remote,
+    merge_remote_sync,
+    observe_peer_clock,
+)
 from manatee_tpu.obs.journal import EventJournal, get_journal
 from manatee_tpu.obs.journal import set_peer as _set_journal_peer
 from manatee_tpu.obs.metrics import (
@@ -85,7 +95,9 @@ __all__ = [
     "EventJournal",
     "Gauge",
     "Histogram",
+    "HybridClock",
     "LoopMonitor",
+    "MERGE_SKEW_BOUND_S",
     "Registry",
     "SamplingProfiler",
     "Span",
@@ -96,13 +108,19 @@ __all__ = [
     "current_span_id",
     "current_trace",
     "ensure_trace",
+    "get_clock",
     "get_journal",
     "get_loop_monitor",
     "get_profiler",
     "get_registry",
     "get_span_store",
+    "hlc_now",
+    "hlc_sort_key",
+    "merge_remote",
+    "merge_remote_sync",
     "new_span_id",
     "new_trace_id",
+    "observe_peer_clock",
     "profile_http_reply",
     "record_span",
     "set_peer",

@@ -1,0 +1,218 @@
+"""Hybrid logical clocks: recoverable happens-before across the fleet.
+
+The journals, spans, alerts, and history snapshots are merged across
+peers on wall-clock timestamps, and wall clocks skew: under a few
+seconds of drift a takeover's *effect* on one peer can sort before its
+*cause* on another, and every downstream consumer — ``manatee-adm
+events``, the doctor's journal cross-checks, the incident analyzer —
+inherits the lie.  This module gives every process one hybrid logical
+clock (Kulkarni et al.: a physical component in milliseconds plus a
+logical counter) with the two HLC operations:
+
+- :func:`hlc_now` advances the clock for a local event / outbound
+  message and returns the encoded stamp;
+- :func:`merge_remote` folds a received stamp in, so the local clock
+  never falls behind anything it has *seen*.
+
+Causality then rides the exact boundaries the trace id already
+crosses, at the same near-zero marginal cost (one small string per
+frame): coord RPC frames client<->coordd (both directions), the
+written cluster-state object, ``POST /backup`` and its reply, and the
+obs-route payloads the prober and the adm fan-out already fetch.  With
+every boundary covered, ``e happened-before f`` implies
+``stamp(e) < stamp(f)`` regardless of skew, so the merged fleet
+timeline can sort by stamp and place every effect after its cause —
+:func:`hlc_sort_key` is that order, with a wall-clock fallback for
+records from old peers that predate HLC stamping.
+
+Degradation contract: a stamp is advisory metadata.  The
+``coord.hlc.merge`` failpoint sits on the merge seam and an injected
+error (or a garbage stamp from a hostile peer) degrades that merge to
+wall-clock ordering — it must never wedge or fail the RPC path
+carrying it.
+
+Skew visibility: :func:`observe_peer_clock` turns any fetched
+``now``-bearing obs payload into a measured per-peer offset, exported
+as ``clock_skew_seconds{peer}`` (the prober measures its shard's peers
+every lag-scrape pass).  :data:`MERGE_SKEW_BOUND_S` is the
+journal-merge safety bound: old-peer records fall back to wall-clock
+ordering, so once measured skew exceeds the bound the doctor warns
+that pre-HLC merges may misorder.
+
+Encoding: ``"%013x.%05x" % (physical_ms, logical)`` — fixed-width hex,
+so the string ordering equals the numeric ordering and the stamp costs
+19 bytes on the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from manatee_tpu.obs.metrics import get_registry
+
+_REG = get_registry()
+_SKEW = _REG.gauge(
+    "clock_skew_seconds",
+    "measured peer wall-clock offset (remote minus local, RTT-"
+    "compensated)", ("peer",))
+_MERGES = _REG.counter(
+    "hlc_merge_total",
+    "inbound HLC stamp merges", ("outcome",))
+
+# The journal-merge safety bound (seconds): records from pre-HLC peers
+# merge on wall clocks alone, so measured skew beyond this can misorder
+# cause and effect for THOSE records (HLC-stamped records stay correct
+# at any skew).  The doctor warns past it (`skew-exceeds-merge-bound`).
+MERGE_SKEW_BOUND_S = 0.5
+
+# fixed widths: 13 hex ms digits reach the year 4147, 5 hex logical
+# digits allow 131k same-millisecond events before the width (not the
+# ordering — sort keys decode) would grow
+_ENC = "%013x.%05x"
+
+
+def encode(pt_ms: int, logical: int) -> str:
+    return _ENC % (pt_ms, logical)
+
+
+def decode(stamp) -> tuple[int, int] | None:
+    """(physical_ms, logical) from an encoded stamp, or None for
+    anything malformed — old peers send nothing, hostile peers could
+    send garbage, and both must degrade to wall-clock ordering rather
+    than raise mid-merge."""
+    if not isinstance(stamp, str):
+        return None
+    head, sep, tail = stamp.partition(".")
+    if not sep:
+        return None
+    try:
+        return int(head, 16), int(tail, 16)
+    except ValueError:
+        return None
+
+
+class HybridClock:
+    """One process's HLC state.  Everything is event-loop-thread
+    confined, like the obs registries."""
+
+    __slots__ = ("pt", "c")
+
+    def __init__(self):
+        self.pt = 0
+        self.c = 0
+
+    def _wall_ms(self) -> int:
+        return int(time.time() * 1000)
+
+    def now(self) -> str:
+        """Advance for a local/send event and return the stamp."""
+        wall = self._wall_ms()
+        if wall > self.pt:
+            self.pt, self.c = wall, 0
+        else:
+            self.c += 1
+        return encode(self.pt, self.c)
+
+    def observe(self, remote_pt: int, remote_c: int) -> str:
+        """Fold a received stamp in (the HLC receive rule) and return
+        the advanced local stamp."""
+        wall = self._wall_ms()
+        if wall > self.pt and wall > remote_pt:
+            self.pt, self.c = wall, 0
+        elif remote_pt > self.pt:
+            self.pt, self.c = remote_pt, remote_c + 1
+        elif self.pt > remote_pt:
+            self.c += 1
+        else:
+            self.c = max(self.c, remote_c) + 1
+        return encode(self.pt, self.c)
+
+
+_CLOCK = HybridClock()
+
+
+def get_clock() -> HybridClock:
+    """The process-wide hybrid clock every stamp comes from."""
+    return _CLOCK
+
+
+def hlc_now() -> str:
+    """THE stamping API: advance the process clock and return the
+    encoded stamp (journal records, spans, snapshots, outbound
+    frames)."""
+    return _CLOCK.now()
+
+
+async def merge_remote(stamp, *, source: str | None = None) -> str | None:
+    """THE merge API for piggybacked stamps: fold *stamp* (as read off
+    a frame/state object/reply — possibly absent or garbage) into the
+    process clock.  Returns the advanced stamp, or None when nothing
+    merged.  Carries the ``coord.hlc.merge`` failpoint; ANY failure
+    degrades to wall-clock ordering (the clock simply does not
+    advance) — it never propagates into the RPC path."""
+    if stamp is None:
+        return None
+    try:
+        from manatee_tpu import faults
+        await faults.point("coord.hlc.merge")
+        decoded = decode(stamp)
+        if decoded is None:
+            _MERGES.inc(outcome="garbage")
+            return None
+        out = _CLOCK.observe(*decoded)
+        _MERGES.inc(outcome="ok")
+        return out
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        # injected error or anything unforeseen: the stamp is advisory
+        # — degrade, never wedge the frame carrying it
+        _MERGES.inc(outcome="degraded")
+        return None
+
+
+def merge_remote_sync(stamp) -> str | None:
+    """Synchronous merge for call sites with no await point (the
+    CLI's fan-out parsers).  No failpoint — the seam is the live RPC
+    path, not the offline reader."""
+    decoded = decode(stamp)
+    if decoded is None:
+        return None
+    return _CLOCK.observe(*decoded)
+
+
+def observe_peer_clock(peer: str, remote_now: float, t0: float,
+                       t1: float) -> float | None:
+    """Measured skew from one fetched obs payload: *remote_now* is the
+    peer's reported wall clock (the ``now`` field every obs route
+    already serves), *t0*/*t1* bracket the request locally.  The
+    remote read is assumed to sit at the RTT midpoint — the classic
+    NTP offset estimate.  Exports ``clock_skew_seconds{peer}`` and
+    returns the offset (remote minus local), or None for junk."""
+    try:
+        skew = float(remote_now) - (t0 + t1) / 2.0
+    except (TypeError, ValueError):
+        return None
+    _SKEW.set(round(skew, 6), peer=str(peer))
+    return skew
+
+
+def hlc_sort_key(rec: dict) -> tuple:
+    """The fleet-merge total order for any stamped record (journal
+    event, span, alert, snapshot, timeline entry): HLC when present,
+    wall-clock fallback for old peers, then ``(ts, peer, seq)`` so the
+    order is deterministic under every mix.  Old records slot in at
+    their wall time (logical -1 sorts them before same-millisecond
+    stamped records)."""
+    ts = rec.get("ts") or 0.0
+    try:
+        ts = float(ts)
+    except (TypeError, ValueError):
+        ts = 0.0
+    decoded = decode(rec.get("hlc"))
+    if decoded is None:
+        pt, logical = int(ts * 1000), -1
+    else:
+        pt, logical = decoded
+    return (pt, logical, ts, str(rec.get("peer")), rec.get("seq") or 0)

@@ -42,6 +42,7 @@ import os
 import time
 from pathlib import Path
 
+from manatee_tpu.obs.causal import hlc_now
 from manatee_tpu.obs.journal import _iso_ms
 from manatee_tpu.obs.metrics import Registry, get_registry
 from manatee_tpu.obs.spans import parse_page_query
@@ -196,6 +197,7 @@ class MetricsHistory:
         self._seq += 1
         ts = round(time.time(), 3)
         rec = {"seq": self._seq, "ts": ts, "time": _iso_ms(ts),
+               "hlc": hlc_now(),
                "metrics": dump_registry(self._registry)}
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         await asyncio.to_thread(self._append_durable, line)
@@ -316,6 +318,7 @@ def history_http_reply(history: MetricsHistory | None, query
         return {"error": "since/limit must be integers"}, 400
     return {
         "now": round(time.time(), 3),
+        "hlc": hlc_now(),
         "dir": str(history.dir),
         "records": history.records(since=since, limit=limit),
     }, 200

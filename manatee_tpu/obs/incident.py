@@ -1,0 +1,569 @@
+"""Incident forensics: one HLC-ordered fleet timeline, reconstructed
+backward from symptom to root cause.
+
+The paper's operators reconstruct incidents by hand: grep per-peer
+logs, guess at clock skew, correlate a client-visible outage with
+whatever the control plane was doing at "about that time".  Everything
+this tree already exports — journals, spans, burn-rate alerts, metric
+history, doctor findings, crash fingerprints — carries a hybrid
+logical clock stamp (obs/causal.py), so the guesswork is mechanical
+now:
+
+- :func:`collect_evidence` fans out over the standard obs routes
+  (every payload a ``manatee-adm`` fan-out already fetches) and the
+  crash-fingerprint directory, normalizing each record into one
+  kind-tagged evidence list;
+- :func:`build_timeline` merges it all into a single fleet timeline
+  ordered by :func:`~manatee_tpu.obs.causal.hlc_sort_key` — cause
+  before effect at any wall-clock skew;
+- :func:`analyze` walks that timeline backward from the client-visible
+  symptom (a fired burn-rate alert, a measured error window) through
+  the failover root span's critical path to the initiating evidence:
+  an injected fault, a crash fingerprint, a loop stall, partition-era
+  reconnect backoff, or a session expiry;
+- :func:`render_report` emits the human postmortem;
+  ``manatee-adm incident -j`` prints the machine form.
+
+Degradation contract: collection is fan-out over lossy HTTP — partial
+peer failure yields a partial (but honest) report with the failures
+named.  The ``obs.incident.collect`` failpoint sits before the
+fan-out; a crash there must leave no partial report artifact, which is
+why :func:`write_report_file` lands reports via tmp+fsync+rename only.
+
+A quiet fleet must analyze to a quiet verdict: the closed-loop chaos
+drill asserts both directions — every injected fault class is named as
+root cause, and a soak with nothing armed attributes nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+from manatee_tpu.obs.causal import MERGE_SKEW_BOUND_S, hlc_sort_key
+from manatee_tpu.obs.spans import assemble_tree, critical_path
+
+# evidence kinds, in collection order
+EVIDENCE_KINDS = ("event", "span", "alert", "history", "doctor",
+                  "crash")
+
+# how many event pages a paginated collect will pull per fan-out
+# before declaring the ring drained (each page advances per-peer seq
+# cursors, so a page that adds nothing new ends the loop early)
+DEFAULT_MAX_PAGES = 8
+
+# the chain filter: timeline entries that narrate a failover even when
+# they carry no trace id (plus anything sharing the symptom's or the
+# root cause's trace)
+_CHAIN_EVENTS = frozenset((
+    "failover.detected", "failover.complete", "failover.aborted",
+    "takeover.begin", "transition.begin", "transition.committed",
+    "transition.conflict", "role.change",
+    "pg.reconfigure.begin", "pg.reconfigure.done",
+    "pg.reconfigure.failed", "pg.reconfigure.cancelled",
+    "restore.start", "restore.done", "restore.failed",
+    "coord.session.connected", "coord.session.disconnected",
+    "coord.session.expired",
+    "fault.armed", "fault.injected",
+    "prober.error_window",
+    "slo.alert.fired", "slo.alert.resolved",
+    "obs.loop.stall", "probe.flip",
+))
+
+_MAX_CHAIN = 200
+
+
+class IncidentError(Exception):
+    pass
+
+
+# ---- collection ----
+
+def read_crash_fingerprints(crash_dir) -> tuple[list[dict],
+                                                dict[str, str]]:
+    """The breadcrumbs dying processes leave (faults._crash_now writes
+    one JSON file per crash into ``MANATEE_CRASH_DIR``): (entries,
+    errors).  A crashed peer's in-memory journal died with it, so
+    these files are the ONLY evidence naming the seam it died at."""
+    entries: list[dict] = []
+    errors: dict[str, str] = {}
+    if not crash_dir:
+        return entries, errors
+    try:
+        names = sorted(os.listdir(crash_dir))
+    except FileNotFoundError:
+        return entries, errors
+    except OSError as e:
+        errors["crash:" + str(crash_dir)] = str(e)
+        return entries, errors
+    for name in names:
+        if not (name.startswith("crash-") and name.endswith(".json")):
+            continue
+        path = os.path.join(crash_dir, name)
+        try:
+            with open(path) as f:
+                fp = json.load(f)
+        except (OSError, ValueError) as e:
+            errors["crash:" + name] = str(e)
+            continue
+        if isinstance(fp, dict):
+            fp["kind"] = "crash"
+            entries.append(fp)
+    return entries, errors
+
+
+async def _collect_events(fetch, evidence: list, errors: dict,
+                          skew: dict, max_pages: int) -> None:
+    """Drain every peer's journal ring through a paginated source:
+    *fetch(since)* mirrors ``AdmClient.shard_events`` (per-peer seq
+    cursors), so each page ships only new tail and a ring larger than
+    one page's limit is still collected whole."""
+    cursors: dict[str, int] = {}
+    for _page in range(max_pages):
+        out = await fetch(dict(cursors))
+        for k, v in (out.get("errors") or {}).items():
+            errors["events:%s" % k] = str(v)
+        for k, v in (out.get("skew") or {}).items():
+            skew[str(k)] = v
+        fresh = 0
+        for e in out.get("events") or []:
+            if not isinstance(e, dict):
+                continue
+            peer, seq = e.get("peer"), e.get("seq")
+            if peer is not None and isinstance(seq, int):
+                if seq <= cursors.get(peer, 0):
+                    continue           # page-overlap duplicate
+                cursors[peer] = max(cursors.get(peer, 0), seq)
+            ent = dict(e)
+            ent["kind"] = "event"
+            evidence.append(ent)
+            fresh += 1
+        if not fresh:
+            return
+
+
+async def collect_evidence(sources: dict, *, crash_dir=None,
+                           max_pages: int = DEFAULT_MAX_PAGES) -> dict:
+    """Fan out over the standard obs surfaces and assemble the raw
+    evidence set.  *sources* maps source name -> async callable:
+
+    - ``events``: called with a per-peer ``since`` cursor dict,
+      returns ``{"events", "errors", "skew"}`` (shard_events);
+    - ``spans``: returns ``{"spans", "open", "errors", "skew"}``;
+    - ``alerts``: returns the prober's ``/alerts`` body (or None);
+    - ``history``: returns a ``/history`` body (``{"records": []}``)
+      or a per-peer mapping of such bodies;
+    - ``doctor``: returns a list of doctor findings.
+
+    Absent sources are skipped (a fleet without a prober still gets a
+    journal+span timeline).  Per-peer fetch failures land in the
+    ``errors`` map namespaced by source — a partial fleet yields a
+    partial report, never an exception.  Returns ``{"evidence",
+    "errors", "skew", "collected_ts"}``."""
+    from manatee_tpu import faults
+
+    # the collector seam: crash here (the sweep's scenario) must leave
+    # no partial report artifact — reports only ever land via
+    # write_report_file's tmp+rename
+    await faults.point("obs.incident.collect")
+
+    evidence: list[dict] = []
+    errors: dict[str, str] = {}
+    skew: dict[str, float] = {}
+    now = time.time()
+
+    async def run(name, coro):
+        try:
+            return await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            errors[name] = str(e) or type(e).__name__
+            return None
+
+    if sources.get("events"):
+        try:
+            await _collect_events(sources["events"], evidence, errors,
+                                  skew, max_pages)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            errors["events"] = str(e) or type(e).__name__
+
+    if sources.get("spans"):
+        out = await run("spans", sources["spans"]())
+        if out:
+            for k, v in (out.get("errors") or {}).items():
+                errors["spans:%s" % k] = str(v)
+            for k, v in (out.get("skew") or {}).items():
+                skew.setdefault(str(k), v)
+            for s in out.get("spans") or []:
+                if isinstance(s, dict):
+                    ent = dict(s)
+                    ent["kind"] = "span"
+                    evidence.append(ent)
+
+    if sources.get("alerts"):
+        body = await run("alerts", sources["alerts"]())
+        if isinstance(body, dict):
+            for a in body.get("alerts") or []:
+                if not isinstance(a, dict):
+                    continue
+                ent = dict(a)
+                ent["kind"] = "alert"
+                ent.setdefault("ts", a.get("since"))
+                ent.setdefault("peer", "prober")
+                ent.setdefault(
+                    "event", "slo.alert.active")
+                evidence.append(ent)
+
+    if sources.get("history"):
+        body = await run("history", sources["history"]())
+        if isinstance(body, dict):
+            # one body, or a per-peer mapping of bodies
+            bodies = ([body] if "records" in body
+                      else [b for b in body.values()
+                            if isinstance(b, dict)])
+            for b in bodies:
+                for r in b.get("records") or []:
+                    if isinstance(r, dict):
+                        ent = dict(r)
+                        ent["kind"] = "history"
+                        ent.setdefault("peer", b.get("peer"))
+                        evidence.append(ent)
+
+    if sources.get("doctor"):
+        findings = await run("doctor", sources["doctor"]())
+        for f in findings or []:
+            if isinstance(f, dict):
+                ent = dict(f)
+                ent["kind"] = "doctor"
+                # findings carry no timestamp of their own: they are
+                # observations made NOW about durable state
+                ent.setdefault("ts", round(now, 3))
+                ent.setdefault("peer", f.get("target"))
+                evidence.append(ent)
+
+    crashes, crash_errors = await asyncio.to_thread(
+        read_crash_fingerprints, crash_dir)
+    evidence.extend(crashes)
+    errors.update(crash_errors)
+
+    return {"evidence": evidence, "errors": errors, "skew": skew,
+            "collected_ts": round(now, 3)}
+
+
+# ---- timeline ----
+
+def build_timeline(evidence: list[dict]) -> list[dict]:
+    """The single fleet timeline: every kind-tagged evidence record in
+    HLC order (wall-clock fallback for unstamped records), cause
+    before effect at any skew."""
+    return sorted((e for e in evidence if isinstance(e, dict)),
+                  key=hlc_sort_key)
+
+
+def _in_window(ent: dict, window) -> bool:
+    if window is None:
+        return True
+    a, b = window
+    try:
+        ts = float(ent.get("ts") or 0.0)
+    except (TypeError, ValueError):
+        return False
+    return (a is None or ts >= a) and (b is None or ts <= b)
+
+
+# ---- analysis ----
+
+# root-cause classes by evidence tier: ground truth (the thing that
+# was actually done to the fleet) beats mechanism (how the damage
+# propagated), and within a tier the cause NEAREST before the symptom
+# wins.
+def _classify_cause(ent: dict) -> tuple[int, str] | None:
+    kind = ent.get("kind")
+    event = str(ent.get("event") or "")
+    if kind == "crash":
+        return 0, "crash-at-seam"
+    if event == "fault.injected":
+        return 0, "injected-fault"
+    if event == "obs.loop.stall":
+        return 1, "loop-stall"
+    if kind == "doctor" and str(ent.get("level")) == "damage":
+        return 1, "store-damage"
+    if event == "coord.session.expired":
+        return 2, "session-expiry"
+    if kind == "span" and ent.get("name") == "retry.backoff" \
+            and "coord" in str(ent.get("op") or ""):
+        return 2, "partition-backoff"
+    return None
+
+
+def _is_symptom(ent: dict) -> bool:
+    """Client-visible symptoms only: a fired burn-rate alert, an
+    active alert, or a measured write-outage window — the things a
+    USER of the shard felt, not control-plane internals."""
+    if ent.get("kind") == "alert":
+        return True
+    return str(ent.get("event") or "") in ("slo.alert.fired",
+                                           "prober.error_window")
+
+
+def _cause_summary(ent: dict, cls: str) -> dict:
+    out = {
+        "class": cls,
+        "peer": ent.get("peer"),
+        "ts": ent.get("ts"),
+        "hlc": ent.get("hlc"),
+        "evidence": ent,
+    }
+    if cls in ("crash-at-seam", "injected-fault"):
+        # the closed loop: name the actually-injected failpoint
+        out["point"] = ent.get("point")
+        out["action"] = ent.get("action")
+        if cls == "crash-at-seam":
+            out["action"] = "crash"
+            out["variant"] = ent.get("variant")
+            out["status"] = ent.get("status")
+    elif cls == "loop-stall":
+        out["detail"] = "event loop stalled %.3fs" % float(
+            ent.get("seconds") or ent.get("stall_s") or 0.0) \
+            if (ent.get("seconds") or ent.get("stall_s")) \
+            else "event loop stall"
+    elif cls == "store-damage":
+        out["detail"] = "%s: %s" % (ent.get("check"),
+                                    ent.get("detail"))
+    elif cls == "session-expiry":
+        out["detail"] = "coordination session expired (%s)" \
+            % (ent.get("session") or "?")
+    elif cls == "partition-backoff":
+        out["detail"] = ("reconnect backoff op=%s attempt=%s — the "
+                         "partition-era signature"
+                         % (ent.get("op"), ent.get("attempt")))
+    return out
+
+
+def _failover_analysis(timeline: list[dict], upto: int) -> dict | None:
+    """The failover root span's critical path, when a failover is in
+    evidence at or before the symptom: find the freshest
+    failover.complete/.detected event, gather that trace's spans, and
+    reuse the `manatee-adm trace` machinery."""
+    tid = None
+    for ent in reversed(timeline[:upto + 1]):
+        if str(ent.get("event") or "") in ("failover.complete",
+                                           "failover.detected") \
+                and ent.get("trace"):
+            tid = ent["trace"]
+            break
+    if tid is None:
+        return None
+    spans = [e for e in timeline
+             if e.get("kind") == "span" and e.get("trace") == tid]
+    if not spans:
+        return {"trace": tid, "critical_path": None}
+    roots, children, orphans = assemble_tree(spans)
+    orphan_ids = {o["span"] for o in orphans}
+    genuine = [r for r in roots if r["span"] not in orphan_ids]
+    pool = genuine or roots
+    main = max(pool, key=lambda r: float(r.get("dur") or 0.0)) \
+        if pool else None
+    return {"trace": tid,
+            "root": main.get("name") if main else None,
+            "critical_path": (critical_path(main, children)
+                              if main else None)}
+
+
+def analyze(timeline: list[dict], *, mode: str = "last-alert",
+            trace: str | None = None,
+            window: tuple[float | None, float | None] | None = None,
+            skew: dict | None = None,
+            errors: dict | None = None) -> dict:
+    """The reconstruction: pick the symptom the *mode* asks about,
+    walk the HLC-ordered *timeline* backward to the initiating
+    evidence, and return the report dict (render_report's input, and
+    `manatee-adm incident -j`'s output).
+
+    Modes: ``last-alert`` (freshest client-visible symptom),
+    ``around`` (everything sharing *trace*), ``window`` (symptoms
+    inside ``[a, b]``).  A timeline with no symptom yields verdict
+    ``quiet`` with NO root cause — a quiet soak must not attribute."""
+    if mode == "around" and not trace:
+        raise IncidentError("mode 'around' requires a trace id")
+    scoped = [e for e in timeline if _in_window(e, window)]
+    if mode == "around":
+        in_trace = [e for e in scoped if e.get("trace") == trace]
+        # the symptom is the trace's last consequence; the
+        # investigation window is everything up to then
+        symptom = in_trace[-1] if in_trace else None
+    else:
+        symptom = None
+        for ent in reversed(scoped):
+            if _is_symptom(ent):
+                symptom = ent
+                break
+
+    skew = dict(skew or {})
+    skew_warnings = sorted(
+        p for p, off in skew.items()
+        if abs(off) > MERGE_SKEW_BOUND_S)
+    base = {
+        "mode": mode,
+        "trace": trace,
+        "window": list(window) if window else None,
+        "skew": skew,
+        "skew_warnings": skew_warnings,
+        "errors": dict(errors or {}),
+        "counts": {k: sum(1 for e in timeline if e.get("kind") == k)
+                   for k in EVIDENCE_KINDS},
+    }
+    if symptom is None:
+        base.update(verdict="quiet", symptom=None, root_cause=None,
+                    chain=[], failover=None)
+        return base
+
+    sym_idx = next(i for i, e in enumerate(scoped) if e is symptom)
+    best: tuple[int, int] | None = None     # (tier, index); latest
+    best_cls = None
+    for i in range(sym_idx, -1, -1):
+        got = _classify_cause(scoped[i])
+        if got is None:
+            continue
+        tier, cls = got
+        if best is None or tier < best[0]:
+            best = (tier, i)
+            best_cls = cls
+            if tier == 0:
+                break                       # ground truth: done
+    root_cause = (_cause_summary(scoped[best[1]], best_cls)
+                  if best is not None else None)
+
+    lo = best[1] if best is not None else 0
+    involved = {t for t in (symptom.get("trace"),
+                            (scoped[lo].get("trace")
+                             if best is not None else None))
+                if t}
+    chain = []
+    for ent in scoped[lo:sym_idx + 1]:
+        if ent.get("kind") in ("crash", "alert") \
+                or str(ent.get("event") or "") in _CHAIN_EVENTS \
+                or (ent.get("trace") and ent["trace"] in involved):
+            chain.append(ent)
+    if len(chain) > _MAX_CHAIN:
+        chain = chain[:1] + chain[-(_MAX_CHAIN - 1):]
+
+    base.update(
+        verdict="incident" if root_cause else "symptom-unattributed",
+        symptom=symptom,
+        root_cause=root_cause,
+        chain=chain,
+        failover=_failover_analysis(scoped, sym_idx),
+    )
+    return base
+
+
+# ---- rendering / persistence ----
+
+def _ent_line(ent: dict) -> str:
+    kind = ent.get("kind") or "?"
+    what = (ent.get("event") or ent.get("name")
+            or ent.get("check") or ent.get("point") or "?")
+    extra = ""
+    if kind == "crash":
+        what = "crash@%s" % ent.get("point")
+        extra = " status=%s" % ent.get("status")
+    elif kind == "alert":
+        extra = " %s/%s" % (ent.get("slo"), ent.get("severity"))
+    elif ent.get("event") == "fault.injected":
+        what = "fault.injected %s=%s" % (ent.get("point"),
+                                         ent.get("action"))
+    elif kind == "span":
+        extra = " %.3fs" % float(ent.get("dur") or 0.0)
+    return "%-24s %-21s %-7s %s%s" % (
+        ent.get("time") or ent.get("ts") or "?",
+        ent.get("peer") or "-", kind, what, extra)
+
+
+def render_report(report: dict) -> list[str]:
+    """The human postmortem, one line per list element (the CLI's
+    non-JSON output)."""
+    lines = ["INCIDENT REPORT (mode=%s)" % report.get("mode"),
+             "verdict: %s" % report.get("verdict")]
+    sym = report.get("symptom")
+    if sym is None:
+        lines.append("no client-visible symptom in the collected "
+                     "window: nothing to attribute")
+    else:
+        lines.append("symptom:")
+        lines.append("  " + _ent_line(sym))
+    rc = report.get("root_cause")
+    if rc is not None:
+        head = "root cause: %s" % rc["class"]
+        if rc.get("point"):
+            head += " at failpoint %s" % rc["point"]
+            if rc.get("action"):
+                head += " (action=%s)" % rc["action"]
+        if rc.get("peer"):
+            head += " on %s" % rc["peer"]
+        lines.append(head)
+        if rc.get("detail"):
+            lines.append("  %s" % rc["detail"])
+        lines.append("  evidence: " + _ent_line(rc["evidence"]))
+    elif sym is not None:
+        lines.append("root cause: NOT FOUND (no initiating evidence "
+                     "survives in the collected rings)")
+    chain = report.get("chain") or []
+    if chain:
+        lines.append("")
+        lines.append("causal chain (%d entries, HLC order):"
+                     % len(chain))
+        for ent in chain:
+            lines.append("  " + _ent_line(ent))
+    fo = report.get("failover")
+    if fo and fo.get("critical_path"):
+        cp = fo["critical_path"]
+        lines.append("")
+        lines.append("failover %s critical path (%.3fs total):"
+                     % (fo["trace"], cp["total_s"]))
+        for st in cp["stages"]:
+            lines.append("  %+8.3fs %8.3fs %5.1f%%  %-24s %s"
+                         % (st["start_s"], st["self_s"], st["pct"],
+                            st["name"], st.get("peer") or "-"))
+    skew = report.get("skew") or {}
+    if skew:
+        lines.append("")
+        lines.append("clock skew (remote minus local): "
+                     + "  ".join("%s %+0.3fs" % (p, skew[p])
+                                 for p in sorted(skew)))
+    for p in report.get("skew_warnings") or []:
+        lines.append("WARNING: measured skew on %s exceeds the "
+                     "journal-merge safety bound (%.1fs): pre-HLC "
+                     "peers' records may misorder" %
+                     (p, MERGE_SKEW_BOUND_S))
+    errors = report.get("errors") or {}
+    for k in sorted(errors):
+        lines.append("warning: evidence from %s unavailable: %s"
+                     % (k, errors[k]))
+    return lines
+
+
+def write_report_file(path: str, report: dict) -> None:
+    """Atomic report persistence: tmp + fsync + rename, so a collector
+    (or the process around it) dying mid-write leaves either the
+    previous report or none — never a torn artifact the crash sweep
+    could mistake for a finding."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -13,6 +13,7 @@ Event shape::
     {"seq":   int,     # per-process, monotonically increasing
      "ts":    float,   # epoch seconds (wall clock, for cross-peer merge)
      "time":  str,     # ISO-8601 ms UTC of ts
+     "hlc":   str,     # hybrid-logical-clock stamp (obs/causal.py)
      "peer":  str,     # this peer's id (set_peer at daemon startup)
      "event": str,     # dotted name, e.g. "transition.committed"
      "trace": str|None,# trace id (bound or explicit)
@@ -24,11 +25,13 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from manatee_tpu.obs.causal import hlc_now
 from manatee_tpu.obs.trace import current_trace
 
 DEFAULT_CAPACITY = 2048
 
-_RESERVED = frozenset(("seq", "ts", "time", "peer", "event", "trace"))
+_RESERVED = frozenset(("seq", "ts", "time", "hlc", "peer", "event",
+                       "trace"))
 
 
 def _iso_ms(ts: float) -> str:
@@ -58,6 +61,7 @@ class EventJournal:
             "seq": self._seq,
             "ts": ts,
             "time": _iso_ms(ts),
+            "hlc": hlc_now(),
             "peer": self.peer,
             "event": event,
             "trace": trace_id if trace_id is not None else current_trace(),

@@ -34,6 +34,7 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from manatee_tpu.obs.causal import hlc_now
 from manatee_tpu.obs.journal import get_journal
 from manatee_tpu.obs.metrics import get_registry
 
@@ -322,6 +323,7 @@ def alerts_http_reply(engine: SLOEngine | None, _query
     alerts = engine.evaluate()
     return {
         "now": round(time.time(), 3),
+        "hlc": hlc_now(),
         "alerts": [a.to_dict() for a in alerts],
         "slos": engine.status(),
         "configs": [c.to_dict()

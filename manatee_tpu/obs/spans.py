@@ -48,6 +48,7 @@ import time
 import uuid
 from collections import deque
 
+from manatee_tpu.obs.causal import hlc_now
 from manatee_tpu.obs.journal import _iso_ms
 from manatee_tpu.obs.trace import bind_trace, current_trace
 
@@ -55,7 +56,7 @@ DEFAULT_CAPACITY = 4096
 
 # span record keys detail attrs may not shadow
 _RESERVED = frozenset(("seq", "span", "parent", "trace", "name", "peer",
-                       "ts", "time", "dur", "status"))
+                       "ts", "time", "hlc", "dur", "status"))
 
 _current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "manatee_span_id", default=None)
@@ -181,6 +182,9 @@ class SpanStore:
             "peer": self.peer,
             "ts": ts,
             "time": _iso_ms(ts),
+            # stamped at COMMIT (span end): a span's completion is the
+            # causal moment its record announces
+            "hlc": hlc_now(),
             "dur": round(dur, 6),
             "status": status,
         }
@@ -302,6 +306,7 @@ def spans_payload(store: SpanStore, *, since: int = 0,
     return {
         "peer": store.peer,
         "now": round(time.time(), 3),
+        "hlc": hlc_now(),
         "open": store.open_spans(),
         "spans": store.spans(since=since, limit=limit, trace=trace),
     }

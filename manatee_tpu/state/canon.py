@@ -27,7 +27,7 @@ import json
 
 # obs metadata embedded in durable states by _write_state: unique per
 # write, semantically irrelevant
-OBS_KEYS = frozenset(("trace", "span"))
+OBS_KEYS = frozenset(("trace", "span", "hlc"))
 
 
 def sem_state(state):

@@ -62,6 +62,8 @@ from manatee_tpu.obs import (
     get_journal,
     get_registry,
     get_span_store,
+    hlc_now,
+    merge_remote,
     new_trace_id,
     span,
 )
@@ -347,6 +349,11 @@ class PeerStateMachine:
         # events/spans) on EVERY peer then correlates with — and nests
         # under — the initiating write.  New transitions we decide
         # below mint their own fresh ids in _write_state.
+        # fold the writer's HLC stamp before reacting: every record the
+        # reaction produces then causally follows the state write, even
+        # when our wall clock lags the writer's (degrades to wall-clock
+        # ordering on merge failure, never blocks the evaluation)
+        await merge_remote(st.get("hlc"))
         with bind_trace(st.get("trace")), bind_parent(st.get("span")):
             fresh = (st.get("span") is not None
                      and st.get("span") != self._reacted_span)
@@ -733,6 +740,11 @@ class PeerStateMachine:
                 # the embedded span id is what makes a transition's
                 # effects on OTHER peers children of this write
                 state["span"] = tsp.span_id
+                # the written state object is an HLC piggyback
+                # boundary: peers reacting to the watch merge this
+                # stamp, so their reaction records sort after the
+                # write at any wall-clock skew
+                state["hlc"] = hlc_now()
                 journal.record("transition.begin", why=why,
                                generation=state.get("generation"))
                 try:

@@ -191,7 +191,7 @@ def _lsn_str(v: int) -> str:
 
 
 _STATE_KEYS = {"generation", "initWal", "primary", "sync", "async",
-               "deposed", "freeze", "promote", "trace", "span"}
+               "deposed", "freeze", "promote", "trace", "span", "hlc"}
 _PROMOTE_KEYS = {"id", "role", "asyncIndex", "generation", "expireTime"}
 
 

@@ -160,24 +160,29 @@ def kill_fleet_sitter(proc: subprocess.Popen) -> None:
         pass
 
 
-def spawn_prober(cfg: dict, root) -> subprocess.Popen:
+def spawn_prober(cfg: dict, root, crash_dir=None) -> subprocess.Popen:
     """Spawn ``manatee-prober`` as a child process: write *cfg* to
     ``root/prober.json``, append its output to ``root/prober.log``,
     start it in its own process group (tear down with
     :func:`kill_fleet_sitter` — same group semantics).  A ``shards``
     list in *cfg* selects fleet mode; ``-f`` accepts both shapes.
+    *crash_dir* opts the prober into the fleet-wide crash-fingerprint
+    directory (pass ``cluster.crash_dir`` for forensics drills).
     Shared by tests and bench.py's slo_probe leg; call via
     ``asyncio.to_thread`` from a coroutine."""
     root = Path(root)
     root.mkdir(parents=True, exist_ok=True)
     (root / "prober.json").write_text(json.dumps(cfg, indent=2))
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               MANATEE_PG_BIN_DIR=FAKEPG_BIN)
+    if crash_dir:
+        Path(crash_dir).mkdir(parents=True, exist_ok=True)
+        env["MANATEE_CRASH_DIR"] = str(crash_dir)
     with open(root / "prober.log", "ab") as logf:
         return subprocess.Popen(
             [sys.executable, "-m", "manatee_tpu.daemons.prober",
              "-f", str(root / "prober.json")],
-            stdout=logf, stderr=logf,
-            env=dict(os.environ, PYTHONPATH=str(REPO),
-                     MANATEE_PG_BIN_DIR=FAKEPG_BIN),
+            stdout=logf, stderr=logf, env=env,
             start_new_session=True)
 
 
@@ -265,7 +270,12 @@ class Peer:
 
     def _spawn(self, module: str, cfg: str, logname: str,
                extra_env: dict | None = None) -> subprocess.Popen:
-        env = dict(os.environ, PYTHONPATH=str(REPO))
+        # every daemon drops crash fingerprints into the cluster-wide
+        # crash dir, so `manatee-adm incident` can name the seam a
+        # crashed process died at (its journal died with it)
+        self.cluster.crash_dir.mkdir(parents=True, exist_ok=True)
+        env = dict(os.environ, PYTHONPATH=str(REPO),
+                   MANATEE_CRASH_DIR=str(self.cluster.crash_dir))
         if extra_env:
             env.update(extra_env)
         logf = open(self.root / logname, "ab")
@@ -442,6 +452,9 @@ class ClusterHarness:
             for i in range(n_coord)]
         self.coord_port = self.coord_ports[0]
         self.coord_procs: list[subprocess.Popen | None] = [None] * n_coord
+        # one fleet-wide crash-fingerprint directory (MANATEE_CRASH_DIR
+        # for every spawned daemon; `manatee-adm incident --crash-dir`)
+        self.crash_dir = self.root / "crashes"
         self.peers = [Peer(self, i + 1) for i in range(n_peers)]
 
     @property
@@ -460,11 +473,13 @@ class ClusterHarness:
 
     def start_coordd(self, idx: int | None = None, *,
                      faults=()) -> None:
+        self.crash_dir.mkdir(parents=True, exist_ok=True)
         env = dict(os.environ, PYTHONPATH=str(REPO),
                    # runtime /faults arming on the metrics listener is
                    # opt-in; the fixture opts in like the peers'
                    # faultsEnabled config key does
-                   MANATEE_FAULTS_ENABLED="1")
+                   MANATEE_FAULTS_ENABLED="1",
+                   MANATEE_CRASH_DIR=str(self.crash_dir))
         if faults:
             env["MANATEE_FAULTS"] = ";".join(faults)
         which = range(self.n_coord) if idx is None else [idx]
@@ -619,7 +634,15 @@ class ClusterHarness:
                 (["events", "-j"], "shard-events.jsonl"),
                 (["trace", "--last-failover"], "failover-trace.txt"),
                 (["trace", "--last-failover", "-j"],
-                 "failover-trace.json")):
+                 "failover-trace.json"),
+                # the automated postmortem: symptom -> root cause over
+                # the HLC-ordered fleet timeline, crash breadcrumbs in
+                (["incident", "--last-alert",
+                  "--crash-dir", str(self.crash_dir)],
+                 "incident-report.txt"),
+                (["incident", "--last-alert", "-j",
+                  "--crash-dir", str(self.crash_dir)],
+                 "incident-report.json")):
             try:
                 cp = await asyncio.to_thread(
                     subprocess.run,

@@ -116,6 +116,21 @@ REPO = Path(__file__).resolve().parent.parent
 #                 armed seam within a few passes, and a clean rerun
 #                 proves the plane works end to end (folded /profile
 #                 body, observed loop-lag ticks)
+#   hlc_subproc   a child process folds an inbound HLC stamp (the
+#                 merge every piggyback boundary performs), crashing
+#                 AT the merge seam; a middle run with ``=error``
+#                 armed proves the degradation contract — the merge
+#                 degrades to wall-clock ordering and the carrying
+#                 call COMPLETES — and a clean rerun merges normally
+#   incident_subproc
+#                 a child process runs the incident evidence
+#                 collector and crashes AT the collect seam (before
+#                 the fan-out), leaving its crash fingerprint in
+#                 MANATEE_CRASH_DIR; the parent asserts NO partial
+#                 report artifact exists (no report, no ``*.tmp.*``
+#                 debris), and a clean rerun writes the full report —
+#                 whose root cause names the faulted seam from the
+#                 fingerprint the crashed run left behind
 #
 # variant: "exit" (default, os._exit → CRASH_EXIT_CODE) or "kill"
 # (SIGKILL-to-self → waitpid -SIGKILL); both variants are exercised.
@@ -132,11 +147,14 @@ SCENARIOS: dict[str, dict] = {
     # the rejoining async's mux demuxes the state-watch push fired by
     # the primary's topology write that adds it — the demux pump dies
     # exactly at the fan-back-out seam
+    "coord.hlc.merge":      dict(kind="hlc_subproc"),
     "coord.mux.demux":      dict(kind="boot_async"),
     "coord.put_state":      dict(kind="primary_write", variant="kill"),
     "coordd.dispatch":      dict(kind="coordd", variant="kill"),
     "coordd.oplog.append":  dict(kind="coordd", induce="freeze"),
     "obs.history.append":   dict(kind="history_subproc"),
+    "obs.incident.collect": dict(kind="incident_subproc",
+                                 variant="kill"),
     "obs.loop.tick":        dict(kind="profile_subproc"),
     "obs.profile.sample":   dict(kind="profile_subproc",
                                  variant="kill"),
@@ -168,7 +186,8 @@ FAST_POINTS = {"backup.post", "coord.client.send",
                "backup.send.stream", "coordd.dispatch",
                "pg.promote", "storage.zfs.exec",
                "obs.history.append", "obs.loop.tick",
-               "prober.write"}
+               "prober.write", "coord.hlc.merge",
+               "obs.incident.collect"}
 
 
 def test_sweep_covers_every_failpoint():
@@ -503,6 +522,116 @@ def _run_profile_subproc_scenario(tmp_path, point: str, scn: dict
     assert "profile-ok" in cp.stdout
 
 
+def _run_hlc_subproc_scenario(tmp_path, point: str, scn: dict) -> None:
+    """Crash the inbound HLC-stamp merge at its seam, then prove the
+    degradation contract the catalog promises: an ``error`` armed at
+    the same seam degrades that merge to wall-clock ordering and the
+    carrying call COMPLETES (the stamp is advisory — it must never
+    fail the RPC/frame that piggybacked it)."""
+    script = (
+        "import asyncio\n"
+        "from manatee_tpu.obs.causal import _MERGES, encode, "
+        "merge_remote\n"
+        "async def main():\n"
+        "    out = await merge_remote(encode(1, 1))\n"
+        "    outcome = 'ok' if out is not None else (\n"
+        "        'degraded' if _MERGES.value(outcome='degraded')\n"
+        "        else 'none')\n"
+        "    print('hlc-ok outcome=%s' % outcome)\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"}
+    argv = [sys.executable, "-c", script]
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60,
+                        env={**env,
+                             "MANATEE_FAULTS": spec_for(point, variant)})
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "hlc-ok" not in cp.stdout
+    # the degradation contract: error at the seam, the call completes
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60,
+                        env={**env, "MANATEE_FAULTS": point + "=error"})
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "hlc-ok outcome=degraded" in cp.stdout, cp.stdout
+    # recovery: nothing armed, the merge folds normally
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60, env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "hlc-ok outcome=ok" in cp.stdout, cp.stdout
+
+
+def _run_incident_subproc_scenario(tmp_path, point: str, scn: dict
+                                   ) -> None:
+    """Crash the incident collector at its seam (before the fan-out).
+    The acceptance contract: NO partial report artifact may exist
+    after the crash — reports land via tmp+fsync+rename only — and a
+    clean rerun writes the full report, whose root cause names the
+    faulted seam from the fingerprint the crashed run left in
+    MANATEE_CRASH_DIR."""
+    crash_dir = tmp_path / "crashes"
+    crash_dir.mkdir()
+    report = tmp_path / "incident-report.json"
+    script = (
+        "import asyncio, os, sys, time\n"
+        "from manatee_tpu.obs.incident import (\n"
+        "    analyze, build_timeline, collect_evidence,\n"
+        "    write_report_file)\n"
+        "async def events(since):\n"
+        "    if since:\n"
+        "        return {'events': []}\n"
+        "    # the symptom sits in the FUTURE of any crash the\n"
+        "    # previous run's fingerprint recorded, so the analyzer's\n"
+        "    # backward walk can reach it\n"
+        "    return {'events': [\n"
+        "        {'ts': time.time() + 60.0, 'peer': 'p1', 'seq': 1,\n"
+        "         'event': 'slo.alert.fired',\n"
+        "         'slo': 'write_availability', 'severity': 'page'}]}\n"
+        "async def main():\n"
+        "    out = await collect_evidence(\n"
+        "        {'events': events},\n"
+        "        crash_dir=os.environ.get('MANATEE_CRASH_DIR'))\n"
+        "    rep = analyze(build_timeline(out['evidence']),\n"
+        "                  errors=out['errors'])\n"
+        "    write_report_file(sys.argv[1], rep)\n"
+        "    print('incident-ok verdict=%s' % rep['verdict'])\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_CRASH_DIR": str(crash_dir)}
+    argv = [sys.executable, "-c", script, str(report)]
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60,
+                        env={**env,
+                             "MANATEE_FAULTS": spec_for(point, variant)})
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+    assert "incident-ok" not in cp.stdout
+    # NO partial report artifact: neither the report nor tmp debris
+    leftovers = [p.name for p in tmp_path.iterdir()
+                 if p.name != "crashes"]
+    assert leftovers == [], \
+        "collector crash left report debris: %r" % leftovers
+    # ...but the dying process did leave its fingerprint
+    fps = sorted(crash_dir.glob("crash-*.json"))
+    assert fps, "no crash fingerprint written"
+    fp = json.loads(fps[0].read_text())
+    assert fp["point"] == point and fp["variant"] == variant
+    assert fp["status"] == crash_status(variant)
+    # recovery: a clean rerun collects (fingerprint included), writes
+    # the full report atomically, and the analyzer closes the loop by
+    # naming the seam the previous run crashed at
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        timeout=60, env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "incident-ok verdict=incident" in cp.stdout, cp.stdout
+    body = json.loads(report.read_text())
+    assert body["root_cause"]["class"] == "crash-at-seam"
+    assert body["root_cause"]["point"] == point
+    assert not list(tmp_path.glob("*.tmp.*"))
+
+
 @pytest.mark.parametrize(
     "point",
     [pytest.param(p,
@@ -517,6 +646,12 @@ def test_crash_at_seam(tmp_path, point):
 
     if scn["kind"] == "zfs_subproc":
         _run_zfs_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "hlc_subproc":
+        _run_hlc_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "incident_subproc":
+        _run_incident_subproc_scenario(tmp_path, point, scn)
         return
     if scn["kind"] == "history_subproc":
         _run_history_subproc_scenario(tmp_path, point, scn)

@@ -1,0 +1,359 @@
+"""Closed-loop incident forensics drill (env-gated: MANATEE_CHAOS=1).
+
+The unit tier (tests/test_incident.py) proves the HLC laws, the
+collector's degradation contract and the analyzer's verdicts over
+synthetic timelines; this tier closes the loop against a REAL fleet:
+fault injection is ground truth, and for every drilled fault class
+`manatee-adm incident` must name the actually-injected failpoint as
+root cause — the same two-sided contract PR 17 built between the lint
+and the stall watchdog, now between the fault plane and the analyzer.
+
+One cluster, five acts:
+
+  * **quiet soak** — a healthy fleet analyzed over the soak window
+    yields verdict ``quiet``: no symptom, NO root cause, nothing
+    fabricated (a forensics plane that invents incidents is worse
+    than none);
+  * **partition** — an asymmetric coordination partition of the
+    primary (``coord.client.connect/send=drop``) is client-seamless,
+    so there is no alert to walk back from; ``--around`` the failover
+    trace instead, and the report must name the partition failpoint;
+  * **write outage** — the documented ``prober.write`` failpoint fires
+    a real page alert; ``--last-alert`` must walk the timeline back
+    to ``prober.write``, not to the (older) partition evidence;
+  * **crash-at-seam** — the new primary's sitter crashes at
+    ``coord.client.send``; its in-memory journal dies with it, so the
+    crash FINGERPRINT (faults._write_crash_fingerprint, collected via
+    the cluster-wide MANATEE_CRASH_DIR) is the only surviving
+    evidence, and ``--around`` the resulting failover must name it;
+  * **coordd disk error** — ``coordd.oplog.append=crash`` kills the
+    coordination service at its durability seam; with the primary
+    also gone no failover can happen, the shard takes a REAL write
+    outage, and after recovery ``--last-alert`` must walk back
+    through the outage to coordd's crash fingerprint — evidence from
+    a process that is not a shard peer at all, which is exactly what
+    the fleet-wide timeline is for.
+
+Runs in the chaos CI jobs alongside tests/test_chaos.py and
+tests/test_slo_live.py.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from tests.harness import (
+    ClusterHarness,
+    alloc_port_block,
+    kill_fleet_sitter,
+    run_cli,
+    spawn_prober,
+)
+from tests.test_partition import http_get
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("MANATEE_CHAOS"),
+    reason="live incident forensics drill; opt in with "
+           "MANATEE_CHAOS=1 (make chaos)")
+
+SOAK_S = float(os.environ.get("MANATEE_INCIDENT_SOAK_SECONDS", "6"))
+PROBE_INTERVAL = 0.05
+# >= ~1s of solid write failure trips the stock page rule on both its
+# windows; 3s leaves margin for the 1s eval cadence
+OUTAGE_S = 3.0
+
+
+def _incident(cluster, base, *extra):
+    """Run `manatee-adm incident ... -j` and return (returncode,
+    report-dict) — the drill's one verdict primitive."""
+    cp = run_cli(cluster, "incident", "-j", "-u", base,
+                 "--crash-dir", str(cluster.crash_dir), *extra,
+                 timeout=60)
+    try:
+        report = json.loads(cp.stdout)
+    except ValueError:
+        report = None
+    assert report is not None, (cp.returncode, cp.stdout, cp.stderr)
+    return cp.returncode, report
+
+
+def test_incident_names_every_injected_fault_class(tmp_path):
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3,
+                                 session_timeout=1.0)
+        prober_proc = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-soak", timeout=60)
+
+            port = alloc_port_block(1)
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "name": "1",
+                "shardPath": cluster.shard_path,
+                "statusHost": "127.0.0.1",
+                "statusPort": port,
+                "probeInterval": PROBE_INTERVAL,
+                "faultsEnabled": True,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": 1.0},
+            }, tmp_path / "prober", crash_dir=cluster.crash_dir)
+            base = "http://127.0.0.1:%d" % port
+
+            async def sli_row() -> dict:
+                _s, body = await http_get(base + "/slis")
+                return body["shards"][0]
+
+            async def prober_events(name) -> list[dict]:
+                _s, body = await http_get(base + "/events")
+                return [e for e in body["events"]
+                        if e["event"] == name]
+
+            # warm: steady good writes, no open error window, any
+            # boot-transient alert resolved
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    row = await sli_row()
+                    _s, al = await http_get(base + "/alerts")
+                    if row["writes_ok"] >= 20 \
+                            and not row["error_window_open"] \
+                            and not al["alerts"]:
+                        break
+                except (OSError, KeyError, IndexError, ValueError,
+                        asyncio.TimeoutError):
+                    pass
+                assert time.monotonic() < deadline, \
+                    "prober never reached a quiet warm state"
+                await asyncio.sleep(0.5)
+
+            # ---- act 1: quiet soak — zero misattribution.  The
+            # window bounds the investigation to the soak itself
+            # (boot transients are history, not evidence).
+            t0 = time.time()
+            await asyncio.sleep(SOAK_S)
+            t1 = time.time()
+            rc, report = _incident(cluster, base, "--window",
+                                   "%f" % t0, "%f" % t1)
+            assert rc == 0, report
+            assert report["verdict"] == "quiet", report
+            assert report["root_cause"] is None, report
+            # the fleet DID produce evidence — quiet is a judgement
+            # over a populated timeline, not an empty fetch
+            assert report["counts"]["event"] > 0, report["counts"]
+
+            # ---- act 2: partition.  Client-seamless (no alert), so
+            # the investigation enters through the failover trace.
+            cp = run_cli(cluster, "fault", "set",
+                         "coord.client.connect=drop",
+                         "coord.client.send=drop", "-n", p1.name,
+                         timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            await cluster.wait_topology(primary=p2, timeout=60)
+            await cluster.wait_writable(p2, "post-takeover",
+                                        timeout=60)
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "trace", "--last-failover", "-j")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            tr_partition = json.loads(cp.stdout)["trace"]
+
+            rc, report = _incident(cluster, base,
+                                   "--around", tr_partition)
+            assert rc == 0, report
+            assert report["verdict"] == "incident", report
+            assert report["root_cause"]["class"] == "injected-fault", \
+                report["root_cause"]
+            assert report["root_cause"]["point"] in \
+                ("coord.client.connect", "coord.client.send"), \
+                report["root_cause"]
+            # the failover critical path came along for the ride
+            assert report["failover"] \
+                and report["failover"]["trace"] == tr_partition
+
+            # un-partition p1 and run the real operator flow for a
+            # deposed returner; it rejoins as an async
+            cp = run_cli(cluster, "fault", "clear", "--url",
+                         "http://127.0.0.1:%d" % p1.status_port,
+                         timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            cp = run_cli(cluster, "rebuild", "-y", "-c",
+                         str(p1.root / "sitter.json"),
+                         "--timeout", "90", timeout=150)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            await cluster.wait_topology(primary=p2, sync=p3,
+                                        asyncs=[p1], timeout=60)
+
+            # ---- act 3: write outage.  A real page alert; the walk
+            # back must stop at prober.write, NOT at the older (but
+            # equally tier-0) partition evidence.
+            cp = run_cli(cluster, "fault", "set", "prober.write=error",
+                         "--url", base, timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            await asyncio.sleep(OUTAGE_S)
+            cp = run_cli(cluster, "fault", "clear", "prober.write",
+                         "--url", base, timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            deadline = time.monotonic() + 30
+            while not [e for e in await prober_events(
+                    "slo.alert.fired") if e["severity"] == "page"]:
+                assert time.monotonic() < deadline, \
+                    "write outage fired no page alert"
+                await asyncio.sleep(0.2)
+
+            rc, report = _incident(cluster, base, "--last-alert")
+            assert rc == 0, report
+            assert report["verdict"] == "incident", report
+            assert report["root_cause"]["class"] == "injected-fault", \
+                report["root_cause"]
+            assert report["root_cause"]["point"] == "prober.write", \
+                report["root_cause"]
+
+            # let the page resolve before the next act
+            deadline = time.monotonic() + 30
+            while True:
+                _s, al = await http_get(base + "/alerts")
+                if not any(a["severity"] == "page"
+                           for a in al["alerts"]):
+                    break
+                assert time.monotonic() < deadline, al["alerts"]
+                await asyncio.sleep(0.5)
+
+            # ---- act 4: crash-at-seam.  The primary's sitter dies
+            # at coord.client.send; its journal dies with it, so the
+            # crash fingerprint must carry the attribution.
+            fp0 = {f.name for f in cluster.crash_dir.glob("*.json")}
+            cp = run_cli(cluster, "fault", "set",
+                         "coord.client.send=crash", "-n", p2.name,
+                         timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            # the next heartbeat hits the seam
+            assert p2.sitter_proc is not None
+            status = await asyncio.to_thread(p2.sitter_proc.wait, 60)
+            assert status == 86, \
+                "sitter did not die at the seam (status %r)" % status
+            new_fp = [f for f in cluster.crash_dir.glob("*.json")
+                      if f.name not in fp0]
+            assert new_fp, "crash left no fingerprint"
+            assert any(json.loads(f.read_text())["point"]
+                       == "coord.client.send" for f in new_fp)
+
+            await cluster.wait_topology(primary=p3, sync=p1,
+                                        timeout=60)
+            await cluster.wait_writable(p3, "post-crash", timeout=60)
+            deadline = time.monotonic() + 60
+            while True:
+                cp = await asyncio.to_thread(
+                    run_cli, cluster, "trace", "--last-failover",
+                    "-j")
+                if cp.returncode == 0:
+                    tr_crash = json.loads(cp.stdout)["trace"]
+                    if tr_crash != tr_partition:
+                        break
+                assert time.monotonic() < deadline, \
+                    (cp.stdout, cp.stderr)
+                await asyncio.sleep(0.5)
+
+            rc, report = _incident(cluster, base,
+                                   "--around", tr_crash)
+            assert rc == 0, report
+            assert report["verdict"] == "incident", report
+            assert report["root_cause"]["class"] == "crash-at-seam", \
+                report["root_cause"]
+            assert report["root_cause"]["point"] == \
+                "coord.client.send", report["root_cause"]
+
+            # bring p2 back (clean respawn: the runtime-armed fault
+            # died with the process) and rebuild the deposed returner
+            await cluster.restart_peer(p2)
+            cp = run_cli(cluster, "rebuild", "-y", "-c",
+                         str(p2.root / "sitter.json"),
+                         "--timeout", "90", timeout=150)
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            await cluster.wait_topology(primary=p3, sync=p1,
+                                        asyncs=[p2], timeout=60)
+
+            # ---- act 5: coordd disk error.  Crash the coordination
+            # service at its durability seam, then kill the primary:
+            # with no coordination there is no failover, so the shard
+            # takes a REAL client-visible outage whose initiating
+            # evidence lives outside every sitter ring.
+            fp0 = {f.name for f in cluster.crash_dir.glob("*.json")}
+            coord_url = cluster.coord_metrics_url(0)
+            cp = run_cli(cluster, "fault", "set",
+                         "coordd.oplog.append=crash",
+                         "--url", coord_url, timeout=30)
+            assert cp.returncode == 0, cp.stderr
+            # force a durable mutation through the armed seam (a fresh
+            # CLI session is one); fall back to the expiry mutation the
+            # primary kill triggers below
+            for _ in range(10):
+                if cluster.coord_procs[0].poll() is not None:
+                    break
+                await asyncio.to_thread(
+                    run_cli, cluster, "show", timeout=15)
+                await asyncio.sleep(0.5)
+            t_act5 = time.time()
+            p3.kill()
+            status = await asyncio.to_thread(
+                cluster.coord_procs[0].wait, 60)
+            assert status == 86, \
+                "coordd did not die at the seam (status %r)" % status
+            new_fp = [f for f in cluster.crash_dir.glob("*.json")
+                      if f.name not in fp0]
+            assert any(json.loads(f.read_text())["point"]
+                       == "coordd.oplog.append" for f in new_fp), \
+                "coordd crash left no fingerprint"
+
+            # the outage is real: wait for the page, then recover
+            deadline = time.monotonic() + 60
+            while not [e for e in await prober_events(
+                    "slo.alert.fired")
+                    if e["severity"] == "page"
+                    and e["ts"] > t_act5]:
+                assert time.monotonic() < deadline, \
+                    "coordd+primary loss fired no page alert"
+                await asyncio.sleep(0.2)
+            cluster.coord_procs[0] = None
+            cluster.start_coordd(0)
+            await cluster._wait_port(cluster.coord_port)
+            # p1 was the sync when p3 died — it takes over
+            await cluster.wait_topology(primary=p1, timeout=120)
+            await cluster.wait_writable(p1, "post-recovery",
+                                        timeout=60)
+            # the error window closes AFTER the fingerprint, so
+            # --last-alert's freshest symptom postdates the crash
+            deadline = time.monotonic() + 60
+            while not await prober_events("prober.error_window"):
+                assert time.monotonic() < deadline, \
+                    "error window never closed after recovery"
+                await asyncio.sleep(0.5)
+
+            rc, report = _incident(
+                cluster, base, "--last-alert",
+                "--source", "coordd=" + coord_url)
+            assert rc == 0, report
+            assert report["verdict"] == "incident", report
+            assert report["root_cause"]["class"] == "crash-at-seam", \
+                report["root_cause"]
+            assert report["root_cause"]["point"] == \
+                "coordd.oplog.append", report["root_cause"]
+            # the restarted coordd's journal joined the timeline via
+            # --source (degradation-free collect on this pass)
+            assert "coordd" not in report["errors"], report["errors"]
+
+            print("incident-live: quiet soak clean; partition, "
+                  "write outage, crash-at-seam and coordd disk "
+                  "error all attributed to their injected "
+                  "failpoints (skew peers: %s)"
+                  % ", ".join(sorted(report["skew"])), flush=True)
+        finally:
+            if prober_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+            await cluster.stop()
+
+    asyncio.run(go())

@@ -64,3 +64,16 @@ def test_renders_trace_man_page(tmp_path):
     # stripped
     assert ".nf" in out and "critical path" in out
     assert "`" not in out and "**" not in out
+
+
+def test_renders_incident_man_page(tmp_path):
+    out = render((REPO / "docs" / "man"
+                  / "manatee-adm-incident.md").read_text(), tmp_path)
+    for section in (".SH SYNOPSIS", ".SH DESCRIPTION", ".SH OPTIONS",
+                    ".SH OUTPUT", ".SH ENVIRONMENT", ".SH EXIT STATUS",
+                    ".SH SEE ALSO"):
+        assert section in out, "missing %s" % section
+    # the worked postmortem survives as a literal block, markdown
+    # stripped
+    assert ".nf" in out and "root cause" in out
+    assert "`" not in out and "**" not in out

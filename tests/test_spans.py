@@ -225,6 +225,53 @@ def test_merge_events_breaks_timestamp_ties_deterministically():
     assert [e.get("seq") for e in m3] == [None, 2, 3]
 
 
+def test_merge_events_orders_cause_before_effect_under_hlc_skew():
+    from manatee_tpu.adm import merge_events
+    from manatee_tpu.obs.causal import HybridClock, decode
+
+    class _FixedClock(HybridClock):
+        __slots__ = ("wall_ms",)
+
+        def __init__(self, wall_ms):
+            super().__init__()
+            self.wall_ms = wall_ms
+
+        def _wall_ms(self):
+            return self.wall_ms
+
+    # the writer's wall clock runs 5s AHEAD, the reactor's 5s BEHIND:
+    # the reaction's wall timestamp lands ~10s BEFORE the write it
+    # reacts to — the inversion the HLC exists to fix
+    writer = _FixedClock(1_005_000)    # true time 1000.00, +5s skew
+    reactor = _FixedClock(995_050)     # true time 1000.05, -5s skew
+    cause_stamp = writer.now()
+    effect_stamp = reactor.observe(*decode(cause_stamp))
+    cause = {"ts": 1005.0, "peer": "writer", "seq": 1,
+             "event": "transition.committed", "hlc": cause_stamp}
+    effect = {"ts": 995.05, "peer": "reactor", "seq": 1,
+              "event": "role.change", "hlc": effect_stamp}
+    # wall clocks alone invert the pair...
+    assert sorted([cause, effect], key=lambda e: e["ts"])[0] is effect
+    # ...the HLC merge does not, whichever order the fan-out returned
+    assert merge_events([effect, cause]) == [cause, effect]
+    assert merge_events([cause, effect]) == [cause, effect]
+
+    # mirrored skew (writer 5s behind, reactor 5s ahead) must also hold
+    writer2 = _FixedClock(995_000)
+    reactor2 = _FixedClock(1_005_050)
+    c2 = {"ts": 995.0, "peer": "w", "seq": 1, "hlc": writer2.now()}
+    e2 = {"ts": 1005.05, "peer": "r", "seq": 1,
+          "hlc": reactor2.observe(*decode(c2["hlc"]))}
+    assert merge_events([e2, c2]) == [c2, e2]
+
+    # old-peer interop: a record with NO hlc (pre-HLC peer) slots in at
+    # its wall time among the stamped ones, deterministically
+    old = {"ts": 1000.0, "peer": "old", "seq": 7, "event": "legacy"}
+    m = merge_events([effect, old, cause])
+    assert m == merge_events([cause, effect, old])
+    assert [e.get("peer") for e in m] == ["old", "writer", "reactor"]
+
+
 # ---- units: tree assembly + critical path ----
 
 def _rec(span_id, name, ts, dur, parent=None, peer="p1", **at):
