@@ -108,7 +108,8 @@ FOLDED=stacks.folded [SVG=out.svg]" >&2; exit 2; }
 man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1 \
 		man/man1/manatee-sitter.1 man/man1/manatee-prober.1 \
 		man/man1/manatee-adm-slo.1 man/man1/manatee-adm-profile.1 \
-		man/man1/manatee-adm-tasks.1 man/man1/manatee-adm-incident.1
+		man/man1/manatee-adm-tasks.1 man/man1/manatee-adm-incident.1 \
+		man/man1/manatee-router.1
 man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
@@ -133,6 +134,9 @@ man/man1/manatee-adm-tasks.1: docs/man/manatee-adm-tasks.md tools/md2man
 man/man1/manatee-adm-incident.1: docs/man/manatee-adm-incident.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm-incident.md > $@
+man/man1/manatee-router.1: docs/man/manatee-router.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-router.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

@@ -94,6 +94,20 @@ And the forensics-plane leg:
                               microbenchmarked per-stamp cost) against
                               the <1%-of-a-core budget.
 
+And the serving-plane leg:
+
+  - router_qps:               `manatee-router` fronting a 4-peer sim
+                              shard: read QPS through the router vs
+                              replica-chain length (3/2/1), write p99
+                              via the router vs direct-to-primary on
+                              the identical topology (<20% overhead is
+                              the bar), the client-observed stall of a
+                              primary SIGKILL under routed write
+                              traffic (max inter-ack gap, zero
+                              errors — the park/replay contract), and
+                              steady-state router CPU per client
+                              connection.
+
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
 every perf PR's effect is attributable stage by stage; the breakdown
@@ -142,7 +156,7 @@ ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
                "incremental_rebuild", "control_plane_scale",
                "modelcheck_throughput", "slo_probe",
-               "incident_reconstruction")
+               "incident_reconstruction", "router_qps")
 # total shards in the control_plane_scale leg: one measured 3-peer
 # shard + (N-1) singleton neighbors in ONE fleet sitter process
 SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
@@ -1021,6 +1035,284 @@ async def bench_slo_probe() -> dict:
             await cluster.stop()
 
 
+async def bench_router_qps() -> dict:
+    """The serving plane measured: `manatee-router` fronting a 4-peer
+    sim shard (primary + sync + 2 asyncs), driven by raw line-JSON
+    clients over the same wire the router relays.
+
+    Four numbers come out:
+
+      * read QPS vs replica-chain length — the same client pool runs
+        bounded selects through the router against 3, then 2, then 1
+        read-eligible replicas (asyncs retired between windows), so
+        the fan-out's scaling is measured, not asserted.  Replicas add
+        CPU capacity, so the sweep climbs exactly as far as the host's
+        cores allow: on a single-core smoke host every peer serializes
+        onto the same core and the sweep is flat BY CONSTRUCTION —
+        host_cpus rides the JSON so the artifact says which regime it
+        measured;
+      * write p99 via the router vs direct-to-primary, interleaved in
+        alternating batches on the identical topology so background
+        load hits both paths equally — the proxy hop's tax on the
+        latency-critical path (<20% is the acceptance bar);
+      * the client-observed failover stall: a writer streams inserts
+        through the router while the primary is SIGKILLed; the router
+        parks the in-flight write and replays it against the new
+        primary, so the client sees its max inter-ack gap — a stall —
+        and ZERO errors;
+      * steady-state router CPU per client connection (/proc
+        utime+stime over the busiest read window).
+    """
+    from tests.test_partition import http_get
+
+    window_s = float(os.environ.get("MANATEE_ROUTER_QPS_WINDOW", "4"))
+    n_clients = int(os.environ.get("MANATEE_ROUTER_CLIENTS", "16"))
+    n_writes = int(os.environ.get("MANATEE_ROUTER_WRITES", "200"))
+    # the read payload: 32 rows of 512B per select keeps the replica's
+    # per-request serialization cost real (so chain capacity, not
+    # request latency, is what the client pool saturates) while the
+    # reply line stays far under asyncio's 64 KiB readline limit
+    prime_rows = 64
+    row_bytes = 512
+    select_limit = 32
+
+    class _LineClient:
+        """One raw connection speaking the sim line-JSON wire —
+        exactly what the router relays, byte for byte."""
+
+        def __init__(self):
+            self.r = None
+            self.w = None
+
+        async def connect(self, host: str, port: int):
+            self.r, self.w = await asyncio.wait_for(
+                asyncio.open_connection(host, port), 10)
+            return self
+
+        async def req(self, obj: dict, timeout: float = 15.0) -> dict:
+            self.w.write(json.dumps(obj).encode() + b"\n")
+            await self.w.drain()
+            line = await asyncio.wait_for(self.r.readline(), timeout)
+            if not line:
+                raise ConnectionResetError("upstream closed")
+            return json.loads(line)
+
+        def close(self):
+            if self.w is not None:
+                self.w.close()
+
+    async def read_window(port: int, seconds: float) -> float:
+        """n_clients concurrent sequential selectors; returns QPS.
+        The reply is prefix-checked, not parsed — the bench process
+        shares the host with the fleet, and json-decoding 16 KiB per
+        request would make the CLIENT the capacity being measured."""
+        clients = [await _LineClient().connect("127.0.0.1", port)
+                   for _ in range(n_clients)]
+        stop = time.monotonic() + seconds
+        counts = [0] * n_clients
+        payload = json.dumps({"op": "select",
+                              "limit": select_limit}).encode() + b"\n"
+
+        async def drive(i: int):
+            c = clients[i]
+            while time.monotonic() < stop:
+                c.w.write(payload)
+                await c.w.drain()
+                line = await asyncio.wait_for(c.r.readline(), 15.0)
+                if not line.startswith(b'{"ok": true'):
+                    raise RuntimeError("routed select failed: %r"
+                                       % line[:200])
+                counts[i] += 1
+
+        try:
+            await asyncio.gather(*(drive(i) for i in range(n_clients)))
+        finally:
+            for c in clients:
+                c.close()
+        return sum(counts) / seconds
+
+    async def write_p99_pair(rport: int, dport: int) -> tuple[float,
+                                                              float]:
+        """p99 insert latency via the router vs direct-to-primary,
+        strictly alternating request by request so a host-noise burst
+        (scheduler stall, neighbor churn) lands on whichever path
+        happens to be in flight — balanced in expectation instead of
+        falling entirely inside one side's measurement window."""
+        via = await _LineClient().connect("127.0.0.1", rport)
+        dcl = await _LineClient().connect("127.0.0.1", dport)
+        lat: dict[str, list[float]] = {"via": [], "direct": []}
+        try:
+            for k in range(n_writes):
+                for tag, c in (("via", via), ("direct", dcl)):
+                    t0 = time.monotonic()
+                    res = await c.req(
+                        {"op": "insert",
+                         "value": "%s-%d" % (tag, k)})
+                    if not res.get("ok"):
+                        raise RuntimeError(
+                            "bench write failed: %r" % res)
+                    lat[tag].append(time.monotonic() - t0)
+        finally:
+            via.close()
+            dcl.close()
+
+        def pct(xs: list[float], q: float) -> float:
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+        return (pct(lat["via"], 0.99), pct(lat["direct"], 0.99),
+                pct(lat["via"], 0.5), pct(lat["direct"], 0.5))
+
+    with tempfile.TemporaryDirectory(
+            prefix="manatee-bench-router-") as d:
+        tmp = Path(d)
+        cluster = ClusterHarness(tmp, n_peers=4,
+                                 session_timeout=SESSION_TIMEOUT,
+                                 disconnect_grace=DISCONNECT_GRACE)
+        try:
+            await cluster.start()
+            # boot order under host load is not deterministic: accept
+            # whichever peer won the primary race and name the chain
+            # from the converged state instead of insisting on peer1
+            st = await cluster.wait_for(
+                lambda s: s.get("primary") and s.get("sync")
+                and len(s.get("async") or []) == 2,
+                60, "4-peer chain")
+            idents = {p.ident: p for p in cluster.peers}
+            prim = idents[st["primary"]["id"]]
+            syncp = idents[st["sync"]["id"]]
+            a1, a2 = (idents[a["id"]] for a in st["async"])
+            await cluster.wait_writable(prim, "pre-router", timeout=60)
+            router = await cluster.start_router()
+            rport = router["listen_port"]
+
+            async def wait_readers(n: int):
+                """The route table converged on n read peers."""
+                deadline = time.monotonic() + 30
+                while True:
+                    _s, body = await http_get(router["status_url"]
+                                              + "/status")
+                    shard = body["shards"][0]
+                    if shard["primary"] and len(shard["readers"]) == n:
+                        return shard
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "route table never reached %d readers: %r"
+                            % (n, shard))
+                    await asyncio.sleep(0.2)
+
+            await wait_readers(3)
+
+            # prime the WAL so selects serialize real payload
+            c = await _LineClient().connect("127.0.0.1", rport)
+            for k in range(prime_rows):
+                res = await c.req({"op": "insert",
+                                   "value": "seed-%04d-%s"
+                                   % (k, "x" * row_bytes)})
+                if not res.get("ok"):
+                    raise RuntimeError("prime write failed: %r" % res)
+            c.close()
+
+            # ---- read QPS, chain = 3, with router CPU metered over
+            # the same (busiest) window
+            router_pid = router["proc"].pid
+            cpu0 = _proc_cpu_seconds(router_pid)
+            qps3 = await read_window(rport, window_s)
+            cpu = _proc_cpu_seconds(router_pid) - cpu0
+            core_per_conn = cpu / window_s / n_clients
+
+            # ---- write p99: via router vs direct, same topology
+            p99_via, p99_direct, p50_via, p50_direct = \
+                await write_p99_pair(rport, prim.pg_port)
+            overhead = (p99_via - p99_direct) / p99_direct
+
+            # ---- failover stall: a writer streams through the router
+            # while the primary dies.  The router parks the in-flight
+            # insert and replays it on the new primary: the client's
+            # account must show a bounded max inter-ack gap and ZERO
+            # errors.
+            wc = await _LineClient().connect("127.0.0.1", rport)
+            errors = 0
+            max_gap = 0.0
+            acked = 0
+            killed_at = None
+            try:
+                last = time.monotonic()
+                k = 0
+                while True:
+                    res = await wc.req(
+                        {"op": "insert", "value": "stall-%d" % k},
+                        timeout=60.0)
+                    now = time.monotonic()
+                    if not res.get("ok"):
+                        errors += 1
+                    else:
+                        acked += 1
+                        if killed_at is not None:
+                            max_gap = max(max_gap, now - last)
+                        last = now
+                    k += 1
+                    if killed_at is None and acked >= 20:
+                        prim.kill()
+                        killed_at = time.monotonic()
+                    elif killed_at is not None and max_gap > 0 \
+                            and now - killed_at > max_gap + 2.0:
+                        break       # steady again on the new primary
+                    # a paced client, not a tight loop: the gap
+                    # measurement wants ack spacing >> cadence noise,
+                    # and the WAL must not balloon under the stall run
+                    await asyncio.sleep(0.05)
+            finally:
+                wc.close()
+            await cluster.wait_topology(primary=syncp, sync=a1,
+                                        asyncs=[a2], timeout=60)
+            await cluster.wait_writable(syncp, "post-router-failover",
+                                        timeout=60)
+
+            # ---- shrink the chain: retire asyncs one at a time and
+            # rerun the same read pool against 2, then 1 replicas
+            shard = await wait_readers(2)
+            qps2 = await read_window(rport, window_s)
+            a2.kill()
+            shard = await wait_readers(1)
+            qps1 = await read_window(rport, window_s)
+
+            _s, body = await http_get(router["status_url"] + "/status")
+            shard = body["shards"][0]
+            out = {
+                "clients": n_clients,
+                "window_s": window_s,
+                "host_cpus": os.cpu_count(),
+                "read_qps_by_chain": {"1": round(qps1, 1),
+                                      "2": round(qps2, 1),
+                                      "3": round(qps3, 1)},
+                "read_scaling_3_vs_1": round(qps3 / qps1, 2),
+                "write_p99_direct_s": round(p99_direct, 5),
+                "write_p99_via_router_s": round(p99_via, 5),
+                "write_p99_overhead_pct": round(100 * overhead, 1),
+                "write_p50_direct_s": round(p50_direct, 5),
+                "write_p50_via_router_s": round(p50_via, 5),
+                "write_p50_overhead_pct": round(
+                    100 * (p50_via - p50_direct) / p50_direct, 1),
+                "failover_stall_s": round(max_gap, 3),
+                "failover_errors": errors,
+                "failover_acks": acked,
+                "router_parks": shard["parks"],
+                "router_cpu_core_per_conn": round(core_per_conn, 5),
+            }
+            print("router_qps: read QPS %s (3v1 %.2fx); write p99 "
+                  "%.1fms via vs %.1fms direct (+%.1f%%); failover "
+                  "stall %.2fs, %d errors, %d parks; %.5f core/conn"
+                  % (out["read_qps_by_chain"],
+                     out["read_scaling_3_vs_1"], 1e3 * p99_via,
+                     1e3 * p99_direct, out["write_p99_overhead_pct"],
+                     max_gap, errors, shard["parks"], core_per_conn),
+                  file=sys.stderr)
+            return out
+        finally:
+            await cluster.stop()
+
+
 def _metric_sum(text: str, name: str) -> float:
     """Sum every sample of a (possibly labeled) counter — e.g. all
     outcome labels of manatee_hlc_merge_total."""
@@ -1369,7 +1661,8 @@ async def main() -> None:
     for name in picked:
         if name in ("restore_throughput", "incremental_rebuild",
                     "control_plane_scale", "modelcheck_throughput",
-                    "slo_probe", "incident_reconstruction"):
+                    "slo_probe", "incident_reconstruction",
+                    "router_qps"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -1389,6 +1682,9 @@ async def main() -> None:
     incident = None
     if "incident_reconstruction" in picked:
         incident = await bench_incident_reconstruction()
+    router = None
+    if "router_qps" in picked:
+        router = await bench_router_qps()
     scale = None
     if "control_plane_scale" in picked:
         scale = await bench_control_plane_scale()
@@ -1422,6 +1718,8 @@ async def main() -> None:
         out["slo_probe"] = slo
     if incident is not None:
         out["incident_reconstruction"] = incident
+    if router is not None:
+        out["router_qps"] = router
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

@@ -948,6 +948,12 @@ def _prober_url(args) -> str | None:
     return url.rstrip("/") if url else None
 
 
+def _router_url(args) -> str | None:
+    url = getattr(args, "router_url", None) \
+        or os.environ.get("MANATEE_ROUTER_URL")
+    return url.rstrip("/") if url else None
+
+
 def cmd_slo(args) -> int:
     """Error budgets + burn-rate alerts, fleet-wide: one GET against a
     prober's /alerts (the prober is where the SLO engine runs — it
@@ -1021,12 +1027,77 @@ def cmd_slo(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_router(args) -> int:
+    """Live route tables from a `manatee-router`'s /status: which peer
+    each fronted shard's writes pin to, how many replicas serve its
+    reads (and the worst observed lag among them), plus the serving
+    counters — open client connections, writes parked right now,
+    lifetime routed requests and parks.  Exits 1 while any shard has
+    no primary route (its writes are parking), so failover drills and
+    cron checks can gate on the serving plane the same way `slo` gates
+    on the measurement plane."""
+    base = _router_url(args)
+    if not base:
+        die("router URL required (-u/--url or MANATEE_ROUTER_URL)")
+
+    async def go():
+        try:
+            status, body = await AdmClient.http_json(base + "/status")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            die("cannot reach router at %s: %s"
+                % (base, str(e) or type(e).__name__))
+        if status != 200:
+            die("%s/status answered HTTP %d" % (base, status))
+        shards = body.get("shards") or []
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0 if all(s.get("primary") for s in shards) else 1
+        cols = [
+            {"name": "shard", "label": "SHARD", "width": 16},
+            {"name": "listen", "label": "LISTEN", "width": 21},
+            {"name": "gen", "label": "GEN", "width": 4},
+            {"name": "primary", "label": "PRIMARY", "width": 21},
+            {"name": "readers", "label": "READERS", "width": 7},
+            {"name": "lag", "label": "LAG-MAX", "width": 7},
+            {"name": "conns", "label": "CONNS", "width": 5},
+            {"name": "parked", "label": "PARKED", "width": 6},
+            {"name": "routed", "label": "ROUTED", "width": 8},
+            {"name": "parks", "label": "PARKS", "width": 5},
+        ]
+        rows = []
+        for s in shards:
+            lags = [r.get("lag") for r in s.get("readers") or []
+                    if r.get("lag") is not None]
+            rows.append({
+                "shard": s.get("shard", "?"),
+                "listen": s.get("listen", "-"),
+                "gen": s.get("gen", 0),
+                "primary": s.get("primary") or "PARKING",
+                "readers": len(s.get("readers") or []),
+                "lag": "-" if not lags else "%.2fs" % max(lags),
+                "conns": s.get("connections", 0),
+                "parked": s.get("parked", 0),
+                "routed": s.get("routed", 0),
+                "parks": s.get("parks", 0),
+            })
+        if rows:
+            emit_table(cols, rows, omit_header=args.omit_header)
+        else:
+            print("router at %s fronts no shards" % base)
+        return 0 if all(s.get("primary") for s in shards) else 1
+    return asyncio.run(go())
+
+
 def cmd_top(args) -> int:
     """Fleet dashboard: one row per peer — role, uptime, CPU, RSS,
     open fds (obs/process.py's self-metrics), replication lag and
     health score — from the /metrics scrape every sitter already
     serves; plus the prober's per-shard client-observed SLIs when a
-    prober URL is given (-u or MANATEE_PROBER_URL)."""
+    prober URL is given (-u or MANATEE_PROBER_URL), and the router's
+    serving-plane rows (route table + parked/routed counters) when a
+    router URL is given (-r or MANATEE_ROUTER_URL)."""
     async def go():
         rc = 0
         async with AdmClient(_coord(args)) as adm:
@@ -1100,9 +1171,28 @@ def cmd_top(args) -> int:
         for p in peers_out:
             p["skew_s"] = skew_by_peer.get(p["peer"])
 
+        # the serving plane rides the same dashboard: the router's
+        # /status is its route table — where writes pin, who serves
+        # reads, and how many clients are parked mid-failover
+        router = None
+        rbase = _router_url(args)
+        if rbase:
+            try:
+                status, body = await AdmClient.http_json(
+                    rbase + "/status")
+                if status == 200:
+                    router = body.get("shards")
+                else:
+                    errors[rbase] = "HTTP %d" % status
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                errors[rbase] = str(e) or type(e).__name__
+
         if args.json:
             print(json.dumps({"now": round(now, 3),
                               "peers": peers_out, "slis": slis,
+                              "router": router,
                               "errors": errors},
                              indent=2, sort_keys=True))
             return 0 if not errors else 1
@@ -1178,6 +1268,30 @@ def cmd_top(args) -> int:
                 })
             print("")
             emit_table(scols, srows, omit_header=args.omit_header)
+        if router is not None:
+            rcols = [
+                {"name": "shard", "label": "SHARD", "width": 16},
+                {"name": "primary", "label": "ROUTE-PRIMARY",
+                 "width": 21},
+                {"name": "readers", "label": "READERS", "width": 7},
+                {"name": "conns", "label": "CONNS", "width": 5},
+                {"name": "parked", "label": "PARKED", "width": 6},
+                {"name": "routed", "label": "ROUTED", "width": 8},
+                {"name": "parks", "label": "PARKS", "width": 5},
+            ]
+            rrows = []
+            for s in router:
+                rrows.append({
+                    "shard": s.get("shard", "?"),
+                    "primary": s.get("primary") or "PARKING",
+                    "readers": len(s.get("readers") or []),
+                    "conns": s.get("connections", 0),
+                    "parked": s.get("parked", 0),
+                    "routed": s.get("routed", 0),
+                    "parks": s.get("parks", 0),
+                })
+            print("")
+            emit_table(rcols, rrows, omit_header=args.omit_header)
         for label, err in sorted(errors.items()):
             sys.stderr.write("warning: no metrics from %s: %s\n"
                              % (label, err))
@@ -1821,12 +1935,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")
 
+    sp = add("router", cmd_router,
+             "route tables + serving counters (from a manatee-router)",
+             shard=False)
+    sp.add_argument("-u", "--url", dest="router_url", default=None,
+                    metavar="URL",
+                    help="router status URL "
+                         "(env: MANATEE_ROUTER_URL)")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
+
     sp = add("top", cmd_top,
              "fleet dashboard: per-peer resources + client-observed "
              "SLIs")
     sp.add_argument("-u", "--url", default=None, metavar="URL",
                     help="also render per-shard SLIs from this "
                          "prober (env: MANATEE_PROBER_URL)")
+    sp.add_argument("-r", "--router-url", dest="router_url",
+                    default=None, metavar="URL",
+                    help="also render the router's route table + "
+                         "serving counters (env: MANATEE_ROUTER_URL)")
     sp.add_argument("-j", "--json", action="store_true")
     sp.add_argument("-H", "--omit-header", action="store_true",
                     dest="omit_header")

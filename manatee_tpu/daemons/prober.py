@@ -37,6 +37,14 @@ Config (single shard, ``-f``)::
      "stalenessBudget": 5.0, "historyDir": "/var/manatee/history",
      "slos": [{"name": "write_availability", "objective": 0.999}]}
 
+``probeVia`` (optional) routes the probe traffic THROUGH a
+manatee-router listener instead of straight at the peers — writes and
+one read per tick (peer label ``router``) judge the router's routing
+against the same SLOs; ``probeTimeout`` overrides the per-probe
+timeout (give a routed prober headroom for the router's park across a
+failover — a parked-then-replayed write should count as a slow
+success, not an error).
+
 Fleet mode (``--fleet`` or a ``shards`` list in ``-f``'s config)
 mirrors the sitter: top-level keys are the shared base, each
 ``shards`` entry ({name, shardPath}) overrides per shard, one probe
@@ -136,6 +144,8 @@ PROBER_SCHEMA = {
         "statusPort": {"type": "integer"},
         "statusHost": {"type": "string"},
         "probeInterval": {"type": "number", "exclusiveMinimum": 0},
+        "probeVia": {"type": ["string", "null"]},
+        "probeTimeout": {"type": "number", "exclusiveMinimum": 0},
         "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
         "historyDir": {"type": ["string", "null"]},
         "historyInterval": {"type": "number", "exclusiveMinimum": 0},
@@ -165,6 +175,8 @@ PROBER_FLEET_SCHEMA = {
         "statusPort": {"type": "integer"},
         "statusHost": {"type": "string"},
         "probeInterval": {"type": "number", "exclusiveMinimum": 0},
+        "probeVia": {"type": ["string", "null"]},
+        "probeTimeout": {"type": "number", "exclusiveMinimum": 0},
         "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
         "historyDir": {"type": ["string", "null"]},
         "historyInterval": {"type": "number", "exclusiveMinimum": 0},
@@ -256,8 +268,17 @@ class ShardProber:
                                       DEFAULT_PROBE_INTERVAL))
         self.budget = float(cfg.get("stalenessBudget",
                                     DEFAULT_STALENESS_BUDGET))
-        self.timeout = min(PROBE_TIMEOUT,
-                           max(0.5, self.interval * 5.0))
+        self.timeout = float(cfg["probeTimeout"]) \
+            if cfg.get("probeTimeout") else \
+            min(PROBE_TIMEOUT, max(0.5, self.interval * 5.0))
+        # probeVia: route the probe traffic THROUGH manatee-router
+        # instead of straight at the peers — the SLO plane then judges
+        # the router's routing (a misrouting router pages itself).
+        # Writes target the router; reads become ONE routed probe per
+        # tick under the peer label "router"; lag/clock telemetry
+        # scrapes keep going straight to the real peers.
+        via = cfg.get("probeVia")
+        self._via_rep = {"id": "router", "pgUrl": via} if via else None
         coord = cfg["coordCfg"]
         self._connstr = coord.get("connStr") or \
             "%s:%d" % (coord["host"], int(coord["port"]))
@@ -369,8 +390,15 @@ class ShardProber:
                 log.warning("topology refresh failed on %s: %s",
                             self.name, e)
         await self._probe_write()
-        for rep in list(self._replicas):
-            await self._probe_read(rep)
+        if self._via_rep is not None:
+            await self._probe_read(self._via_rep)
+            for rep in list(self._replicas):
+                peer = rep.get("id") or rep["pgUrl"]
+                await self._maybe_scrape_lag(rep, peer)
+                await self._maybe_probe_clock(rep, peer)
+        else:
+            for rep in list(self._replicas):
+                await self._probe_read(rep)
         if self._primary is not None:
             await self._maybe_probe_clock(
                 self._primary,
@@ -385,11 +413,17 @@ class ShardProber:
         err = None
         try:
             await faults.point("prober.write")
-            if self._primary is None:
+            if self._via_rep is not None:
+                # routed: the router owns primary discovery (and
+                # parks the write across a failover instead of
+                # erroring — the stall this probe then measures)
+                target = self._via_rep["pgUrl"]
+            elif self._primary is None:
                 raise PgError("no primary in cluster state")
+            else:
+                target = self._primary["pgUrl"]
             await self._engines.query(
-                self._primary["pgUrl"],
-                {"op": "insert", "value": value}, self.timeout)
+                target, {"op": "insert", "value": value}, self.timeout)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -448,6 +482,8 @@ class ShardProber:
         _READS.inc(shard=self.name, peer=peer,
                    result="ok" if good else "stale")
         self._slo.record("read_staleness", good=good, shard=self.name)
+        if rep is self._via_rep:
+            return      # the router serves no /metrics at pgUrl+1
         await self._maybe_scrape_lag(rep, peer)
         await self._maybe_probe_clock(rep, peer)
 

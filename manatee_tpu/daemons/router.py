@@ -1,0 +1,829 @@
+"""manatee-router — the fleet's connection front door.
+
+Everything below this daemon *manages* databases; nothing so far
+*serves* them.  The router is the missing data-plane edge: one
+pgbouncer-shaped async TCP proxy fronts a whole fleet of shards over
+ONE multiplexed coordination session (CoordMux, exactly like
+``manatee-sitter --fleet`` and ``manatee-prober``), watches each
+shard's cluster state, and routes the simpg line protocol:
+
+- **writes** (``insert``, and any verb it cannot classify) pin to the
+  shard's primary;
+- **reads** (``select``, ``health``) spread round-robin across the
+  sync/async chain, **staleness-bounded**: a replica whose known
+  replication lag exceeds ``stalenessBudget`` drops out of the read
+  set.  Lag is fed the way the prober feeds it — the
+  ``replication_lag_seconds`` gauge scraped from each sitter's
+  /metrics — plus passive inference from the state watch itself (a
+  peer deposed or removed from the chain is evicted the moment the
+  watch fires, without waiting for a scrape);
+- ``status`` goes to the primary (the authoritative replication
+  view); ``replicate`` is refused — routers do not proxy replication
+  streams.
+
+The headline behavior is what happens during a failover: instead of
+erroring, in-flight writes are **drained and parked** — held while
+the topology watch converges on the new primary, then replayed
+against it — so a client sees a sub-second stall where it used to see
+connection errors.  The park is bounded by ``parkTimeout`` and
+measured (``router_park_seconds``, a ``router.park`` journal event).
+A replay after a connection died mid-ack can duplicate a write — the
+same exposure any client retry loop has, and the sim engine's
+insert-only table is idempotent about it.
+
+Per-connection cost is the perf target, per the serialize-once /
+amortize-everything discipline (RPCAcc, Poseidon — PAPERS.md):
+
+- upstream connections are **pooled per (shard, peer)** and reused
+  across requests (``router_upstream_dials_total`` stays flat while
+  ``router_routed_total`` grows);
+- the route table is computed **once per state watch / lag update**
+  (``router_route_rebuilds_total``), never per connection or per
+  request — the relay path reads one immutable table;
+- the steady-state relay path does **no JSON parse and no per-request
+  object construction**: the verb is sniffed with a single compiled
+  regex over the raw line, the routing decision is a table lookup,
+  and the bytes the client sent are the bytes the upstream receives.
+
+The router fronts the simpg newline-JSON wire (``sim://`` pgUrls) —
+the protocol every test cluster and the bench speak.  Fronting real
+PostgreSQL would mean speaking the pg wire protocol at this seam; the
+routing, parking and pooling layers are protocol-agnostic and would
+carry over unchanged.
+
+Config (single shard, ``-f``)::
+
+    {"shardPath": "/manatee/1", "listenPort": 15432,
+     "coordCfg": {"connStr": "127.0.0.1:2281"},
+     "statusPort": 14002, "stalenessBudget": 5.0,
+     "parkTimeout": 30.0}
+
+Fleet mode (``--fleet`` or a ``shards`` list) mirrors the sitter and
+prober: top-level keys are the shared base, each ``shards`` entry
+({name, shardPath, listenPort}) overrides per shard, one listener per
+shard over ONE coordination connection.
+
+The traffic seams carry the ``router.accept``, ``router.relay`` and
+``router.park`` failpoints (armable over this daemon's own
+``/faults``); the crash-recovery sweep kills the router mid-relay and
+mid-park and proves clients see a closed socket, never a wedge.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import re
+import time
+
+from manatee_tpu import faults
+from manatee_tpu.coord.api import CoordError, NoNodeError
+from manatee_tpu.coord.client import mux_handle
+from manatee_tpu.daemons.common import (
+    attach_obs_routes,
+    daemon_main,
+    start_daemon_introspection,
+)
+from manatee_tpu.obs import get_journal, get_registry, set_peer, span
+from manatee_tpu.pg.engine import parse_pg_url
+from manatee_tpu.utils.validation import ConfigError
+
+log = logging.getLogger("manatee.router")
+
+DEFAULT_STALENESS_BUDGET = 5.0
+DEFAULT_PARK_TIMEOUT = 30.0
+DEFAULT_RELAY_TIMEOUT = 5.0
+DEFAULT_LAG_INTERVAL = 2.0
+DEFAULT_MAX_IDLE = 8
+# parked writers re-check the table at least this often even when no
+# route-change event fires (a new primary may become writable without
+# a state transition we can observe)
+PARK_POLL = 0.25
+# a peer that failed a relay is out of the read set for this long;
+# the next state watch or lag refresh re-admits it if healthy
+DOWN_COOLDOWN = 5.0
+UPSTREAM_DIAL_TIMEOUT = 5.0
+
+_REG = get_registry()
+_CONNS = _REG.gauge(
+    "router_connections",
+    "live client connections per fronted shard",
+    ("shard",))
+_ROUTED = _REG.counter(
+    "router_routed_total",
+    "requests relayed, by sniffed verb and the peer that served them",
+    ("shard", "verb", "peer"))
+_PARK_SECONDS = _REG.histogram(
+    "router_park_seconds",
+    "how long parked requests were held across a failover before "
+    "replay (or park-budget exhaustion)",
+    ("shard",))
+_PARKED = _REG.gauge(
+    "router_parked",
+    "requests currently parked awaiting a writable primary",
+    ("shard",))
+_DIALS = _REG.counter(
+    "router_upstream_dials_total",
+    "new upstream connections dialed (pool misses); flat while "
+    "router_routed_total grows means the pool is doing its job",
+    ("shard", "peer"))
+_POOLED = _REG.gauge(
+    "router_pooled_idle",
+    "idle pooled upstream connections per (shard, peer)",
+    ("shard", "peer"))
+_REBUILDS = _REG.counter(
+    "router_route_rebuilds_total",
+    "route-table recomputations (one per state watch or lag-set "
+    "change, NEVER per request)",
+    ("shard",))
+_READ_PEERS = _REG.gauge(
+    "router_read_peers",
+    "replicas currently eligible for reads (within the staleness "
+    "budget and not recently failed)",
+    ("shard",))
+_ROUTER_LAG = _REG.gauge(
+    "router_replica_lag_seconds",
+    "replication lag the router last learned for each replica "
+    "(scraped from the peer's sitter, prober-style)",
+    ("shard", "peer"))
+
+# the verb sniff: one compiled regex over the raw request line — the
+# engine's json.dumps puts the "op" key first, so the first match IS
+# the op (no JSON parse on the relay path)
+_OP_RE = re.compile(rb'"op"\s*:\s*"([A-Za-z_]+)"')
+_READ_VERBS = ("select", "health")
+# simpg's reply when an insert lands on a standby (or a primary still
+# in catchup): the signal that the state's primary is not yet
+# writable and the request should park, not error
+_READONLY_MARK = b"read-only"
+_ERR_REPLICATE = (b'{"ok": false, "error": "router: replication '
+                  b'streams are not proxied"}\n')
+_ERR_PARK_BUDGET = (b'{"ok": false, "error": "router: no writable '
+                    b'primary within park budget"}\n')
+
+ROUTE_ERRORS = (OSError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError)
+
+ROUTER_SCHEMA = {
+    "type": "object",
+    "required": ["shardPath", "listenPort", "coordCfg"],
+    "properties": {
+        "name": {"type": "string"},
+        "shardPath": {"type": "string"},
+        "listenPort": {"type": "integer"},
+        "listenHost": {"type": "string"},
+        "statusPort": {"type": "integer"},
+        "statusHost": {"type": "string"},
+        "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
+        "parkTimeout": {"type": "number", "exclusiveMinimum": 0},
+        "relayTimeout": {"type": "number", "exclusiveMinimum": 0},
+        "lagInterval": {"type": "number", "exclusiveMinimum": 0},
+        "maxIdlePerPeer": {"type": "integer", "minimum": 0},
+        "faults": {"type": "array", "items": {"type": "string"}},
+        "faultsEnabled": {"type": "boolean"},
+        "coordCfg": {
+            "type": "object",
+            "anyOf": [
+                {"required": ["host", "port"]},
+                {"required": ["connStr"]},
+            ],
+        },
+    },
+}
+
+ROUTER_FLEET_SCHEMA = {
+    "type": "object",
+    "required": ["shards", "coordCfg"],
+    "properties": {
+        "shards": {
+            "type": "array",
+            "minItems": 1,
+            "items": {"type": "object",
+                      "required": ["shardPath", "listenPort"]},
+        },
+        "coordCfg": ROUTER_SCHEMA["properties"]["coordCfg"],
+        "listenHost": {"type": "string"},
+        "statusPort": {"type": "integer"},
+        "statusHost": {"type": "string"},
+        "stalenessBudget": {"type": "number", "exclusiveMinimum": 0},
+        "parkTimeout": {"type": "number", "exclusiveMinimum": 0},
+        "relayTimeout": {"type": "number", "exclusiveMinimum": 0},
+        "lagInterval": {"type": "number", "exclusiveMinimum": 0},
+        "maxIdlePerPeer": {"type": "integer", "minimum": 0},
+        "faults": {"type": "array", "items": {"type": "string"}},
+        "faultsEnabled": {"type": "boolean"},
+    },
+}
+
+
+def router_shard_configs(cfg: dict) -> list[dict]:
+    """The fleet merge, sitter/prober-style: shared base + per-shard
+    overrides; duplicate names/paths/ports are config errors."""
+    if not isinstance(cfg.get("shards"), list):
+        one = dict(cfg)
+        one["name"] = str(cfg.get("name")
+                          or cfg["shardPath"].strip("/").replace("/", "-"))
+        return [one]
+    base = {k: v for k, v in cfg.items() if k != "shards"}
+    merged, names, paths, ports = [], set(), set(), set()
+    for i, entry in enumerate(cfg["shards"]):
+        c = dict(base)
+        c.update(entry)
+        if not c.get("shardPath"):
+            raise ConfigError("router shard %d has no shardPath" % i)
+        if not c.get("listenPort"):
+            raise ConfigError("router shard %d has no listenPort" % i)
+        name = str(c.get("name")
+                   or c["shardPath"].strip("/").replace("/", "-"))
+        c["name"] = name
+        if name in names:
+            raise ConfigError("duplicate router shard name %r" % name)
+        if c["shardPath"] in paths:
+            raise ConfigError("duplicate router shardPath %r"
+                              % c["shardPath"])
+        if c["listenPort"] in ports:
+            raise ConfigError("duplicate router listenPort %r"
+                              % c["listenPort"])
+        names.add(name)
+        paths.add(c["shardPath"])
+        ports.add(c["listenPort"])
+        merged.append(c)
+    return merged
+
+
+class RouteTable:
+    """One immutable routing decision: built once per state watch or
+    lag-set change, consulted (never recomputed) per request."""
+
+    __slots__ = ("gen", "primary", "primary_id", "readers", "_rr")
+
+    def __init__(self, gen: int, primary: tuple | None,
+                 primary_id: str | None,
+                 readers: tuple[tuple[str, tuple], ...]):
+        self.gen = gen
+        self.primary = primary          # (host, port) or None
+        self.primary_id = primary_id
+        self.readers = readers          # ((peer_id, (host, port)), ...)
+        self._rr = 0
+
+    def read_pick(self) -> tuple[str, tuple] | None:
+        """Next (peer_id, addr) round-robin, or None when the read
+        set is empty (caller falls back to the primary)."""
+        n = len(self.readers)
+        if not n:
+            return None
+        i = self._rr
+        self._rr = (i + 1) % n
+        return self.readers[i % n]
+
+    def signature(self) -> tuple:
+        return (self.primary, self.primary_id, self.readers)
+
+
+class UpstreamPool:
+    """Pooled upstream (reader, writer) pairs per peer address.  A
+    request costs a checkout, not a dial; relays that fail discard the
+    connection so a stale pooled socket can never serve twice."""
+
+    def __init__(self, shard: str, max_idle: int = DEFAULT_MAX_IDLE):
+        self.shard = shard
+        self.max_idle = max_idle
+        self._idle: dict[tuple, list] = {}
+        self._peer_of: dict[tuple, str] = {}
+
+    async def acquire(self, addr: tuple, peer: str):
+        self._peer_of[addr] = peer
+        idle = self._idle.get(addr)
+        while idle:
+            reader, writer = idle.pop()
+            _POOLED.set(len(idle), shard=self.shard, peer=peer)
+            if reader.at_eof() or writer.is_closing():
+                writer.close()
+                continue
+            return reader, writer
+        _DIALS.inc(shard=self.shard, peer=peer)
+        return await asyncio.wait_for(
+            asyncio.open_connection(addr[0], addr[1]),
+            UPSTREAM_DIAL_TIMEOUT)
+
+    def release(self, addr: tuple, conn) -> None:
+        idle = self._idle.setdefault(addr, [])
+        if len(idle) < self.max_idle and not conn[0].at_eof():
+            idle.append(conn)
+        else:
+            conn[1].close()
+        _POOLED.set(len(idle), shard=self.shard,
+                    peer=self._peer_of.get(addr, "?"))
+
+    def discard(self, conn) -> None:
+        with contextlib.suppress(Exception):
+            conn[1].close()
+
+    def invalidate(self, addr: tuple) -> None:
+        """Close every idle connection to *addr* (the old primary's
+        pool is garbage the moment a failover starts)."""
+        for conn in self._idle.pop(addr, []):
+            self.discard(conn)
+        _POOLED.set(0, shard=self.shard,
+                    peer=self._peer_of.get(addr, "?"))
+
+    def close_all(self) -> None:
+        for addr in list(self._idle):
+            self.invalidate(addr)
+
+
+class ShardRouter:
+    """The front door for ONE shard: a TCP listener relaying the simpg
+    line protocol against a route table maintained from the shard's
+    cluster-state watch and the replicas' scraped lag."""
+
+    def __init__(self, cfg: dict, *, http_get=None):
+        self.name = cfg["name"]
+        self.path = cfg["shardPath"]
+        self.listen_host = cfg.get("listenHost", "0.0.0.0")
+        self.listen_port = int(cfg["listenPort"])
+        self.budget = float(cfg.get("stalenessBudget",
+                                    DEFAULT_STALENESS_BUDGET))
+        self.park_timeout = float(cfg.get("parkTimeout",
+                                          DEFAULT_PARK_TIMEOUT))
+        self.relay_timeout = float(cfg.get("relayTimeout",
+                                           DEFAULT_RELAY_TIMEOUT))
+        self.lag_interval = float(cfg.get("lagInterval",
+                                          DEFAULT_LAG_INTERVAL))
+        coord = cfg.get("coordCfg") or {}
+        self._connstr = coord.get("connStr") or \
+            ("%s:%d" % (coord["host"], int(coord["port"]))
+             if coord else "")
+        self._session_timeout = float(coord.get("sessionTimeout", 60.0))
+        grace = coord.get("disconnectGrace")
+        self._disconnect_grace = None if grace is None else float(grace)
+        self._http_get = http_get or _http_get_text
+        self._pool = UpstreamPool(
+            self.name, int(cfg.get("maxIdlePerPeer", DEFAULT_MAX_IDLE)))
+        self._handle = None
+        self._dirty = True
+        self._wake = asyncio.Event()
+        self._wake.set()
+        self._change = asyncio.Event()
+        self._primary_addr: tuple | None = None
+        self._primary_id: str | None = None
+        self._replicas: list[dict] = []
+        self._lag: dict[str, float] = {}
+        self._down: dict[str, float] = {}
+        self._gen = 0
+        self._table = RouteTable(0, None, None, ())
+        self._server = None
+        self._topo_task: asyncio.Task | None = None
+        self._lag_task: asyncio.Task | None = None
+
+    # -- lifecycle --
+
+    async def start(self, *, topology: bool = True) -> None:
+        """Bind the listener; with *topology* (the daemon path) also
+        start the state watch and lag loops.  Tests drive the table
+        directly via :meth:`apply_state` with ``topology=False``."""
+        self._server = await asyncio.start_server(
+            self._serve_client, self.listen_host, self.listen_port)
+        if self.listen_port == 0:
+            self.listen_port = \
+                self._server.sockets[0].getsockname()[1]
+        if topology:
+            self._topo_task = asyncio.create_task(self._topo_loop())
+            self._lag_task = asyncio.create_task(self._lag_loop())
+        log.info("router %s listening on %s:%d", self.name,
+                 self.listen_host, self.listen_port)
+
+    async def stop(self) -> None:
+        for task in (self._topo_task, self._lag_task):
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+        self._topo_task = self._lag_task = None
+        if self._server is not None:
+            self._server.close()
+            with contextlib.suppress(Exception):
+                await self._server.wait_closed()
+            self._server = None
+        if self._handle is not None:
+            with contextlib.suppress(Exception):
+                await self._handle.close()
+            self._handle = None
+        self._pool.close_all()
+
+    # -- topology --
+
+    def _on_change(self, _ev) -> None:
+        self._dirty = True
+        self._wake.set()
+
+    async def _topo_loop(self) -> None:
+        while True:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._wake.wait(), 1.0)
+            self._wake.clear()
+            if not self._dirty:
+                continue
+            try:
+                await self._refresh_topology()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("topology refresh failed on %s: %s",
+                            self.name, e)
+                await asyncio.sleep(0.2)
+
+    async def _refresh_topology(self) -> None:
+        if self._handle is None:
+            self._handle = await mux_handle(
+                self._connstr,
+                session_timeout=self._session_timeout,
+                disconnect_grace=self._disconnect_grace,
+                name="router:%s" % self.name)
+            self._handle.on_session_event(self._on_change)
+        try:
+            data, _ver = await self._handle.get(
+                self.path + "/state", watch=self._on_change)
+        except NoNodeError:
+            self._dirty = True      # watch did not arm: keep polling
+            self.apply_state({})
+            return
+        except CoordError:
+            with contextlib.suppress(Exception):
+                await self._handle.close()
+            self._handle = None
+            self._dirty = True
+            raise
+        self._dirty = False
+        self.apply_state(json.loads(data.decode()))
+
+    def apply_state(self, state: dict) -> None:
+        """Fold one cluster state into the route table (the state
+        watch's landing point, and the test seam).  Peers no longer in
+        the chain are evicted here — passive lag inference: a deposed
+        peer is stale by definition, no scrape needed."""
+        prim = state.get("primary") or {}
+        if prim.get("pgUrl"):
+            _s, host, port = parse_pg_url(prim["pgUrl"])
+            new_addr = (host, port)
+            if (self._primary_addr is not None
+                    and new_addr != self._primary_addr):
+                # the old primary's pooled connections are garbage
+                self._pool.invalidate(self._primary_addr)
+            self._primary_addr = new_addr
+            self._primary_id = prim.get("id") or prim["pgUrl"]
+        else:
+            self._primary_addr = self._primary_id = None
+        reps = []
+        for p in [state.get("sync")] + list(state.get("async") or []):
+            if not (p and p.get("pgUrl")):
+                continue
+            _s, host, port = parse_pg_url(p["pgUrl"])
+            reps.append({"id": p.get("id") or p["pgUrl"],
+                         "addr": (host, port), "pgUrl": p["pgUrl"]})
+        self._replicas = reps
+        live = {r["id"] for r in reps}
+        self._lag = {p: v for p, v in self._lag.items() if p in live}
+        self._rebuild("state")
+
+    def _rebuild(self, reason: str) -> None:
+        """Serialize-once: the ONLY place a routing decision is
+        computed.  Everything on the relay path reads the resulting
+        immutable table."""
+        now = time.monotonic()
+        self._down = {p: t for p, t in self._down.items() if t > now}
+        with span("router.route", shard=self.name, reason=reason,
+                  primary=self._primary_id or ""):
+            readers = []
+            for rep in self._replicas:
+                pid = rep["id"]
+                if pid in self._down:
+                    continue
+                lag = self._lag.get(pid)
+                if lag is not None and lag > self.budget:
+                    continue
+                readers.append((pid, rep["addr"]))
+            self._gen += 1
+            table = RouteTable(self._gen, self._primary_addr,
+                               self._primary_id, tuple(readers))
+        changed = table.signature() != self._table.signature()
+        self._table = table
+        _REBUILDS.inc(shard=self.name)
+        _READ_PEERS.set(len(readers), shard=self.name)
+        if changed:
+            get_journal().record(
+                "router.route_change", shard=self.name, reason=reason,
+                gen=table.gen, primary=self._primary_id,
+                readers=[p for p, _ in readers])
+            old = self._change
+            self._change = asyncio.Event()
+            old.set()       # wake every parked request
+
+    def _mark_down(self, peer: str) -> None:
+        self._down[peer] = time.monotonic() + DOWN_COOLDOWN
+        self._rebuild("peer-down")
+
+    def _suspect_primary(self, addr: tuple | None) -> None:
+        """A failed write relay is the moment to re-learn the primary
+        (the prober's rule) — and to drop its pooled connections."""
+        if addr is not None:
+            self._pool.invalidate(addr)
+        self._dirty = True
+        self._wake.set()
+
+    # -- lag feed (active scrape, prober-style) --
+
+    async def _lag_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.lag_interval)
+            try:
+                await self._refresh_lag()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.debug("lag refresh failed on %s: %s", self.name, e)
+
+    async def _refresh_lag(self) -> None:
+        changed = False
+        for rep in list(self._replicas):
+            pid = rep["id"]
+            try:
+                host, port = rep["addr"]
+                text = await self._http_get(
+                    "http://%s:%d/metrics" % (host, port + 1))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                continue
+            lag = _parse_lag_gauge(text)
+            if lag is None:
+                continue
+            old = self._lag.get(pid)
+            self._lag[pid] = lag
+            _ROUTER_LAG.set(lag, shard=self.name, peer=pid)
+            was_ok = old is None or old <= self.budget
+            now_ok = lag <= self.budget
+            if was_ok != now_ok:
+                changed = True
+        if changed:
+            self._rebuild("lag")
+
+    # -- the relay path --
+
+    async def _serve_client(self, reader, writer) -> None:
+        _CONNS.inc(shard=self.name)
+        try:
+            if await faults.point("router.accept") == "drop":
+                return
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    reply = await self._route_one(line)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    reply = (json.dumps(
+                        {"ok": False,
+                         "error": "router: %s" % e})
+                        .encode() + b"\n")
+                if reply is None:
+                    continue        # black-holed (drop): no reply
+                writer.write(reply)
+                await writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("client connection on %s closed: %s",
+                      self.name, e)
+        finally:
+            _CONNS.dec(shard=self.name)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _route_one(self, line: bytes) -> bytes | None:
+        m = _OP_RE.search(line)
+        verb = m.group(1).decode() if m else "unknown"
+        if await faults.point("router.relay") == "drop":
+            return None
+        if verb == "replicate":
+            _ROUTED.inc(shard=self.name, verb=verb, peer="refused")
+            return _ERR_REPLICATE
+        if verb in _READ_VERBS:
+            return await self._relay_read(line, verb)
+        return await self._relay_write(line, verb)
+
+    async def _relay_read(self, line: bytes, verb: str) -> bytes:
+        table = self._table
+        for _ in range(len(table.readers) + 1):
+            picked = table.read_pick()
+            if picked is None:
+                break
+            peer, addr = picked
+            try:
+                reply = await self._relay(addr, peer, line)
+            except ROUTE_ERRORS:
+                self._mark_down(peer)
+                table = self._table
+                continue
+            _ROUTED.inc(shard=self.name, verb=verb, peer=peer)
+            return reply
+        # no eligible replica: the primary serves reads too
+        return await self._relay_write(line, verb)
+
+    async def _relay_write(self, line: bytes, verb: str) -> bytes:
+        """Primary-pinned relay with park/replay: a request that finds
+        no writable primary is HELD — drained out of the error path —
+        until the topology watch lands a new one, then replayed."""
+        t0 = None
+        while True:
+            table = self._table
+            addr = table.primary
+            if addr is not None:
+                try:
+                    reply = await self._relay(
+                        addr, table.primary_id or "?", line)
+                except ROUTE_ERRORS:
+                    self._suspect_primary(addr)
+                else:
+                    if (verb == "insert"
+                            and _READONLY_MARK in reply):
+                        # state says primary, pg still in catchup:
+                        # park and replay, don't bounce the error
+                        self._dirty = True
+                        self._wake.set()
+                    else:
+                        _ROUTED.inc(shard=self.name, verb=verb,
+                                    peer=table.primary_id or "?")
+                        if t0 is not None:
+                            self._close_park(t0, verb, replayed=True)
+                        return reply
+            if t0 is None:
+                await faults.point("router.park")
+                t0 = time.monotonic()
+                _PARKED.inc(shard=self.name)
+            if time.monotonic() - t0 >= self.park_timeout:
+                self._close_park(t0, verb, replayed=False)
+                return _ERR_PARK_BUDGET
+            change = self._change
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(change.wait(), PARK_POLL)
+
+    def _close_park(self, t0: float, verb: str,
+                    *, replayed: bool) -> None:
+        held = time.monotonic() - t0
+        _PARKED.dec(shard=self.name)
+        _PARK_SECONDS.observe(held, shard=self.name)
+        get_journal().record("router.park", shard=self.name,
+                             verb=verb, seconds=round(held, 3),
+                             replayed=replayed)
+
+    async def _relay(self, addr: tuple, peer: str,
+                     line: bytes) -> bytes:
+        conn = await self._pool.acquire(addr, peer)
+        try:
+            reply = None
+            conn[1].write(line)
+            await conn[1].drain()
+            reply = await asyncio.wait_for(conn[0].readline(),
+                                           self.relay_timeout)
+            if not reply:
+                reply = None
+                raise ConnectionResetError("upstream closed")
+        finally:
+            # success returns the conn to the pool; any failure (error,
+            # timeout, cancellation) discards it — a half-read stream
+            # must never be reused
+            if reply is None:
+                self._pool.discard(conn)
+            else:
+                self._pool.release(addr, conn)
+        return reply
+
+    # -- status --
+
+    def describe(self) -> dict:
+        table = self._table
+        return {
+            "shard": self.name,
+            "listen": "%s:%d" % (self.listen_host, self.listen_port),
+            "gen": table.gen,
+            "primary": table.primary_id,
+            "readers": [
+                {"peer": p, "lag": self._lag.get(p)}
+                for p, _a in table.readers],
+            "connections": _CONNS.value(shard=self.name),
+            "parked": _PARKED.value(shard=self.name),
+            "routed": sum(
+                v for labels, v in _ROUTED.samples()
+                if labels.get("shard") == self.name),
+            "parks": _PARK_SECONDS.snapshot(shard=self.name)["count"],
+        }
+
+
+_LAG_RE = re.compile(
+    r'^manatee_replication_lag_seconds\{[^}]*\}\s+([0-9.eE+-]+)\s*$',
+    re.M)
+
+
+def _parse_lag_gauge(text: str) -> float | None:
+    m = _LAG_RE.search(text)
+    return float(m.group(1)) if m else None
+
+
+async def _http_get_text(url: str, timeout: float = 2.0) -> str:
+    import aiohttp
+    tmo = aiohttp.ClientTimeout(total=timeout)
+    async with aiohttp.ClientSession(timeout=tmo) as http:
+        async with http.get(url) as resp:
+            return await resp.text()
+
+
+# ---- the router's own HTTP listener ----
+
+class RouterServer:
+    """The control listener (NOT the data path): /status renders every
+    shard's live route table; the shared obs routes make the router
+    scrapeable/drillable exactly like every other daemon."""
+
+    def __init__(self, routers: list[ShardRouter], *,
+                 host: str = "0.0.0.0", port: int = 0):
+        from aiohttp import web
+        self._web = web
+        self.routers = routers
+        self.host = host
+        self.port = port
+        self._runner = None
+        app = web.Application()
+        app.router.add_get("/", self._routes)
+        app.router.add_get("/status", self._status)
+        self._obs_routes = attach_obs_routes(app, metrics=True)
+        self._app = app
+
+    async def start(self) -> None:
+        web = self._web
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        log.info("router control listening on %s:%d (%d shards)",
+                 self.host, self.port, len(self.routers))
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _routes(self, _req):
+        return self._web.json_response(["/status"] + self._obs_routes)
+
+    async def _status(self, _req):
+        return self._web.json_response({
+            "now": round(time.time(), 3),
+            "shards": [r.describe() for r in self.routers]})
+
+
+# ---- daemon wiring ----
+
+async def start_router(cfg: dict):
+    shard_cfgs = router_shard_configs(cfg)
+    host = cfg.get("statusHost", "0.0.0.0")
+    port = int(cfg.get("statusPort", 0))
+    set_peer("router:%d" % port if port else "router")
+    faults.arm_specs(cfg.get("faults"), source="config")
+    if cfg.get("faultsEnabled"):
+        faults.enable_http()
+    routers = [ShardRouter(c) for c in shard_cfgs]
+    intro = start_daemon_introspection(cfg)
+    server = RouterServer(routers, host=host, port=port)
+    await server.start()
+    for r in routers:
+        await r.start()
+    log.info("router fronting %d shards on one coordination "
+             "connection", len(routers))
+
+    async def stop():
+        for r in routers:
+            await r.stop()
+        await server.stop()
+        await intro.stop()
+
+    return stop
+
+
+def main(argv=None) -> None:
+    daemon_main("manatee-router",
+                "topology-aware connection front door (primary-pinned "
+                "writes, staleness-bounded reads, park-don't-error "
+                "failovers)",
+                ROUTER_SCHEMA, start_router, argv,
+                fleet_schema=ROUTER_FLEET_SCHEMA)
+
+
+if __name__ == "__main__":
+    main()

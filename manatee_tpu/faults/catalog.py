@@ -182,6 +182,27 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/daemons/prober.py",),
         ("error", "delay", "stall", "crash"),
     ),
+    "router.accept": (
+        "router's client-connection accept, before the first request "
+        "line is read; drop = the connection is closed without a "
+        "byte (clients retry-connect)",
+        ("manatee_tpu/daemons/router.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
+    "router.park": (
+        "router's park entry: a write found no writable primary and "
+        "is about to be held for replay; stall models a park that "
+        "never wakes (bounded by the client's own timeout)",
+        ("manatee_tpu/daemons/router.py",),
+        ("error", "delay", "stall", "crash"),
+    ),
+    "router.relay": (
+        "router's per-request relay, after the verb sniff and before "
+        "the routing decision; drop = the request is consumed and "
+        "never answered (a black-holed proxy hop)",
+        ("manatee_tpu/daemons/router.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
     "state.write": (
         "state machine's durable CAS write of a decided transition",
         ("manatee_tpu/state/machine.py",),

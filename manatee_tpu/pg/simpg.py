@@ -527,8 +527,16 @@ class SimPgServer:
                                      "replication (timeout)"}
             return {"ok": True, "lsn": lsn_str(lsn)}
         if op == "select":
-            return {"ok": True,
-                    "rows": [r["value"] for r in self.wal.records]}
+            rows = [r["value"] for r in self.wal.records]
+            try:
+                limit = int(req.get("limit") or 0)
+            except (TypeError, ValueError):
+                limit = 0
+            if limit > 0:
+                # bounded tail read: constant reply cost however long
+                # the WAL grows — what read-QPS benchmarks drive
+                rows = rows[-limit:]
+            return {"ok": True, "rows": rows}
         return {"ok": False, "error": "unknown op %r" % op}
 
     async def _wait_sync_flush(self, syncs: list[str], lsn: int,

@@ -186,6 +186,30 @@ def spawn_prober(cfg: dict, root, crash_dir=None) -> subprocess.Popen:
             start_new_session=True)
 
 
+def spawn_router(cfg: dict, root, crash_dir=None) -> subprocess.Popen:
+    """Spawn ``manatee-router`` as a child process: write *cfg* to
+    ``root/router.json``, append its output to ``root/router.log``,
+    start it in its own process group (tear down with
+    :func:`kill_fleet_sitter` — same group semantics).  A ``shards``
+    list in *cfg* selects fleet mode.  *crash_dir* opts the router
+    into the fleet-wide crash-fingerprint directory.  Shared by tests
+    and bench.py's router_qps leg; call via ``asyncio.to_thread``
+    from a coroutine (or use :meth:`ClusterHarness.start_router`)."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "router.json").write_text(json.dumps(cfg, indent=2))
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    if crash_dir:
+        Path(crash_dir).mkdir(parents=True, exist_ok=True)
+        env["MANATEE_CRASH_DIR"] = str(crash_dir)
+    with open(root / "router.log", "ab") as logf:
+        return subprocess.Popen(
+            [sys.executable, "-m", "manatee_tpu.daemons.router",
+             "-f", str(root / "router.json")],
+            stdout=logf, stderr=logf, env=env,
+            start_new_session=True)
+
+
 class Peer:
     def __init__(self, cluster: "ClusterHarness", idx: int):
         self.cluster = cluster
@@ -456,6 +480,9 @@ class ClusterHarness:
         # for every spawned daemon; `manatee-adm incident --crash-dir`)
         self.crash_dir = self.root / "crashes"
         self.peers = [Peer(self, i + 1) for i in range(n_peers)]
+        # routers spawned via start_router: killed by stop(), their
+        # journal/span evidence dumped by _dump_obs on red teardowns
+        self.routers: list[dict] = []
 
     @property
     def coord_connstr(self) -> str:
@@ -601,6 +628,41 @@ class ClusterHarness:
         peer.start(sitter_faults=sitter_faults,
                    backup_faults=backup_faults)
 
+    async def start_router(self, *, listen_port: int | None = None,
+                           status_port: int | None = None,
+                           crash: bool = True, **overrides) -> dict:
+        """Spawn ``manatee-router`` fronting this cluster's shard (the
+        prober-helper pattern): allocates ports unless given, waits
+        for the listener, and tracks the process for teardown — killed
+        by :meth:`stop`, journal/span evidence dumped by
+        :meth:`_dump_obs` on red teardowns.  Returns ``{"proc",
+        "listen_port", "status_port", "url", "status_url"}``; point
+        clients (or the prober's ``probeVia``) at ``url``."""
+        if listen_port is None or status_port is None:
+            base = alloc_port_block(2)
+            listen_port = listen_port or base
+            status_port = status_port or base + 1
+        cfg = {"shardPath": self.shard_path,
+               "listenPort": listen_port, "listenHost": "127.0.0.1",
+               "statusPort": status_port, "statusHost": "127.0.0.1",
+               "coordCfg": {"connStr": self.coord_connstr,
+                            "sessionTimeout": self.session_timeout,
+                            **({"disconnectGrace": self.disconnect_grace}
+                               if self.disconnect_grace is not None
+                               else {})},
+               "faultsEnabled": True}
+        cfg.update(overrides)
+        proc = await asyncio.to_thread(
+            spawn_router, cfg, self.root / "router",
+            self.crash_dir if crash else None)
+        rec = {"proc": proc, "listen_port": listen_port,
+               "status_port": status_port,
+               "url": "sim://127.0.0.1:%d" % listen_port,
+               "status_url": "http://127.0.0.1:%d" % status_port}
+        self.routers.append(rec)
+        await self._wait_port(listen_port)
+        return rec
+
     async def stop(self) -> None:
         # dump only on FAILING teardowns: stop() runs in the tests'
         # finally blocks, so an in-flight exception here means the test
@@ -609,6 +671,9 @@ class ClusterHarness:
         if os.environ.get("MANATEE_OBS_DUMP") \
                 and sys.exc_info()[0] is not None:
             await self._dump_obs()
+        for rec in self.routers:
+            kill_fleet_sitter(rec["proc"])
+        self.routers.clear()
         for p in self.peers:
             p.kill()
         self.kill_coordd()
@@ -628,6 +693,25 @@ class ClusterHarness:
         CI sets MANATEE_OBS_DUMP=1 and uploads these files as
         artifacts on failure, so a red run's failover is debuggable
         from `manatee-adm events`/`trace` output without a rerun."""
+        def _fetch(url: str) -> str:
+            import urllib.request
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read().decode()
+
+        # the router is not in the durable topology, so the CLI
+        # fan-out below never reaches it: pull its route table and
+        # journal/span rings off its own status port directly
+        for i, rec in enumerate(self.routers):
+            for ep in ("status", "events", "spans"):
+                try:
+                    text = await asyncio.to_thread(
+                        _fetch, "%s/%s" % (rec["status_url"], ep))
+                    (self.root / ("router%d-%s.json" % (i, ep))
+                     ).write_text(text)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
         if not any(p and p.poll() is None for p in self.coord_procs):
             return        # no coordination service left to fan out from
         for args, fname in (

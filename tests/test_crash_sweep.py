@@ -37,6 +37,7 @@ import itertools
 import json
 import signal
 import subprocess
+import socket
 import sys
 import time
 from pathlib import Path
@@ -108,6 +109,15 @@ REPO = Path(__file__).resolve().parent.parent
 #                 in-memory engine, crashes at the probe seam, and a
 #                 clean rerun completes the probe cycle (the prober
 #                 itself holds no durable state to damage)
+#   router_subproc
+#                 the router is a stateless data-plane proxy (no
+#                 durable store to doctor): a child process serves a
+#                 real client socket held by the PARENT and crashes
+#                 mid-relay / mid-park at the armed seam; the parent
+#                 asserts the client socket reads EOF promptly (a
+#                 closed socket, never a wedge) and the process died
+#                 with the crash fingerprint; a clean rerun completes
+#                 a relay round trip AND a full park/replay cycle
 #   profile_subproc
 #                 the introspection plane (obs/profile.py) runs in
 #                 every daemon but holds no durable state: a child
@@ -164,6 +174,10 @@ SCENARIOS: dict[str, dict] = {
     "pg.restore":           dict(kind="boot_async", wipe=True),
     "prober.read":          dict(kind="prober_subproc", variant="kill"),
     "prober.write":         dict(kind="prober_subproc"),
+    "router.accept":        dict(kind="router_subproc"),
+    "router.park":          dict(kind="router_subproc"),
+    "router.relay":         dict(kind="router_subproc",
+                                 variant="kill"),
     "state.write":          dict(kind="primary_write"),
     "storage.delta.apply":  dict(kind="incr_apply"),
     "storage.delta.send":   dict(kind="incr_sender", variant="kill"),
@@ -187,7 +201,7 @@ FAST_POINTS = {"backup.post", "coord.client.send",
                "pg.promote", "storage.zfs.exec",
                "obs.history.append", "obs.loop.tick",
                "prober.write", "coord.hlc.merge",
-               "obs.incident.collect"}
+               "obs.incident.collect", "router.relay"}
 
 
 def test_sweep_covers_every_failpoint():
@@ -477,6 +491,132 @@ def _run_prober_subproc_scenario(tmp_path, point: str, scn: dict
     assert "probe-ok" in cp.stdout
 
 
+_ROUTER_UP = (
+    "class Up:\n"
+    "    async def start(self):\n"
+    "        self.server = await asyncio.start_server(\n"
+    "            self._conn, '127.0.0.1', 0)\n"
+    "        self.port = self.server.sockets[0].getsockname()[1]\n"
+    "    async def _conn(self, reader, writer):\n"
+    "        while True:\n"
+    "            line = await reader.readline()\n"
+    "            if not line:\n"
+    "                return\n"
+    "            writer.write(b'{\"ok\": true}\\n')\n"
+    "            await writer.drain()\n")
+
+_ROUTER_CFG = (
+    "    cfg = {'name': 'sweep', 'shardPath': '/manatee/sweep',\n"
+    "           'listenPort': 0, 'listenHost': '127.0.0.1',\n"
+    "           'coordCfg': {'connStr': '127.0.0.1:1'},\n"
+    "           'parkTimeout': 30.0}\n")
+
+
+def _run_router_subproc_scenario(tmp_path, point: str, scn: dict
+                                 ) -> None:
+    """Crash the router mid-relay / mid-park with a REAL client socket
+    held by this (parent) process.  The router is a stateless proxy —
+    no durable store to doctor — so recovery is its black-box
+    contract: the crash leaves the client with a promptly-closed
+    socket (EOF, never a wedge), and a clean rerun completes a relay
+    round trip plus a full park/replay cycle."""
+    # mid-park needs a park: no primary in the state.  The other
+    # seams fire on any relayed request.
+    park = point == "router.park"
+    serve_script = (
+        "import asyncio\n"
+        "from manatee_tpu.daemons.router import ShardRouter\n"
+        + _ROUTER_UP +
+        "async def main():\n"
+        "    up = Up()\n"
+        "    await up.start()\n"
+        + _ROUTER_CFG +
+        "    r = ShardRouter(cfg)\n"
+        "    await r.start(topology=False)\n"
+        + ("    r.apply_state({})\n" if park else
+           "    r.apply_state({'primary': {'id': 'p0',\n"
+           "        'pgUrl': 'sim://127.0.0.1:%d' % up.port}})\n") +
+        "    print('router-port=%d' % r.listen_port, flush=True)\n"
+        "    await asyncio.Event().wait()\n"
+        "asyncio.run(main())\n")
+    variant = scn.get("variant", "exit")
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_FAULTS": spec_for(point, variant)}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", serve_script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("router-port="), \
+            (line, proc.stderr.read())
+        port = int(line.split("=", 1)[1])
+        sock = socket.create_connection(("127.0.0.1", port),
+                                        timeout=10)
+        try:
+            sock.settimeout(30)
+            try:
+                sock.sendall(b'{"op": "insert", "value": {"k": 1}}\n')
+                data = sock.recv(4096)
+            except OSError:
+                # a reset IS a closed socket — what we assert against
+                # is a wedge (recv hanging until the timeout)
+                data = b""
+            assert data == b"", \
+                "crashed router answered instead of dying: %r" % data
+        finally:
+            sock.close()
+        assert proc.wait(timeout=60) == crash_status(variant)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    # clean rerun: a relay round trip and a full park/replay cycle
+    clean_script = (
+        "import asyncio, json\n"
+        "from manatee_tpu.daemons import router as R\n"
+        + _ROUTER_UP +
+        "async def query(port, op):\n"
+        "    reader, writer = await asyncio.open_connection(\n"
+        "        '127.0.0.1', port)\n"
+        "    writer.write((json.dumps(op) + '\\n').encode())\n"
+        "    await writer.drain()\n"
+        "    line = await asyncio.wait_for(reader.readline(), 10)\n"
+        "    writer.close()\n"
+        "    return json.loads(line)\n"
+        "async def main():\n"
+        "    up = Up()\n"
+        "    await up.start()\n"
+        + _ROUTER_CFG +
+        "    r = R.ShardRouter(cfg)\n"
+        "    await r.start(topology=False)\n"
+        "    prim = {'primary': {'id': 'p0',\n"
+        "            'pgUrl': 'sim://127.0.0.1:%d' % up.port}}\n"
+        "    r.apply_state(prim)\n"
+        "    rep = await query(r.listen_port,\n"
+        "                      {'op': 'insert', 'value': {'k': 1}})\n"
+        "    assert rep['ok'], rep\n"
+        "    r.apply_state({})\n"
+        "    task = asyncio.create_task(query(\n"
+        "        r.listen_port, {'op': 'insert', 'value': {'k': 2}}))\n"
+        "    await asyncio.sleep(0.3)\n"
+        "    assert not task.done(), 'errored instead of parking'\n"
+        "    r.apply_state(prim)\n"
+        "    rep = await asyncio.wait_for(task, 10)\n"
+        "    assert rep['ok'], rep\n"
+        "    snap = R._PARK_SECONDS.snapshot(shard='sweep')\n"
+        "    assert snap['count'] == 1, snap\n"
+        "    await r.stop()\n"
+        "    print('router-ok')\n"
+        "asyncio.run(main())\n")
+    env.pop("MANATEE_FAULTS")
+    cp = subprocess.run([sys.executable, "-c", clean_script],
+                        capture_output=True, text=True, timeout=60,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    assert "router-ok" in cp.stdout
+
+
 def _run_profile_subproc_scenario(tmp_path, point: str, scn: dict
                                   ) -> None:
     """Crash the introspection plane at its two seams (the profiler's
@@ -658,6 +798,9 @@ def test_crash_at_seam(tmp_path, point):
         return
     if scn["kind"] == "prober_subproc":
         _run_prober_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "router_subproc":
+        _run_router_subproc_scenario(tmp_path, point, scn)
         return
     if scn["kind"] == "profile_subproc":
         _run_profile_subproc_scenario(tmp_path, point, scn)

@@ -66,6 +66,21 @@ def test_renders_trace_man_page(tmp_path):
     assert "`" not in out and "**" not in out
 
 
+def test_renders_router_man_page(tmp_path):
+    out = render((REPO / "docs" / "man"
+                  / "manatee-router.md").read_text(), tmp_path)
+    for section in (".SH SYNOPSIS", ".SH DESCRIPTION", ".SH OPTIONS",
+                    ".SH CONFIGURATION", ".SH ENDPOINTS",
+                    ".SH ENVIRONMENT", ".SH EXIT STATUS",
+                    ".SH SEE ALSO"):
+        assert section in out, "missing %s" % section
+    # the config example survives as a literal block, and the routing
+    # contract's headline words made it through markdown stripping
+    assert ".nf" in out and "parkTimeout" in out
+    assert "park" in out and "replay" in out
+    assert "`" not in out and "**" not in out
+
+
 def test_renders_incident_man_page(tmp_path):
     out = render((REPO / "docs" / "man"
                   / "manatee-adm-incident.md").read_text(), tmp_path)

@@ -1,0 +1,448 @@
+"""manatee-router unit tier: route-table correctness from synthetic
+cluster states, park/replay against a fake upstream, staleness-budget
+enforcement, pooled-upstream reuse, and the obs-route round trip.
+
+Everything here drives :class:`ShardRouter` directly through its
+``apply_state`` seam (``topology=False``) — the live coordination
+watch path is exercised by the chaos soak (test_slo_live.py) and the
+bench's router_qps leg.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tests.harness import ClusterHarness, run_cli
+
+from manatee_tpu.daemons import router as router_mod
+from manatee_tpu.daemons.router import (
+    RouterServer,
+    ShardRouter,
+    router_shard_configs,
+)
+from manatee_tpu.utils.validation import ConfigError
+
+
+class FakeUpstream:
+    """A minimal simpg-wire server: one JSON reply per request line,
+    tagged with this upstream's name so tests can see who served."""
+
+    def __init__(self, name: str, *, read_only: bool = False):
+        self.name = name
+        self.read_only = read_only
+        self.requests: list[dict] = []
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(
+            self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        if self.server is not None:
+            self.server.close()
+            await self.server.wait_closed()
+            self.server = None
+
+    @property
+    def url(self) -> str:
+        return "sim://127.0.0.1:%d" % self.port
+
+    async def _conn(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                self.requests.append(req)
+                if req.get("op") == "insert" and self.read_only:
+                    rep = {"ok": False,
+                           "error": "cannot execute INSERT in a "
+                                    "read-only transaction"}
+                elif req.get("op") == "select":
+                    rep = {"ok": True, "rows": [],
+                           "served_by": self.name}
+                else:
+                    rep = {"ok": True, "served_by": self.name}
+                writer.write((json.dumps(rep) + "\n").encode())
+                await writer.drain()
+        except (OSError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+def _mk_router(name: str, **over) -> ShardRouter:
+    cfg = {"name": name, "shardPath": "/manatee/" + name,
+           "listenPort": 0, "listenHost": "127.0.0.1",
+           "coordCfg": {"connStr": "127.0.0.1:1"},
+           "parkTimeout": 5.0, "relayTimeout": 2.0}
+    cfg.update(over)
+    return ShardRouter(cfg)
+
+
+def _state(primary=None, sync=None, asyncs=()):
+    st = {"async": [{"id": n, "pgUrl": u} for n, u in asyncs]}
+    if primary:
+        st["primary"] = {"id": primary[0], "pgUrl": primary[1]}
+    if sync:
+        st["sync"] = {"id": sync[0], "pgUrl": sync[1]}
+    return st
+
+
+async def _query(port: int, op: dict, timeout: float = 5.0) -> dict:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection("127.0.0.1", port), timeout)
+    try:
+        writer.write((json.dumps(op) + "\n").encode())
+        await writer.drain()
+        line = await asyncio.wait_for(reader.readline(), timeout)
+        assert line, "router closed the connection without a reply"
+        return json.loads(line)
+    finally:
+        writer.close()
+
+
+# ---- route-table correctness from synthetic states ----
+
+def test_route_table_primary_flip_and_deposed_peer():
+    async def go():
+        r = _mk_router("rt1")
+        r.apply_state(_state(primary=("A", "sim://127.0.0.1:9001"),
+                             sync=("B", "sim://127.0.0.1:9002"),
+                             asyncs=[("C", "sim://127.0.0.1:9003")]))
+        t = r._table
+        assert t.primary_id == "A"
+        assert t.primary == ("127.0.0.1", 9001)
+        assert [p for p, _ in t.readers] == ["B", "C"]
+        # failover: B takes over, A is deposed (gone from the chain)
+        r.apply_state(_state(primary=("B", "sim://127.0.0.1:9002"),
+                             asyncs=[("C", "sim://127.0.0.1:9003")]))
+        t2 = r._table
+        assert t2.gen > t.gen
+        assert t2.primary_id == "B"
+        assert [p for p, _ in t2.readers] == ["C"]
+        # the deposed peer was evicted passively — no lag entry lives on
+        assert "A" not in r._lag
+    asyncio.run(go())
+
+
+def test_lag_over_budget_evicts_replica():
+    async def go():
+        texts = {
+            9012: "manatee_replication_lag_seconds{x=\"1\"} 0.2\n",
+            9013: "manatee_replication_lag_seconds{x=\"1\"} 99.0\n",
+        }
+
+        async def fake_get(url, timeout=2.0):
+            port = int(url.split(":")[2].split("/")[0])
+            return texts[port - 1]
+
+        r = _mk_router("rt2", stalenessBudget=5.0)
+        r._http_get = fake_get
+        r.apply_state(_state(primary=("A", "sim://127.0.0.1:9011"),
+                             sync=("B", "sim://127.0.0.1:9012"),
+                             asyncs=[("C", "sim://127.0.0.1:9013")]))
+        assert [p for p, _ in r._table.readers] == ["B", "C"]
+        await r._refresh_lag()
+        # C is over budget: out of the read set, B stays
+        assert [p for p, _ in r._table.readers] == ["B"]
+        assert r._lag["C"] == 99.0
+        # C catches up: re-admitted on the next refresh
+        texts[9013] = "manatee_replication_lag_seconds{x=\"1\"} 0.5\n"
+        await r._refresh_lag()
+        assert [p for p, _ in r._table.readers] == ["B", "C"]
+    asyncio.run(go())
+
+
+def test_fleet_config_merge_rejects_duplicates():
+    base = {"coordCfg": {"connStr": "127.0.0.1:1"},
+            "shards": [
+                {"shardPath": "/manatee/1", "listenPort": 15001},
+                {"shardPath": "/manatee/2", "listenPort": 15002}]}
+    cfgs = router_shard_configs(base)
+    assert [c["name"] for c in cfgs] == ["manatee-1", "manatee-2"]
+    assert all(c["coordCfg"] for c in cfgs)
+    dup_port = {"coordCfg": {"connStr": "127.0.0.1:1"},
+                "shards": [
+                    {"shardPath": "/manatee/1", "listenPort": 15001},
+                    {"shardPath": "/manatee/2", "listenPort": 15001}]}
+    with pytest.raises(ConfigError):
+        router_shard_configs(dup_port)
+    dup_path = {"coordCfg": {"connStr": "127.0.0.1:1"},
+                "shards": [
+                    {"shardPath": "/manatee/1", "listenPort": 15001},
+                    {"shardPath": "/manatee/1", "listenPort": 15002}]}
+    with pytest.raises(ConfigError):
+        router_shard_configs(dup_path)
+
+
+# ---- live relay against fake upstreams ----
+
+def test_write_routes_to_primary_reads_spread_replicas():
+    async def go():
+        prim = await FakeUpstream("P").start()
+        rep1 = await FakeUpstream("R1").start()
+        rep2 = await FakeUpstream("R2").start()
+        r = _mk_router("relay1")
+        await r.start(topology=False)
+        r.apply_state(_state(primary=("P", prim.url),
+                             sync=("R1", rep1.url),
+                             asyncs=[("R2", rep2.url)]))
+        try:
+            rep = await _query(r.listen_port,
+                               {"op": "insert", "value": {"k": 1}})
+            assert rep["ok"] and rep["served_by"] == "P"
+            served = set()
+            for _ in range(4):
+                rep = await _query(r.listen_port, {"op": "select"})
+                served.add(rep["served_by"])
+            # round-robin: both replicas served, the primary none
+            assert served == {"R1", "R2"}
+            # replication streams are refused outright
+            rep = await _query(r.listen_port, {"op": "replicate"})
+            assert not rep["ok"] and "not proxied" in rep["error"]
+        finally:
+            await r.stop()
+            for up in (prim, rep1, rep2):
+                await up.stop()
+    asyncio.run(go())
+
+
+def test_read_falls_back_on_dead_replica_then_primary():
+    async def go():
+        prim = await FakeUpstream("P").start()
+        rep1 = await FakeUpstream("R1").start()
+        r = _mk_router("relay2")
+        await r.start(topology=False)
+        r.apply_state(_state(primary=("P", prim.url),
+                             sync=("R1", rep1.url)))
+        try:
+            await rep1.stop()      # replica dies under the router
+            rep = await _query(r.listen_port, {"op": "select"})
+            # evicted + retried: the primary served the read
+            assert rep["ok"] and rep["served_by"] == "P"
+            assert "R1" not in [p for p, _ in r._table.readers]
+        finally:
+            await r.stop()
+            await prim.stop()
+    asyncio.run(go())
+
+
+def test_park_and_replay_against_new_primary():
+    async def go():
+        up = await FakeUpstream("P2").start()
+        r = _mk_router("park1")
+        await r.start(topology=False)
+        r.apply_state(_state())          # failover in progress
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", r.listen_port), 5)
+            try:
+                writer.write(b'{"op": "insert", "value": {"k": 7}}\n')
+                await writer.drain()
+                await asyncio.sleep(0.4)
+                # the request is parked, not errored
+                assert router_mod._PARKED.value(shard="park1") == 1
+                r.apply_state(_state(primary=("P2", up.url)))
+                line = await asyncio.wait_for(reader.readline(), 5)
+                rep = json.loads(line)
+                assert rep["ok"] and rep["served_by"] == "P2"
+            finally:
+                writer.close()
+            snap = router_mod._PARK_SECONDS.snapshot(shard="park1")
+            assert snap["count"] == 1
+            assert snap["sum"] >= 0.3    # held across the outage
+            assert router_mod._PARKED.value(shard="park1") == 0
+        finally:
+            await r.stop()
+            await up.stop()
+    asyncio.run(go())
+
+
+def test_readonly_primary_parks_until_writable():
+    async def go():
+        ro = await FakeUpstream("OLD", read_only=True).start()
+        rw = await FakeUpstream("NEW").start()
+        r = _mk_router("park2")
+        await r.start(topology=False)
+        # state points at a primary still in catchup (read-only)
+        r.apply_state(_state(primary=("OLD", ro.url)))
+        try:
+            task = asyncio.create_task(_query(
+                r.listen_port, {"op": "insert", "value": {"k": 8}}))
+            await asyncio.sleep(0.4)
+            assert not task.done()       # parked, not bounced
+            r.apply_state(_state(primary=("NEW", rw.url)))
+            rep = await asyncio.wait_for(task, 5)
+            assert rep["ok"] and rep["served_by"] == "NEW"
+            assert router_mod._PARK_SECONDS.snapshot(
+                shard="park2")["count"] == 1
+        finally:
+            await r.stop()
+            await ro.stop()
+            await rw.stop()
+    asyncio.run(go())
+
+
+def test_park_budget_exhaustion_errors_cleanly():
+    async def go():
+        r = _mk_router("park3", parkTimeout=0.5)
+        await r.start(topology=False)
+        r.apply_state(_state())
+        try:
+            rep = await _query(r.listen_port,
+                               {"op": "insert", "value": {"k": 9}})
+            assert not rep["ok"]
+            assert "park budget" in rep["error"]
+        finally:
+            await r.stop()
+    asyncio.run(go())
+
+
+def test_pooled_upstream_reuse():
+    async def go():
+        up = await FakeUpstream("P3").start()
+        r = _mk_router("pool1")
+        await r.start(topology=False)
+        r.apply_state(_state(primary=("P3", up.url)))
+        try:
+            for i in range(5):
+                rep = await _query(r.listen_port,
+                                   {"op": "insert", "value": {"i": i}})
+                assert rep["ok"]
+            # five requests, ONE upstream dial: the pool is real
+            assert router_mod._DIALS.value(
+                shard="pool1", peer="P3") == 1
+            assert router_mod._ROUTED.value(
+                shard="pool1", verb="insert", peer="P3") == 5
+        finally:
+            await r.stop()
+            await up.stop()
+    asyncio.run(go())
+
+
+def test_route_rebuilds_are_per_state_not_per_request():
+    async def go():
+        up = await FakeUpstream("P4").start()
+        r = _mk_router("once1")
+        await r.start(topology=False)
+        r.apply_state(_state(primary=("P4", up.url)))
+        try:
+            before = router_mod._REBUILDS.value(shard="once1")
+            for i in range(10):
+                await _query(r.listen_port,
+                             {"op": "insert", "value": {"i": i}})
+            # ten requests, zero recomputations
+            assert router_mod._REBUILDS.value(
+                shard="once1") == before
+        finally:
+            await r.stop()
+            await up.stop()
+    asyncio.run(go())
+
+
+# ---- obs-route round trip ----
+
+def test_router_server_obs_roundtrip():
+    async def go():
+        import aiohttp
+
+        up = await FakeUpstream("P5").start()
+        r = _mk_router("obs1")
+        await r.start(topology=False)
+        r.apply_state(_state(primary=("P5", up.url)))
+        srv = RouterServer([r], host="127.0.0.1", port=0)
+        await srv.start()
+        try:
+            await _query(r.listen_port,
+                         {"op": "insert", "value": {"k": 1}})
+            base = "http://127.0.0.1:%d" % srv.port
+            async with aiohttp.ClientSession() as http:
+                async with http.get(base + "/status") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                shard = body["shards"][0]
+                assert shard["shard"] == "obs1"
+                assert shard["primary"] == "P5"
+                assert shard["routed"] >= 1
+                async with http.get(base + "/metrics") as resp:
+                    text = await resp.text()
+                    assert "router_routed_total" in text
+                    assert "router_park_seconds" in text
+                async with http.get(base + "/events") as resp:
+                    events = await resp.json()
+                    kinds = {e["event"]
+                             for e in events.get("events", [])}
+                    assert "router.route_change" in kinds
+                async with http.get(base + "/faults") as resp:
+                    assert resp.status == 200
+        finally:
+            await srv.stop()
+            await r.stop()
+            await up.stop()
+    asyncio.run(go())
+
+
+# ---- live daemon against a real cluster ----
+
+def test_router_daemon_live_roundtrip(tmp_path):
+    """The real spawn path: manatee-router as a subprocess fronting a
+    live 2-peer shard over its coordination watch — writes land on the
+    primary, reads on the replica, /status reflects the topology."""
+    async def go():
+        import aiohttp
+
+        cluster = ClusterHarness(tmp_path, n_peers=2, engine="sim")
+        try:
+            await cluster.start()
+            await cluster.wait_topology(
+                primary=cluster.peers[0], sync=cluster.peers[1])
+            rec = await cluster.start_router()
+            # the watch needs a beat to land the first route table
+            for _ in range(100):
+                rep = await _query(rec["listen_port"],
+                                   {"op": "insert",
+                                    "value": {"live": 1}},
+                                   timeout=10)
+                if rep.get("ok"):
+                    break
+                await asyncio.sleep(0.2)
+            assert rep["ok"], rep
+            rep = await _query(rec["listen_port"], {"op": "select"},
+                               timeout=10)
+            assert rep.get("rows") is not None, rep
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        rec["status_url"] + "/status") as resp:
+                    body = await resp.json()
+            shard = body["shards"][0]
+            assert shard["primary"] == cluster.peers[0].ident
+            assert [x["peer"] for x in shard["readers"]] == \
+                [cluster.peers[1].ident]
+            assert shard["routed"] >= 2
+
+            # the adm surface over the same /status: `router` renders
+            # the route table (exit 0 while every shard has a primary
+            # route) and `top -r` rides the serving rows alongside the
+            # per-peer dashboard
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "router", "-u", rec["status_url"],
+                "-j")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            body = json.loads(cp.stdout)
+            assert body["shards"][0]["primary"] == \
+                cluster.peers[0].ident
+            cp = await asyncio.to_thread(
+                run_cli, cluster, "top", "-r", rec["status_url"],
+                "-j")
+            assert cp.returncode == 0, (cp.stdout, cp.stderr)
+            body = json.loads(cp.stdout)
+            assert body["router"][0]["routed"] >= 2, body["router"]
+        finally:
+            await cluster.stop()
+    asyncio.run(go())

@@ -308,3 +308,151 @@ def test_healthy_soak_is_silent_and_partition_pages(tmp_path):
             await cluster.stop()
 
     asyncio.run(go())
+
+
+def test_routed_failover_parks_not_errors(tmp_path):
+    """PR 19: the same soak discipline with the prober's traffic routed
+    THROUGH `manatee-router` (``probeVia``) — the router's own SLO
+    contract, measured by the instrument that pages on it:
+
+      * a healthy routed soak stays zero-page (the proxy hop must not
+        burn error budget on a quiet fleet);
+      * a HARD primary kill under routed traffic is a stall, not an
+        outage: the router parks the in-flight writes and replays them
+        against the new primary, so ``prober_error_window_seconds``
+        never opens a window — the direct-wired drill above measures
+        the outage; this one proves the router erased it.
+    """
+    async def go():
+        cluster = ClusterHarness(tmp_path, n_peers=3,
+                                 session_timeout=1.0)
+        prober_proc = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-soak", timeout=60)
+
+            router = await cluster.start_router()
+
+            port = alloc_port_block(1)
+            # probeTimeout must cover a park: a write held through the
+            # takeover is a SLOW SUCCESS, and only the client-side
+            # deadline decides whether slow becomes error
+            prober_proc = await asyncio.to_thread(spawn_prober, {
+                "name": "1",
+                "shardPath": cluster.shard_path,
+                "statusHost": "127.0.0.1",
+                "statusPort": port,
+                "probeInterval": PROBE_INTERVAL,
+                "probeVia": router["url"],
+                "probeTimeout": 10.0,
+                "faultsEnabled": True,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": 1.0},
+            }, tmp_path / "prober")
+            base = "http://127.0.0.1:%d" % port
+
+            async def sli_row() -> dict:
+                _s, body = await http_get(base + "/slis")
+                return body["shards"][0]
+
+            async def alert_events() -> list[dict]:
+                _s, body = await http_get(base + "/events")
+                return [e for e in body["events"]
+                        if e["event"] == "slo.alert.fired"]
+
+            async def router_shard() -> dict:
+                _s, body = await http_get(router["status_url"]
+                                          + "/status")
+                return body["shards"][0]
+
+            # warm through the router: steady good writes, no window
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    row = await sli_row()
+                    _s, al = await http_get(base + "/alerts")
+                    if row["writes_ok"] >= 20 \
+                            and not row["error_window_open"] \
+                            and not al["alerts"]:
+                        break
+                except (OSError, KeyError, IndexError, ValueError,
+                        asyncio.TimeoutError):
+                    pass
+                assert time.monotonic() < deadline, \
+                    "routed prober never reached a quiet warm state"
+                await asyncio.sleep(0.5)
+
+            # the traffic really flows through the router, not around
+            # it: its routed counter moves with the probe cadence
+            routed0 = (await router_shard())["routed"]
+            soak = min(SOAK_S, 10.0)
+            fired0 = len(await alert_events())
+            errors0 = (await sli_row())["writes_error"]
+            await asyncio.sleep(soak)
+            fired = await alert_events()
+            row = await sli_row()
+            shard = await router_shard()
+            assert len(fired) == fired0, \
+                "healthy routed soak fired alerts: %r" % fired[fired0:]
+            assert row["writes_error"] == errors0, \
+                "probe writes failed during the healthy routed soak"
+            assert shard["routed"] >= routed0 + 10, \
+                "router saw %d requests across a %.0fs soak at %gs " \
+                "cadence — prober is not routing via the router" \
+                % (shard["routed"] - routed0, soak, PROBE_INTERVAL)
+            cursor = max((e["seq"] for e in fired), default=0)
+            ok0 = row["writes_ok"]
+            old_primary = row["primary"]
+
+            # ---- the drill: kill the primary HARD (sitter and
+            # database both).  Without the router this is a measured
+            # outage — the direct drill's error window; with it the
+            # router parks every in-flight write until the sync takes
+            # over, then replays.
+            p1.kill()
+            await cluster.wait_topology(primary=p2, timeout=60)
+            await cluster.wait_writable(p2, "post-takeover",
+                                        timeout=60)
+            deadline = time.monotonic() + 30
+            while True:
+                row = await sli_row()
+                if row["primary"] and row["primary"] != old_primary \
+                        and row["writes_ok"] > ok0 \
+                        and not row["error_window_open"]:
+                    break
+                assert time.monotonic() < deadline, \
+                    "routed prober never resumed good writes on the " \
+                    "new primary: %r" % row
+                await asyncio.sleep(0.2)
+
+            # the headline: the window the direct drill measures in
+            # seconds never opened here — parked, not errored
+            window = float(row["last_error_window_s"] or 0.0)
+            assert window == 0.0, \
+                "routed failover opened a %.3fs error window — the " \
+                "router bounced writes instead of parking them" % window
+            paged = [e for e in await alert_events()
+                     if e["seq"] > cursor]
+            assert not paged, \
+                "routed failover burned the pager: %r" % paged
+
+            # and the stall was real, measured where it happened: the
+            # router parked at least one write across the takeover
+            shard = await router_shard()
+            assert shard["parks"] >= 1, \
+                "no write ever parked across a hard primary kill: %r" \
+                % shard
+            assert shard["primary"] == p2.ident, shard
+
+            print("slo-live routed: soak quiet %.0fs; hard kill "
+                  "parked %d write(s), zero error window, zero pages"
+                  % (soak, shard["parks"]), flush=True)
+        finally:
+            if prober_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, prober_proc)
+            await cluster.stop()
+
+    asyncio.run(go())
