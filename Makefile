@@ -109,7 +109,7 @@ man: man/man1/manatee-adm.1 man/man1/manatee-adm-trace.1 \
 		man/man1/manatee-sitter.1 man/man1/manatee-prober.1 \
 		man/man1/manatee-adm-slo.1 man/man1/manatee-adm-profile.1 \
 		man/man1/manatee-adm-tasks.1 man/man1/manatee-adm-incident.1 \
-		man/man1/manatee-router.1
+		man/man1/manatee-router.1 man/man1/manatee-adm-reshard.1
 man/man1/manatee-adm.1: docs/man/manatee-adm.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-adm.md > $@
@@ -137,6 +137,9 @@ man/man1/manatee-adm-incident.1: docs/man/manatee-adm-incident.md tools/md2man
 man/man1/manatee-router.1: docs/man/manatee-router.md tools/md2man
 	mkdir -p man/man1
 	$(PYTHON) tools/md2man docs/man/manatee-router.md > $@
+man/man1/manatee-adm-reshard.1: docs/man/manatee-adm-reshard.md tools/md2man
+	mkdir -p man/man1
+	$(PYTHON) tools/md2man docs/man/manatee-adm-reshard.md > $@
 
 devcluster:
 	$(PYTHON) tools/mkdevcluster -n 3

@@ -108,6 +108,17 @@ And the serving-plane leg:
                               steady-state router CPU per client
                               connection.
 
+And the resharding leg:
+
+  - reshard_cutover:          split a populated mini-world shard under
+                              one keyed client streaming inserts
+                              through a shard-map router — the
+                              client-observed cutover window (max
+                              inter-ack gap across freeze/final/flip,
+                              zero errors), bytes moved, and the
+                              delta-vs-full wire ratio from the step
+                              record (docs/resharding.md).
+
 The ensemble_postgres leg also runs the PR 3 critical-path analyzer
 (`manatee-adm trace --last-failover -j`) after its final failover, so
 every perf PR's effect is attributable stage by stage; the breakdown
@@ -156,7 +167,8 @@ ALL_CONFIGS = ("ensemble", "single", "ensemble_hung_follower",
                "ensemble_postgres", "restore_throughput",
                "incremental_rebuild", "control_plane_scale",
                "modelcheck_throughput", "slo_probe",
-               "incident_reconstruction", "router_qps")
+               "incident_reconstruction", "router_qps",
+               "reshard_cutover")
 # total shards in the control_plane_scale leg: one measured 3-peer
 # shard + (N-1) singleton neighbors in ONE fleet sitter process
 SCALE_SHARDS = int(os.environ.get("MANATEE_SCALE_SHARDS", "32"))
@@ -1313,6 +1325,170 @@ async def bench_router_qps() -> dict:
             await cluster.stop()
 
 
+async def bench_reshard_cutover() -> dict:
+    """The resharding plane measured: split a populated mini-world
+    shard (tests/reshard_world.py) while ONE keyed client streams
+    inserts through a real `manatee-router` in shard-map mode, keys
+    cycling the whole keyspace so traffic lands on both sides of the
+    cut.  The router relays real bytes to real line-JSON upstreams on
+    the world's sim ports, so what comes out is client-observed:
+
+      * cutover_window_s — the writer's max inter-ack gap across the
+        freeze -> final-delta -> flip sequence (the router parks the
+        frozen range's writes and replays them against the new owner;
+        docs/resharding.md's acceptance number, budget 5s);
+      * zero write errors — parked, never failed;
+      * bytes_moved / rounds / wire_ratio — the seed-vs-delta wire
+        economics from the durable step record (delta bytes as a
+        fraction of the full seed, the same ratio
+        incremental_rebuild reports for one peer).
+    """
+    from tests.reshard_world import (
+        SRC_PGURL,
+        TGT_PGURL,
+        ReshardWorld,
+        probe_key,
+    )
+
+    from manatee_tpu.daemons.router import ShardMapRouter
+    from manatee_tpu.pg.engine import parse_pg_url
+
+    n_rows = int(os.environ.get("MANATEE_RESHARD_ROWS", "256"))
+    pad = "x" * 512         # give the seed/delta rounds real bytes
+
+    with tempfile.TemporaryDirectory(prefix="manatee-bench-rs-") as d:
+        w = ReshardWorld(Path(d) / "world")
+        await w.start()
+        servers = []
+        router = None
+        try:
+            await w.init_map()
+            w.populate(n_rows)
+
+            # real simpg-wire servers on the world's fixed sim ports,
+            # backed by the SAME rows files the orchestrator's engine
+            # reads — the router relays end to end, byte for byte
+            async def serve(url):
+                async def conn(reader, writer):
+                    try:
+                        while True:
+                            line = await reader.readline()
+                            if not line:
+                                return
+                            rep = await w.engine.query_url(
+                                url, json.loads(line), 5.0)
+                            writer.write(
+                                json.dumps(rep).encode() + b"\n")
+                            await writer.drain()
+                    except (ConnectionError, asyncio.TimeoutError):
+                        pass
+                    finally:
+                        writer.close()
+                _s, host, port = parse_pg_url(url)
+                return await asyncio.start_server(conn, host, port)
+
+            servers = [await serve(SRC_PGURL), await serve(TGT_PGURL)]
+
+            router = ShardMapRouter({
+                "name": "bench", "shardMapPath": "/manatee-shardmap",
+                "listenHost": "127.0.0.1", "listenPort": 0,
+                "coordCfg": {"connStr": "127.0.0.1:%d" % w.server.port},
+                "parkTimeout": 60.0, "relayTimeout": 15.0})
+            await router.start(topology=True)
+            deadline = time.monotonic() + 10
+            while "src" not in router.describe_map()["shards"]:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("router never compiled the map")
+                await asyncio.sleep(0.05)
+
+            acked = errors = 0
+            max_gap = 0.0
+            stop = False
+
+            async def keyed_writer():
+                nonlocal acked, errors, max_gap
+                r, wtr = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        "127.0.0.1", router.listen_port), 10.0)
+                try:
+                    seq = 0
+                    last = time.monotonic()
+                    while not stop:
+                        key = probe_key(seq)
+                        wtr.write(json.dumps(
+                            {"op": "insert", "key": key,
+                             "value": {"key": key,
+                                       "seq": 100000 + seq,
+                                       "pad": pad}}).encode() + b"\n")
+                        await wtr.drain()
+                        line = await asyncio.wait_for(
+                            r.readline(), 90.0)
+                        now = time.monotonic()
+                        if line and json.loads(line).get("ok"):
+                            acked += 1
+                            max_gap = max(max_gap, now - last)
+                        else:
+                            errors += 1
+                        last = now
+                        seq += 1
+                        await asyncio.sleep(0.005)
+                finally:
+                    wtr.close()
+
+            writer_task = asyncio.create_task(keyed_writer())
+            await asyncio.sleep(0.5)    # a steady-state gap baseline
+
+            t0 = time.monotonic()
+            rec = await w.make_resharder(cutoverBudget=5.0).run()
+            total_s = time.monotonic() - t0
+            await asyncio.sleep(0.5)    # post-flip acks re-steady
+            stop = True
+            await writer_task
+
+            report = await w.report()
+            if not report["ok"] or errors:
+                raise RuntimeError("reshard bench lost writes: "
+                                   "%d errors, report %r"
+                                   % (errors, report))
+            rounds = rec.get("rounds") or []
+            seed_b = sum(r["bytes"] for r in rounds
+                         if r["basis"] == "full")
+            deltas = [r["bytes"] for r in rounds
+                      if r["basis"] != "full"]
+            # avg delta round vs the full seed: the wire cost of one
+            # catch-up pass relative to reshipping everything
+            delta_b = (sum(deltas) / len(deltas)) if deltas else 0
+            out = {
+                "rows": n_rows,
+                "reshard_total_s": round(total_s, 3),
+                "cutover_window_s": round(max_gap, 3),
+                "budget_s": 5.0,
+                "bytes_moved": rec["stats"]["bytesMoved"],
+                "rounds": len(rounds),
+                "wire_ratio": (round(delta_b / seed_b, 4)
+                               if seed_b else None),
+                "writes_acked": acked,
+                "write_errors": errors,
+                "map_epoch": report["epoch"],
+            }
+            print("reshard_cutover: window %.3fs (budget 5s) over a "
+                  "%.2fs split; %d bytes in %d rounds (avg delta "
+                  "round / full seed %.3f); %d keyed writes, "
+                  "%d errors"
+                  % (max_gap, total_s, out["bytes_moved"],
+                     out["rounds"], out["wire_ratio"] or 0.0,
+                     acked, errors),
+                  file=sys.stderr)
+            return out
+        finally:
+            if router is not None:
+                await router.stop()
+            for srv in servers:
+                srv.close()
+                await srv.wait_closed()
+            await w.stop()
+
+
 def _metric_sum(text: str, name: str) -> float:
     """Sum every sample of a (possibly labeled) counter — e.g. all
     outcome labels of manatee_hlc_merge_total."""
@@ -1662,7 +1838,7 @@ async def main() -> None:
         if name in ("restore_throughput", "incremental_rebuild",
                     "control_plane_scale", "modelcheck_throughput",
                     "slo_probe", "incident_reconstruction",
-                    "router_qps"):
+                    "router_qps", "reshard_cutover"):
             continue
         med, bd = await bench_config(name, **failover_kw[name])
         results[name] = med
@@ -1685,6 +1861,9 @@ async def main() -> None:
     router = None
     if "router_qps" in picked:
         router = await bench_router_qps()
+    reshard = None
+    if "reshard_cutover" in picked:
+        reshard = await bench_reshard_cutover()
     scale = None
     if "control_plane_scale" in picked:
         scale = await bench_control_plane_scale()
@@ -1720,6 +1899,8 @@ async def main() -> None:
         out["incident_reconstruction"] = incident
     if router is not None:
         out["router_qps"] = router
+    if reshard is not None:
+        out["reshard_cutover"] = reshard
     if breakdown is not None:
         out["critical_path"] = breakdown
         print("critical path (%.3fs total):"

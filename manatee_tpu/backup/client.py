@@ -112,7 +112,8 @@ class RestoreClient:
 
     async def restore(self, backup_url: str, *,
                       isolate_prefix: str = "autorebuild",
-                      incremental: bool = True) -> None:
+                      incremental: bool = True,
+                      fresh_snapshot: bool = False) -> None:
         """Restore from *backup_url* (the upstream PeerInfo's
         backupUrl).  With *incremental* (the default), local epoch-ms
         snapshots are offered as candidate delta bases in the POST;
@@ -120,7 +121,14 @@ class RestoreClient:
         delta.  No common base, an old peer on either side, or ANY
         failure along the incremental path degrades to the classic
         full stream — a bad base can cost a re-transfer, never a wrong
-        dataset."""
+        dataset.
+
+        *fresh_snapshot* asks the sender to snapshot its dataset at
+        POST time before picking what to stream, so the transfer is
+        current as of the request rather than the sender's last
+        snapshotter tick — the reshard catch-up loop depends on this
+        to converge on the write rate (an old server ignores the key
+        and streams its latest existing snapshot)."""
         journal = get_journal()
         self.last_isolated = None
         bases, base_src = await self._delta_plan(incremental)
@@ -136,7 +144,8 @@ class RestoreClient:
                     try:
                         basis = await self._receive(
                             backup_url, bases=bases, base_src=base_src,
-                            isolate_prefix=isolate_prefix)
+                            isolate_prefix=isolate_prefix,
+                            fresh_snapshot=fresh_snapshot)
                     except asyncio.CancelledError:
                         raise
                     except Exception as e:
@@ -162,10 +171,12 @@ class RestoreClient:
                         journal.record("restore.delta.fallback",
                                        url=backup_url, error=str(e))
                         basis = await self._receive(
-                            backup_url, isolate_prefix=isolate_prefix)
+                            backup_url, isolate_prefix=isolate_prefix,
+                            fresh_snapshot=fresh_snapshot)
                 else:
                     basis = await self._receive(
-                        backup_url, isolate_prefix=isolate_prefix)
+                        backup_url, isolate_prefix=isolate_prefix,
+                        fresh_snapshot=fresh_snapshot)
                 sp.attrs["basis"] = basis
         except Exception as e:
             # the failed partial was cleaned by storage.recv; the
@@ -243,7 +254,8 @@ class RestoreClient:
     async def _receive(self, backup_url: str, *,
                        bases: list[str] | None = None,
                        base_src: str | None = None,
-                       isolate_prefix: str = "autorebuild") -> str:
+                       isolate_prefix: str = "autorebuild",
+                       fresh_snapshot: bool = False) -> str:
         recv_done: asyncio.Future = asyncio.get_running_loop() \
             .create_future()
         self.attempts += 1
@@ -431,6 +443,10 @@ class RestoreClient:
                              # we probe for the wire header, check
                              # stream ids, and apply delta streams
                              "streamProto": 2}
+                if fresh_snapshot:
+                    # reshard catch-ups: stream the dataset as of NOW,
+                    # not as of the sender's last snapshotter tick
+                    post_body["freshSnapshot"] = True
                 if bases:
                     # candidate common bases, newest first; an old
                     # server ignores the key and streams full

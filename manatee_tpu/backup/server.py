@@ -127,6 +127,19 @@ class BackupRestServer:
             offered = []
         proto = params.get("streamProto")
         proto = proto if isinstance(proto, int) else 0
+        if params.get("freshSnapshot") and self.storage is not None \
+                and self.dataset:
+            # reshard catch-ups: snapshot NOW so the stream (and the
+            # base negotiation below) reflect the dataset as of this
+            # request, not the last snapshotter tick.  A failed
+            # snapshot serves a staler basis, never a refused rebuild.
+            try:
+                await self.storage.snapshot(self.dataset)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("freshSnapshot failed (%s); serving the "
+                            "latest existing snapshot", e)
         base = None
         if self.storage is not None and self.dataset \
                 and proto >= 2 and params.get("bases"):

@@ -1104,6 +1104,22 @@ def cmd_top(args) -> int:
             shard = _shard(args)
             state, _v = await adm.get_state(shard)
             texts, errors = await adm.shard_metrics(shard)
+            # the RESHARD column: the durable step record of any
+            # in-flight split (reshard/plan.py) — "-" when this shard
+            # is not one of the op's owners
+            reshard = None
+            try:
+                from manatee_tpu.coord.api import NoNodeError
+                from manatee_tpu.reshard.plan import DEFAULT_RECORD_PATH
+                raw, _rv = await adm._client.get(DEFAULT_RECORD_PATH)
+                reshard = json.loads(raw.decode())
+            except NoNodeError:
+                pass
+        reshard_step = "-"
+        if reshard and "->" in str(reshard.get("op", "")):
+            src, _, tgts = reshard["op"].partition("->")
+            if shard == src or shard in tgts.split(","):
+                reshard_step = str(reshard.get("step", "?"))
         roles: dict[str, str] = {}
         if state:
             for role, plist in (("primary", [state.get("primary")]),
@@ -1193,6 +1209,7 @@ def cmd_top(args) -> int:
             print(json.dumps({"now": round(now, 3),
                               "peers": peers_out, "slis": slis,
                               "router": router,
+                              "reshard": reshard,
                               "errors": errors},
                              indent=2, sort_keys=True))
             return 0 if not errors else 1
@@ -1209,6 +1226,7 @@ def cmd_top(args) -> int:
             {"name": "pred", "label": "PRED", "width": 5},
             {"name": "loop", "label": "LOOP-P99", "width": 8},
             {"name": "stalls", "label": "STALLS", "width": 6},
+            {"name": "reshard", "label": "RESHARD", "width": 8},
         ]
         rows = []
         for p in peers_out:
@@ -1231,6 +1249,7 @@ def cmd_top(args) -> int:
                          else "%.3gs" % p["loop_p99_s"]),
                 "stalls": ("-" if p["loop_stalls"] is None
                            else "%d" % p["loop_stalls"]),
+                "reshard": reshard_step,
             })
         emit_table(cols, rows, omit_header=args.omit_header)
         if slis is not None:
@@ -1292,6 +1311,11 @@ def cmd_top(args) -> int:
                 })
             print("")
             emit_table(rcols, rrows, omit_header=args.omit_header)
+        if reshard and reshard.get("step") not in (None, "done",
+                                                   "aborted"):
+            print("\nreshard in flight: %s at step %r "
+                  "(manatee-adm shardmap / reshard --resume)"
+                  % (reshard.get("op", "?"), reshard.get("step")))
         for label, err in sorted(errors.items()):
             sys.stderr.write("warning: no metrics from %s: %s\n"
                              % (label, err))
@@ -1411,6 +1435,7 @@ def cmd_doctor(args) -> int:
         check_dirstore,
         check_history,
         check_introspection,
+        check_shard_map,
         check_skew,
         finding,
         summarize,
@@ -1462,9 +1487,39 @@ def cmd_doctor(args) -> int:
                             "no event journal reachable (%s); "
                             "generation checks ran against the "
                             "history only" % e))
-                return state, hist, events, skew
+                # the shard-map integrity surface (reshard/plan.py):
+                # map + step record + any parked boot holds
+                from manatee_tpu.coord.api import NoNodeError
+                from manatee_tpu.reshard.orchestrator import hold_path
+                from manatee_tpu.reshard.plan import (
+                    DEFAULT_MAP_PATH,
+                    DEFAULT_RECORD_PATH,
+                )
+                smap = record = None
+                holds: list[str] = []
+                try:
+                    raw, _ = await adm._client.get(DEFAULT_MAP_PATH)
+                    smap = json.loads(raw.decode())
+                except NoNodeError:
+                    pass
+                try:
+                    raw, _ = await adm._client.get(DEFAULT_RECORD_PATH)
+                    record = json.loads(raw.decode())
+                except NoNodeError:
+                    pass
+                paths = {r.get("shardPath")
+                         for r in (smap or {}).get("ranges") or []
+                         if isinstance(r, dict)}
+                if record and isinstance(record.get("plan"), dict):
+                    paths.add(record["plan"].get("targetPath"))
+                for sp in sorted(p for p in paths if p):
+                    hp = hold_path(sp)
+                    if await adm._client.exists(hp) is not None:
+                        holds.append(hp)
+                return state, hist, events, skew, smap, record, holds
         try:
-            state, hist, events, skew = asyncio.run(go())
+            state, hist, events, skew, smap, record, holds = \
+                asyncio.run(go())
         except KeyboardInterrupt:
             raise
         except Exception as e:
@@ -1479,6 +1534,7 @@ def cmd_doctor(args) -> int:
             findings.extend(check_cluster(state, hist, events))
             findings.extend(check_introspection(events))
             findings.extend(check_skew(skew))
+            findings.extend(check_shard_map(smap, record, holds))
     elif not (args.coord_data or store_roots or args.history_dir
               or findings):
         # findings counts: a zfs-backend -c config produced a
@@ -1795,6 +1851,135 @@ def cmd_rebuild(args) -> int:
     return asyncio.run(go())
 
 
+def _reshard_cfg(args, shard: str) -> dict:
+    """The Resharder config from the CLI surface (docs/resharding.md,
+    docs/man/manatee-adm-reshard.md)."""
+    cfg: dict = {
+        "source": shard,
+        "mapPath": args.map_path,
+        "recordPath": args.record_path,
+        "cutoverBudget": args.cutover_budget,
+        "maxRounds": args.max_rounds,
+        "freezeGrace": args.freeze_grace,
+        "flipTimeout": args.flip_timeout,
+        "routers": [u.rstrip("/") for u in (args.router or [])],
+    }
+    if args.into:
+        cfg["into"] = [s.strip() for s in args.into.split(",")
+                       if s.strip()]
+    if args.at:
+        cfg["splitKey"] = args.at
+    tc = args.target_config \
+        or os.environ.get("MANATEE_RESHARD_TARGET_CONFIG")
+    if tc:
+        from manatee_tpu.utils.validation import load_json_config
+        cfg["target"] = load_json_config(tc, None,
+                                         name="target shard config")
+    return cfg
+
+
+def cmd_reshard(args) -> int:
+    """Automated live resharding (docs/resharding.md): split one
+    shard's key range in place with a prober-measured cutover window.
+    A fresh run needs --into a,b (one of them the source) and
+    --target-config (the target shard's first sitter config — it
+    names the shardPath the split hands the high half to, and the
+    dataset the seed restores into).  --resume continues a crashed
+    run from its durable step record; --abort rolls a pre-flip run
+    back (map restored, seeded target dataset destroyed)."""
+    from manatee_tpu.reshard.orchestrator import Resharder, ReshardError
+
+    if sum(map(bool, (args.resume, args.abort, bool(args.into)))) > 1:
+        die("choose one of --into a,b / --resume / --abort")
+    if not (args.resume or args.abort or args.into):
+        die("a fresh reshard needs --into a,b "
+            "(or --resume / --abort an existing one)")
+    shard = _shard(args)
+
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            cfg = _reshard_cfg(args, shard)
+            cfg.setdefault("sourcePath", adm._shard_path(shard))
+            r = Resharder(adm._client, cfg)
+            if args.abort:
+                rec = await r.abort()
+            elif args.resume:
+                rec = await r.resume()
+            else:
+                rec = await r.run()
+        step = rec.get("step")
+        stats = rec.get("stats") or {}
+        print("reshard %s: %s%s"
+              % (rec.get("op", "?"), step,
+                 (" (%d bytes moved over %d round(s))"
+                  % (stats["bytesMoved"], stats["rounds"])
+                  if step == "done" and stats else "")))
+        return 0
+
+    if not (args.yes or args.resume):
+        verb = "abort (and DESTROY the seeded target dataset of)" \
+            if args.abort else "live-reshard"
+        print("This will %s shard %s." % (verb, shard))
+        confirm_or_die("Are you sure you want to proceed? (yes/no): ")
+    try:
+        return asyncio.run(go())
+    except ReshardError as e:
+        die(str(e), 1)
+
+
+def cmd_shardmap(args) -> int:
+    """The shard map (reshard/plan.py): `shardmap init` bootstraps
+    the single-range map (the named shard owns the whole key space);
+    `shardmap show` prints the ranges plus any in-flight reshard's
+    step record."""
+    from manatee_tpu.reshard.plan import (
+        ShardMapError,
+        ShardMapStore,
+    )
+
+    async def go():
+        async with AdmClient(_coord(args)) as adm:
+            store = ShardMapStore(adm._client,
+                                  map_path=args.map_path,
+                                  record_path=args.record_path)
+            if args.action == "init":
+                shard = _shard(args)
+                m = await store.init(shard, adm._shard_path(shard))
+                ver = 0
+                rec = None
+            else:
+                m, ver = await store.load()
+                rec, _rv = await store.load_record()
+        if args.json:
+            print(json.dumps({"map": m, "version": ver,
+                              "record": rec},
+                             indent=2, sort_keys=True))
+            return 0
+        cols = [
+            {"name": "lo", "label": "LO", "width": 12},
+            {"name": "hi", "label": "HI", "width": 12},
+            {"name": "shard", "label": "SHARD", "width": 16},
+            {"name": "state", "label": "STATE", "width": 8},
+            {"name": "path", "label": "PATH", "width": 24},
+        ]
+        rows = [{"lo": r["lo"] or "-inf",
+                 "hi": "+inf" if r.get("hi") is None else r["hi"],
+                 "shard": r["shard"], "state": r["state"],
+                 "path": r["shardPath"]} for r in m["ranges"]]
+        print("epoch %d (version %d)" % (m["epoch"], ver))
+        emit_table(cols, rows, omit_header=args.omit_header)
+        if rec is not None and rec.get("step") != "done":
+            print("reshard in flight: %s at step %r (resume/abort "
+                  "with `manatee-adm reshard`)"
+                  % (rec.get("op", "?"), rec.get("step")))
+        return 0
+
+    try:
+        return asyncio.run(go())
+    except ShardMapError as e:
+        die(str(e), 1)
+
+
 # ---- argument parsing ----
 
 def build_parser() -> argparse.ArgumentParser:
@@ -2084,6 +2269,64 @@ def build_parser() -> argparse.ArgumentParser:
                          "the dataset under a name the restore plane "
                          "never offers as a delta base, forcing the "
                          "classic full stream")
+
+    from manatee_tpu.reshard.plan import (
+        DEFAULT_MAP_PATH,
+        DEFAULT_RECORD_PATH,
+    )
+
+    sp = add("reshard", cmd_reshard,
+             "split this shard's key range live "
+             "(docs/resharding.md)")
+    sp.add_argument("--into", metavar="A,B", default=None,
+                    help="the two owners after the split; one must be "
+                         "the source shard (it keeps the low half)")
+    sp.add_argument("--at", metavar="KEY", default=None,
+                    help="split key (default: median of the source's "
+                         "sampled keys)")
+    sp.add_argument("--target-config", default=None, metavar="FILE",
+                    dest="target_config",
+                    help="the target shard's first sitter config "
+                         "(env: MANATEE_RESHARD_TARGET_CONFIG); names "
+                         "the shardPath and the dataset the seed "
+                         "restores into")
+    sp.add_argument("--router", action="append", default=None,
+                    metavar="URL",
+                    help="router status base URL to confirm the "
+                         "write drain against (repeatable)")
+    sp.add_argument("--map-path", default=DEFAULT_MAP_PATH,
+                    dest="map_path")
+    sp.add_argument("--record-path", default=DEFAULT_RECORD_PATH,
+                    dest="record_path")
+    sp.add_argument("--cutover-budget", type=float, default=5.0,
+                    dest="cutover_budget", metavar="SECONDS",
+                    help="freeze writes only once a catch-up round "
+                         "fits this window (default 5s)")
+    sp.add_argument("--max-rounds", type=int, default=8,
+                    dest="max_rounds")
+    sp.add_argument("--freeze-grace", type=float, default=1.0,
+                    dest="freeze_grace")
+    sp.add_argument("--flip-timeout", type=float, default=120.0,
+                    dest="flip_timeout")
+    sp.add_argument("--resume", action="store_true",
+                    help="continue a crashed reshard from its durable "
+                         "step record")
+    sp.add_argument("--abort", action="store_true",
+                    help="roll a pre-flip reshard back (map restored, "
+                         "seeded target dataset destroyed)")
+    sp.add_argument("-y", "--yes", action="store_true")
+
+    sp = add("shardmap", cmd_shardmap,
+             "inspect or bootstrap the key-range shard map")
+    sp.add_argument("action", choices=("show", "init"), nargs="?",
+                    default="show")
+    sp.add_argument("--map-path", default=DEFAULT_MAP_PATH,
+                    dest="map_path")
+    sp.add_argument("--record-path", default=DEFAULT_RECORD_PATH,
+                    dest="record_path")
+    sp.add_argument("-j", "--json", action="store_true")
+    sp.add_argument("-H", "--omit-header", action="store_true",
+                    dest="omit_header")
 
     return p
 

@@ -51,6 +51,17 @@ mirrors the sitter: top-level keys are the shared base, each
 loop per shard over ONE coordination connection and ONE engine per
 database flavor.
 
+Shard-map mode (``shardMapPath`` instead of ``shardPath``/``shards``)
+follows the resharder's versioned shard map the way the router does:
+per-shard probe loops are reconciled from the watched map record — a
+shard that appears at a ``manatee-adm reshard`` flip gets its probe
+loop WITHOUT a restart — and, when ``probeVia`` points at a map-mode
+router, a keyed probe loop cycles synthetic writes across the
+keyspace and read-your-writes each key back through the router.  That
+keyed loop's ``prober_error_window_seconds`` is the reshard drill's
+headline number: the write outage a routed client actually saw across
+the cutover.
+
 The probe seams carry the ``prober.write`` and ``prober.read``
 failpoints (armable over this daemon's own ``/faults``): an ``error``
 counts a bad SLI event without touching the cluster — the way the
@@ -86,6 +97,7 @@ from manatee_tpu.obs.history import DEFAULT_INTERVAL as HISTORY_INTERVAL
 from manatee_tpu.obs.history import HistoryRecorder, init_history
 from manatee_tpu.obs.slo import init_slo_engine, parse_slo_configs
 from manatee_tpu.pg.engine import PgError, parse_pg_url
+from manatee_tpu.utils.aio import cancel_and_wait
 from manatee_tpu.utils.validation import ConfigError
 
 log = logging.getLogger("manatee.prober")
@@ -135,12 +147,29 @@ _LAST_ERR_WINDOW = _REG.gauge(
     "most recent closed error window per shard",
     ("shard",))
 
+
+# the per-shard and keyed via-router probes are the SAME seams, so
+# they share each failpoint through one call site (one seam, one name)
+async def _write_fault() -> str | None:
+    return await faults.point("prober.write")
+
+
+async def _read_fault() -> str | None:
+    return await faults.point("prober.read")
+
 PROBER_SCHEMA = {
     "type": "object",
-    "required": ["shardPath", "coordCfg"],
+    "required": ["coordCfg"],
+    # probe either ONE shard (shardPath) or a whole keyspace
+    # (shardMapPath, the resharder's map record)
+    "anyOf": [
+        {"required": ["shardPath"]},
+        {"required": ["shardMapPath"]},
+    ],
     "properties": {
         "name": {"type": "string"},
         "shardPath": {"type": "string"},
+        "shardMapPath": {"type": "string"},
         "statusPort": {"type": "integer"},
         "statusHost": {"type": "string"},
         "probeInterval": {"type": "number", "exclusiveMinimum": 0},
@@ -309,11 +338,9 @@ class ShardProber:
 
     async def stop(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # re-issuing cancel: one cancel can be swallowed by the
+            # wait_for race under the probe queries (utils/aio.py)
+            await cancel_and_wait(self._task)
             self._task = None
         if self._handle is not None:
             try:
@@ -412,7 +439,7 @@ class ShardProber:
         t0 = time.monotonic()
         err = None
         try:
-            await faults.point("prober.write")
+            await _write_fault()
             if self._via_rep is not None:
                 # routed: the router owns primary discovery (and
                 # parks the write across a failover instead of
@@ -459,7 +486,7 @@ class ShardProber:
     async def _probe_read(self, rep: dict) -> None:
         peer = rep.get("id") or rep["pgUrl"]
         try:
-            await faults.point("prober.read")
+            await _read_fault()
             res = await self._engines.query(
                 rep["pgUrl"], {"op": "select"}, self.timeout)
         except asyncio.CancelledError:
@@ -561,6 +588,288 @@ class ShardProber:
         await merge_remote(body.get("hlc"))
 
 
+class ShardMapProber:
+    """Map mode: a probe plane that follows the shard map.
+
+    Two jobs, both reconciled from the same watched map record the
+    router compiles routes from (manatee_tpu/reshard/plan.py):
+
+    - **follow-the-split**: one direct :class:`ShardProber` per shard
+      the map names, started/stopped as ranges change hands — the
+      shard a reshard flip creates starts getting measured the moment
+      the map says it serves;
+    - **the keyed via-router loop** (``probeVia``): synthetic writes
+      whose values carry a ``key`` cycling across the keyspace, each
+      read-your-write'd back through the router by the same key.  The
+      router sniffs the key and routes per the map, so this loop
+      measures what a keyed client sees through a cutover — its
+      ``prober_error_window_seconds{shard=<map name>}`` is the
+      reshard acceptance number.
+    """
+
+    def __init__(self, cfg: dict, engines: EngineCache, slo_engine, *,
+                 http_get=None):
+        self.name = str(cfg.get("name") or "map")
+        self.map_path = cfg["shardMapPath"]
+        self.interval = float(cfg.get("probeInterval",
+                                      DEFAULT_PROBE_INTERVAL))
+        self.via = cfg.get("probeVia")
+        self.timeout = float(cfg["probeTimeout"]) \
+            if cfg.get("probeTimeout") else \
+            min(PROBE_TIMEOUT, max(0.5, self.interval * 5.0))
+        coord = cfg["coordCfg"]
+        self._connstr = coord.get("connStr") or \
+            "%s:%d" % (coord["host"], int(coord["port"]))
+        self._session_timeout = float(coord.get("sessionTimeout", 60.0))
+        grace = coord.get("disconnectGrace")
+        self._disconnect_grace = None if grace is None else float(grace)
+        self._engines = engines
+        self._slo = slo_engine
+        self._http_get = http_get
+        # per-shard child config base: identity and map/via keys out
+        # (children probe their shard DIRECT; the via loop is ours)
+        self._child_base = {
+            k: v for k, v in cfg.items()
+            if k not in ("shardMapPath", "shardPath", "name",
+                         "probeVia", "statusPort", "statusHost",
+                         "slos", "historyDir", "historyInterval",
+                         "faults", "faultsEnabled")}
+        self._children: dict[str, ShardProber] = {}
+        self._handle = None
+        self._dirty = True
+        self._wake = asyncio.Event()
+        self._wake.set()
+        self._epoch = 0
+        self._map_task: asyncio.Task | None = None
+        self._via_task: asyncio.Task | None = None
+        # keyed via-loop state: last acked (seq, wall ts) per key
+        self._wseq = 0
+        self._acked_by_key: dict[str, tuple[int, float]] = {}
+        self._err_start: float | None = None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if self._map_task is None:
+            self._map_task = asyncio.create_task(self._map_loop())
+        if self.via and self._via_task is None:
+            self._via_task = asyncio.create_task(self._via_loop())
+
+    async def stop(self) -> None:
+        for task in (self._map_task, self._via_task):
+            await cancel_and_wait(task)
+        self._map_task = self._via_task = None
+        for child in self._children.values():
+            await child.stop()
+        self._children.clear()
+        if self._handle is not None:
+            try:
+                await self._handle.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self._handle = None
+
+    # -- the map watch (the router's pattern) --
+
+    def _on_change(self, _ev) -> None:
+        self._dirty = True
+        self._wake.set()
+
+    async def _map_loop(self) -> None:
+        while True:
+            with_timeout = asyncio.wait_for(self._wake.wait(), 1.0)
+            try:
+                await with_timeout
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if not self._dirty:
+                continue
+            try:
+                await self._refresh_map()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("shard-map refresh failed: %s", e)
+                await asyncio.sleep(0.2)
+
+    async def _refresh_map(self) -> None:
+        if self._handle is None:
+            self._handle = await mux_handle(
+                self._connstr,
+                session_timeout=self._session_timeout,
+                disconnect_grace=self._disconnect_grace,
+                name="prober:%s" % self.name)
+            self._handle.on_session_event(self._on_change)
+        try:
+            data, _ver = await self._handle.get(
+                self.map_path, watch=self._on_change)
+        except NoNodeError:
+            self._dirty = True      # keep polling for the map
+            return
+        except CoordError:
+            try:
+                await self._handle.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self._handle = None
+            self._dirty = True
+            raise
+        self._dirty = False
+        await self.apply_map(json.loads(data.decode()))
+
+    async def apply_map(self, m: dict) -> None:
+        """Reconcile the per-shard probe loops against the shards the
+        map names (the watch's landing point, and the test seam).  An
+        invalid map keeps the current loops running."""
+        from manatee_tpu.reshard.plan import validate_map
+        try:
+            validate_map(m)
+        except Exception as e:
+            log.warning("refusing invalid shard map: %s", e)
+            return
+        want = {r["shard"]: r["shardPath"] for r in m["ranges"]}
+        for name in [n for n in self._children if n not in want]:
+            old = self._children.pop(name)
+            await old.stop()
+        started = []
+        for name, path in want.items():
+            child = self._children.get(name)
+            if child is not None and child.path != path:
+                await child.stop()
+                del self._children[name]
+                child = None
+            if child is None:
+                ccfg = dict(self._child_base)
+                ccfg["name"] = name
+                ccfg["shardPath"] = path
+                child = ShardProber(ccfg, self._engines, self._slo,
+                                    http_get=self._http_get)
+                child.start()
+                self._children[name] = child
+                started.append(name)
+        old_epoch = self._epoch
+        self._epoch = int(m.get("epoch", 0))
+        if self._epoch != old_epoch or started:
+            get_journal().record(
+                "prober.map_change", epoch=self._epoch,
+                shards=sorted(want), started=sorted(started))
+
+    # -- the keyed via-router loop --
+
+    @staticmethod
+    def probe_key(seq: int) -> str:
+        """The key cycle: 256 keys spread over [k00, kff] so a split
+        at any interior point keeps traffic landing on BOTH sides of
+        the cut (37 is coprime to 256 — every key is visited)."""
+        return "k%02x" % ((seq * 37) % 256)
+
+    async def _via_loop(self) -> None:
+        while True:
+            t0 = time.monotonic()
+            try:
+                await self._via_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("via probe tick failed on %s", self.name)
+            elapsed = time.monotonic() - t0
+            await asyncio.sleep(max(0.0, self.interval - elapsed))
+
+    async def _via_tick(self) -> None:
+        self._wseq += 1
+        seq = self._wseq
+        key = self.probe_key(seq)
+        ts = time.time()
+        value = {"probe": self.name, "seq": seq,
+                 "ts": round(ts, 6), "key": key}
+        t0 = time.monotonic()
+        err = None
+        try:
+            await _write_fault()
+            await self._engines.query(
+                self.via, {"op": "insert", "value": value},
+                self.timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            err = e
+        now = time.monotonic()
+        if err is None:
+            _WRITES.inc(shard=self.name, result="ok")
+            _WRITE_ACK.observe(now - t0, shard=self.name)
+            self._slo.record("write_availability", good=True,
+                             shard=self.name)
+            self._acked_by_key[key] = (seq, ts)
+            if self._err_start is not None:
+                window = now - self._err_start
+                self._err_start = None
+                _ERR_WINDOW.observe(window, shard=self.name)
+                _LAST_ERR_WINDOW.set(window, shard=self.name)
+                get_journal().record("prober.error_window",
+                                     shard=self.name,
+                                     seconds=round(window, 3))
+        else:
+            log.debug("keyed write probe failed on %s: %s",
+                      self.name, err)
+            _WRITES.inc(shard=self.name, result="error")
+            self._slo.record("write_availability", good=False,
+                             shard=self.name)
+            if self._err_start is None:
+                self._err_start = t0
+        await self._via_read(key)
+
+    async def _via_read(self, key: str) -> None:
+        """Keyed read-your-write THROUGH the router: the key in the
+        select line steers the router to whichever shard owns it now,
+        where our last acked write for that key must be visible."""
+        try:
+            await _read_fault()
+            res = await self._engines.query(
+                self.via,
+                {"op": "select", "key": key, "limit": ACKED_RING},
+                self.timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("keyed read probe failed on %s/%s: %s",
+                      self.name, key, e)
+            _READS.inc(shard=self.name, peer="router", result="error")
+            self._slo.record("read_staleness", good=False,
+                             shard=self.name)
+            return
+        acked = self._acked_by_key.get(key)
+        if acked is None:
+            _READS.inc(shard=self.name, peer="router", result="ok")
+            return
+        seen = 0
+        for v in reversed(res.get("rows") or []):
+            if isinstance(v, dict) and v.get("probe") == self.name \
+                    and v.get("key") == key:
+                seen = int(v.get("seq") or 0)
+                break
+        good = seen >= acked[0]
+        staleness = 0.0 if good else max(0.0, time.time() - acked[1])
+        _READ_STALENESS.set(round(staleness, 6),
+                            shard=self.name, peer="router")
+        _READS.inc(shard=self.name, peer="router",
+                   result="ok" if good else "stale")
+        self._slo.record("read_staleness", good=good, shard=self.name)
+
+    def describe_map(self) -> dict:
+        return {
+            "epoch": self._epoch,
+            "path": self.map_path,
+            "via": self.via,
+            "shards": sorted(self._children),
+            "error_window_open": self._err_start is not None,
+        }
+
+
 _LAG_RE = re.compile(
     r'^manatee_replication_lag_seconds\{[^}]*\}\s+([0-9.eE+-]+)\s*$',
     re.M)
@@ -605,10 +914,12 @@ async def _http_get_text(url: str, timeout: float = 2.0) -> str:
 
 class ProberServer:
     def __init__(self, probers: list[ShardProber], *,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 map_prober: ShardMapProber | None = None):
         from aiohttp import web
         self._web = web
         self.probers = probers
+        self.map_prober = map_prober
         self.host = host
         self.port = port
         self._runner = None
@@ -641,7 +952,11 @@ class ProberServer:
         """Per-shard instantaneous SLIs — what `manatee-adm top`
         renders alongside the budget table."""
         out = []
-        for p in self.probers:
+        probers = list(self.probers)
+        if self.map_prober is not None:
+            # map mode: the reconciled children are the shard list
+            probers += list(self.map_prober._children.values())
+        for p in probers:
             out.append({
                 "shard": p.name,
                 "primary": (self._primary_id(p)),
@@ -661,8 +976,17 @@ class ProberServer:
                     shard=p.name) or None,
                 "error_window_open": p._err_start is not None,
             })
-        return self._web.json_response({
-            "now": round(time.time(), 3), "shards": out})
+        body = {"now": round(time.time(), 3), "shards": out}
+        if self.map_prober is not None:
+            mp = self.map_prober
+            body["map"] = dict(
+                mp.describe_map(),
+                writes_ok=_WRITES.value(shard=mp.name, result="ok"),
+                writes_error=_WRITES.value(shard=mp.name,
+                                           result="error"),
+                last_error_window_s=_LAST_ERR_WINDOW.value(
+                    shard=mp.name) or None)
+        return self._web.json_response(body)
 
     @staticmethod
     def _primary_id(p: ShardProber):
@@ -672,7 +996,9 @@ class ProberServer:
 # ---- daemon wiring ----
 
 async def start_prober(cfg: dict):
-    shard_cfgs = prober_shard_configs(cfg)
+    map_mode = bool(cfg.get("shardMapPath")) \
+        and not cfg.get("shards") and not cfg.get("shardPath")
+    shard_cfgs = [] if map_mode else prober_shard_configs(cfg)
     host = cfg.get("statusHost", "0.0.0.0")
     port = int(cfg.get("statusPort", 0))
     set_peer("prober:%d" % port if port else "prober")
@@ -694,11 +1020,20 @@ async def start_prober(cfg: dict):
     engines = EngineCache()
     probers = [ShardProber(c, engines, slo_engine)
                for c in shard_cfgs]
+    map_prober = ShardMapProber(cfg, engines, slo_engine) \
+        if map_mode else None
     intro = start_daemon_introspection(cfg)
-    server = ProberServer(probers, host=host, port=port)
+    server = ProberServer(probers, host=host, port=port,
+                          map_prober=map_prober)
     await server.start()
     for p in probers:
         p.start()
+    if map_prober is not None:
+        map_prober.start()
+        log.info("prober following shard map %s%s",
+                 cfg["shardMapPath"],
+                 " via %s" % cfg["probeVia"]
+                 if cfg.get("probeVia") else "")
 
     async def eval_loop():
         # journal alert transitions promptly even when nobody scrapes
@@ -718,6 +1053,8 @@ async def start_prober(cfg: dict):
             pass
         for p in probers:
             await p.stop()
+        if map_prober is not None:
+            await map_prober.stop()
         if recorder is not None:
             await recorder.stop()
         await engines.aclose()

@@ -63,6 +63,20 @@ prober: top-level keys are the shared base, each ``shards`` entry
 ({name, shardPath, listenPort}) overrides per shard, one listener per
 shard over ONE coordination connection.
 
+Shard-map mode (``shardMapPath`` instead of ``shardPath``/``shards``)
+fronts a *keyspace* instead of a fixed shard list: the router watches
+the versioned shard-map record the resharder maintains
+(manatee_tpu/reshard/plan.py), sniffs the ``"key"`` field off each
+request line the same zero-parse way it sniffs the verb, and routes to
+whichever shard's range owns the key.  Child per-shard routers are
+reconciled from the map on every watch fire — a range that changes
+hands mid-flight (``manatee-adm reshard``) re-routes WITHOUT a restart,
+and a range marked ``frozen`` parks writes at the map layer until the
+flip lands, exactly the failover park but keyed to the cutover.  The
+``/status`` ``map`` section (epoch + per-shard ``inflight_writes``)
+is the drain barrier the resharder polls before shipping the final
+delta.
+
 The traffic seams carry the ``router.accept``, ``router.relay`` and
 ``router.park`` failpoints (armable over this daemon's own
 ``/faults``); the crash-recovery sweep kills the router mid-relay and
@@ -88,6 +102,7 @@ from manatee_tpu.daemons.common import (
 )
 from manatee_tpu.obs import get_journal, get_registry, set_peer, span
 from manatee_tpu.pg.engine import parse_pg_url
+from manatee_tpu.utils.aio import cancel_and_wait
 from manatee_tpu.utils.validation import ConfigError
 
 log = logging.getLogger("manatee.router")
@@ -148,11 +163,24 @@ _ROUTER_LAG = _REG.gauge(
     "replication lag the router last learned for each replica "
     "(scraped from the peer's sitter, prober-style)",
     ("shard", "peer"))
+_MAP_EPOCH = _REG.gauge(
+    "router_map_epoch",
+    "shard-map epoch this router last compiled routes from "
+    "(map mode only; lags the coord record by one watch fire)")
+_MAP_CHANGES = _REG.counter(
+    "router_map_changes_total",
+    "shard-map recompilations (one per watched map change, which is "
+    "one per reshard step that edits the map — NEVER per request)")
 
 # the verb sniff: one compiled regex over the raw request line — the
 # engine's json.dumps puts the "op" key first, so the first match IS
 # the op (no JSON parse on the relay path)
 _OP_RE = re.compile(rb'"op"\s*:\s*"([A-Za-z_]+)"')
+# map mode's routing key, sniffed the same zero-parse way: the first
+# "key" field in the request line (inserts carry it in the value,
+# keyed reads carry it top-level; a line without one routes to the
+# map's first range)
+_KEY_RE = re.compile(rb'"key"\s*:\s*"([^"\\]*)"')
 _READ_VERBS = ("select", "health")
 # simpg's reply when an insert lands on a standby (or a primary still
 # in catchup): the signal that the state's primary is not yet
@@ -166,12 +194,29 @@ _ERR_PARK_BUDGET = (b'{"ok": false, "error": "router: no writable '
 ROUTE_ERRORS = (OSError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError)
 
+
+# the per-shard and map-level front doors are the SAME seams, so they
+# share each failpoint through one call site (one seam, one name)
+async def _accept_fault() -> str | None:
+    return await faults.point("router.accept")
+
+
+async def _park_fault() -> str | None:
+    return await faults.point("router.park")
+
 ROUTER_SCHEMA = {
     "type": "object",
-    "required": ["shardPath", "listenPort", "coordCfg"],
+    "required": ["listenPort", "coordCfg"],
+    # one listener fronts either ONE shard (shardPath) or a whole
+    # keyspace (shardMapPath, the resharder's map record)
+    "anyOf": [
+        {"required": ["shardPath"]},
+        {"required": ["shardMapPath"]},
+    ],
     "properties": {
         "name": {"type": "string"},
         "shardPath": {"type": "string"},
+        "shardMapPath": {"type": "string"},
         "listenPort": {"type": "integer"},
         "listenHost": {"type": "string"},
         "statusPort": {"type": "integer"},
@@ -380,27 +425,32 @@ class ShardRouter:
 
     # -- lifecycle --
 
-    async def start(self, *, topology: bool = True) -> None:
+    async def start(self, *, topology: bool = True,
+                    listen: bool = True) -> None:
         """Bind the listener; with *topology* (the daemon path) also
         start the state watch and lag loops.  Tests drive the table
-        directly via :meth:`apply_state` with ``topology=False``."""
-        self._server = await asyncio.start_server(
-            self._serve_client, self.listen_host, self.listen_port)
-        if self.listen_port == 0:
-            self.listen_port = \
-                self._server.sockets[0].getsockname()[1]
+        directly via :meth:`apply_state` with ``topology=False``.
+        Map mode runs children with ``listen=False`` — the map router
+        owns the one socket and hands lines straight to
+        :meth:`_route_one`."""
+        if listen:
+            self._server = await asyncio.start_server(
+                self._serve_client, self.listen_host, self.listen_port)
+            if self.listen_port == 0:
+                self.listen_port = \
+                    self._server.sockets[0].getsockname()[1]
         if topology:
             self._topo_task = asyncio.create_task(self._topo_loop())
             self._lag_task = asyncio.create_task(self._lag_loop())
-        log.info("router %s listening on %s:%d", self.name,
-                 self.listen_host, self.listen_port)
+        if listen:
+            log.info("router %s listening on %s:%d", self.name,
+                     self.listen_host, self.listen_port)
 
     async def stop(self) -> None:
         for task in (self._topo_task, self._lag_task):
-            if task is not None:
-                task.cancel()
-                with contextlib.suppress(asyncio.CancelledError):
-                    await task
+            # re-issuing cancel: one cancel can be swallowed by the
+            # wait_for race under the relay/scrape awaits (utils/aio)
+            await cancel_and_wait(task)
         self._topo_task = self._lag_task = None
         if self._server is not None:
             self._server.close()
@@ -575,7 +625,7 @@ class ShardRouter:
     async def _serve_client(self, reader, writer) -> None:
         _CONNS.inc(shard=self.name)
         try:
-            if await faults.point("router.accept") == "drop":
+            if await _accept_fault() == "drop":
                 return
             while True:
                 line = await reader.readline()
@@ -663,7 +713,7 @@ class ShardRouter:
                             self._close_park(t0, verb, replayed=True)
                         return reply
             if t0 is None:
-                await faults.point("router.park")
+                await _park_fault()
                 t0 = time.monotonic()
                 _PARKED.inc(shard=self.name)
             if time.monotonic() - t0 >= self.park_timeout:
@@ -743,6 +793,320 @@ async def _http_get_text(url: str, timeout: float = 2.0) -> str:
             return await resp.text()
 
 
+# ---- shard-map mode (manatee-adm reshard's data-plane half) ----
+
+class ShardMapRouter:
+    """One listener fronting a keyspace: routes each request line to
+    the shard whose map range owns the sniffed key, against the
+    versioned shard-map record the resharder maintains.
+
+    The map is compiled exactly like a shard's route table — once per
+    watch fire, never per request (:meth:`apply_map` is the landing
+    point and the test seam).  Child :class:`ShardRouter` instances
+    (one per shard the map names, listener-less) do the actual
+    relaying, so parking, pooling, staleness bounds and lag scrapes
+    all carry over unchanged; children ride the same mux'd
+    coordination session as the map watch.
+
+    The reshard cutover contract lives here:
+
+    - a range in state ``frozen`` parks WRITES at the map layer (the
+      child never sees them) until a map change re-homes the range —
+      the same drain-and-replay a failover gets, bounded by the same
+      ``parkTimeout``;
+    - ``inflight_writes`` counts writes between owner lookup and
+      relay completion, bumped in the same event-loop tick as the
+      lookup, so once the resharder sees the frozen epoch compiled
+      AND the count at zero, no write can still be bound for the old
+      owner (the drain barrier `_drain_routers` polls);
+    - reads keep flowing to a frozen range — the source stays
+      readable through the cutover window.
+    """
+
+    def __init__(self, cfg: dict, *, http_get=None):
+        self.name = str(cfg.get("name") or "map")
+        self.map_path = cfg["shardMapPath"]
+        self.listen_host = cfg.get("listenHost", "0.0.0.0")
+        self.listen_port = int(cfg["listenPort"])
+        self.park_timeout = float(cfg.get("parkTimeout",
+                                          DEFAULT_PARK_TIMEOUT))
+        coord = cfg.get("coordCfg") or {}
+        self._connstr = coord.get("connStr") or \
+            ("%s:%d" % (coord["host"], int(coord["port"]))
+             if coord else "")
+        self._session_timeout = float(coord.get("sessionTimeout", 60.0))
+        grace = coord.get("disconnectGrace")
+        self._disconnect_grace = None if grace is None else float(grace)
+        self._http_get = http_get
+        # per-shard child config base: everything but the map/listen
+        # identity (children are listener-less, port 0 placates the
+        # schema-shaped ctor)
+        self._child_base = {
+            k: v for k, v in cfg.items()
+            if k not in ("shardMapPath", "shardPath", "name",
+                         "listenPort", "statusPort", "statusHost",
+                         "faults", "faultsEnabled")}
+        self._child_base["listenPort"] = 0
+        self._handle = None
+        self._dirty = True
+        self._wake = asyncio.Event()
+        self._wake.set()
+        self._map_change = asyncio.Event()
+        self._epoch = 0
+        self._ranges: tuple[dict, ...] = ()
+        self._children: dict[str, ShardRouter] = {}
+        self._inflight: dict[str, int] = {}
+        self._server = None
+        self._map_task: asyncio.Task | None = None
+
+    # -- lifecycle --
+
+    async def start(self, *, topology: bool = True) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_client, self.listen_host, self.listen_port)
+        if self.listen_port == 0:
+            self.listen_port = \
+                self._server.sockets[0].getsockname()[1]
+        if topology:
+            self._map_task = asyncio.create_task(self._map_loop())
+        log.info("map router listening on %s:%d (map %s)",
+                 self.listen_host, self.listen_port, self.map_path)
+
+    async def stop(self) -> None:
+        if self._map_task is not None:
+            await cancel_and_wait(self._map_task)
+            self._map_task = None
+        if self._server is not None:
+            self._server.close()
+            with contextlib.suppress(Exception):
+                await self._server.wait_closed()
+            self._server = None
+        for child in self._children.values():
+            await child.stop()
+        self._children.clear()
+        if self._handle is not None:
+            with contextlib.suppress(Exception):
+                await self._handle.close()
+            self._handle = None
+
+    # -- the map watch --
+
+    def _on_change(self, _ev) -> None:
+        self._dirty = True
+        self._wake.set()
+
+    async def _map_loop(self) -> None:
+        while True:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._wake.wait(), 1.0)
+            self._wake.clear()
+            if not self._dirty:
+                continue
+            try:
+                await self._refresh_map()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("shard-map refresh failed: %s", e)
+                await asyncio.sleep(0.2)
+
+    async def _refresh_map(self) -> None:
+        if self._handle is None:
+            self._handle = await mux_handle(
+                self._connstr,
+                session_timeout=self._session_timeout,
+                disconnect_grace=self._disconnect_grace,
+                name="router:%s" % self.name)
+            self._handle.on_session_event(self._on_change)
+        try:
+            data, _ver = await self._handle.get(
+                self.map_path, watch=self._on_change)
+        except NoNodeError:
+            # map not initialized yet (or torn down): keep the last
+            # compiled routes and keep polling for it to appear
+            self._dirty = True
+            return
+        except CoordError:
+            with contextlib.suppress(Exception):
+                await self._handle.close()
+            self._handle = None
+            self._dirty = True
+            raise
+        self._dirty = False
+        await self.apply_map(json.loads(data.decode()))
+
+    async def apply_map(self, m: dict) -> None:
+        """Fold one shard map into the route state (the watch's
+        landing point, and the test seam): validate, reconcile the
+        child-router set against the shards the map names, publish the
+        new ranges, wake every parked writer.  An invalid map keeps
+        the last good routes — a half-written record must degrade to
+        staleness, never to misrouting."""
+        from manatee_tpu.reshard.plan import validate_map
+        try:
+            validate_map(m)
+        except Exception as e:
+            log.warning("refusing invalid shard map: %s", e)
+            return
+        want = {r["shard"]: r["shardPath"] for r in m["ranges"]}
+        for name in [n for n in self._children if n not in want]:
+            old = self._children.pop(name)
+            self._inflight.pop(name, None)
+            await old.stop()
+        for name, path in want.items():
+            child = self._children.get(name)
+            if child is not None and child.path != path:
+                await child.stop()
+                del self._children[name]
+                child = None
+            if child is None:
+                ccfg = dict(self._child_base)
+                ccfg["name"] = name
+                ccfg["shardPath"] = path
+                child = ShardRouter(ccfg, http_get=self._http_get)
+                await child.start(topology=True, listen=False)
+                self._children[name] = child
+        old_epoch = self._epoch
+        self._ranges = tuple(dict(r) for r in m["ranges"])
+        self._epoch = int(m.get("epoch", 0))
+        _MAP_EPOCH.set(self._epoch)
+        if self._epoch != old_epoch:
+            _MAP_CHANGES.inc()
+            get_journal().record(
+                "router.map_change", epoch=self._epoch,
+                shards=sorted(want),
+                frozen=sorted(r["shard"] for r in self._ranges
+                              if r["state"] != "serving"))
+            old = self._map_change
+            self._map_change = asyncio.Event()
+            old.set()       # wake writes parked on a frozen range
+
+    def _owner(self, key: str | None) -> dict | None:
+        """The per-request routing decision: a scan of the compiled
+        ranges (maps are a handful of entries; no tree needed).  A
+        keyless line belongs to the first range — keyless traffic is
+        health checks and tail reads, and ONE consistent answer
+        matters more than which one."""
+        ranges = self._ranges
+        if not ranges:
+            return None
+        if key is None:
+            return ranges[0]
+        for r in ranges:
+            if (not r["lo"] or r["lo"] <= key) and \
+                    (r.get("hi") is None or key < r["hi"]):
+                return r
+        return ranges[0]
+
+    # -- the relay path --
+
+    async def _serve_client(self, reader, writer) -> None:
+        _CONNS.inc(shard=self.name)
+        try:
+            if await _accept_fault() == "drop":
+                return
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    reply = await self._route_one(line)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    reply = (json.dumps(
+                        {"ok": False,
+                         "error": "router: %s" % e})
+                        .encode() + b"\n")
+                if reply is None:
+                    continue        # black-holed (drop): no reply
+                writer.write(reply)
+                await writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("client connection on %s closed: %s",
+                      self.name, e)
+        finally:
+            _CONNS.dec(shard=self.name)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _route_one(self, line: bytes) -> bytes | None:
+        m = _OP_RE.search(line)
+        verb = m.group(1).decode() if m else "unknown"
+        k = _KEY_RE.search(line)
+        key = k.group(1).decode() if k else None
+        is_write = verb not in _READ_VERBS and verb != "replicate"
+        t0 = None
+        label = self.name
+        while True:
+            # owner lookup and the inflight bump happen in ONE event-
+            # loop tick (no await between them): a status poll showing
+            # {frozen epoch compiled, inflight 0} therefore proves no
+            # write that saw the old serving state is still pending
+            rng = self._owner(key)
+            if rng is not None:
+                label = rng["shard"]
+                child = self._children.get(label)
+                if child is not None and (
+                        not is_write or rng["state"] == "serving"):
+                    self._inflight[label] = \
+                        self._inflight.get(label, 0) + 1
+                    try:
+                        reply = await child._route_one(line)
+                    finally:
+                        self._inflight[label] -= 1
+                    if t0 is not None:
+                        self._close_park(t0, label, verb)
+                    return reply
+            # a write bound for a frozen range (or any line with no
+            # routable owner yet): park for the map change, exactly
+            # the failover hold
+            if t0 is None:
+                await _park_fault()
+                t0 = time.monotonic()
+                _PARKED.inc(shard=label)
+            if time.monotonic() - t0 >= self.park_timeout:
+                held = time.monotonic() - t0
+                _PARKED.dec(shard=label)
+                _PARK_SECONDS.observe(held, shard=label)
+                get_journal().record(
+                    "router.park", shard=label, verb=verb,
+                    seconds=round(held, 3), replayed=False)
+                return _ERR_PARK_BUDGET
+            change = self._map_change
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(change.wait(), PARK_POLL)
+
+    def _close_park(self, t0: float, label: str, verb: str) -> None:
+        held = time.monotonic() - t0
+        _PARKED.dec(shard=label)
+        _PARK_SECONDS.observe(held, shard=label)
+        get_journal().record("router.park", shard=label, verb=verb,
+                             seconds=round(held, 3), replayed=True)
+
+    # -- status --
+
+    def describe_map(self) -> dict:
+        """The ``map`` section of /status — the resharder's drain
+        barrier reads exactly this shape."""
+        return {
+            "epoch": self._epoch,
+            "path": self.map_path,
+            "listen": "%s:%d" % (self.listen_host, self.listen_port),
+            "ranges": [
+                {"lo": r["lo"], "hi": r.get("hi"),
+                 "shard": r["shard"], "state": r["state"]}
+                for r in self._ranges],
+            "shards": {
+                name: dict(child.describe(),
+                           inflight_writes=self._inflight.get(name, 0))
+                for name, child in self._children.items()},
+        }
+
+
 # ---- the router's own HTTP listener ----
 
 class RouterServer:
@@ -751,10 +1115,12 @@ class RouterServer:
     scrapeable/drillable exactly like every other daemon."""
 
     def __init__(self, routers: list[ShardRouter], *,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 map_router: ShardMapRouter | None = None):
         from aiohttp import web
         self._web = web
         self.routers = routers
+        self.map_router = map_router
         self.host = host
         self.port = port
         self._runner = None
@@ -783,23 +1149,47 @@ class RouterServer:
         return self._web.json_response(["/status"] + self._obs_routes)
 
     async def _status(self, _req):
-        return self._web.json_response({
-            "now": round(time.time(), 3),
-            "shards": [r.describe() for r in self.routers]})
+        body = {"now": round(time.time(), 3),
+                "shards": [r.describe() for r in self.routers]}
+        if self.map_router is not None:
+            # the map section IS the resharder's drain barrier; the
+            # flat shards list keeps map-mode /status shaped like
+            # every other router's for the generic tooling
+            body["map"] = self.map_router.describe_map()
+            body["shards"] = [
+                c.describe()
+                for c in self.map_router._children.values()]
+        return self._web.json_response(body)
 
 
 # ---- daemon wiring ----
 
 async def start_router(cfg: dict):
-    shard_cfgs = router_shard_configs(cfg)
     host = cfg.get("statusHost", "0.0.0.0")
     port = int(cfg.get("statusPort", 0))
     set_peer("router:%d" % port if port else "router")
     faults.arm_specs(cfg.get("faults"), source="config")
     if cfg.get("faultsEnabled"):
         faults.enable_http()
-    routers = [ShardRouter(c) for c in shard_cfgs]
     intro = start_daemon_introspection(cfg)
+    if cfg.get("shardMapPath"):
+        # map mode: one listener over the whole keyspace; per-shard
+        # children are reconciled from the watched map record
+        map_router = ShardMapRouter(cfg)
+        server = RouterServer([], host=host, port=port,
+                              map_router=map_router)
+        await server.start()
+        await map_router.start()
+        log.info("router fronting shard map %s", cfg["shardMapPath"])
+
+        async def stop():
+            await map_router.stop()
+            await server.stop()
+            await intro.stop()
+
+        return stop
+    shard_cfgs = router_shard_configs(cfg)
+    routers = [ShardRouter(c) for c in shard_cfgs]
     server = RouterServer(routers, host=host, port=port)
     await server.start()
     for r in routers:

@@ -630,3 +630,65 @@ def check_skew(skew: dict | None) -> list[dict]:
                 "misorder cause and effect; HLC-stamped records are "
                 "unaffected" % (off, MERGE_SKEW_BOUND_S)))
     return out
+
+
+# ---- the key-range shard map (reshard/plan.py) ----
+
+def check_shard_map(map_obj: dict | None, record: dict | None,
+                    holds: list[str] | None = None) -> list[dict]:
+    """Pure checks over the shard map, the reshard step record, and
+    any discovered boot-hold nodes.  An invalid map is DAMAGE (a key
+    range with zero or two owners breaks the routing invariant); a
+    ``frozen`` range with no live reshard record is DAMAGE too (the
+    cutover that froze it is gone, and routers will park its writes
+    forever).  A ``done`` record is a NOTE (history, overwritten by
+    the next reshard); a boot hold with no live record is a WARNING
+    (sitters under that shardPath are parked waiting on a resharder
+    that no longer exists)."""
+    out: list[dict] = []
+    live = record is not None \
+        and record.get("step") not in ("done", "aborted")
+    if map_obj is not None:
+        from manatee_tpu.reshard.plan import (
+            FROZEN,
+            ShardMapError,
+            validate_map,
+        )
+        try:
+            validate_map(map_obj)
+        except ShardMapError as e:
+            out.append(finding(
+                DAMAGE, "shardmap-invalid", "shardmap",
+                "the shard map violates the one-owner-per-range "
+                "invariant: %s" % e))
+            return out
+        for r in map_obj["ranges"]:
+            if r["state"] == FROZEN and not live:
+                out.append(finding(
+                    DAMAGE, "shardmap-frozen-orphan", r["shard"],
+                    "range [%r, %r) is frozen but no reshard is in "
+                    "flight — routers park its writes forever; "
+                    "restore it with a map CAS back to 'serving' "
+                    "(or `manatee-adm reshard --resume` if a record "
+                    "reappears)" % (r["lo"], r["hi"])))
+    if record is not None and not live:
+        out.append(finding(
+            NOTE, "reshard-record-finished", "shardmap",
+            "the last reshard (%s) finished at step %r; the record "
+            "is history and the next `manatee-adm reshard` "
+            "overwrites it" % (record.get("op", "?"),
+                               record.get("step"))))
+    elif live:
+        out.append(finding(
+            NOTE, "reshard-in-flight", "shardmap",
+            "reshard %s is at step %r — resume or abort it with "
+            "`manatee-adm reshard`" % (record.get("op", "?"),
+                                       record.get("step"))))
+    for path in holds or []:
+        if not live:
+            out.append(finding(
+                WARNING, "reshard-hold-orphan", path,
+                "a reshard boot hold exists with no reshard in "
+                "flight: sitters booting under that shardPath are "
+                "parked until the node is deleted"))
+    return out

@@ -182,6 +182,43 @@ CATALOG: dict[str, tuple[str, tuple[str, ...], tuple[str, ...]]] = {
         ("manatee_tpu/daemons/prober.py",),
         ("error", "delay", "stall", "crash"),
     ),
+    "reshard.cleanup": (
+        "resharder's cleanup step, before the topology unfreeze and "
+        "the done-record CAS; a crash here leaves a flipped, serving "
+        "split whose source topology is still frozen (resume "
+        "finishes the bookkeeping)",
+        ("manatee_tpu/reshard/orchestrator.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
+    "reshard.delta": (
+        "resharder's incremental catch-up round (and the post-freeze "
+        "final delta), before the restore is issued; drop = the "
+        "round is skipped and the step fails",
+        ("manatee_tpu/reshard/orchestrator.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
+    "reshard.flip": (
+        "resharder's cutover CAS seam: the boot hold is released and "
+        "the target is writable, but the shard map has NOT yet "
+        "changed hands — a crash here must leave the source the "
+        "sole owner until resume re-runs the flip",
+        ("manatee_tpu/reshard/orchestrator.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
+    "reshard.freeze": (
+        "resharder's freeze step, before the source range goes "
+        "frozen in the shard map; a crash here leaves everything "
+        "serving (abort and resume both trivially reconverge)",
+        ("manatee_tpu/reshard/orchestrator.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
+    "reshard.seed": (
+        "resharder's initial full seed of the target dataset, before "
+        "the restore is issued; drop = the seed is skipped and the "
+        "step fails",
+        ("manatee_tpu/reshard/orchestrator.py",),
+        ("error", "delay", "stall", "drop", "crash"),
+    ),
     "router.accept": (
         "router's client-connection accept, before the first request "
         "line is read; drop = the connection is closed without a "

@@ -1,0 +1,33 @@
+"""Automated live resharding (docs/resharding.md).
+
+``plan.py`` holds the pure split-plan math and the versioned
+shard-map record (the single ownership authority, CAS'd in the
+coordination store); ``orchestrator.py`` is the resumable step
+machine behind ``manatee-adm reshard``.
+"""
+
+from manatee_tpu.reshard.plan import (
+    DEFAULT_MAP_PATH,
+    DEFAULT_RECORD_PATH,
+    KEY_MAX,
+    KEY_MIN,
+    ShardMapError,
+    ShardMapStore,
+    bootstrap_map,
+    owner_of,
+    plan_split,
+    validate_map,
+)
+
+__all__ = [
+    "DEFAULT_MAP_PATH",
+    "DEFAULT_RECORD_PATH",
+    "KEY_MAX",
+    "KEY_MIN",
+    "ShardMapError",
+    "ShardMapStore",
+    "bootstrap_map",
+    "owner_of",
+    "plan_split",
+    "validate_map",
+]

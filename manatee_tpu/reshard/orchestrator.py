@@ -1,0 +1,656 @@
+"""The resumable reshard step machine behind `manatee-adm reshard`.
+
+One shard's key range is split in place: the source keeps the low
+half, a new target shard takes ``[splitKey, hi)`` seeded over the
+incremental backup plane.  The step sequence::
+
+    plan -> seed -> catchup -> freeze -> final -> flip -> verify
+         -> cleanup -> done
+
+Every arrow is a durable CAS on the step record (plan.py), so a
+crashed orchestrator resumes exactly where it died (``--resume``) or
+rolls back (``--abort``, any step before ``flip``'s map CAS).  The
+shard map is the single ownership authority: no step hands a key
+range to two owners, because ownership only ever changes in one
+compare-and-set map write.
+
+Mechanics per step:
+
+- **seed / catchup**: ``RestoreClient.restore`` against the source
+  primary's backup server — full first, then the PR 9 delta
+  negotiation makes every later round incremental (received
+  snapshots keep the sender's epoch-ms names, so the negotiation is
+  dataset-name-independent).  Each round asks the sender for a fresh
+  source snapshot (``freshSnapshot``) so the residual delta shrinks
+  toward the write rate.  Rounds repeat until one fits inside the
+  cutover budget.
+- **freeze**: the source's whole range goes ``frozen`` in the map
+  (routers park writes for its keys — park, not error), the source
+  shard's topology is frozen against failovers, in-flight router
+  writes are drained (confirmed via router /status, or a grace
+  sleep), and a marker row is written directly to the source — the
+  proxy for "the last acked client write".
+- **final**: one more fresh-snapshot delta; it must carry the marker.
+- **flip**: the target-shard boot hold (``<shardPath>/reshard-hold``,
+  which kept the target's sitters from initializing a database over
+  the seed) is released, the target primary is awaited writable, and
+  ONE map CAS installs the split: source's low half ``serving``
+  (unfreezing it), target's high half ``serving``.  Routers watching
+  the map recompile and replay parked writes against the new owner.
+- **verify**: canary write/read on both sides plus the freeze
+  marker's presence on the target (zero-acked-write-loss evidence).
+- **cleanup**: topology unfreeze + the step record marked ``done``.
+
+Failpoints ``reshard.seed`` / ``reshard.delta`` / ``reshard.freeze``
+/ ``reshard.flip`` / ``reshard.cleanup`` sit on these seams and join
+the crash sweep (tests/test_crash_sweep.py, ``reshard_subproc``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from manatee_tpu import faults
+from manatee_tpu.backup.client import RestoreClient
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    NodeExistsError,
+    NoNodeError,
+    cluster_state_txn,
+)
+from manatee_tpu.daemons.prober import EngineCache
+from manatee_tpu.obs import get_journal, span
+from manatee_tpu.reshard.plan import (
+    DEFAULT_MAP_PATH,
+    DEFAULT_RECORD_PATH,
+    FROZEN,
+    SERVING,
+    ShardMapError,
+    ShardMapStore,
+    SplitPlan,
+    apply_split,
+    choose_split_key,
+    in_range,
+    plan_split,
+    range_for_shard,
+    with_range_state,
+)
+
+log = logging.getLogger("manatee.reshard")
+
+RECORD_FMT = 1
+HOLD_NODE = "reshard-hold"
+
+STEPS = ("plan", "seed", "catchup", "freeze", "final", "flip",
+         "verify", "cleanup", "done")
+# --abort is a rollback only while ownership has not moved: flip's
+# map CAS is the point of no return (after it, resume rolls forward)
+ABORTABLE = ("plan", "seed", "catchup", "freeze", "final", "aborting")
+
+
+class ReshardError(Exception):
+    """Operator-facing orchestration failure (exit 1, not a crash)."""
+
+
+def hold_path(shard_path: str) -> str:
+    return shard_path.rstrip("/") + "/" + HOLD_NODE
+
+
+def _now() -> float:
+    return time.time()
+
+
+async def _delta_fault() -> str | None:
+    # one call site for the one seam: both the catch-up rounds and
+    # the post-freeze final delta are the same incremental-restore
+    # seam, so they share the failpoint through this helper
+    return await faults.point("reshard.delta")
+
+
+class Resharder:
+    """Drives one split over ONE coordination handle (the process's
+    CoordMux session — the orchestrator must not open per-step fresh
+    connections).
+
+    *cfg* keys: ``source`` (shard name), ``sourcePath``, ``into``
+    (pair, run only), ``splitKey`` (optional — sampled when absent),
+    ``target`` (sitter-style config for the target shard's first
+    peer: shardPath, dataset, dataDir, ip, storage backend keys),
+    ``mapPath``/``recordPath``, ``cutoverBudget`` (seconds a catch-up
+    round must fit in before freezing, default 5), ``maxRounds``,
+    ``routers`` (status base URLs to confirm the drain against),
+    ``freezeGrace``, ``flipTimeout``.
+    """
+
+    def __init__(self, coord, cfg: dict, *,
+                 storage_factory=None, engine=None):
+        self.coord = coord
+        self.cfg = cfg
+        self.store = ShardMapStore(
+            coord,
+            map_path=cfg.get("mapPath", DEFAULT_MAP_PATH),
+            record_path=cfg.get("recordPath", DEFAULT_RECORD_PATH))
+        self.budget = float(cfg.get("cutoverBudget", 5.0))
+        self.max_rounds = int(cfg.get("maxRounds", 8))
+        self.freeze_grace = float(cfg.get("freezeGrace", 1.0))
+        self.flip_timeout = float(cfg.get("flipTimeout", 120.0))
+        self.routers = list(cfg.get("routers") or ())
+        self.engine = engine or EngineCache()
+        # injectable for tests; the default builds the target-side
+        # storage from the target config exactly like a sitter would
+        self._storage_factory = storage_factory
+        self._restore: RestoreClient | None = None
+        self.record: dict | None = None
+        self._rec_ver = -1
+
+    # ---- plumbing ----
+
+    def _target_cfg(self) -> dict:
+        t = self.cfg.get("target")
+        if not isinstance(t, dict):
+            raise ReshardError("reshard needs a target shard config "
+                               "(--target-config)")
+        return t
+
+    def _target_storage(self):
+        if self._storage_factory is not None:
+            return self._storage_factory(self._target_cfg())
+        from manatee_tpu.shard import build_storage
+        return build_storage(self._target_cfg())
+
+    def _restore_client(self) -> RestoreClient:
+        if self._restore is None:
+            t = self._target_cfg()
+            self._restore = RestoreClient(
+                self._target_storage(),
+                dataset=t["dataset"],
+                mountpoint=t["dataDir"],
+                listen_host=t.get("zfsHost", t.get("ip", "127.0.0.1")),
+                listen_port=int(t.get("zfsPort", 0)))
+        return self._restore
+
+    async def _state(self, shard_path: str) -> tuple[dict | None, int]:
+        try:
+            raw, ver = await self.coord.get(shard_path + "/state")
+        except NoNodeError:
+            return None, -1
+        return json.loads(raw.decode()), ver
+
+    async def _advance(self, step: str, **extra) -> None:
+        assert self.record is not None
+        self.record["step"] = step
+        self.record["updated"] = _now()
+        self.record.update(extra)
+        self._rec_ver = await self.store.write_record(
+            self.record, self._rec_ver)
+        get_journal().record("reshard.step", step=step,
+                             op=self.record.get("op"))
+
+    def _plan(self) -> SplitPlan:
+        assert self.record is not None
+        return SplitPlan.from_dict(self.record["plan"])
+
+    # ---- entry points ----
+
+    async def run(self) -> dict:
+        """Fresh start: plan the split, write the durable record, and
+        drive it to done.  Returns the final record."""
+        rec, ver = await self.store.load_record()
+        if rec is not None and rec.get("step") != "done":
+            raise ReshardError(
+                "a reshard is already recorded (step %r) — finish it "
+                "with --resume or --abort" % rec.get("step"))
+        plan = await self._make_plan()
+        self.record = {
+            "fmt": RECORD_FMT,
+            "op": "%s->%s,%s" % (plan.source, plan.source, plan.target),
+            "step": "plan",
+            "plan": plan.to_dict(),
+            "rounds": [],
+            "frozeTopology": False,
+            "created": _now(),
+            "updated": _now(),
+        }
+        # a finished record is history, not a conflict: overwrite it
+        # at its version (fresh create otherwise)
+        self._rec_ver = await self.store.write_record(self.record, ver)
+        get_journal().record("reshard.start", op=self.record["op"],
+                             split_key=plan.split_key)
+        await self._ensure_hold()
+        await self._advance("seed")
+        return await self._drive()
+
+    async def resume(self) -> dict:
+        """Continue a crashed run from its durable step."""
+        rec, ver = await self.store.load_record()
+        if rec is None:
+            raise ReshardError("no reshard in progress (no record at "
+                               "%s)" % self.store.record_path)
+        self.record, self._rec_ver = rec, ver
+        get_journal().record("reshard.resume", op=rec.get("op"),
+                             step=rec.get("step"))
+        if rec["step"] == "aborting":
+            return await self._finish_abort()
+        return await self._drive()
+
+    async def abort(self) -> dict:
+        """Roll back a pre-flip reshard: map back to source-serving,
+        seeded target dataset destroyed, hold + record removed."""
+        rec, ver = await self.store.load_record()
+        if rec is None:
+            raise ReshardError("no reshard in progress")
+        self.record, self._rec_ver = rec, ver
+        if rec["step"] not in ABORTABLE:
+            raise ReshardError(
+                "step %r is past the flip — ownership already moved; "
+                "run --resume to roll forward" % rec["step"])
+        await self._advance("aborting")
+        return await self._finish_abort()
+
+    # ---- the step machine ----
+
+    async def _drive(self) -> dict:
+        assert self.record is not None
+        handlers = {
+            "plan": self._step_plan, "seed": self._step_seed,
+            "catchup": self._step_catchup, "freeze": self._step_freeze,
+            "final": self._step_final, "flip": self._step_flip,
+            "verify": self._step_verify, "cleanup": self._step_cleanup,
+        }
+        while self.record["step"] != "done":
+            step = self.record["step"]
+            fn = handlers.get(step)
+            if fn is None:
+                raise ReshardError("unknown recorded step %r" % step)
+            with span("reshard." + step, op=self.record.get("op")):
+                await fn()
+        return self.record
+
+    async def _make_plan(self) -> SplitPlan:
+        m, _ver = await self.store.load()
+        source = self.cfg["source"]
+        into = self.cfg.get("into")
+        if not into or len(into) != 2:
+            raise ReshardError("--into a,b is required")
+        t = self._target_cfg()
+        split_key = self.cfg.get("splitKey")
+        if split_key is None:
+            split_key = await self._sample_split_key(m, source)
+        try:
+            return plan_split(m, source, tuple(into), split_key,
+                              t["shardPath"])
+        except ShardMapError as e:
+            raise ReshardError(str(e)) from None
+
+    async def _sample_split_key(self, m: dict, source: str) -> str:
+        """Median key of the source's current rows (no --at given)."""
+        src = range_for_shard(m, source)
+        primary = await self._source_primary(src["shardPath"])
+        res = await self.engine.for_url(
+            primary["pgUrl"]).query_url(
+                primary["pgUrl"], {"op": "select"}, 30.0)
+        keys = []
+        for row in res.get("rows") or ():
+            if isinstance(row, dict) and isinstance(
+                    row.get("key"), str):
+                keys.append(row["key"])
+        try:
+            return choose_split_key(keys, src)
+        except ShardMapError as e:
+            raise ReshardError(str(e)) from None
+
+    async def _source_primary(self, shard_path: str) -> dict:
+        st, _ = await self._state(shard_path)
+        if not st or not st.get("primary"):
+            raise ReshardError("source shard at %s has no declared "
+                               "primary" % shard_path)
+        return st["primary"]
+
+    async def _ensure_hold(self) -> None:
+        """The target-shard boot gate: while this node exists, target
+        sitters wait before initializing a database (shard.py), so
+        the seed lands on a quiescent dataset."""
+        path = hold_path(self._plan().target_path)
+        body = json.dumps({"op": self.record["op"],
+                           "ts": _now()}).encode()
+        try:
+            await self.coord.mkdirp(self._plan().target_path)
+            await self.coord.create(path, body)
+        except NodeExistsError:
+            pass
+
+    async def _release_hold(self) -> None:
+        try:
+            await self.coord.delete(hold_path(self._plan().target_path))
+        except NoNodeError:
+            pass
+
+    async def _step_plan(self) -> None:
+        # run() already recorded the plan; a resume landing here just
+        # re-ensures the boot hold and moves on
+        await self._ensure_hold()
+        await self._advance("seed")
+
+    async def _one_round(self, label: str) -> dict:
+        """One restore round against the source primary's backup
+        server, fresh source snapshot included; returns the round
+        stats that feed the record and the bench artifact."""
+        plan = self._plan()
+        primary = await self._source_primary(
+            self.record["plan"]["sourceRange"]["shardPath"])
+        rc = self._restore_client()
+        t0 = time.monotonic()
+        await rc.restore(primary["backupUrl"],
+                         isolate_prefix="reshard",
+                         incremental=True, fresh_snapshot=True)
+        job = rc.current_job or {}
+        round_ = {"label": label, "basis": job.get("basis", "full"),
+                  "bytes": int(job.get("completed") or 0),
+                  "seconds": round(time.monotonic() - t0, 3),
+                  "target": plan.target}
+        self.record.setdefault("rounds", []).append(round_)
+        get_journal().record("reshard.round", **round_)
+        return round_
+
+    async def _step_seed(self) -> None:
+        await self._ensure_hold()
+        if await faults.point("reshard.seed") == "drop":
+            raise ReshardError("seed dropped (fault)")
+        await self._one_round("seed")
+        await self._advance("catchup")
+
+    async def _step_catchup(self) -> None:
+        """Delta rounds until one fits the cutover budget: the round
+        duration is the honest proxy for how long the final
+        (write-frozen) delta will take."""
+        rounds = [r for r in self.record.get("rounds", ())
+                  if r["label"] == "catchup"]
+        while True:
+            if len(rounds) >= self.max_rounds:
+                log.warning(
+                    "catch-up never fit the %.1fs budget in %d rounds;"
+                    " freezing anyway (the final delta bounds the "
+                    "window)", self.budget, len(rounds))
+                break
+            if await _delta_fault() == "drop":
+                raise ReshardError("delta round dropped (fault)")
+            r = await self._one_round("catchup")
+            rounds.append(r)
+            self._rec_ver = await self.store.write_record(
+                self.record, self._rec_ver)
+            if r["seconds"] <= self.budget:
+                break
+        await self._advance("freeze")
+
+    async def _step_freeze(self) -> None:
+        if await faults.point("reshard.freeze") == "drop":
+            raise ReshardError("freeze dropped (fault)")
+        plan = self._plan()
+        # 1. topology freeze: no failover may move the source primary
+        # out from under the final delta (idempotent on resume; an
+        # operator's pre-existing freeze is respected and kept)
+        if not self.record.get("frozeTopology"):
+            froze = await self._freeze_topology(
+                self.record["plan"]["sourceRange"]["shardPath"])
+            self.record["frozeTopology"] = froze
+        # 2. map freeze: ONE CAS turns the source range frozen —
+        # routers watching the map park its writes from here on
+        m, ver = await self.store.load()
+        src = range_for_shard(m, plan.source)
+        if src["state"] != FROZEN:
+            await self.store.cas(
+                with_range_state(m, plan.source, FROZEN), ver)
+            get_journal().record("reshard.freeze", op=self.record["op"],
+                                 epoch=m["epoch"] + 1)
+        # 3. drain: writes relayed before a router observed the freeze
+        # may still be in flight to the source; they are acked, so the
+        # final snapshot must include them
+        await self._drain_routers(m["epoch"] + 1)
+        # 4. the last-acked-write proxy: a marker the final delta MUST
+        # carry to the target (verify asserts it)
+        primary = await self._source_primary(
+            self.record["plan"]["sourceRange"]["shardPath"])
+        marker = {"key": plan.split_key, "reshard_marker":
+                  self.record["op"], "ts": _now()}
+        await self.engine.for_url(primary["pgUrl"]).query_url(
+            primary["pgUrl"], {"op": "insert", "value": marker}, 15.0)
+        await self._advance("final", marker=marker)
+
+    async def _freeze_topology(self, shard_path: str) -> bool:
+        st, ver = await self._state(shard_path)
+        if st is None:
+            raise ReshardError("no cluster state at %s" % shard_path)
+        if st.get("freeze"):
+            return False
+        st["freeze"] = {"date": time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.gmtime()),
+            "reason": "reshard %s" % self.record["op"]}
+        try:
+            await self.coord.multi(cluster_state_txn(
+                shard_path + "/history", shard_path + "/state",
+                st, ver))
+        except BadVersionError:
+            raise ReshardError("lost a state-update race freezing "
+                               "the source topology; resume to retry"
+                               ) from None
+        return True
+
+    async def _unfreeze_topology(self, shard_path: str) -> None:
+        st, ver = await self._state(shard_path)
+        if st is None or not st.get("freeze"):
+            return
+        if "reshard" not in str(st["freeze"].get("reason", "")):
+            return          # an operator froze it since: not ours
+        st.pop("freeze", None)
+        try:
+            await self.coord.multi(cluster_state_txn(
+                shard_path + "/history", shard_path + "/state",
+                st, ver))
+        except BadVersionError:
+            log.warning("lost the unfreeze race on %s; leaving the "
+                        "freeze for `manatee-adm unfreeze`", shard_path)
+
+    async def _drain_routers(self, want_epoch: int) -> None:
+        """Wait until every configured router has observed the frozen
+        map AND has no write still in flight to the source; without
+        router URLs, a grace sleep bounds the same window."""
+        if not self.routers:
+            await asyncio.sleep(self.freeze_grace)
+            return
+        import aiohttp
+        plan = self._plan()
+        deadline = time.monotonic() + max(self.freeze_grace * 10, 15.0)
+        async with aiohttp.ClientSession() as http:
+            while time.monotonic() < deadline:
+                ok = True
+                for base in self.routers:
+                    try:
+                        async with http.get(
+                                base.rstrip("/") + "/status",
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as r:
+                            body = await r.json()
+                    except (aiohttp.ClientError, OSError,
+                            asyncio.TimeoutError):
+                        ok = False
+                        break
+                    mp = body.get("map") or {}
+                    sh = (mp.get("shards") or {}).get(plan.source) or {}
+                    if int(mp.get("epoch") or -1) < want_epoch \
+                            or int(sh.get("inflight_writes") or 0):
+                        ok = False
+                        break
+                if ok:
+                    return
+                await asyncio.sleep(0.1)
+        log.warning("router drain confirmation timed out; proceeding "
+                    "after the grace window")
+        await asyncio.sleep(self.freeze_grace)
+
+    async def _step_final(self) -> None:
+        if await _delta_fault() == "drop":
+            raise ReshardError("final delta dropped (fault)")
+        await self._one_round("final")
+        await self._advance("flip")
+
+    async def _step_flip(self) -> None:
+        plan = self._plan()
+        # release the boot hold: the target's sitters may now declare
+        # a cluster on the seeded dataset
+        await self._release_hold()
+        target_primary = await self._wait_target_primary()
+        if await faults.point("reshard.flip") == "drop":
+            raise ReshardError("flip dropped (fault)")
+        m, ver = await self.store.load()
+        owners = {r["shard"] for r in m["ranges"]}
+        if plan.target not in owners:
+            # THE cutover: one CAS splits the source range, unfreezes
+            # the low half, and hands the high half to the target
+            new = apply_split(m, plan, state=SERVING)
+            await self.store.cas(new, ver)
+            get_journal().record("reshard.flip", op=self.record["op"],
+                                 epoch=new["epoch"],
+                                 split_key=plan.split_key)
+        await self._advance("verify",
+                            targetPrimary=target_primary.get("id"))
+
+    async def _wait_target_primary(self) -> dict:
+        """The target shard must be writable BEFORE ownership flips,
+        or parked writes replay into nothing; the seeded peer must be
+        the one that declared (an unseeded peer winning the election
+        would serve an empty database)."""
+        from manatee_tpu.shard import build_ident
+        t = self._target_cfg()
+        want_id = build_ident(t)["id"]
+        plan = self._plan()
+        deadline = time.monotonic() + self.flip_timeout
+        while time.monotonic() < deadline:
+            st, _ = await self._state(plan.target_path)
+            primary = (st or {}).get("primary")
+            if primary:
+                if primary["id"] != want_id:
+                    raise ReshardError(
+                        "target shard declared primary %s, not the "
+                        "seeded peer %s — an unseeded peer won the "
+                        "election; abort and retarget"
+                        % (primary["id"], want_id))
+                try:
+                    res = await self.engine.for_url(
+                        primary["pgUrl"]).query_url(
+                            primary["pgUrl"],
+                            {"op": "insert", "value": {
+                                "key": plan.split_key,
+                                "reshard_canary": self.record["op"],
+                                "side": "target-preflip",
+                                "ts": _now()}}, 5.0)
+                    if res.get("ok"):
+                        return primary
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:     # noqa: BLE001 — retried
+                    log.debug("target not writable yet: %s", e)
+            await asyncio.sleep(0.25)
+        raise ReshardError(
+            "target shard never became writable within %.0fs (are its "
+            "sitters running?)" % self.flip_timeout)
+
+    async def _step_verify(self) -> None:
+        """Canary write/read on BOTH sides of the split + the freeze
+        marker's presence on the target."""
+        plan = self._plan()
+        m, _ver = await self.store.load()
+        src_rng = range_for_shard(m, plan.source)
+        tgt_rng = range_for_shard(m, plan.target)
+        src_primary = await self._source_primary(src_rng["shardPath"])
+        tgt_st, _ = await self._state(plan.target_path)
+        tgt_primary = (tgt_st or {}).get("primary")
+        if not tgt_primary:
+            raise ReshardError("target primary vanished before verify")
+        checks = [(src_primary, src_rng["lo"], "source"),
+                  (tgt_primary, plan.split_key, "target")]
+        for primary, key, side in checks:
+            value = {"key": key, "reshard_canary": self.record["op"],
+                     "side": side, "ts": _now()}
+            eng = self.engine.for_url(primary["pgUrl"])
+            res = await eng.query_url(
+                primary["pgUrl"], {"op": "insert", "value": value},
+                15.0)
+            if not res.get("ok"):
+                raise ReshardError("canary write on the %s side "
+                                   "failed: %r" % (side, res))
+            got = await eng.query_url(
+                primary["pgUrl"], {"op": "select", "limit": 64}, 15.0)
+            rows = got.get("rows") or ()
+            if not any(isinstance(r, dict)
+                       and r.get("reshard_canary") == self.record["op"]
+                       and r.get("side") == side for r in rows):
+                raise ReshardError("canary row did not read back on "
+                                   "the %s side" % side)
+        marker = self.record.get("marker")
+        if marker:
+            eng = self.engine.for_url(tgt_primary["pgUrl"])
+            got = await eng.query_url(
+                tgt_primary["pgUrl"], {"op": "select"}, 30.0)
+            if not any(isinstance(r, dict)
+                       and r.get("reshard_marker") == self.record["op"]
+                       for r in got.get("rows") or ()):
+                raise ReshardError(
+                    "the last-acked-write marker never reached the "
+                    "target — the final delta was incomplete")
+        # belt: the split the map now serves must be internally sound
+        if not in_range(tgt_rng, plan.split_key):
+            raise ReshardError("flipped map does not route the split "
+                               "key to the target")
+        await self._advance("cleanup")
+
+    async def _step_cleanup(self) -> None:
+        if await faults.point("reshard.cleanup") == "drop":
+            raise ReshardError("cleanup dropped (fault)")
+        plan = self._plan()
+        if self.record.get("frozeTopology"):
+            await self._unfreeze_topology(
+                self.record["plan"]["sourceRange"]["shardPath"])
+            self.record["frozeTopology"] = False
+        await self._release_hold()     # belt: flip already removed it
+        moved = sum(r["bytes"] for r in self.record.get("rounds", ()))
+        await self._advance(
+            "done", finished=_now(),
+            stats={"bytesMoved": moved,
+                   "rounds": len(self.record.get("rounds", ()))})
+        get_journal().record("reshard.done", op=self.record["op"],
+                             bytes_moved=moved)
+
+    # ---- abort ----
+
+    async def _finish_abort(self) -> dict:
+        """Idempotent rollback: map back to source-serving, seeded
+        target dataset destroyed, topology unfrozen, hold + record
+        gone.  Safe to re-run from any crash inside itself."""
+        plan = self._plan()
+        m, ver = await self.store.load()
+        owners = {r["shard"] for r in m["ranges"]}
+        if plan.target in owners:
+            raise ReshardError("map already lists the target as an "
+                               "owner — past the flip; --resume "
+                               "rolls forward")
+        src = range_for_shard(m, plan.source)
+        if src["state"] == FROZEN:
+            await self.store.cas(
+                with_range_state(m, plan.source, SERVING), ver)
+        if self.record.get("frozeTopology"):
+            await self._unfreeze_topology(
+                self.record["plan"]["sourceRange"]["shardPath"])
+        t = self._target_cfg()
+        storage = self._target_storage()
+        if await storage.exists(t["dataset"]):
+            if await storage.is_mounted(t["dataset"]):
+                await storage.unmount(t["dataset"])
+            await storage.destroy(t["dataset"], recursive=True)
+        await self._release_hold()
+        await self.store.delete_record()
+        get_journal().record("reshard.aborted", op=self.record["op"])
+        self.record["step"] = "aborted"
+        return self.record

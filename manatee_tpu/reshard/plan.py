@@ -1,0 +1,315 @@
+"""Split-plan math + the versioned shard-map record.
+
+The shard map is ONE versioned JSON node in the coordination store —
+the single authority for which shard owns which key range.  Keys are
+unicode strings compared lexicographically; ranges are half-open
+``[lo, hi)`` with ``lo == ""`` meaning the minimum key and
+``hi == None`` meaning +inf.  A valid map partitions the whole key
+space: sorted, first ``lo`` is ``""``, last ``hi`` is ``None``, each
+range's ``hi`` equals the next range's ``lo`` — no overlap, no gap.
+That shape IS the exactly-one-authoritative-owner invariant: every
+mutation goes through one compare-and-set on the node version, so a
+resharder dying at any seam leaves either the old map or the new map,
+never a blend.
+
+Range states: ``serving`` (normal) and ``frozen`` (a cutover in
+flight: routers park writes for keys in the range until the flip or
+an abort returns it to ``serving``; reads keep serving from the
+owner).
+
+Everything in this module except :class:`ShardMapStore` is pure and
+synchronous so the planner, the doctor check, the router, and the
+tests share one implementation of the range rules.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from manatee_tpu.coord.api import (
+    BadVersionError,
+    CoordClient,
+    NoNodeError,
+    NodeExistsError,
+)
+
+# sibling of the /manatee/<shard> namespace: a node UNDER /manatee
+# would show up in `manatee-adm show`'s shard listing
+DEFAULT_MAP_PATH = "/manatee-shardmap"
+DEFAULT_RECORD_PATH = "/manatee-shardmap-op"
+
+KEY_MIN = ""      # lo of the first range
+KEY_MAX = None    # hi of the last range (+inf)
+
+MAP_FMT = 1
+
+SERVING = "serving"
+FROZEN = "frozen"
+
+
+class ShardMapError(Exception):
+    """An invalid map, plan, or CAS conflict (message is operator-facing)."""
+
+
+def key_lt(a: str, b: str | None) -> bool:
+    """``a < b`` under the range ordering (``None`` = +inf)."""
+    return b is None or a < b
+
+
+def in_range(rng: dict, key: str) -> bool:
+    return rng["lo"] <= key and key_lt(key, rng["hi"])
+
+
+def validate_map(m: dict) -> None:
+    """Raise ShardMapError unless *m* partitions the key space with
+    exactly one owner per range (module docstring)."""
+    if not isinstance(m, dict) or m.get("fmt") != MAP_FMT:
+        raise ShardMapError("unrecognized shard-map fmt: %r"
+                            % (m.get("fmt") if isinstance(m, dict)
+                               else m))
+    ranges = m.get("ranges")
+    if not isinstance(ranges, list) or not ranges:
+        raise ShardMapError("shard map has no ranges")
+    seen_shards: set[str] = set()
+    for i, r in enumerate(ranges):
+        for k in ("lo", "shard", "shardPath", "state"):
+            if k not in r:
+                raise ShardMapError("range %d missing %r" % (i, k))
+        if r["state"] not in (SERVING, FROZEN):
+            raise ShardMapError("range %d has unknown state %r"
+                                % (i, r["state"]))
+        if r["shard"] in seen_shards:
+            raise ShardMapError("shard %r owns more than one range"
+                                % r["shard"])
+        seen_shards.add(r["shard"])
+    if ranges[0]["lo"] != KEY_MIN:
+        raise ShardMapError("first range starts at %r, not the "
+                            "minimum key" % ranges[0]["lo"])
+    if ranges[-1].get("hi") is not None:
+        raise ShardMapError("last range ends at %r, not +inf"
+                            % ranges[-1]["hi"])
+    for a, b in zip(ranges, ranges[1:]):
+        hi = a.get("hi")
+        if hi is None or hi != b["lo"]:
+            raise ShardMapError(
+                "ranges %r and %r do not meet: hi=%r lo=%r (every key "
+                "must have exactly one owner)"
+                % (a["shard"], b["shard"], hi, b["lo"]))
+        if not (a["lo"] < hi):
+            raise ShardMapError("range %r is empty: [%r, %r)"
+                                % (a["shard"], a["lo"], hi))
+
+
+def owner_of(m: dict, key: str) -> dict:
+    """The range record owning *key* (map assumed valid)."""
+    for r in m["ranges"]:
+        if in_range(r, key):
+            return r
+    raise ShardMapError("no range owns key %r" % key)
+
+
+def range_for_shard(m: dict, shard: str) -> dict:
+    for r in m["ranges"]:
+        if r["shard"] == shard:
+            return r
+    raise ShardMapError("shard %r is not in the shard map" % shard)
+
+
+def bootstrap_map(shard: str, shard_path: str) -> dict:
+    """A single-range map: *shard* owns the whole key space."""
+    return {"fmt": MAP_FMT, "epoch": 0,
+            "ranges": [{"lo": KEY_MIN, "hi": KEY_MAX, "shard": shard,
+                        "shardPath": shard_path, "state": SERVING}]}
+
+
+@dataclass
+class SplitPlan:
+    """The frozen decision `manatee-adm reshard` executes: split the
+    source's range at *split_key*; the source keeps the low half, the
+    new *target* shard takes ``[split_key, old_hi)``."""
+    source: str
+    target: str
+    target_path: str
+    split_key: str
+    source_range: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"source": self.source, "target": self.target,
+                "targetPath": self.target_path,
+                "splitKey": self.split_key,
+                "sourceRange": self.source_range}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SplitPlan":
+        return cls(source=d["source"], target=d["target"],
+                   target_path=d["targetPath"],
+                   split_key=d["splitKey"],
+                   source_range=d.get("sourceRange") or {})
+
+
+def plan_split(m: dict, source: str, into: tuple[str, str],
+               split_key: str, target_path: str) -> SplitPlan:
+    """Validate a ``reshard <source> --into a,b`` request against the
+    current map.  One of *into* must be the source itself (it keeps
+    the low half in place — no data moves for it); the other is the
+    new target, which must not already own a range.  *split_key* must
+    fall strictly inside the source's range so neither half is
+    empty."""
+    validate_map(m)
+    src = range_for_shard(m, source)
+    if src["state"] != SERVING:
+        raise ShardMapError(
+            "source range is %r — another cutover is in flight "
+            "(resume or abort it first)" % src["state"])
+    a, b = into
+    if a == b:
+        raise ShardMapError("--into names the same shard twice: %r" % a)
+    if source not in (a, b):
+        raise ShardMapError(
+            "one of --into must be the source shard %r (it keeps the "
+            "low half of its range in place)" % source)
+    target = b if a == source else a
+    for r in m["ranges"]:
+        if r["shard"] == target:
+            raise ShardMapError("target shard %r already owns "
+                                "[%r, %r)" % (target, r["lo"], r["hi"]))
+    if not (src["lo"] < split_key and key_lt(split_key, src["hi"])):
+        raise ShardMapError(
+            "split key %r is not strictly inside the source range "
+            "[%r, %r)" % (split_key, src["lo"], src["hi"]))
+    return SplitPlan(source=source, target=target,
+                     target_path=target_path, split_key=split_key,
+                     source_range=dict(src))
+
+
+def apply_split(m: dict, plan: SplitPlan, *, state: str) -> dict:
+    """The post-flip map: source's range split at the plan's key, the
+    high half owned by the target with *state*.  Pure — returns a new
+    map with ``epoch`` bumped; the caller CASes it."""
+    validate_map(m)
+    src = range_for_shard(m, plan.source)
+    if not (src["lo"] < plan.split_key
+            and key_lt(plan.split_key, src["hi"])):
+        raise ShardMapError(
+            "split key %r no longer inside source range [%r, %r)"
+            % (plan.split_key, src["lo"], src["hi"]))
+    out = {"fmt": MAP_FMT, "epoch": int(m["epoch"]) + 1, "ranges": []}
+    for r in m["ranges"]:
+        if r["shard"] != plan.source:
+            out["ranges"].append(dict(r))
+            continue
+        low = dict(r)
+        low["hi"] = plan.split_key
+        low["state"] = SERVING
+        out["ranges"].append(low)
+        out["ranges"].append({
+            "lo": plan.split_key, "hi": r.get("hi"),
+            "shard": plan.target, "shardPath": plan.target_path,
+            "state": state})
+    validate_map(out)
+    return out
+
+
+def with_range_state(m: dict, shard: str, state: str) -> dict:
+    """A new map with *shard*'s range state replaced, epoch bumped."""
+    out = {"fmt": MAP_FMT, "epoch": int(m["epoch"]) + 1,
+           "ranges": [dict(r) for r in m["ranges"]]}
+    range_for_shard(out, shard)["state"] = state
+    validate_map(out)
+    return out
+
+
+def choose_split_key(keys: list[str], rng: dict) -> str:
+    """Median in-range key from a sample — the default when the
+    operator gives no ``--at``.  Needs at least two distinct in-range
+    keys so both halves are nonempty."""
+    eligible = sorted({k for k in keys
+                       if isinstance(k, str) and in_range(rng, k)
+                       and k > rng["lo"]})
+    if not eligible:
+        raise ShardMapError(
+            "cannot choose a split key: no sampled keys fall strictly "
+            "inside [%r, %r) — pass --at KEY" % (rng["lo"], rng["hi"]))
+    return eligible[len(eligible) // 2]
+
+
+class ShardMapStore:
+    """The shard-map + step-record nodes, read/CAS'd over one coord
+    handle (the orchestrator rides the process's CoordMux session)."""
+
+    def __init__(self, coord: CoordClient, *,
+                 map_path: str = DEFAULT_MAP_PATH,
+                 record_path: str = DEFAULT_RECORD_PATH):
+        self.coord = coord
+        self.map_path = map_path
+        self.record_path = record_path
+
+    # -- shard map --
+
+    async def init(self, shard: str, shard_path: str) -> dict:
+        """Create the bootstrap single-range map; error if one exists."""
+        m = bootstrap_map(shard, shard_path)
+        try:
+            await self.coord.create(
+                self.map_path, json.dumps(m).encode())
+        except NodeExistsError:
+            raise ShardMapError(
+                "shard map already exists at %s" % self.map_path
+            ) from None
+        return m
+
+    async def load(self, watch=None) -> tuple[dict, int]:
+        """``(map, version)``; the version is the CAS token."""
+        try:
+            raw, ver = await self.coord.get(self.map_path, watch=watch)
+        except NoNodeError:
+            raise ShardMapError(
+                "no shard map at %s (run `manatee-adm shardmap init` "
+                "first)" % self.map_path) from None
+        m = json.loads(raw.decode())
+        validate_map(m)
+        return m, ver
+
+    async def cas(self, m: dict, version: int) -> int:
+        """Write *m* iff the node is still at *version*."""
+        validate_map(m)
+        try:
+            return await self.coord.set(
+                self.map_path, json.dumps(m).encode(), version)
+        except BadVersionError:
+            raise ShardMapError(
+                "shard map changed underneath this write (version %d "
+                "is stale) — re-read and retry" % version) from None
+
+    # -- durable step record (one active reshard at a time) --
+
+    async def load_record(self) -> tuple[dict | None, int]:
+        try:
+            raw, ver = await self.coord.get(self.record_path)
+        except NoNodeError:
+            return None, -1
+        return json.loads(raw.decode()), ver
+
+    async def write_record(self, rec: dict, version: int) -> int:
+        data = json.dumps(rec).encode()
+        if version == -1:
+            try:
+                await self.coord.create(self.record_path, data)
+                return 0
+            except NodeExistsError:
+                raise ShardMapError(
+                    "a reshard record already exists at %s — resume "
+                    "or abort it" % self.record_path) from None
+        try:
+            return await self.coord.set(self.record_path, data, version)
+        except BadVersionError:
+            raise ShardMapError(
+                "reshard record changed underneath this orchestrator "
+                "(two resharders running?)") from None
+
+    async def delete_record(self, version: int = -1) -> None:
+        try:
+            await self.coord.delete(self.record_path, version)
+        except NoNodeError:
+            pass

@@ -2,6 +2,30 @@
 
 from __future__ import annotations
 
+import asyncio
+
+
+async def cancel_and_wait(task, *, poke: float = 1.0) -> None:
+    """Cancel *task* and wait until it has actually finished.
+
+    A single ``task.cancel(); await task`` can hang on Python 3.10:
+    ``asyncio.wait_for`` swallows a cancellation that lands in the
+    same tick its inner future completes (bpo-42130), so a task whose
+    body runs queries under wait_for can absorb the one cancel and
+    keep looping — and the awaiting ``stop()`` never returns.
+    Re-issue the cancel at a short cadence until the task is done.
+
+    A non-cancellation crash inside the task is re-raised, matching
+    the plain ``await task`` the callers used before.
+    """
+    if task is None:
+        return
+    while not task.done():
+        task.cancel()
+        await asyncio.wait([task], timeout=poke)
+    if not task.cancelled() and task.exception() is not None:
+        raise task.exception()
+
 
 def cancel_requests(task) -> int:
     """``task.cancelling()`` (Python >= 3.11), else 0.

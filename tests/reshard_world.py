@@ -1,0 +1,345 @@
+"""A self-contained mini world for the reshard orchestrator.
+
+One process hosts everything the step machine touches: a durable
+in-process ``CoordServer`` (op-logged ``data_dir`` so a crash at an
+armed failpoint leaves real on-disk state for ``--resume``), a
+source "shard" (a DirBackend dataset whose rows live in
+``rows.jsonl`` under the mountpoint, served by :class:`MiniEngine`
+over fake ``sim://`` URLs), the real backup plane (``BackupQueue`` +
+``BackupRestServer`` + ``BackupSender`` — the restore rounds move
+real bytes), and a fake target sitter: a task that waits for the
+reshard boot hold to release and then declares the seeded peer
+primary, exactly the contract ``shard.py`` implements live.
+
+Runnable as a subprocess for the crash sweep::
+
+    python -m tests.reshard_world STATE_DIR --phase run
+    python -m tests.reshard_world STATE_DIR --phase resume
+    python -m tests.reshard_world STATE_DIR --phase abort
+    python -m tests.reshard_world STATE_DIR --phase check
+
+Every phase re-opens the same durable state dir, so arming
+``MANATEE_FAULTS=reshard.<seam>=crash`` on a ``run`` and following
+with a clean ``resume`` is the sweep's crash-at-every-seam drill.
+The last stdout line of each phase is a JSON report
+(``{"ok", "step", "epoch", "owners", "rows_src", "rows_tgt", ...}``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from pathlib import Path
+
+SRC_SHARD = "src"
+TGT_SHARD = "tgt"
+SRC_PATH = "/manatee/src"
+TGT_PATH = "/manatee/tgt"
+SRC_PGURL = "sim://127.0.0.1:7001"
+TGT_PGURL = "sim://127.0.0.1:7002"
+ROWS_NAME = "rows.jsonl"
+
+
+def probe_key(seq: int) -> str:
+    """The prober's key cycle (daemons/prober.py): 37 is coprime to
+    256, so every key in [k00, kff] is visited and any interior split
+    keeps traffic landing on both sides of the cut."""
+    return "k%02x" % ((seq * 37) % 256)
+
+
+class MiniEngine:
+    """``EngineCache``-shaped adapter mapping fake ``sim://`` URLs to
+    rows files on disk — the only surface the orchestrator uses to
+    talk to a 'database' (sample, marker, canary, verify reads)."""
+
+    def __init__(self, urlmap: dict[str, Path]):
+        self.urlmap = urlmap
+
+    def for_url(self, url: str) -> "MiniEngine":
+        return self
+
+    def _rows_path(self, url: str) -> Path:
+        return self.urlmap[url] / ROWS_NAME
+
+    def read_rows(self, url: str) -> list[dict]:
+        try:
+            text = self._rows_path(url).read_text()
+        except OSError:
+            return []
+        return [json.loads(line) for line in text.splitlines()
+                if line.strip()]
+
+    async def query_url(self, url: str, op: dict,
+                        timeout: float) -> dict:
+        if op.get("op") == "insert":
+            p = self._rows_path(url)
+            try:
+                with open(p, "a") as fh:
+                    fh.write(json.dumps(op.get("value")) + "\n")
+            except OSError as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True}
+        if op.get("op") == "select":
+            rows = self.read_rows(url)
+            limit = int(op.get("limit") or 0)
+            if limit > 0:
+                rows = rows[-limit:]
+            return {"ok": True, "rows": rows}
+        return {"ok": False, "error": "unknown op %r" % op.get("op")}
+
+
+class ReshardWorld:
+    """Everything a Resharder needs, rooted at one durable state dir."""
+
+    def __init__(self, state_dir: Path):
+        self.state_dir = Path(state_dir)
+        self.src_store = self.state_dir / "src-store"
+        self.tgt_store = self.state_dir / "tgt-store"
+        self.src_mnt = self.state_dir / "src-mnt"
+        self.tgt_mnt = self.state_dir / "tgt-mnt"
+        self.coord_data = self.state_dir / "coord"
+        self.server = None
+        self.coord = None
+        self.backup_server = None
+        self.backup_sender = None
+        self._sitter_task = None
+        self.engine = MiniEngine({SRC_PGURL: self.src_mnt,
+                                  TGT_PGURL: self.tgt_mnt})
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        from manatee_tpu.backup import (
+            BackupQueue,
+            BackupRestServer,
+            BackupSender,
+        )
+        from manatee_tpu.coord.client import NetCoord
+        from manatee_tpu.coord.server import CoordServer
+        from manatee_tpu.storage import DirBackend
+
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        self.coord_data.mkdir(exist_ok=True)
+        self.server = CoordServer(port=0, tick=0.05,
+                                  data_dir=str(self.coord_data))
+        await self.server.start()
+        self.coord = NetCoord("127.0.0.1", self.server.port,
+                              session_timeout=20)
+        await self.coord.connect()
+
+        self.src_be = DirBackend(self.src_store)
+        self.tgt_be = DirBackend(self.tgt_store)
+        if not await self.src_be.exists("pg-src"):
+            await self.src_be.create("pg-src",
+                                     mountpoint=str(self.src_mnt))
+        if not await self.src_be.is_mounted("pg-src"):
+            await self.src_be.mount("pg-src")
+
+        queue = BackupQueue()
+        self.backup_server = BackupRestServer(
+            queue, host="127.0.0.1", port=0,
+            storage=self.src_be, dataset="pg-src")
+        await self.backup_server.start()
+        self.backup_sender = BackupSender(queue, self.src_be, "pg-src")
+        self.backup_sender.start()
+
+        await self._write_states()
+        self._sitter_task = asyncio.create_task(
+            self._target_sitter(), name="reshard-world-target-sitter")
+
+    async def stop(self) -> None:
+        if self._sitter_task:
+            self._sitter_task.cancel()
+            try:
+                await self._sitter_task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                pass
+        if self.backup_sender:
+            await self.backup_sender.stop()
+        if self.backup_server:
+            await self.backup_server.stop()
+        if self.coord:
+            await self.coord.close()
+        if self.server:
+            await self.server.stop()
+
+    def target_cfg(self) -> dict:
+        return {"ip": "127.0.0.1", "postgresPort": 7002,
+                "backupPort": 7102, "name": TGT_SHARD,
+                "shardPath": TGT_PATH, "dataset": "pg-tgt",
+                "dataDir": str(self.tgt_mnt),
+                "storageBackend": "dir",
+                "storageRoot": str(self.tgt_store)}
+
+    def resharder_cfg(self, **over) -> dict:
+        cfg = {"source": SRC_SHARD, "sourcePath": SRC_PATH,
+               "into": [SRC_SHARD, TGT_SHARD],
+               "target": self.target_cfg(),
+               "cutoverBudget": 30.0, "maxRounds": 4,
+               "freezeGrace": 0.05, "flipTimeout": 30.0}
+        cfg.update(over)
+        return cfg
+
+    def make_resharder(self, **over):
+        from manatee_tpu.reshard.orchestrator import Resharder
+        return Resharder(self.coord, self.resharder_cfg(**over),
+                         engine=self.engine)
+
+    # ---- cluster-state fakery ----
+
+    async def _put_state(self, path: str, state: dict) -> None:
+        from manatee_tpu.coord.api import NoNodeError
+        data = json.dumps(state).encode()
+        await self.coord.mkdirp(path)
+        try:
+            _raw, ver = await self.coord.get(path + "/state")
+            await self.coord.set(path + "/state", data, ver)
+        except NoNodeError:
+            await self.coord.create(path + "/state", data)
+
+    async def _write_states(self) -> None:
+        """(Re)declare the source primary with THIS boot's backup
+        port — ports are dynamic, so a resumed world must refresh the
+        durable state the previous run left behind."""
+        backup_url = "http://127.0.0.1:%d" % self.backup_server.port
+        await self.coord.mkdirp(SRC_PATH + "/history")
+        await self.coord.mkdirp(TGT_PATH + "/history")
+        await self._put_state(SRC_PATH, {
+            "generation": 1, "initWal": "0/0",
+            "primary": {"id": "127.0.0.1:7001:%d"
+                             % self.backup_server.port,
+                        "pgUrl": SRC_PGURL, "backupUrl": backup_url},
+            "sync": None, "async": [], "deposed": []})
+
+    async def _target_sitter(self) -> None:
+        """The fake target sitter: park while the reshard boot hold
+        exists (shard.py's `_wait_reshard_hold` contract), then
+        declare the seeded peer primary."""
+        from manatee_tpu.reshard.orchestrator import hold_path
+        from manatee_tpu.shard import build_ident
+        hp = hold_path(TGT_PATH)
+        while True:
+            try:
+                stat = await self.coord.exists(hp)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                await asyncio.sleep(0.1)
+                continue
+            if stat is None:
+                break
+            await asyncio.sleep(0.05)
+        ident = build_ident(self.target_cfg())
+        await self._put_state(TGT_PATH, {
+            "generation": 1, "initWal": "0/0",
+            "primary": {"id": ident["id"], "pgUrl": TGT_PGURL,
+                        "backupUrl": ident["backupUrl"]},
+            "sync": None, "async": [], "deposed": []})
+
+    # ---- data plane ----
+
+    def populate(self, n: int = 64) -> None:
+        p = self.src_mnt / ROWS_NAME
+        with open(p, "a") as fh:
+            for i in range(n):
+                fh.write(json.dumps({"key": probe_key(i), "seq": i,
+                                     "ts": time.time()}) + "\n")
+
+    async def init_map(self):
+        from manatee_tpu.reshard.plan import ShardMapError, ShardMapStore
+        store = ShardMapStore(self.coord)
+        try:
+            await store.init(SRC_SHARD, SRC_PATH)
+        except ShardMapError:
+            pass        # already bootstrapped by an earlier phase
+        return store
+
+    # ---- report ----
+
+    async def report(self) -> dict:
+        from manatee_tpu.reshard.plan import (
+            ShardMapStore,
+            owner_of,
+            validate_map,
+        )
+        store = ShardMapStore(self.coord)
+        m, _ver = await store.load()
+        validate_map(m)
+        rec, _rv = await store.load_record()
+        src_rows = self.engine.read_rows(SRC_PGURL)
+        try:
+            tgt_rows = self.engine.read_rows(TGT_PGURL)
+        except OSError:
+            tgt_rows = []
+        # exactly one authoritative owner per key: every data row's
+        # key must be present on the shard the map routes it to
+        misrouted = []
+        by_url = {SRC_SHARD: src_rows, TGT_SHARD: tgt_rows}
+        for i in range(256):
+            key = probe_key(i)
+            owner = owner_of(m, key)["shard"]
+            rows = by_url.get(owner) or ()
+            if any(r.get("key") == key and "seq" in r
+                   for r in src_rows + tgt_rows) \
+                    and not any(r.get("key") == key for r in rows):
+                misrouted.append((key, owner))
+        return {"ok": not misrouted,
+                "step": (rec or {}).get("step"),
+                "epoch": m["epoch"],
+                "owners": [r["shard"] for r in m["ranges"]],
+                "states": [r["state"] for r in m["ranges"]],
+                "rows_src": len(src_rows), "rows_tgt": len(tgt_rows),
+                "misrouted": misrouted}
+
+
+async def _phase(state_dir: Path, phase: str) -> dict:
+    from manatee_tpu.reshard.orchestrator import ReshardError
+    w = ReshardWorld(state_dir)
+    await w.start()
+    try:
+        await w.init_map()
+        if not (w.src_mnt / ROWS_NAME).exists():
+            w.populate(64)
+        if phase == "run":
+            r = w.make_resharder()
+            rec = await r.run()
+        elif phase == "resume":
+            r = w.make_resharder()
+            rec = await r.resume()
+        elif phase == "abort":
+            r = w.make_resharder()
+            try:
+                rec = await r.abort()
+            except ReshardError:
+                # past the flip: roll forward instead (the sweep
+                # aborts blindly; the orchestrator knows better)
+                rec = await r.resume()
+        elif phase == "check":
+            rec = None
+        else:
+            raise SystemExit("unknown phase %r" % phase)
+        out = await w.report()
+        if rec is not None:
+            out["step"] = rec.get("step")
+        return out
+    finally:
+        await w.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="reshard mini world")
+    p.add_argument("state_dir")
+    p.add_argument("--phase", default="run",
+                   choices=("run", "resume", "abort", "check"))
+    args = p.parse_args(argv)
+    out = asyncio.run(_phase(Path(args.state_dir), args.phase))
+    print(json.dumps(out, sort_keys=True))
+    raise SystemExit(0 if out.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -132,6 +132,17 @@ REPO = Path(__file__).resolve().parent.parent
 #                 armed proves the degradation contract — the merge
 #                 degrades to wall-clock ordering and the carrying
 #                 call COMPLETES — and a clean rerun merges normally
+#   reshard_subproc
+#                 the reshard orchestrator's cutover seams run over
+#                 the DURABLE mini world (tests/reshard_world.py): a
+#                 child process drives `reshard src --into src,tgt`
+#                 end to end and crashes AT the armed seam; the
+#                 coordination store's op-logged data dir survives,
+#                 so a follow-up phase (--resume, or --abort for the
+#                 pre-flip rollback edge) must drive the recorded
+#                 step machine to a converged map — exactly one
+#                 authoritative owner per key range, no misrouted
+#                 rows — which a final check phase re-verifies cold
 #   incident_subproc
 #                 a child process runs the incident evidence
 #                 collector and crashes AT the collect seam (before
@@ -174,6 +185,12 @@ SCENARIOS: dict[str, dict] = {
     "pg.restore":           dict(kind="boot_async", wipe=True),
     "prober.read":          dict(kind="prober_subproc", variant="kill"),
     "prober.write":         dict(kind="prober_subproc"),
+    "reshard.seed":         dict(kind="reshard_subproc"),
+    "reshard.delta":        dict(kind="reshard_subproc", variant="kill",
+                                 followup="abort"),
+    "reshard.freeze":       dict(kind="reshard_subproc", variant="kill"),
+    "reshard.flip":         dict(kind="reshard_subproc"),
+    "reshard.cleanup":      dict(kind="reshard_subproc", variant="kill"),
     "router.accept":        dict(kind="router_subproc"),
     "router.park":          dict(kind="router_subproc"),
     "router.relay":         dict(kind="router_subproc",
@@ -491,6 +508,48 @@ def _run_prober_subproc_scenario(tmp_path, point: str, scn: dict
     assert "probe-ok" in cp.stdout
 
 
+def _run_reshard_subproc_scenario(tmp_path, point: str, scn: dict
+                                  ) -> None:
+    """Crash the reshard orchestrator at a cutover seam over the
+    durable mini world (tests/reshard_world.py).  The child's coord
+    data dir outlives the crash, so the follow-up phase — --resume,
+    or --abort for the pre-flip rollback edge — must reconverge the
+    recorded step machine, and the phase's JSON report (last stdout
+    line) proves exactly one authoritative owner per key range."""
+    variant = scn.get("variant", "exit")
+    state = tmp_path / "reshard-world"
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+           "MANATEE_FAULTS": spec_for(point, variant)}
+    argv = [sys.executable, "-m", "tests.reshard_world", str(state)]
+    cp = subprocess.run(argv + ["--phase", "run"],
+                        capture_output=True, text=True, timeout=120,
+                        env=env)
+    assert cp.returncode == crash_status(variant), \
+        (cp.returncode, cp.stdout, cp.stderr)
+
+    env.pop("MANATEE_FAULTS")
+    followup = scn.get("followup", "resume")
+    cp = subprocess.run(argv + ["--phase", followup],
+                        capture_output=True, text=True, timeout=120,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    out = json.loads(cp.stdout.strip().splitlines()[-1])
+    assert out["ok"], out
+    assert out["step"] == \
+        ("aborted" if followup == "abort" else "done"), out
+    assert len(out["owners"]) == len(set(out["owners"])), out
+    assert not out["misrouted"], out
+    assert all(s == "serving" for s in out["states"]), out
+
+    # a cold re-open of the same durable state must agree
+    cp = subprocess.run(argv + ["--phase", "check"],
+                        capture_output=True, text=True, timeout=120,
+                        env=env)
+    assert cp.returncode == 0, (cp.stdout, cp.stderr)
+    again = json.loads(cp.stdout.strip().splitlines()[-1])
+    assert again["ok"] and again["owners"] == out["owners"], again
+
+
 _ROUTER_UP = (
     "class Up:\n"
     "    async def start(self):\n"
@@ -798,6 +857,9 @@ def test_crash_at_seam(tmp_path, point):
         return
     if scn["kind"] == "prober_subproc":
         _run_prober_subproc_scenario(tmp_path, point, scn)
+        return
+    if scn["kind"] == "reshard_subproc":
+        _run_reshard_subproc_scenario(tmp_path, point, scn)
         return
     if scn["kind"] == "router_subproc":
         _run_router_subproc_scenario(tmp_path, point, scn)

@@ -81,6 +81,20 @@ def test_renders_router_man_page(tmp_path):
     assert "`" not in out and "**" not in out
 
 
+def test_renders_reshard_man_page(tmp_path):
+    out = render((REPO / "docs" / "man"
+                  / "manatee-adm-reshard.md").read_text(), tmp_path)
+    for section in (".SH SYNOPSIS", ".SH DESCRIPTION", ".SH OPTIONS",
+                    ".SH SHARDMAP", ".SH FAILURE MODEL",
+                    ".SH EXIT STATUS", ".SH SEE ALSO"):
+        assert section in out, "missing %s" % section
+    # the step machine survives as a literal block, and the ownership
+    # contract's headline words made it through markdown stripping
+    assert ".nf" in out and "catchup" in out and "flip" in out
+    assert "exactly one shard owns each key range" in out
+    assert "`" not in out and "**" not in out
+
+
 def test_renders_incident_man_page(tmp_path):
     out = render((REPO / "docs" / "man"
                   / "manatee-adm-incident.md").read_text(), tmp_path)

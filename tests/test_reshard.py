@@ -1,0 +1,356 @@
+"""Reshard orchestrator tier (manatee_tpu/reshard/): the in-process
+mini world end to end (seed → deltas → freeze → final → flip → verify
+→ cleanup), error-at-every-seam resume, abort rollback, the
+cross-shard delta-base negotiation (differing dataset names on the
+two sides), and the router/prober follow-the-flip contract — both
+recompile from a shard-map CAS without restart.
+
+The crash (SIGKILL / os._exit) variants of the same seams run as
+subprocess drills in test_crash_sweep.py over tests/reshard_world.py.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tests.reshard_world import ReshardWorld, SRC_SHARD, TGT_SHARD
+
+from manatee_tpu import faults
+from manatee_tpu.reshard.orchestrator import ReshardError
+from manatee_tpu.reshard.plan import (
+    FROZEN,
+    SERVING,
+    ShardMapError,
+    ShardMapStore,
+    apply_split,
+    plan_split,
+    with_range_state,
+)
+
+RESHARD_POINTS = ("reshard.seed", "reshard.delta", "reshard.freeze",
+                  "reshard.flip", "reshard.cleanup")
+
+
+async def _fresh_world(tmp_path):
+    w = ReshardWorld(tmp_path / "world")
+    await w.start()
+    await w.init_map()
+    w.populate(64)
+    return w
+
+
+# ---- the whole machine, in process ----
+
+def test_reshard_end_to_end_moves_ownership(tmp_path):
+    async def go():
+        w = await _fresh_world(tmp_path)
+        try:
+            rec = await w.make_resharder().run()
+            assert rec["step"] == "done"
+            assert rec["stats"]["bytesMoved"] > 0
+            out = await w.report()
+            assert out["ok"], out
+            assert out["owners"] == [SRC_SHARD, TGT_SHARD]
+            assert out["states"] == [SERVING, SERVING]
+            assert out["epoch"] >= 2    # freeze + flip both bumped
+            assert out["rows_tgt"] > 0
+            return rec
+        finally:
+            await w.stop()
+    rec = asyncio.run(go())
+    # cross-shard delta-base negotiation: the source dataset is
+    # pg-src, the target pg-tgt — names differ, yet every round after
+    # the full seed must find a common snapshot basis (negotiation is
+    # by snapshot NAME, not dataset name) and ship an increment
+    labels = [r["label"] for r in rec["rounds"]]
+    assert labels[0] == "seed" and "final" in labels
+    assert rec["rounds"][0]["basis"] == "full"
+    deltas = rec["rounds"][1:]
+    assert deltas and all(r["basis"] != "full" for r in deltas), \
+        rec["rounds"]
+
+
+def test_reshard_run_refused_while_one_is_in_flight(tmp_path):
+    async def go():
+        w = await _fresh_world(tmp_path)
+        reg = faults.get_faults()
+        try:
+            reg.arm_spec("reshard.freeze=error", source="api")
+            with pytest.raises(faults.FaultError):
+                await w.make_resharder().run()
+            # the durable record now says a reshard is in flight: a
+            # second `reshard` must refuse and point at resume/abort
+            with pytest.raises(ReshardError, match="already recorded"):
+                await w.make_resharder().run()
+            reg.clear()
+            rec = await w.make_resharder().resume()
+            assert rec["step"] == "done"
+            # ...and once DONE the record is history, not a lock: a
+            # fresh run() against the now-split map gets past the
+            # record and fails on plan validation instead (the target
+            # already owns a range), NOT on "already recorded"
+            with pytest.raises((ShardMapError, ReshardError)) as ei:
+                await w.make_resharder().run()
+            assert "already recorded" not in str(ei.value)
+        finally:
+            reg.clear()
+            await w.stop()
+    asyncio.run(go())
+
+
+@pytest.mark.parametrize("point", RESHARD_POINTS)
+def test_reshard_error_at_seam_then_resume_converges(tmp_path, point):
+    """An injected error at every seam leaves a record --resume can
+    drive to done (the crash variants of the same drill live in the
+    subprocess sweep)."""
+    async def go():
+        w = await _fresh_world(tmp_path)
+        reg = faults.get_faults()
+        try:
+            reg.arm_spec("%s=error,count=1" % point, source="api")
+            with pytest.raises(faults.FaultError):
+                await w.make_resharder().run()
+            rec, _ = await ShardMapStore(w.coord).load_record()
+            assert rec is not None and rec["step"] != "done"
+            out = await w.make_resharder().resume()
+            assert out["step"] == "done"
+            report = await w.report()
+            assert report["ok"], report
+            assert report["owners"] == [SRC_SHARD, TGT_SHARD]
+        finally:
+            reg.clear()
+            await w.stop()
+    asyncio.run(go())
+
+
+def test_reshard_abort_rolls_back_cleanly(tmp_path):
+    async def go():
+        w = await _fresh_world(tmp_path)
+        reg = faults.get_faults()
+        try:
+            reg.arm_spec("reshard.freeze=error", source="api")
+            with pytest.raises(faults.FaultError):
+                await w.make_resharder().run()
+            reg.clear()
+            # the seed landed real bytes on the target before the
+            # freeze blew up — abort must destroy them
+            assert await w.tgt_be.exists("pg-tgt")
+            rec = await w.make_resharder().abort()
+            assert rec["step"] == "aborted"
+            store = ShardMapStore(w.coord)
+            m, _ = await store.load()
+            assert [r["shard"] for r in m["ranges"]] == [SRC_SHARD]
+            assert m["ranges"][0]["state"] == SERVING
+            r2, _ = await store.load_record()
+            assert r2 is None               # record gone
+            assert not await w.tgt_be.exists("pg-tgt")
+            from manatee_tpu.reshard.orchestrator import hold_path
+            from tests.reshard_world import TGT_PATH
+            assert await w.coord.exists(hold_path(TGT_PATH)) is None
+            # nothing in flight any more: abort now refuses
+            with pytest.raises(ReshardError, match="no reshard"):
+                await w.make_resharder().abort()
+        finally:
+            reg.clear()
+            await w.stop()
+    asyncio.run(go())
+
+
+def test_reshard_abort_refused_past_the_flip(tmp_path):
+    async def go():
+        w = await _fresh_world(tmp_path)
+        reg = faults.get_faults()
+        try:
+            reg.arm_spec("reshard.cleanup=error,count=1", source="api")
+            with pytest.raises(faults.FaultError):
+                await w.make_resharder().run()
+            # the map flip already happened: ownership moved, so the
+            # only way out is forward
+            with pytest.raises(ReshardError, match="past the flip"):
+                await w.make_resharder().abort()
+            rec = await w.make_resharder().resume()
+            assert rec["step"] == "done"
+        finally:
+            reg.clear()
+            await w.stop()
+    asyncio.run(go())
+
+
+# ---- follow-the-flip: the router and prober recompile from the map
+# CAS without restart (satellite contract, pinned here) ----
+
+async def _flip_world(tmp_path):
+    """A real CoordServer + two FakeUpstream 'shards' + an initialized
+    single-range map: the substrate both follow-the-flip tests drive."""
+    from tests.test_router import FakeUpstream
+
+    from manatee_tpu.coord.client import NetCoord
+    from manatee_tpu.coord.server import CoordServer
+
+    server = CoordServer(port=0, tick=0.05,
+                         data_dir=str(tmp_path / "coord"))
+    await server.start()
+    coord = NetCoord("127.0.0.1", server.port, session_timeout=20)
+    await coord.connect()
+    up_a = await FakeUpstream("a1").start()
+    up_b = await FakeUpstream("b1").start()
+    for path, up in (("/manatee/a", up_a), ("/manatee/b", up_b)):
+        await coord.mkdirp(path)
+        await coord.create(path + "/state", json.dumps({
+            "primary": {"id": up.name, "pgUrl": up.url},
+            "sync": None, "async": []}).encode())
+    store = ShardMapStore(coord)
+    await store.init("a", "/manatee/a")
+    return server, coord, store, up_a, up_b
+
+
+async def _wait_for(cond, timeout=10.0, msg="condition"):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise AssertionError("timed out waiting for " + msg)
+        await asyncio.sleep(0.05)
+
+
+def test_map_router_follows_flip_without_restart(tmp_path):
+    async def go():
+        from tests.test_router import _query
+
+        from manatee_tpu.daemons.router import ShardMapRouter
+
+        server, coord, store, up_a, up_b = await _flip_world(tmp_path)
+        router = ShardMapRouter({
+            "name": "map", "shardMapPath": store.map_path,
+            "listenHost": "127.0.0.1", "listenPort": 0,
+            "coordCfg": {"connStr": "127.0.0.1:%d" % server.port},
+            "parkTimeout": 10.0, "relayTimeout": 2.0})
+        try:
+            await router.start(topology=True)
+            await _wait_for(
+                lambda: "a" in router.describe_map()["shards"],
+                msg="map compile")
+            # pre-flip: every key routes to the sole owner
+            rep = await _query(router.listen_port,
+                              {"op": "insert",
+                               "value": {"key": "k90", "x": 1},
+                               "key": "k90"})
+            assert rep.get("served_by") == "a1", rep
+
+            # freeze the source range via the SAME CAS the resharder
+            # does; a write for a frozen range must park...
+            m, ver = await store.load()
+            plan = plan_split(m, "a", ("a", "b"), "k80", "/manatee/b")
+            ver = await store.cas(with_range_state(m, "a", FROZEN), ver)
+            await _wait_for(
+                lambda: router.describe_map()["epoch"] == 1,
+                msg="frozen epoch compile")
+            parked = asyncio.create_task(_query(
+                router.listen_port,
+                {"op": "insert", "value": {"key": "k90", "x": 2},
+                 "key": "k90"}, timeout=15.0))
+            await asyncio.sleep(0.3)
+            assert not parked.done()        # parked, not errored
+            # ...while reads keep flowing to the frozen owner
+            rd = await _query(router.listen_port,
+                              {"op": "select", "key": "k90"})
+            assert rd.get("served_by") == "a1", rd
+
+            # the flip: one CAS splits the range; the parked write
+            # must wake and land on the NEW owner — no restart
+            m, ver = await store.load()
+            await store.cas(apply_split(m, plan, state=SERVING), ver)
+            rep2 = await asyncio.wait_for(parked, 15.0)
+            assert rep2.get("served_by") == "b1", rep2
+            dm = router.describe_map()
+            assert dm["epoch"] == 2
+            assert set(dm["shards"]) == {"a", "b"}
+            # low half still routes to the source
+            low = await _query(router.listen_port,
+                               {"op": "insert",
+                                "value": {"key": "k10"},
+                                "key": "k10"})
+            assert low.get("served_by") == "a1", low
+            hi = await _query(router.listen_port,
+                              {"op": "select", "key": "k90"})
+            assert hi.get("served_by") == "b1", hi
+        finally:
+            await router.stop()
+            await up_a.stop()
+            await up_b.stop()
+            await coord.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_map_prober_follows_flip_without_restart(tmp_path):
+    """The prober reconciles a per-shard probe loop for the shard a
+    flip creates, and its keyed via-router loop keeps acking across
+    the cutover."""
+    async def go():
+        from manatee_tpu.daemons.prober import (
+            EngineCache,
+            ShardMapProber,
+        )
+        from manatee_tpu.daemons.router import ShardMapRouter
+        from manatee_tpu.obs.slo import SLOEngine, default_slos
+
+        server, coord, store, up_a, up_b = await _flip_world(tmp_path)
+
+        async def no_http(url, timeout=2.0):
+            return ""       # no lag/metrics scrapes in this world
+
+        router = ShardMapRouter({
+            "name": "map", "shardMapPath": store.map_path,
+            "listenHost": "127.0.0.1", "listenPort": 0,
+            "coordCfg": {"connStr": "127.0.0.1:%d" % server.port},
+            "parkTimeout": 10.0, "relayTimeout": 2.0},
+            http_get=no_http)
+        engines = EngineCache()
+        prober = ShardMapProber({
+            "name": "map", "shardMapPath": store.map_path,
+            "probeVia": None,   # set below once the router listens
+            "probeInterval": 0.05, "probeTimeout": 2.0,
+            "coordCfg": {"connStr": "127.0.0.1:%d" % server.port}},
+            engines, SLOEngine(default_slos()), http_get=no_http)
+        try:
+            await router.start(topology=True)
+            prober.via = "sim://127.0.0.1:%d" % router.listen_port
+            prober.start()
+            await _wait_for(lambda: "a" in prober._children,
+                            msg="prober child for the source")
+            await _wait_for(lambda: len(prober._acked_by_key) > 0,
+                            msg="first via-router ack")
+
+            m, ver = await store.load()
+            plan = plan_split(m, "a", ("a", "b"), "k80", "/manatee/b")
+            frozen = with_range_state(m, "a", FROZEN)
+            ver = await store.cas(frozen, ver)
+            m2, ver = await store.load()
+            await store.cas(apply_split(m2, plan, state=SERVING), ver)
+
+            # follow-the-split: a probe loop for the new shard
+            # appears without any restart...
+            await _wait_for(
+                lambda: set(prober._children) == {"a", "b"},
+                msg="prober child for the flipped-in target")
+            assert prober._epoch == 2
+            # ...and the keyed via loop keeps acking on BOTH sides of
+            # the cut (37 is coprime to 256: the cycle crosses k80)
+            seq_now = prober._wseq
+            await _wait_for(lambda: prober._wseq >= seq_now + 8,
+                            msg="via loop progress across the flip")
+            acked = {k: s for k, (s, _) in
+                     prober._acked_by_key.items()}
+            fresh = {k for k, s in acked.items() if s > seq_now}
+            assert any(k >= "k80" for k in fresh), acked
+            assert any(k < "k80" for k in fresh), acked
+        finally:
+            await prober.stop()
+            await router.stop()
+            await engines.aclose()
+            await up_a.stop()
+            await up_b.stop()
+            await coord.close()
+            await server.stop()
+    asyncio.run(go())

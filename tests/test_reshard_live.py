@@ -1,0 +1,279 @@
+"""The live resharding acceptance drill (docs/resharding.md).
+
+A real 3-peer source shard is split in half by the real
+`manatee-adm reshard` CLI while a keyed client — a ShardMapProber's
+via-router loop — writes through a real `manatee-router` child in
+shard-map mode.  The target shard is a real singleton sitter spawned
+AFTER the reshard begins: it parks on the boot hold (shard.py's
+`_wait_reshard_hold`) and only declares primary when the flip
+releases it, adopting the seeded dataset.
+
+Acceptance (ISSUE 20, and the reshard-drill CI job's contract):
+
+- the client-observed cutover window — the longest the keyed writer
+  goes without a fresh ack, parks included — fits the 5s budget;
+- zero acked-write loss: every via-loop write the prober saw acked is
+  readable on the shard the FINAL map routes its key to;
+- the shard map verifies doctor-clean (no DAMAGE, no orphan holds);
+- the map actually flipped: epoch advanced, both owners serving, the
+  durable step record parked at `done`.
+"""
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.harness import (
+    ClusterHarness,
+    alloc_port_block,
+    kill_fleet_sitter,
+    run_cli,
+    spawn_fleet_sitter,
+)
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 5.0
+SPLIT_KEY = "k80"
+
+
+async def _wait_for(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("timed out waiting for %s" % msg)
+
+
+def _target_cfg(root: Path, base_port: int) -> dict:
+    """The target shard's first sitter config — the SAME dict is the
+    CLI's --target-config file and the fleet sitter's shard entry, so
+    build_ident agrees between the orchestrator's flip wait and the
+    sitter that actually declares primary."""
+    return {
+        "name": "tgt",
+        "shardPath": "/manatee/tgt",
+        "ip": "127.0.0.1",
+        "postgresPort": base_port,
+        "backupPort": base_port + 2,
+        "zfsPort": base_port + 3,
+        "dataset": "manatee/pg",
+        "dataDir": str(root / "data"),
+        "storageBackend": "dir",
+        "storageRoot": str(root / "store"),
+        "pgEngine": "sim",
+    }
+
+
+def test_live_reshard_cutover_window_and_no_acked_loss(tmp_path):
+    async def go():
+        from manatee_tpu.daemons.prober import EngineCache, ShardMapProber
+        from manatee_tpu.doctor import check_shard_map
+        from manatee_tpu.obs.slo import SLOEngine, default_slos
+        from manatee_tpu.reshard.orchestrator import hold_path
+        from manatee_tpu.reshard.plan import (
+            DEFAULT_MAP_PATH,
+            SERVING,
+            ShardMapStore,
+            owner_of,
+        )
+        from manatee_tpu.storage import DirBackend
+
+        cluster = ClusterHarness(tmp_path / "src", n_peers=3)
+        engines = EngineCache()
+        prober = None
+        fleet_proc = None
+        sampler = None
+        try:
+            await cluster.start()
+            p1, p2, p3 = cluster.peers
+            await cluster.wait_topology(primary=p1, sync=p2,
+                                        asyncs=[p3], timeout=60)
+            await cluster.wait_writable(p1, "pre-reshard", timeout=60)
+
+            # the keyspace: the prober's own 256-key cycle, so the
+            # split at k80 leaves real rows on BOTH sides of the cut
+            for i in range(128):
+                key = ShardMapProber.probe_key(i)
+                rep = await p1.pg_query(
+                    {"op": "insert",
+                     "value": {"key": key, "fill": i}}, timeout=10.0)
+                assert rep.get("ok"), rep
+
+            # shard map bootstrap via the real CLI (SHARD=1 env)
+            res = run_cli(cluster, "shardmap", "init")
+            assert res.returncode == 0, res.stderr
+
+            # a real manatee-router child in shard-map mode (the
+            # shardMapPath override wins over the harness shardPath)
+            router = await cluster.start_router(
+                shardMapPath=DEFAULT_MAP_PATH, parkTimeout=60.0)
+
+            async def no_http(url, timeout=2.0):
+                return ""    # no metrics scrapes: the via loop is it
+
+            prober = ShardMapProber({
+                "name": "drill", "shardMapPath": DEFAULT_MAP_PATH,
+                "probeVia": router["url"],
+                "probeInterval": 0.05, "probeTimeout": 20.0,
+                "coordCfg": {"connStr": cluster.coord_connstr,
+                             "sessionTimeout": 30}},
+                engines, SLOEngine(default_slos()), http_get=no_http)
+            prober.start()
+            await _wait_for(lambda: len(prober._acked_by_key) > 0,
+                            msg="first keyed ack through the router")
+
+            # the client-observed window: longest stretch with no NEW
+            # ack (a parked write stalls the sequential via loop, so
+            # ack progress is exactly what a keyed client sees)
+            gap = {"hi": max(s for s, _ in
+                             prober._acked_by_key.values()),
+                   "last": time.monotonic(), "max": 0.0}
+
+            async def sample():
+                while True:
+                    hi = max((s for s, _ in
+                              prober._acked_by_key.values()),
+                             default=-1)
+                    now = time.monotonic()
+                    if hi > gap["hi"]:
+                        gap["hi"] = hi
+                        gap["max"] = max(gap["max"], now - gap["last"])
+                        gap["last"] = now
+                    await asyncio.sleep(0.02)
+
+            sampler = asyncio.create_task(sample())
+
+            # the target shard's world: parent dataset pre-created
+            # (the operator's delegated dataset), config shared with
+            # the CLI byte-for-byte
+            troot = tmp_path / "tgt"
+            troot.mkdir()
+            tcfg = _target_cfg(troot, alloc_port_block(4))
+            be = DirBackend(tcfg["storageRoot"])
+            if not await be.exists("manatee"):
+                await be.create("manatee")
+            tcfg_file = tmp_path / "target.json"
+            tcfg_file.write_text(json.dumps(tcfg, indent=2))
+
+            await asyncio.sleep(0.5)      # baseline ack cadence
+
+            cli = asyncio.create_task(asyncio.to_thread(
+                run_cli, cluster, "reshard",
+                "--into", "1,tgt", "--at", SPLIT_KEY,
+                "--target-config", str(tcfg_file),
+                "--router", router["status_url"],
+                "--freeze-grace", "0.2", "--cutover-budget",
+                str(BUDGET), "-y", timeout=240))
+
+            # the orchestrator ensures the boot hold before seeding;
+            # once it exists the target sitter can come up — it parks
+            # on the hold and must NOT touch the database until the
+            # flip releases it
+            coord = await cluster.coord_client()
+
+            async def hold_exists():
+                try:
+                    await coord.get(hold_path("/manatee/tgt"))
+                    return True
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    return False
+
+            deadline = time.monotonic() + 60
+            while not await hold_exists():
+                assert time.monotonic() < deadline, \
+                    "reshard never created the target boot hold"
+                assert not cli.done(), (cli.result().stdout,
+                                        cli.result().stderr)
+                await asyncio.sleep(0.1)
+
+            fleet_proc = await asyncio.to_thread(
+                spawn_fleet_sitter,
+                {"ip": "127.0.0.1", "dataset": "manatee/pg",
+                 "storageBackend": "dir", "pgEngine": "sim",
+                 "oneNodeWriteMode": True,
+                 "statusPort": alloc_port_block(1),
+                 "healthChkInterval": 0.5,
+                 "coordCfg": {"connStr": cluster.coord_connstr,
+                              "sessionTimeout": 30},
+                 "shards": [tcfg]},
+                troot)
+
+            res = await asyncio.wait_for(cli, 240)
+            assert res.returncode == 0, (res.stdout, res.stderr)
+            assert "done (" in res.stdout, res.stdout
+
+            # post-flip: let the via loop cycle across both halves so
+            # the window measurement includes the full recovery
+            seq_now = prober._wseq
+            await _wait_for(lambda: prober._wseq >= seq_now + 12,
+                            timeout=60,
+                            msg="via loop progress after the flip")
+            sampler.cancel()
+            await asyncio.gather(sampler, return_exceptions=True)
+            sampler = None
+
+            # -- acceptance 1: the prober-measured cutover window --
+            assert gap["max"] <= BUDGET, \
+                "client-observed window %.3fs blew the %.1fs budget" \
+                % (gap["max"], BUDGET)
+            assert not prober.describe_map()["error_window_open"]
+
+            # -- acceptance 2: the map flipped, doctor-clean --
+            store = ShardMapStore(coord)
+            m, _ver = await store.load()
+            assert m["epoch"] >= 2, m
+            owners = {r["shard"]: r for r in m["ranges"]}
+            assert set(owners) == {"1", "tgt"}, m
+            assert all(r["state"] == SERVING
+                       for r in m["ranges"]), m
+            rec, _rv = await store.load_record()
+            assert rec is not None and rec["step"] == "done", rec
+            findings = check_shard_map(m, rec, holds=[])
+            damage = [f for f in findings
+                      if f.get("severity") == "damage"]
+            assert not damage, findings
+            dm = prober.describe_map()
+            assert set(dm["shards"]) == {"1", "tgt"}, dm
+
+            # -- acceptance 3: zero acked-write loss --
+            # every write the client saw acked must be readable on the
+            # shard the FINAL map routes its key to
+            acked = dict(prober._acked_by_key)
+            assert any(k >= SPLIT_KEY for k in acked), acked
+            assert any(k < SPLIT_KEY for k in acked), acked
+            src_rows = (await p1.pg_query(
+                {"op": "select"}, timeout=10.0)).get("rows") or []
+            tgt_rows = (await engines.query(
+                "sim://%s:%d" % (tcfg["ip"], tcfg["postgresPort"]),
+                {"op": "select"}, 10.0)).get("rows") or []
+            by_shard = {"1": src_rows, "tgt": tgt_rows}
+            lost = []
+            for key, (seq, _ts) in acked.items():
+                owner = owner_of(m, key)["shard"]
+                hit = any(isinstance(r, dict)
+                          and r.get("probe") == "drill"
+                          and r.get("key") == key
+                          and int(r.get("seq") or 0) >= seq
+                          for r in by_shard[owner])
+                if not hit:
+                    lost.append((key, seq, owner))
+            assert not lost, "acked writes missing on their owner: " \
+                "%r" % lost
+        finally:
+            if sampler is not None:
+                sampler.cancel()
+                await asyncio.gather(sampler, return_exceptions=True)
+            if prober is not None:
+                await prober.stop()
+            await engines.aclose()
+            if fleet_proc is not None:
+                await asyncio.to_thread(kill_fleet_sitter, fleet_proc)
+            await cluster.stop()
+    asyncio.run(go())

@@ -1,0 +1,258 @@
+"""Split-plan math + shard-map record tier (manatee_tpu/reshard/plan.py):
+the partition invariants validate_map enforces, plan_split's rejection
+matrix, the pure apply_split/with_range_state transforms, split-key
+selection, and ShardMapStore CAS conflicts over a real CoordServer —
+the seam that makes "exactly one authoritative owner per key" hold
+when two writers race.
+"""
+
+import asyncio
+
+import pytest
+
+from manatee_tpu.reshard.plan import (
+    FROZEN,
+    KEY_MAX,
+    KEY_MIN,
+    SERVING,
+    ShardMapError,
+    ShardMapStore,
+    SplitPlan,
+    apply_split,
+    bootstrap_map,
+    choose_split_key,
+    in_range,
+    key_lt,
+    owner_of,
+    plan_split,
+    range_for_shard,
+    validate_map,
+    with_range_state,
+)
+
+
+def _map(*ranges, epoch=0):
+    return {"fmt": 1, "epoch": epoch, "ranges": list(ranges)}
+
+
+def _rng(lo, hi, shard, state=SERVING):
+    return {"lo": lo, "hi": hi, "shard": shard,
+            "shardPath": "/manatee/" + shard, "state": state}
+
+
+# ---- range primitives ----
+
+def test_key_ordering_and_membership():
+    assert key_lt("a", "b")
+    assert key_lt("a", None)        # None is +inf
+    assert not key_lt("b", "a")
+    r = _rng("k40", "k80", "a")
+    assert in_range(r, "k40")       # lo inclusive
+    assert in_range(r, "k7f")
+    assert not in_range(r, "k80")   # hi exclusive
+    assert not in_range(r, "k3f")
+    last = _rng("k80", KEY_MAX, "b")
+    assert in_range(last, "zzzz")   # open top
+
+
+def test_owner_of_and_range_for_shard():
+    m = _map(_rng(KEY_MIN, "k80", "a"), _rng("k80", KEY_MAX, "b"))
+    validate_map(m)
+    assert owner_of(m, "")["shard"] == "a"
+    assert owner_of(m, "k7f")["shard"] == "a"
+    assert owner_of(m, "k80")["shard"] == "b"
+    assert range_for_shard(m, "b")["lo"] == "k80"
+    with pytest.raises(ShardMapError):
+        range_for_shard(m, "nope")
+
+
+# ---- the partition invariant ----
+
+def test_validate_accepts_bootstrap_and_splits():
+    validate_map(bootstrap_map("1", "/manatee/1"))
+    validate_map(_map(_rng(KEY_MIN, "k40", "a"),
+                      _rng("k40", "k80", "b"),
+                      _rng("k80", KEY_MAX, "c", state=FROZEN)))
+
+
+@pytest.mark.parametrize("bad", [
+    "not-a-map",
+    {"fmt": 2, "epoch": 0, "ranges": [_rng(KEY_MIN, KEY_MAX, "a")]},
+    _map(),                                       # no ranges
+    # gap: a's hi k40 != b's lo k50
+    _map(_rng(KEY_MIN, "k40", "a"), _rng("k50", KEY_MAX, "b")),
+    # overlap: a's hi k60 != b's lo k40
+    _map(_rng(KEY_MIN, "k60", "a"), _rng("k40", KEY_MAX, "b")),
+    # empty range: [k40, k40)
+    _map(_rng(KEY_MIN, "k40", "a"), _rng("k40", "k40", "b"),
+         _rng("k40", KEY_MAX, "c")),
+    # one shard owning two ranges
+    _map(_rng(KEY_MIN, "k40", "a"), _rng("k40", KEY_MAX, "a")),
+    # first lo not the minimum key
+    _map(_rng("k10", KEY_MAX, "a")),
+    # last hi not +inf
+    _map(_rng(KEY_MIN, "k80", "a")),
+    # interior hi of None (a hole to +inf mid-map)
+    _map(_rng(KEY_MIN, None, "a"), _rng("k80", KEY_MAX, "b")),
+    # unknown state
+    _map(_rng(KEY_MIN, KEY_MAX, "a", state="draining")),
+], ids=["not-dict", "bad-fmt", "no-ranges", "gap", "overlap",
+        "empty-range", "dup-owner", "bad-first-lo", "bad-last-hi",
+        "interior-inf", "bad-state"])
+def test_validate_rejects_non_partitions(bad):
+    with pytest.raises(ShardMapError):
+        validate_map(bad)
+
+
+# ---- plan_split's rejection matrix ----
+
+def test_plan_split_happy_path_and_roundtrip():
+    m = bootstrap_map("1", "/manatee/1")
+    plan = plan_split(m, "1", ("1", "2"), "k80", "/manatee/2")
+    assert (plan.source, plan.target) == ("1", "2")
+    assert plan.split_key == "k80"
+    assert plan.source_range["lo"] == KEY_MIN
+    # order of --into doesn't matter: the non-source name is target
+    plan2 = plan_split(m, "1", ("2", "1"), "k80", "/manatee/2")
+    assert plan2.target == "2"
+    assert SplitPlan.from_dict(plan.to_dict()) == plan
+
+
+def test_plan_split_rejections():
+    m = bootstrap_map("1", "/manatee/1")
+    with pytest.raises(ShardMapError, match="same shard twice"):
+        plan_split(m, "1", ("1", "1"), "k80", "/manatee/1")
+    with pytest.raises(ShardMapError, match="must be the source"):
+        plan_split(m, "1", ("2", "3"), "k80", "/manatee/2")
+    # split key not strictly inside: at lo, the low half is empty
+    with pytest.raises(ShardMapError, match="strictly inside"):
+        plan_split(m, "1", ("1", "2"), KEY_MIN, "/manatee/2")
+    # target already owns a range
+    split = _map(_rng(KEY_MIN, "k80", "1"), _rng("k80", KEY_MAX, "2"))
+    with pytest.raises(ShardMapError, match="already owns"):
+        plan_split(split, "1", ("1", "2"), "k40", "/manatee/2")
+    # key outside the (now bounded) source range
+    with pytest.raises(ShardMapError, match="strictly inside"):
+        plan_split(split, "1", ("1", "3"), "k90", "/manatee/3")
+    # a cutover already in flight freezes planning
+    frozen = _map(_rng(KEY_MIN, KEY_MAX, "1", state=FROZEN))
+    with pytest.raises(ShardMapError, match="in flight"):
+        plan_split(frozen, "1", ("1", "2"), "k80", "/manatee/2")
+
+
+# ---- the pure transforms ----
+
+def test_apply_split_partitions_and_bumps_epoch():
+    m = bootstrap_map("1", "/manatee/1")
+    plan = plan_split(m, "1", ("1", "2"), "k80", "/manatee/2")
+    out = apply_split(m, plan, state=SERVING)
+    validate_map(out)
+    assert out["epoch"] == m["epoch"] + 1
+    assert [r["shard"] for r in out["ranges"]] == ["1", "2"]
+    assert owner_of(out, "k7f")["shard"] == "1"
+    assert owner_of(out, "k80")["shard"] == "2"
+    # source map untouched (pure transform)
+    assert len(m["ranges"]) == 1
+
+
+def test_apply_split_refuses_moved_goalposts():
+    m = bootstrap_map("1", "/manatee/1")
+    plan = plan_split(m, "1", ("1", "2"), "k80", "/manatee/2")
+    # the map changed underneath: source range shrank past the key
+    shrunk = _map(_rng(KEY_MIN, "k40", "1"), _rng("k40", KEY_MAX, "3"),
+                  epoch=3)
+    with pytest.raises(ShardMapError, match="no longer inside"):
+        apply_split(shrunk, plan, state=SERVING)
+
+
+def test_with_range_state_round_trips():
+    m = _map(_rng(KEY_MIN, "k80", "a"), _rng("k80", KEY_MAX, "b"))
+    frozen = with_range_state(m, "a", FROZEN)
+    assert frozen["epoch"] == 1
+    assert range_for_shard(frozen, "a")["state"] == FROZEN
+    assert range_for_shard(frozen, "b")["state"] == SERVING
+    back = with_range_state(frozen, "a", SERVING)
+    assert range_for_shard(back, "a")["state"] == SERVING
+    assert back["epoch"] == 2
+
+
+def test_choose_split_key_median_excludes_lo():
+    rng = _rng("k10", "k90", "a")
+    # k10 == lo is excluded (it would make the low half empty);
+    # out-of-range and non-string samples ignored; dupes collapse
+    keys = ["k10", "k20", "k20", "k40", "k60", "k95", None, 7]
+    assert choose_split_key(keys, rng) == "k40"
+    with pytest.raises(ShardMapError, match="pass --at"):
+        choose_split_key(["k10", "k95"], rng)
+
+
+# ---- ShardMapStore over a real coordination server ----
+
+def _store_world(tmp_path):
+    """(server, coord, store) against a throwaway CoordServer."""
+    from manatee_tpu.coord.client import NetCoord
+    from manatee_tpu.coord.server import CoordServer
+
+    async def go():
+        server = CoordServer(port=0, tick=0.05,
+                             data_dir=str(tmp_path / "coord"))
+        await server.start()
+        coord = NetCoord("127.0.0.1", server.port, session_timeout=20)
+        await coord.connect()
+        return server, coord, ShardMapStore(coord)
+    return go
+
+
+def test_store_init_load_cas_conflict(tmp_path):
+    async def go():
+        server, coord, store = await _store_world(tmp_path)()
+        try:
+            with pytest.raises(ShardMapError, match="shardmap init"):
+                await store.load()
+            await store.init("1", "/manatee/1")
+            with pytest.raises(ShardMapError, match="already exists"):
+                await store.init("1", "/manatee/1")
+            m, ver = await store.load()
+            assert m["epoch"] == 0 and len(m["ranges"]) == 1
+
+            # two writers race: the second CAS at the stale version
+            # must lose — this IS the one-authoritative-map invariant
+            plan = plan_split(m, "1", ("1", "2"), "k80", "/manatee/2")
+            ver2 = await store.cas(
+                apply_split(m, plan, state=FROZEN), ver)
+            assert ver2 != ver
+            with pytest.raises(ShardMapError, match="stale"):
+                await store.cas(with_range_state(m, "1", FROZEN), ver)
+            m2, _ = await store.load()
+            assert owner_of(m2, "k80")["shard"] == "2"
+        finally:
+            await coord.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_store_record_create_update_conflict(tmp_path):
+    async def go():
+        server, coord, store = await _store_world(tmp_path)()
+        try:
+            rec, ver = await store.load_record()
+            assert rec is None and ver == -1
+            ver = await store.write_record({"step": "plan"}, ver)
+            # a second orchestrator trying a fresh create loses
+            with pytest.raises(ShardMapError, match="resume "):
+                await store.write_record({"step": "plan"}, -1)
+            rec, ver2 = await store.load_record()
+            assert rec["step"] == "plan"
+            ver3 = await store.write_record({"step": "seed"}, ver2)
+            # ...and a stale-version update loses too
+            with pytest.raises(ShardMapError, match="two resharders"):
+                await store.write_record({"step": "seed"}, ver2)
+            assert ver3 != ver2
+            await store.delete_record()
+            rec, ver = await store.load_record()
+            assert rec is None and ver == -1
+            await store.delete_record()     # idempotent
+        finally:
+            await coord.close()
+            await server.stop()
+    asyncio.run(go())
